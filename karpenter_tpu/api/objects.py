@@ -1,0 +1,258 @@
+"""Core object model: the k8s objects the control loop consumes/produces.
+
+This is a deliberately small, hermetic re-expression of the object surface the
+reference interacts with through the kube API (SURVEY.md §1: "Kubernetes API
+server is the message bus"). Objects are plain dataclasses stored in the
+in-process API store (`karpenter_tpu.controllers.store`) with watch semantics,
+so the whole control loop closes without a cluster — the same trick the
+reference's kwok provider uses (kwok/ec2/ec2.go:374-628 creates Node objects
+directly).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from ..scheduling.requirements import IN, NOT_IN, EXISTS, Requirement, Requirements
+from ..utils.resources import Resources
+from . import wellknown as wk
+
+_uid_counter = itertools.count(1)
+
+
+def new_uid(prefix: str = "uid") -> str:
+    return f"{prefix}-{next(_uid_counter)}"
+
+
+@dataclass
+class ObjectMeta:
+    name: str
+    namespace: str = "default"
+    uid: str = field(default_factory=lambda: new_uid())
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    finalizers: List[str] = field(default_factory=list)
+    owner_refs: List[str] = field(default_factory=list)  # uids
+    creation_timestamp: float = field(default_factory=time.monotonic)
+    deletion_timestamp: Optional[float] = None
+    resource_version: int = 0
+
+    @property
+    def deleting(self) -> bool:
+        return self.deletion_timestamp is not None
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    effect: str
+    value: str = ""
+
+    def as_tuple(self) -> Tuple[str, str, str]:
+        return (self.key, self.value, self.effect)
+
+
+@dataclass(frozen=True)
+class Toleration:
+    key: str = ""  # empty key + Exists tolerates everything
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # empty matches all effects
+
+    def tolerates(self, taint: Taint) -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.operator == EXISTS or self.operator == "Exists":
+            return not self.key or self.key == taint.key
+        return self.key == taint.key and self.value == taint.value
+
+
+def tolerates_all(tolerations: Sequence[Toleration], taints: Sequence[Taint]) -> bool:
+    """Pod schedulability gate: every NoSchedule/NoExecute taint must be
+    tolerated (PreferNoSchedule is advisory and ignored, matching
+    kube-scheduler semantics the reference simulates)."""
+    for t in taints:
+        if t.effect == wk.EFFECT_PREFER_NO_SCHEDULE:
+            continue
+        if not any(tol.tolerates(t) for tol in tolerations):
+            return False
+    return True
+
+
+@dataclass
+class TopologySpreadConstraint:
+    max_skew: int
+    topology_key: str
+    when_unsatisfiable: str = "DoNotSchedule"  # or ScheduleAnyway
+    label_selector: Dict[str, str] = field(default_factory=dict)
+    min_domains: Optional[int] = None
+
+
+@dataclass
+class PodAffinityTerm:
+    label_selector: Dict[str, str]
+    topology_key: str
+    anti: bool = False
+    # weight != None => preferred (soft); reference treats preferred terms via
+    # relaxation (website/.../scheduling.md:212-219)
+    weight: Optional[int] = None
+
+
+@dataclass
+class Pod:
+    meta: ObjectMeta
+    requests: Resources = field(default_factory=Resources)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    # requiredDuringScheduling node affinity: list of OR'd term-groups, each a
+    # Requirements conjunction.
+    node_affinity: List[Requirements] = field(default_factory=list)
+    preferred_node_affinity: List[Tuple[int, Requirements]] = field(default_factory=list)
+    tolerations: List[Toleration] = field(default_factory=list)
+    topology_spread: List[TopologySpreadConstraint] = field(default_factory=list)
+    affinity_terms: List[PodAffinityTerm] = field(default_factory=list)
+    node_name: Optional[str] = None  # binding
+    phase: str = "Pending"
+    priority: int = 0
+    scheduling_gated: bool = False
+    owner_kind: str = ""  # "DaemonSet" pods get special handling
+
+    def scheduling_requirements(self) -> Requirements:
+        """nodeSelector + ALL required node-affinity terms folded into one
+        conjunction. NOTE: OR'd terms folded this way over-constrain; the
+        scheduler handles alternatives properly via
+        `Scheduler._pod_requirement_alternatives`. This fold is only used
+        where a single conservative conjunction is acceptable (daemonset
+        matching)."""
+        reqs = Requirements.from_labels(self.node_selector)
+        for term in self.node_affinity:
+            reqs = reqs.union(term)
+        return reqs
+
+    @property
+    def bound(self) -> bool:
+        return self.node_name is not None
+
+
+@dataclass
+class Node:
+    meta: ObjectMeta
+    capacity: Resources = field(default_factory=Resources)
+    allocatable: Resources = field(default_factory=Resources)
+    taints: List[Taint] = field(default_factory=list)
+    ready: bool = False
+    provider_id: str = ""
+    unschedulable: bool = False
+
+    @property
+    def name(self) -> str:
+        return self.meta.name
+
+    def labels(self) -> Dict[str, str]:
+        return self.meta.labels
+
+
+@dataclass
+class PodDisruptionBudget:
+    meta: ObjectMeta
+    selector: Dict[str, str] = field(default_factory=dict)
+    min_available: Optional[int] = None
+    max_unavailable: Optional[int] = None
+
+    def matches(self, pod: Pod) -> bool:
+        return all(pod.meta.labels.get(k) == v for k, v in self.selector.items())
+
+
+# ---------------------------------------------------------------------------
+# karpenter.sh API types
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Budget:
+    """Disruption budget (website/.../disruption.md:274-330): `nodes` is a
+    count or percentage; optional cron schedule+duration; optional reasons."""
+
+    nodes: str = "10%"
+    schedule: Optional[str] = None
+    duration_s: Optional[float] = None
+    reasons: Optional[List[str]] = None  # None => all reasons
+
+
+@dataclass
+class Disruption:
+    consolidation_policy: str = "WhenEmptyOrUnderutilized"  # or WhenEmpty
+    consolidate_after_s: float = 0.0
+    budgets: List[Budget] = field(default_factory=lambda: [Budget()])
+
+
+@dataclass
+class NodeClaimTemplate:
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    taints: List[Taint] = field(default_factory=list)
+    startup_taints: List[Taint] = field(default_factory=list)
+    requirements: Requirements = field(default_factory=Requirements)
+    node_class_ref: str = "default"
+    expire_after_s: Optional[float] = None
+    termination_grace_period_s: Optional[float] = None
+
+
+@dataclass
+class NodePool:
+    """NodePool spec per website/.../nodepools.md:33-165,268-330,363-413."""
+
+    meta: ObjectMeta
+    template: NodeClaimTemplate = field(default_factory=NodeClaimTemplate)
+    disruption: Disruption = field(default_factory=Disruption)
+    limits: Resources = field(default_factory=Resources)
+    weight: int = 0
+
+    @property
+    def name(self) -> str:
+        return self.meta.name
+
+    def scheduling_requirements(self) -> Requirements:
+        """Template labels + requirements + the implied nodepool label."""
+        reqs = Requirements.from_labels(self.template.labels)
+        reqs = reqs.union(self.template.requirements)
+        reqs.add(Requirement.create(wk.NODEPOOL_LABEL, IN, [self.name]))
+        return reqs
+
+
+@dataclass
+class NodeClaim:
+    """The node-intent object: created by the provisioner, fulfilled by the
+    cloud provider, tracked through registration/initialization
+    (website/.../concepts/nodeclaims.md)."""
+
+    meta: ObjectMeta
+    nodepool: str = ""
+    node_class_ref: str = "default"
+    requirements: Requirements = field(default_factory=Requirements)
+    resource_requests: Resources = field(default_factory=Resources)  # scheduled pod sum
+    taints: List[Taint] = field(default_factory=list)
+    startup_taints: List[Taint] = field(default_factory=list)
+    expire_after_s: Optional[float] = None
+    termination_grace_period_s: Optional[float] = None
+
+    # status
+    provider_id: str = ""
+    instance_type: str = ""
+    zone: str = ""
+    capacity_type: str = ""
+    price: float = 0.0
+    capacity: Resources = field(default_factory=Resources)
+    allocatable: Resources = field(default_factory=Resources)
+    node_name: Optional[str] = None
+    launched: bool = False
+    registered: bool = False
+    initialized: bool = False
+    drifted: Optional[str] = None  # drift reason
+    last_transition: float = field(default_factory=time.monotonic)
+
+    @property
+    def name(self) -> str:
+        return self.meta.name

@@ -1,0 +1,50 @@
+"""Well-known label/annotation/taint vocabulary.
+
+Mirrors the karpenter.sh domain vocabulary consumed throughout the reference
+(kwok/ec2/ec2.go:44,890; website/content/en/preview/concepts/nodepools.md,
+scheduling.md:383-387) — the three topology keys the scheduler supports, the
+capacity-type domain, and the control-flow taints/annotations.
+"""
+
+GROUP = "karpenter.sh"
+
+# Labels
+NODEPOOL_LABEL = "karpenter.sh/nodepool"
+CAPACITY_TYPE_LABEL = "karpenter.sh/capacity-type"
+INSTANCE_TYPE_LABEL = "node.kubernetes.io/instance-type"
+ZONE_LABEL = "topology.kubernetes.io/zone"
+REGION_LABEL = "topology.kubernetes.io/region"
+HOSTNAME_LABEL = "kubernetes.io/hostname"
+ARCH_LABEL = "kubernetes.io/arch"
+OS_LABEL = "kubernetes.io/os"
+INITIALIZED_LABEL = "karpenter.sh/initialized"
+REGISTERED_LABEL = "karpenter.sh/registered"
+NODECLASS_LABEL = "karpenter.tpu/nodeclass"
+
+# The exactly-three topology keys supported for topology spread
+# (website/.../scheduling.md:383-387).
+TOPOLOGY_KEYS = (ZONE_LABEL, HOSTNAME_LABEL, CAPACITY_TYPE_LABEL)
+
+# Capacity types
+CAPACITY_TYPE_ON_DEMAND = "on-demand"
+CAPACITY_TYPE_SPOT = "spot"
+CAPACITY_TYPE_RESERVED = "reserved"
+
+# Annotations
+DO_NOT_DISRUPT_ANNOTATION = "karpenter.sh/do-not-disrupt"
+NODEPOOL_HASH_ANNOTATION = "karpenter.sh/nodepool-hash"
+NODEPOOL_HASH_VERSION_ANNOTATION = "karpenter.sh/nodepool-hash-version"
+NODECLASS_HASH_ANNOTATION = "karpenter.tpu/nodeclass-hash"
+
+# Taints (key, effect)
+UNREGISTERED_TAINT_KEY = "karpenter.sh/unregistered"
+DISRUPTED_TAINT_KEY = "karpenter.sh/disrupted"
+EFFECT_NO_SCHEDULE = "NoSchedule"
+EFFECT_PREFER_NO_SCHEDULE = "PreferNoSchedule"
+EFFECT_NO_EXECUTE = "NoExecute"
+
+# Restricted label domains a NodePool may not set directly.
+RESTRICTED_LABELS = frozenset({NODEPOOL_LABEL, HOSTNAME_LABEL})
+
+# Finalizers
+TERMINATION_FINALIZER = "karpenter.sh/termination"

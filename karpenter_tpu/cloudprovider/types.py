@@ -1,0 +1,185 @@
+"""CloudProvider contract: InstanceType / Offering model + typed errors.
+
+Behavioral mirror of karpenter core `pkg/cloudprovider` as implemented by the
+reference at pkg/cloudprovider/cloudprovider.go:56-305 (SURVEY.md §2.1/§2.3):
+
+  InstanceType{Name, Requirements, Offerings, Capacity, Overhead}
+  Offering{Requirements, Price, Available, ReservationCapacity}
+  typed errors: InsufficientCapacityError, NodeClaimNotFoundError,
+                CreateError, NodeClassNotReadyError
+  InstanceTypes.Truncate (pkg/providers/instance/instance.go:260)
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from ..api import wellknown as wk
+from ..api.objects import NodeClaim, Taint
+from ..scheduling.requirements import IN, Requirement, Requirements
+from ..utils import resources as res
+from ..utils.resources import Resources
+
+
+@dataclass
+class Offering:
+    """One (instance-type, zone, capacity-type) purchasable unit."""
+
+    zone: str
+    capacity_type: str  # on-demand | spot | reserved
+    price: float
+    available: bool = True
+    reservation_capacity: int = 0  # for capacity_type == reserved
+    reservation_id: str = ""
+
+    def requirements(self) -> Requirements:
+        return Requirements.of(
+            Requirement.create(wk.ZONE_LABEL, IN, [self.zone]),
+            Requirement.create(wk.CAPACITY_TYPE_LABEL, IN, [self.capacity_type]),
+        )
+
+
+@dataclass
+class InstanceType:
+    name: str
+    # The label universe this type offers (arch, os, zone set, capacity types,
+    # cpu, memory-mib, family, size, ... ~25 keys in the reference,
+    # pkg/providers/instancetype/types.go:158-284).
+    requirements: Requirements
+    capacity: Resources
+    overhead: Resources  # kube-reserved + system-reserved + eviction threshold
+    offerings: List[Offering] = field(default_factory=list)
+
+    def allocatable(self) -> Resources:
+        out = self.capacity.sub(self.overhead)
+        return Resources({k: max(0, v) for k, v in out.items()})
+
+    def cheapest_available(self, reqs: Optional[Requirements] = None) -> Optional[Offering]:
+        best = None
+        for o in self.offerings:
+            if not o.available:
+                continue
+            if reqs is not None and not reqs.compatible(o.requirements()):
+                continue
+            if best is None or o.price < best.price:
+                best = o
+        return best
+
+    def available(self, reqs: Optional[Requirements] = None) -> bool:
+        return self.cheapest_available(reqs) is not None
+
+
+def truncate(
+    instance_types: Sequence[InstanceType],
+    reqs: Requirements,
+    max_items: int = 60,
+) -> List[InstanceType]:
+    """Order by cheapest compatible offering price ascending and keep the first
+    `max_items` — the launch-path truncation at
+    pkg/providers/instance/instance.go:60,260.
+
+    Raises ValueError if truncation would violate a minValues floor, matching
+    the reference's minValues enforcement during truncation.
+    """
+    def key(it: InstanceType) -> float:
+        o = it.cheapest_available(reqs)
+        return o.price if o else float("inf")
+
+    ordered = sorted(instance_types, key=lambda it: (key(it), it.name))
+    kept = ordered[:max_items]
+    if reqs.has_min_values():
+        _check_min_values(kept, reqs)
+    return kept
+
+
+def _check_min_values(instance_types: Sequence[InstanceType], reqs: Requirements) -> None:
+    for k, r in reqs.items():
+        if not r.min_values:
+            continue
+        domain = set()
+        for it in instance_types:
+            itr = it.requirements.get(k)
+            if itr is not None and not itr.complement:
+                domain |= set(itr.values_list())
+        if len(domain) < r.min_values:
+            raise ValueError(
+                f"minValues violation: key {k} has {len(domain)} values, needs {r.min_values}"
+            )
+
+
+# ---------------------------------------------------------------------------
+# Typed errors (cloudprovider.go:96,104,107)
+# ---------------------------------------------------------------------------
+
+
+class CloudProviderError(Exception):
+    pass
+
+
+class InsufficientCapacityError(CloudProviderError):
+    """All attempted offerings were unavailable (ICE)."""
+
+    def __init__(self, message: str, offerings: Sequence[tuple] = ()):  # (instance_type, zone, capacity_type)
+        super().__init__(message)
+        self.offerings = list(offerings)
+
+
+class NodeClaimNotFoundError(CloudProviderError):
+    pass
+
+
+class NodeClassNotReadyError(CloudProviderError):
+    pass
+
+
+class CreateError(CloudProviderError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Provider interface (cloudprovider.go:56-305)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class RepairPolicy:
+    """Node condition + toleration duration after which the node is replaced
+    (cloudprovider.go:264-305)."""
+
+    condition_type: str
+    condition_status: str
+    toleration_duration_s: float
+
+
+class CloudProvider(abc.ABC):
+    @abc.abstractmethod
+    def create(self, node_claim: NodeClaim) -> NodeClaim:
+        """Launch capacity for the claim; returns the claim with status
+        (provider_id, instance_type, zone, capacity_type, capacity) filled."""
+
+    @abc.abstractmethod
+    def delete(self, node_claim: NodeClaim) -> None:
+        ...
+
+    @abc.abstractmethod
+    def get(self, provider_id: str) -> NodeClaim:
+        ...
+
+    @abc.abstractmethod
+    def list(self) -> List[NodeClaim]:
+        ...
+
+    @abc.abstractmethod
+    def get_instance_types(self, nodepool_name: str) -> List[InstanceType]:
+        ...
+
+    def is_drifted(self, node_claim: NodeClaim) -> Optional[str]:
+        return None
+
+    def repair_policies(self) -> List[RepairPolicy]:
+        return [
+            RepairPolicy("Ready", "False", 30 * 60),
+            RepairPolicy("Ready", "Unknown", 30 * 60),
+        ]

@@ -1,0 +1,631 @@
+"""Reference scheduler: exact, sequential implementation of solver/SPEC.md.
+
+This is the ground-truth `Solver` — the behavioral mirror of karpenter core's
+`provisioning/scheduling.Scheduler.Solve` (designs/bin-packing.md:17-43;
+website/.../concepts/scheduling.md; SURVEY.md §2.1). The TPU tensor solver in
+`karpenter_tpu/solver/tpu/` must produce bit-identical decisions; the
+differential tests enforce it.
+
+Everything here is integer-exact and deterministic per SPEC.md.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from ..api import wellknown as wk
+from ..api.objects import Pod, Taint, Toleration, TopologySpreadConstraint, tolerates_all
+from ..cloudprovider.types import InstanceType
+from ..scheduling.requirements import IN, Requirement, Requirements
+from ..utils.resources import PODS, Resources
+
+
+# ---------------------------------------------------------------------------
+# Inputs / outputs
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ExistingNode:
+    """A schedulable existing node or in-flight NodeClaim."""
+
+    id: str
+    labels: Dict[str, str]
+    taints: List[Taint]
+    free: Resources  # allocatable minus bound pods/daemonsets
+    pod_labels: List[Dict[str, str]] = field(default_factory=list)  # bound pods (for topo/affinity)
+    schedulable: bool = True
+
+
+@dataclass
+class NodePoolSpec:
+    name: str
+    weight: int
+    requirements: Requirements  # template labels+requirements (+nodepool label)
+    taints: List[Taint]
+    instance_types: List[InstanceType]
+    limits: Resources = field(default_factory=Resources)
+    usage: Resources = field(default_factory=Resources)  # current aggregate
+
+
+@dataclass
+class SolverInput:
+    pods: List[Pod]
+    nodes: List[ExistingNode]
+    nodepools: List[NodePoolSpec]
+    daemonset_pods: List[Pod] = field(default_factory=list)
+    zones: Tuple[str, ...] = ()  # zone universe (for topology domains)
+    capacity_types: Tuple[str, ...] = (wk.CAPACITY_TYPE_ON_DEMAND, wk.CAPACITY_TYPE_SPOT)
+
+
+@dataclass
+class ClaimResult:
+    nodepool: str
+    requirements: Requirements
+    instance_type_names: List[str]
+    pod_uids: List[str]
+    requests: Resources
+    taints: List[Taint]
+    hostname: str
+
+
+@dataclass
+class SolverResult:
+    placements: Dict[str, Tuple[str, object]]  # pod uid -> ("node", id) | ("claim", idx)
+    claims: List[ClaimResult]
+    errors: Dict[str, str]
+
+
+# ---------------------------------------------------------------------------
+# FFD order (SPEC.md "Pod order")
+# ---------------------------------------------------------------------------
+
+
+def ffd_key(pod: Pod):
+    return (-pod.requests.get_("cpu"), -pod.requests.get_("memory"), pod.meta.uid)
+
+
+# ---------------------------------------------------------------------------
+# Topology / affinity state (SPEC.md "Topology spread", "Inter-pod affinity")
+# ---------------------------------------------------------------------------
+
+
+def _sel_sig(selector: Mapping[str, str]) -> tuple:
+    return tuple(sorted(selector.items()))
+
+
+def _matches(selector: Mapping[str, str], labels: Mapping[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class TopologyState:
+    def __init__(self, inp: SolverInput):
+        self._zones = tuple(inp.zones)
+        self._capacity_types = tuple(inp.capacity_types)
+        self._hostnames: List[str] = [n.id for n in inp.nodes]
+        # spread counts: (key, sel_sig, max_skew) -> {domain: count}
+        self._spread: Dict[tuple, Dict[str, int]] = {}
+        # matching-pod counts per (sel_sig, topo_key) -> {domain: count}
+        self._match: Dict[tuple, Dict[str, int]] = {}
+        # anti-affinity terms owned by placed pods: (sel_sig, key) -> set(domain)
+        self._anti: Dict[tuple, set] = {}
+        self._existing = inp.nodes
+        # pods placed THIS solve: (labels, key->domain) — lazily-materialized
+        # groups must see them (they are invisible in node.pod_labels when the
+        # pod landed on a virtual claim).
+        self._placed: List[Tuple[Dict[str, str], Dict[str, str]]] = []
+
+    # -- universes ----------------------------------------------------------
+
+    def universe(self, key: str) -> List[str]:
+        if key == wk.ZONE_LABEL:
+            return list(self._zones)
+        if key == wk.CAPACITY_TYPE_LABEL:
+            return list(self._capacity_types)
+        if key == wk.HOSTNAME_LABEL:
+            return list(self._hostnames)
+        return []
+
+    def add_hostname(self, h: str) -> None:
+        self._hostnames.append(h)
+
+    # -- spread groups ------------------------------------------------------
+
+    def _group(self, tsc: TopologySpreadConstraint) -> Dict[str, int]:
+        sig = (tsc.topology_key, _sel_sig(tsc.label_selector), tsc.max_skew)
+        g = self._spread.get(sig)
+        if g is None:
+            g = {d: 0 for d in self.universe(tsc.topology_key)}
+            for n in self._existing:
+                d = n.labels.get(tsc.topology_key)
+                if d is None:
+                    continue
+                g.setdefault(d, 0)
+                for pl in n.pod_labels:
+                    if _matches(tsc.label_selector, pl):
+                        g[d] += 1
+            for labels, domains in self._placed:
+                d = domains.get(tsc.topology_key)
+                if d is not None and _matches(tsc.label_selector, labels):
+                    g[d] = g.get(d, 0) + 1
+            self._spread[sig] = g
+        return g
+
+    def spread_allowed(
+        self,
+        tsc: TopologySpreadConstraint,
+        pod_domains: Optional[set],
+        extra_domains: Sequence[str] = (),
+    ) -> set:
+        """Domains where the pod may land: count[d]+1-min <= maxSkew."""
+        g = self._group(tsc)
+        for d in self.universe(tsc.topology_key):
+            g.setdefault(d, 0)
+        for d in extra_domains:  # e.g. a not-yet-registered claim hostname
+            g.setdefault(d, 0)
+        eligible = set(g)
+        if pod_domains is not None:
+            eligible &= pod_domains
+        if not eligible:
+            return set()
+        if tsc.topology_key == wk.HOSTNAME_LABEL:
+            floor = 0  # a fresh empty hostname is always creatable (SPEC.md)
+        else:
+            floor = min(g[d] for d in eligible)
+        return {d for d in eligible if g[d] + 1 - floor <= tsc.max_skew}
+
+    # -- affinity -----------------------------------------------------------
+
+    def _match_group(self, selector: Mapping[str, str], key: str) -> Dict[str, int]:
+        sig = (_sel_sig(selector), key)
+        g = self._match.get(sig)
+        if g is None:
+            g = {}
+            for n in self._existing:
+                d = n.labels.get(key)
+                if d is None:
+                    continue
+                for pl in n.pod_labels:
+                    if _matches(selector, pl):
+                        g[d] = g.get(d, 0) + 1
+            for labels, domains in self._placed:
+                d = domains.get(key)
+                if d is not None and _matches(selector, labels):
+                    g[d] = g.get(d, 0) + 1
+            self._match[sig] = g
+        return g
+
+    def affinity_domains(self, selector: Mapping[str, str], key: str) -> Dict[str, int]:
+        return dict(self._match_group(selector, key))
+
+    def anti_blocked(self, selector: Mapping[str, str], key: str) -> set:
+        """Domains holding a pod matching `selector` (can't place anti pod)."""
+        return {d for d, c in self._match_group(selector, key).items() if c > 0}
+
+    def symmetric_anti_blocked(self, pod_labels: Mapping[str, str]) -> Dict[str, set]:
+        """key -> blocked domains from already-placed pods' anti terms whose
+        selector matches this pod."""
+        out: Dict[str, set] = {}
+        for (sel_sig, key), domains in self._anti.items():
+            if _matches(dict(sel_sig), pod_labels):
+                out.setdefault(key, set()).update(domains)
+        return out
+
+    # -- commit -------------------------------------------------------------
+
+    def record(self, pod: Pod, domains: Mapping[str, str]) -> None:
+        """Update all state after the pod lands with the given key->domain."""
+        self._placed.append((dict(pod.meta.labels), dict(domains)))
+        # every materialized spread group whose selector matches sees the pod
+        # (not just the pod's own TSC signatures)
+        for (key, sel_sig, _skew), g in self._spread.items():
+            if _matches(dict(sel_sig), pod.meta.labels):
+                d = domains.get(key)
+                if d is not None:
+                    g[d] = g.get(d, 0) + 1
+        # matching-pod index: update every materialized group this pod matches
+        for (sel_sig, key), g in self._match.items():
+            if _matches(dict(sel_sig), pod.meta.labels):
+                d = domains.get(key)
+                if d is not None:
+                    g[d] = g.get(d, 0) + 1
+        # register owned anti-affinity terms
+        for term in pod.affinity_terms:
+            if term.weight is not None or not term.anti:
+                continue
+            d = domains.get(term.topology_key)
+            if d is not None:
+                sig = (_sel_sig(term.label_selector), term.topology_key)
+                self._anti.setdefault(sig, set()).add(d)
+
+
+# ---------------------------------------------------------------------------
+# Virtual node (SPEC.md "Virtual-node instance-type survival")
+# ---------------------------------------------------------------------------
+
+
+class VirtualNode:
+    def __init__(self, index: int, pool: NodePoolSpec, daemon_overhead: Resources):
+        self.index = index
+        self.pool = pool
+        self.hostname = f"claim-{index}"
+        self.requirements = Requirements(pool.requirements)
+        self.options: List[InstanceType] = list(pool.instance_types)
+        self.requests = Resources(daemon_overhead)
+        self.requests[PODS] = self.requests.get_(PODS)  # ensure key
+        self.pod_uids: List[str] = []
+        self.taints = list(pool.taints)
+
+    def _surviving(self, reqs: Requirements, requests: Resources) -> List[InstanceType]:
+        out = []
+        for it in self.options:
+            if not reqs.compatible(it.requirements):
+                continue
+            if not requests.fits(it.allocatable()):
+                continue
+            if not _has_offering(it, reqs):
+                continue
+            out.append(it)
+        return out
+
+    def try_add(self, pod: Pod, pod_reqs: Requirements) -> Optional[Tuple[Requirements, List[InstanceType], Resources]]:
+        """Feasibility check; returns prospective state without committing."""
+        if not tolerates_all(pod.tolerations, self.taints):
+            return None
+        combined = Requirements(self.requirements)
+        combined.add(*pod_reqs.values())
+        # unsatisfiable keys (empty sets, contradictory Gt/Lt) => fail fast
+        for r in combined.values():
+            if not r.satisfiable():
+                return None
+        requests = self.requests.add(pod.requests)
+        requests[PODS] = requests.get_(PODS) + 1
+        survivors = self._surviving(combined, requests)
+        if not survivors:
+            return None
+        return combined, survivors, requests
+
+    def commit(self, pod: Pod, state: Tuple[Requirements, List[InstanceType], Resources]) -> None:
+        self.requirements, self.options, self.requests = state
+        self.pod_uids.append(pod.meta.uid)
+
+    def narrow(self, key: str, allowed: set) -> bool:
+        """Intersect a label requirement with `allowed`; refilter options."""
+        cur = self.requirements.get(key)
+        req = Requirement.create(key, IN, sorted(allowed))
+        nxt = cur.intersect(req) if cur is not None else req
+        if not nxt.complement and not nxt.values:
+            return False
+        trial = Requirements(self.requirements)
+        trial[key] = nxt
+        survivors = self._surviving(trial, self.requests)
+        if not survivors:
+            return False
+        self.requirements, self.options = trial, survivors
+        return True
+
+    def domain_values(self, key: str, universe: Sequence[str]) -> List[str]:
+        """Current admissible domains for a topology key."""
+        if key == wk.HOSTNAME_LABEL:
+            return [self.hostname]
+        r = self.requirements.get(key)
+        if r is None:
+            return list(universe)
+        return [v for v in universe if r.has(v)]
+
+
+def _has_offering(it: InstanceType, reqs: Requirements) -> bool:
+    for o in it.offerings:
+        if o.available and reqs.compatible(o.requirements()):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Scheduler
+# ---------------------------------------------------------------------------
+
+
+class Scheduler:
+    """Sequential FFD scheduler per SPEC.md."""
+
+    def __init__(self, inp: SolverInput):
+        self.inp = inp
+        self.topo = TopologyState(inp)
+        self.claims: List[VirtualNode] = []
+        self.node_free = {n.id: Resources(n.free) for n in inp.nodes}
+        self.node_pods = {n.id: 0 for n in inp.nodes}
+        self.pool_usage = {p.name: Resources(p.usage) for p in inp.nodepools}
+        self.pools = sorted(inp.nodepools, key=lambda p: (-p.weight, p.name))
+        self._daemon_cache: Dict[str, Resources] = {}
+        # node labels are immutable during a solve: build Requirements once
+        self._node_reqs = {n.id: Requirements.from_labels(n.labels) for n in inp.nodes}
+
+    # -- daemonset overhead -------------------------------------------------
+
+    def _daemon_overhead(self, pool: NodePoolSpec) -> Resources:
+        cached = self._daemon_cache.get(pool.name)
+        if cached is not None:
+            return cached
+        total = Resources()
+        count = 0
+        for dp in self.inp.daemonset_pods:
+            if not tolerates_all(dp.tolerations, pool.taints):
+                continue
+            if not dp.scheduling_requirements().compatible(pool.requirements):
+                continue
+            total = total.add(dp.requests)
+            count += 1
+        total[PODS] = total.get_(PODS) + count
+        self._daemon_cache[pool.name] = total
+        return total
+
+    # -- main loop ----------------------------------------------------------
+
+    def solve(self) -> SolverResult:
+        placements: Dict[str, Tuple[str, object]] = {}
+        errors: Dict[str, str] = {}
+        pods = sorted([p for p in self.inp.pods if not p.scheduling_gated and not p.bound], key=ffd_key)
+        for pod in pods:
+            err = self._schedule_with_relaxation(pod, placements)
+            if err:
+                errors[pod.meta.uid] = err
+        claims = [
+            ClaimResult(
+                nodepool=c.pool.name,
+                requirements=c.requirements,
+                instance_type_names=[it.name for it in c.options],
+                pod_uids=c.pod_uids,
+                requests=c.requests,
+                taints=c.taints,
+                hostname=c.hostname,
+            )
+            for c in self.claims
+        ]
+        return SolverResult(placements=placements, claims=claims, errors=errors)
+
+    def _schedule_with_relaxation(self, pod: Pod, placements) -> Optional[str]:
+        prefs = sorted(
+            range(len(pod.preferred_node_affinity)),
+            key=lambda i: (pod.preferred_node_affinity[i][0], i),
+        )
+        dropped = 0
+        while True:
+            active = [pod.preferred_node_affinity[i] for i in prefs[dropped:]]
+            err = self._try_schedule(pod, active, placements)
+            if err is None:
+                return None
+            if dropped >= len(prefs):
+                return err
+            dropped += 1  # relax lowest-weight preference and retry
+
+    def _pod_requirement_alternatives(self, pod: Pod, active_prefs) -> List[Requirements]:
+        """nodeSelector ∧ (one OR'd required node-affinity term) ∧ active
+        preferences — kube semantics: a node matches if ANY term matches, so
+        each term yields an alternative tried per target in input order."""
+        base = Requirements.from_labels(pod.node_selector)
+        for _w, pref in active_prefs:
+            base = base.union(pref)
+        if not pod.node_affinity:
+            return [base]
+        return [base.union(term) for term in pod.node_affinity]
+
+    def _try_schedule(self, pod: Pod, active_prefs, placements) -> Optional[str]:
+        alternatives = self._pod_requirement_alternatives(pod, active_prefs)
+
+        # 1. existing nodes, in order
+        for n in self.inp.nodes:
+            if any(self._try_existing(pod, reqs, n) for reqs in alternatives):
+                placements[pod.meta.uid] = ("node", n.id)
+                return None
+
+        # 2. open claims, in order
+        for c in self.claims:
+            if any(self._try_claim(pod, reqs, c) for reqs in alternatives):
+                placements[pod.meta.uid] = ("claim", c.index)
+                return None
+
+        # 3. new claim per nodepool
+        last_err = "no nodepool admits the pod"
+        for pool in self.pools:
+            if self._limits_exceeded(pool):
+                last_err = f"nodepool {pool.name} limits exceeded"
+                continue
+            c = VirtualNode(len(self.claims), pool, self._daemon_overhead(pool))
+            if any(self._try_claim(pod, reqs, c, new=True) for reqs in alternatives):
+                self.claims.append(c)
+                self.topo.add_hostname(c.hostname)
+                placements[pod.meta.uid] = ("claim", c.index)
+                self._charge_pool(pool, c)
+                return None
+            last_err = f"no instance type in nodepool {pool.name} satisfies the pod"
+        return last_err
+
+    # -- existing-node path -------------------------------------------------
+
+    def _try_existing(self, pod: Pod, pod_reqs: Requirements, n: ExistingNode) -> bool:
+        if not n.schedulable:
+            return False
+        if not tolerates_all(pod.tolerations, n.taints):
+            return False
+        if not pod_reqs.strictly_compatible(self._node_reqs[n.id]):
+            return False
+        requests = pod.requests
+        free = self.node_free[n.id]
+        if not requests.fits(free):
+            return False
+        if free.get_(PODS) < 1:
+            return False
+        domains = {k: n.labels[k] for k in wk.TOPOLOGY_KEYS if k in n.labels}
+        if not self._topo_admits_fixed(pod, pod_reqs, domains):
+            return False
+        # commit (the placement log in TopologyState.record covers topology
+        # bookkeeping; n.pod_labels stays as-input to avoid double counting)
+        nf = free.sub(requests)
+        nf[PODS] = free.get_(PODS) - 1
+        self.node_free[n.id] = nf
+        self.topo.record(pod, domains)
+        return True
+
+    # -- claim path ---------------------------------------------------------
+
+    def _try_claim(self, pod: Pod, pod_reqs: Requirements, c: VirtualNode, new: bool = False) -> bool:
+        state = c.try_add(pod, pod_reqs)
+        if state is None:
+            return False
+        combined, survivors, requests = state
+        # Topology/affinity: compute per-key narrowing before committing.
+        saved_reqs, saved_opts = c.requirements, c.options
+        c.requirements, c.options = combined, survivors
+        ok, domains = self._topo_admits_claim(pod, pod_reqs, c)
+        if not ok:
+            c.requirements, c.options = saved_reqs, saved_opts
+            return False
+        c.requests = requests
+        c.pod_uids.append(pod.meta.uid)
+        self.topo.record(pod, domains)
+        return True
+
+    # -- topology/affinity admission ---------------------------------------
+
+    def _pod_own_domains(self, pod_reqs: Requirements, key: str) -> Optional[set]:
+        r = pod_reqs.get(key)
+        if r is None or r.complement:
+            return None
+        return set(r.values_list())
+
+    def _topo_admits_fixed(self, pod: Pod, pod_reqs: Requirements, domains: Mapping[str, str]) -> bool:
+        for tsc in pod.topology_spread:
+            if tsc.when_unsatisfiable != "DoNotSchedule":
+                continue
+            d = domains.get(tsc.topology_key)
+            if d is None:
+                return False
+            allowed = self.topo.spread_allowed(tsc, self._pod_own_domains(pod_reqs, tsc.topology_key))
+            if d not in allowed:
+                return False
+        return self._affinity_admits(pod, {k: {v} for k, v in domains.items()}, fixed=True)[0]
+
+    def _topo_admits_claim(self, pod: Pod, pod_reqs: Requirements, c: VirtualNode) -> Tuple[bool, Dict[str, str]]:
+        """Admission + narrowing for a virtual node. Returns committed domains."""
+        committed: Dict[str, str] = {wk.HOSTNAME_LABEL: c.hostname}
+        # spread constraints
+        for tsc in pod.topology_spread:
+            if tsc.when_unsatisfiable != "DoNotSchedule":
+                continue
+            key = tsc.topology_key
+            universe = self.topo.universe(key)
+            node_domains = c.domain_values(key, universe)
+            allowed = self.topo.spread_allowed(
+                tsc,
+                self._pod_own_domains(pod_reqs, key),
+                extra_domains=(c.hostname,) if key == wk.HOSTNAME_LABEL else (),
+            )
+            inter = [d for d in node_domains if d in allowed]
+            if not inter:
+                return False, {}
+            if key == wk.HOSTNAME_LABEL:
+                committed[key] = c.hostname
+                continue
+            g = self.topo._group(tsc)
+            d_star = min(inter, key=lambda d: (g.get(d, 0), d))
+            if len(node_domains) > 1 or node_domains[0] != d_star:
+                if not c.narrow(key, {d_star}):
+                    return False, {}
+            committed[key] = d_star
+        ok, aff_committed = self._affinity_admits(
+            pod,
+            {
+                k: set(c.domain_values(k, self.topo.universe(k)))
+                for k in (wk.ZONE_LABEL, wk.CAPACITY_TYPE_LABEL, wk.HOSTNAME_LABEL)
+            },
+            fixed=False,
+            claim=c,
+        )
+        if not ok:
+            return False, {}
+        committed.update(aff_committed)
+        # fill in remaining single-valued domains for bookkeeping
+        for key in (wk.ZONE_LABEL, wk.CAPACITY_TYPE_LABEL):
+            if key in committed:
+                continue
+            vals = c.domain_values(key, self.topo.universe(key))
+            if len(vals) == 1:
+                committed[key] = vals[0]
+        return True, committed
+
+    def _affinity_admits(
+        self,
+        pod: Pod,
+        node_domains: Mapping[str, set],
+        fixed: bool,
+        claim: Optional[VirtualNode] = None,
+    ) -> Tuple[bool, Dict[str, str]]:
+        committed: Dict[str, str] = {}
+        # symmetric anti-affinity from placed pods
+        for key, blocked in self.topo.symmetric_anti_blocked(pod.meta.labels).items():
+            doms = node_domains.get(key)
+            if doms is None:
+                continue
+            remaining = doms - blocked
+            if not remaining:
+                return False, {}
+            if not fixed and len(doms) > len(remaining) and key != wk.HOSTNAME_LABEL and claim is not None:
+                if not claim.narrow(key, remaining):
+                    return False, {}
+                node_domains = dict(node_domains)
+                node_domains[key] = remaining
+        for term in pod.affinity_terms:
+            if term.weight is not None:
+                continue  # preferred: relaxation handles
+            key = term.topology_key
+            doms = set(node_domains.get(key, set()))
+            if not doms:
+                return False, {}
+            match = self.topo.affinity_domains(term.label_selector, key)
+            if term.anti:
+                blocked = {d for d, cnt in match.items() if cnt > 0}
+                remaining = doms - blocked
+                if not remaining:
+                    return False, {}
+                if not fixed and len(remaining) < len(doms) and key != wk.HOSTNAME_LABEL and claim is not None:
+                    if not claim.narrow(key, remaining):
+                        return False, {}
+            else:
+                present = {d for d, cnt in match.items() if cnt > 0}
+                if not present:
+                    # self-affinity bootstrap
+                    if _matches(term.label_selector, pod.meta.labels):
+                        continue
+                    return False, {}
+                inter = doms & present
+                if not inter:
+                    return False, {}
+                d_star = min(inter, key=lambda d: (-match.get(d, 0), d))
+                if not fixed and key != wk.HOSTNAME_LABEL and claim is not None and len(doms) > 1:
+                    if not claim.narrow(key, {d_star}):
+                        return False, {}
+                    committed[key] = d_star
+        return True, committed
+
+    # -- limits -------------------------------------------------------------
+
+    def _limits_exceeded(self, pool: NodePoolSpec) -> bool:
+        if not pool.limits:
+            return False
+        usage = self.pool_usage[pool.name]
+        return any(usage.get(k, 0) >= v for k, v in pool.limits.items())
+
+    def _charge_pool(self, pool: NodePoolSpec, c: VirtualNode) -> None:
+        """Charge the minimum resources among surviving options (SPEC.md)."""
+        if not c.options:
+            return
+        mins = Resources()
+        for key in ("cpu", "memory"):
+            mins[key] = min(it.capacity.get_(key) for it in c.options)
+        self.pool_usage[pool.name] = self.pool_usage[pool.name].add(mins)
+
+
+def solve(inp: SolverInput) -> SolverResult:
+    return Scheduler(inp).solve()

@@ -1,0 +1,14 @@
+"""Test harness: force a virtual 8-device CPU mesh before jax initializes.
+
+Multi-chip hardware is not available in CI; shardings are validated on a
+virtual CPU mesh (SURVEY.md §7 / driver contract). Must run before any
+`import jax` anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,131 @@
+"""Requirements set-algebra (karpenter_tpu/scheduling/requirements.py).
+
+Covers the operator semantics the reference exercises through
+karpenter core pkg/scheduling (SURVEY.md §2.1): In/NotIn/Exists/DoesNotExist/
+Gt/Lt, intersection, Compatible, minValues propagation.
+"""
+
+import pytest
+
+from karpenter_tpu.scheduling.requirements import (
+    DOES_NOT_EXIST,
+    EXISTS,
+    GT,
+    IN,
+    LT,
+    NOT_IN,
+    Requirement,
+    Requirements,
+)
+
+
+class TestRequirement:
+    def test_in(self):
+        r = Requirement.create("zone", IN, ["a", "b"])
+        assert r.has("a") and r.has("b") and not r.has("c")
+        assert r.len_hint() == 2
+
+    def test_not_in(self):
+        r = Requirement.create("zone", NOT_IN, ["a"])
+        assert not r.has("a") and r.has("b")
+        assert r.len_hint() is None
+
+    def test_exists(self):
+        r = Requirement.create("zone", EXISTS)
+        assert r.has("anything")
+
+    def test_does_not_exist(self):
+        r = Requirement.create("zone", DOES_NOT_EXIST)
+        assert not r.has("a")
+        assert r.allows_absent()
+
+    def test_gt_lt(self):
+        gt = Requirement.create("gen", GT, ["4"])
+        assert gt.has("5") and not gt.has("4") and not gt.has("x")
+        lt = Requirement.create("gen", LT, ["4"])
+        assert lt.has("3") and not lt.has("4")
+
+    def test_gt_requires_single_value(self):
+        with pytest.raises(ValueError):
+            Requirement.create("gen", GT, ["1", "2"])
+
+    def test_intersect_in_in(self):
+        a = Requirement.create("z", IN, ["a", "b"])
+        b = Requirement.create("z", IN, ["b", "c"])
+        assert a.intersect(b).values_list() == ["b"]
+
+    def test_intersect_in_notin(self):
+        a = Requirement.create("z", IN, ["a", "b"])
+        b = Requirement.create("z", NOT_IN, ["a"])
+        assert a.intersect(b).values_list() == ["b"]
+
+    def test_intersect_notin_notin(self):
+        a = Requirement.create("z", NOT_IN, ["a"])
+        b = Requirement.create("z", NOT_IN, ["b"])
+        c = a.intersect(b)
+        assert not c.has("a") and not c.has("b") and c.has("x")
+
+    def test_intersect_gt_in(self):
+        a = Requirement.create("gen", GT, ["4"])
+        b = Requirement.create("gen", IN, ["3", "5", "7"])
+        assert a.intersect(b).values_list() == ["5", "7"]
+
+    def test_intersects_disjoint(self):
+        a = Requirement.create("z", IN, ["a"])
+        b = Requirement.create("z", IN, ["b"])
+        assert not a.intersects(b)
+        assert a.intersects(Requirement.create("z", EXISTS))
+
+
+class TestRequirements:
+    def test_add_intersects_same_key(self):
+        rs = Requirements.of(Requirement.create("z", IN, ["a", "b"]))
+        rs.add(Requirement.create("z", IN, ["b", "c"]))
+        assert rs["z"].values_list() == ["b"]
+
+    def test_from_labels(self):
+        rs = Requirements.from_labels({"arch": "amd64"})
+        assert rs["arch"].values_list() == ["amd64"]
+
+    def test_compatible(self):
+        pod = Requirements.of(Requirement.create("zone", IN, ["a", "b"]))
+        node = Requirements.of(Requirement.create("zone", IN, ["b"]))
+        assert pod.compatible(node)
+        assert node.compatible(pod)
+        other = Requirements.of(Requirement.create("zone", IN, ["c"]))
+        assert not pod.compatible(other)
+
+    def test_compatible_missing_key_is_unconstrained(self):
+        pod = Requirements.of(Requirement.create("special", IN, ["x"]))
+        node = Requirements()
+        assert pod.compatible(node)
+
+    def test_strictly_compatible_requires_key_present(self):
+        pod = Requirements.of(Requirement.create("special", IN, ["x"]))
+        node_labels = Requirements.from_labels({"zone": "a"})
+        assert not pod.strictly_compatible(node_labels)
+        assert pod.strictly_compatible(Requirements.from_labels({"special": "x", "zone": "a"}))
+
+    def test_strictly_compatible_does_not_exist(self):
+        pod = Requirements.of(Requirement.create("special", DOES_NOT_EXIST))
+        assert pod.strictly_compatible(Requirements.from_labels({"zone": "a"}))
+        assert not pod.strictly_compatible(Requirements.from_labels({"special": "x"}))
+
+    def test_labels_single_valued(self):
+        rs = Requirements.of(
+            Requirement.create("a", IN, ["1"]),
+            Requirement.create("b", IN, ["1", "2"]),
+        )
+        assert rs.labels() == {"a": "1"}
+
+    def test_min_values(self):
+        rs = Requirements.from_node_selector_terms(
+            [{"key": "family", "operator": IN, "values": ["m5", "c5"], "minValues": 2}]
+        )
+        assert rs.has_min_values()
+        assert rs["family"].min_values == 2
+
+    def test_min_values_max_on_intersect(self):
+        a = Requirement.create("f", IN, ["x", "y", "z"], min_values=1)
+        b = Requirement.create("f", IN, ["x", "y"], min_values=2)
+        assert a.intersect(b).min_values == 2

@@ -1,0 +1,93 @@
+"""Integer-exact resource math (karpenter_tpu/utils/resources.py)."""
+
+import pytest
+
+from karpenter_tpu.utils.resources import (
+    CPU,
+    MEMORY,
+    Resources,
+    format_quantity,
+    parse_quantity,
+)
+
+
+class TestParseQuantity:
+    def test_cpu_cores(self):
+        assert parse_quantity("1", CPU) == 1000
+        assert parse_quantity(2, CPU) == 2000
+        assert parse_quantity("0.5", CPU) == 500
+
+    def test_cpu_milli(self):
+        assert parse_quantity("100m", CPU) == 100
+        assert parse_quantity("1500m", CPU) == 1500
+
+    def test_cpu_fractional_rounds_up(self):
+        assert parse_quantity("0.0001", CPU) == 1  # 0.1m -> 1m
+
+    def test_memory_binary_suffixes(self):
+        assert parse_quantity("1Ki", MEMORY) == 1024
+        assert parse_quantity("1Mi", MEMORY) == 1024**2
+        assert parse_quantity("1Gi", MEMORY) == 1024**3
+        assert parse_quantity("1.5Gi", MEMORY) == 1024**3 + 512 * 1024**2
+
+    def test_memory_decimal_suffixes(self):
+        assert parse_quantity("1k", MEMORY) == 1000
+        assert parse_quantity("1M", MEMORY) == 10**6
+        assert parse_quantity("1G", MEMORY) == 10**9
+
+    def test_plain_count(self):
+        assert parse_quantity("4", "nvidia.com/gpu") == 4
+        assert parse_quantity("110", "pods") == 110
+
+    def test_exactness_large(self):
+        # 24Ti must be byte-exact (would overflow float32 mantissa)
+        assert parse_quantity("24Ti", MEMORY) == 24 * 1024**4
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            parse_quantity("abc", CPU)
+        with pytest.raises(ValueError):
+            parse_quantity("1Qx", MEMORY)
+
+    def test_format_roundtrip(self):
+        assert format_quantity(1500, CPU) == "1500m"
+        assert format_quantity(2000, CPU) == "2"
+        assert format_quantity(1024**3, MEMORY) == "1Gi"
+
+
+class TestResources:
+    def test_parse_add_sub(self):
+        a = Resources.parse({"cpu": "1", "memory": "1Gi"})
+        b = Resources.parse({"cpu": "500m", "memory": "512Mi"})
+        s = a.add(b)
+        assert s["cpu"] == 1500
+        assert s["memory"] == 1024**3 + 512 * 1024**2
+        d = s.sub(b)
+        assert d["cpu"] == 1000
+
+    def test_fits(self):
+        req = Resources.parse({"cpu": "2", "memory": "4Gi"})
+        cap = Resources.parse({"cpu": "4", "memory": "8Gi", "pods": "110"})
+        assert req.fits(cap)
+        assert not cap.fits(req)
+
+    def test_fits_missing_capacity_key(self):
+        req = Resources.parse({"nvidia.com/gpu": "1"})
+        cap = Resources.parse({"cpu": "4"})
+        assert not req.fits(cap)
+
+    def test_zero_request_always_fits(self):
+        req = Resources.parse({"cpu": "0"})
+        assert req.fits(Resources())
+
+    def test_exceeds(self):
+        usage = Resources.parse({"cpu": "10"})
+        assert usage.exceeds(Resources.parse({"cpu": "5"}))
+        assert not usage.exceeds(Resources.parse({"cpu": "20"}))
+        assert not usage.exceeds(Resources.parse({"memory": "1Gi"}))
+
+    def test_max(self):
+        a = Resources.parse({"cpu": "1", "memory": "4Gi"})
+        b = Resources.parse({"cpu": "2", "memory": "1Gi"})
+        m = a.max(b)
+        assert m["cpu"] == 2000 and m["memory"] == 4 * 1024**3
