@@ -1,0 +1,217 @@
+"""Solver backends: the pluggable `Solver` seam (BASELINE.json north_star).
+
+- `ReferenceSolver` — the exact sequential Python path (ground truth).
+- `TPUSolver` — encodes to tensors, runs the device FFD kernel, decodes back.
+  If the input contains constructs the device kernel can't express yet
+  (fallback groups — see encode.py), it transparently routes the WHOLE solve
+  to the reference path so semantics never fork mid-solve.
+
+Both operate on MiB-quantized inputs (encode.quantize_input) so decisions are
+bit-identical; `tests/test_solver_parity.py` asserts it.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..api import wellknown as wk
+from ..provisioning.scheduler import (
+    ClaimResult,
+    ExistingNode,
+    NodePoolSpec,
+    Scheduler,
+    SolverInput,
+    SolverResult,
+)
+from ..scheduling.requirements import IN, Requirement, Requirements
+from ..utils.resources import PODS, Resources
+from .encode import EncodedInput, encode, quantize_input
+
+
+class Solver(abc.ABC):
+    @abc.abstractmethod
+    def solve(self, inp: SolverInput) -> SolverResult:
+        ...
+
+
+class ReferenceSolver(Solver):
+    def solve(self, inp: SolverInput) -> SolverResult:
+        return Scheduler(inp).solve()
+
+
+class TPUSolver(Solver):
+    """Tensorized FFD on device (JAX/XLA; see tpu/ffd.py).
+
+    max_claims bounds the claim-slot array; inputs that overflow it (or use
+    unsupported constructs) fall back to the reference path.
+    """
+
+    def __init__(self, max_claims: int = 1024, fallback: Optional[Solver] = None):
+        self.max_claims = max_claims
+        self.fallback = fallback or ReferenceSolver()
+        self.stats: Dict[str, int] = {"device_solves": 0, "fallback_solves": 0}
+
+    def solve(self, inp: SolverInput) -> SolverResult:
+        qinp = quantize_input(inp)
+        enc = encode(qinp)
+        if (
+            enc.group_fallback.any()
+            or enc.has_topology
+            or enc.has_affinity
+            or enc.G == 0
+        ):
+            # v1 device kernel: configs 1-2 (resources + masks). Topology /
+            # affinity kernels land next; until then whole-solve fallback
+            # keeps semantics unforked.
+            self.stats["fallback_solves"] += 1
+            return self.fallback.solve(qinp)
+        out = self._device_solve(enc)
+        if out is None:
+            self.stats["fallback_solves"] += 1
+            return self.fallback.solve(qinp)
+        self.stats["device_solves"] += 1
+        return out
+
+    # -- device path --------------------------------------------------------
+
+    @staticmethod
+    def _bucket(n: int, mult: int, floor: int) -> int:
+        """Round up to a multiple of `mult` (min `floor`) — bounds the number
+        of distinct compiled shapes (SURVEY.md §7 hard parts: bucketed padding
+        avoids recompilation storms)."""
+        return max(floor, ((n + mult - 1) // mult) * mult)
+
+    def _device_solve(self, enc: EncodedInput) -> Optional[SolverResult]:
+        import jax.numpy as jnp
+
+        from .tpu.ffd import ffd_solve
+
+        INT32_MAX_NP = np.int32(2**31 - 1)
+        S, G, T, E, P = len(enc.run_group), enc.G, enc.T, enc.E, enc.P
+        R, Z, C = enc.group_req.shape[1], len(enc.zones), len(enc.capacity_types)
+        Sp = self._bucket(S, 64, 64)
+        Gp = self._bucket(G, 16, 16)
+        Tp = self._bucket(T, 128, 128)
+        Ep = self._bucket(E, 64, 64)
+        Pp = self._bucket(P, 4, 4)
+        total_pods = int(sum(len(p) for p in enc.group_pods))
+        m = 64
+        while m < min(total_pods + 1, self.max_claims):
+            m *= 2
+        M = min(m, max(self.max_claims, 64))
+
+        def pad(a, shape, fill=0):
+            out = np.full(shape, fill, dtype=a.dtype)
+            out[tuple(slice(0, s) for s in a.shape)] = a
+            return out
+
+        type_charge = np.where(enc.charge_axes[None, :], enc.type_capacity, 0).astype(np.int32)
+        out = ffd_solve(
+            jnp.asarray(pad(enc.run_group, (Sp,))),
+            jnp.asarray(pad(enc.run_count, (Sp,))),
+            jnp.asarray(pad(enc.group_req, (Gp, R))),
+            jnp.asarray(pad(enc.group_compat_t, (Gp, Tp))),
+            jnp.asarray(pad(enc.group_zone, (Gp, Z))),
+            jnp.asarray(pad(enc.group_ct, (Gp, C))),
+            jnp.asarray(pad(enc.group_pool, (Gp, Pp))),
+            jnp.asarray(pad(enc.group_pair, (Gp, Gp), fill=True)),
+            jnp.asarray(pad(~enc.group_fallback, (Gp,))),
+            jnp.asarray(pad(enc.type_alloc, (Tp, R))),
+            jnp.asarray(pad(type_charge, (Tp, R))),
+            jnp.asarray(pad(enc.offer_avail, (Tp, Z, C))),
+            jnp.asarray(pad(enc.pool_type, (Pp, Tp))),
+            jnp.asarray(pad(enc.pool_zone, (Pp, Z))),
+            jnp.asarray(pad(enc.pool_ct, (Pp, C))),
+            jnp.asarray(pad(enc.pool_daemon, (Pp, R))),
+            jnp.asarray(pad(enc.pool_limit, (Pp, R), fill=INT32_MAX_NP)),
+            jnp.asarray(pad(enc.pool_usage, (Pp, R))),
+            jnp.asarray(pad(enc.node_free, (Ep, R))),
+            jnp.asarray(pad(enc.node_compat, (Gp, Ep))),
+            max_claims=M,
+        )
+        used = int(out.state.used)
+        if used >= M:
+            return None  # possible overflow — replay on fallback
+        return decode(enc, np.asarray(out.take_e)[:S, :E], np.asarray(out.take_c)[:S],
+                      np.asarray(out.leftover)[:S], np.asarray(out.state.c_mask)[:, :T],
+                      np.asarray(out.state.c_zone), np.asarray(out.state.c_ct),
+                      np.asarray(out.state.c_pool), np.asarray(out.state.c_gmask)[:, :G],
+                      np.asarray(out.state.c_cum), used)
+
+
+def decode(
+    enc: EncodedInput,
+    take_e: np.ndarray,  # [S, E]
+    take_c: np.ndarray,  # [S, M]
+    leftover: np.ndarray,  # [S]
+    c_mask: np.ndarray,  # [M, T]
+    c_zone: np.ndarray,  # [M, Z]
+    c_ct: np.ndarray,  # [M, C]
+    c_pool: np.ndarray,  # [M]
+    c_gmask: np.ndarray,  # [M, G]
+    c_cum: np.ndarray,  # [M, R]
+    used: int,
+) -> SolverResult:
+    """Reassemble a SolverResult: pods assigned in index order per run
+    (existing nodes first, then claim slots — exactly first-fit order)."""
+    placements: Dict[str, Tuple[str, object]] = {}
+    errors: Dict[str, str] = {}
+    cursor = {g: 0 for g in range(enc.G)}
+    claim_pods: Dict[int, List[str]] = {m: [] for m in range(used)}
+
+    S = len(enc.run_group)
+    for s in range(S):
+        g = int(enc.run_group[s])
+        n = int(enc.run_count[s])
+        pods = enc.group_pods[g][cursor[g] : cursor[g] + n]
+        cursor[g] += n
+        i = 0
+        for e in np.nonzero(take_e[s])[0]:
+            for _ in range(int(take_e[s, e])):
+                placements[pods[i].meta.uid] = ("node", enc.node_ids[e])
+                i += 1
+        for m in np.nonzero(take_c[s])[0]:
+            for _ in range(int(take_c[s, m])):
+                placements[pods[i].meta.uid] = ("claim", int(m))
+                claim_pods[int(m)].append(pods[i].meta.uid)
+                i += 1
+        for _ in range(int(leftover[s])):
+            errors[pods[i].meta.uid] = "no instance type in any nodepool satisfies the pod"
+            i += 1
+
+    claims: List[ClaimResult] = []
+    for m in range(used):
+        pool_name = enc.pool_names[int(c_pool[m])]
+        type_names = [enc.type_names[t] for t in np.nonzero(c_mask[m])[0]]
+        reqs = Requirements.of(Requirement.create(wk.NODEPOOL_LABEL, IN, [pool_name]))
+        zones = [enc.zones[z] for z in np.nonzero(c_zone[m])[0]]
+        cts = [enc.capacity_types[c] for c in np.nonzero(c_ct[m])[0]]
+        if zones:
+            reqs.add(Requirement.create(wk.ZONE_LABEL, IN, zones))
+        if cts:
+            reqs.add(Requirement.create(wk.CAPACITY_TYPE_LABEL, IN, cts))
+        for g in np.nonzero(c_gmask[m])[0]:
+            reqs = reqs.union(enc.group_pods[int(g)][0].scheduling_requirements())
+        requests = Resources()
+        for i, k in enumerate(enc.resource_keys):
+            v = int(c_cum[m, i])
+            if k in ("memory", "ephemeral-storage"):
+                v *= 1024**2  # decode MiB back to bytes
+            if v:
+                requests[k] = v
+        claims.append(
+            ClaimResult(
+                nodepool=pool_name,
+                requirements=reqs,
+                instance_type_names=type_names,
+                pod_uids=claim_pods[m],
+                requests=requests,
+                taints=[],
+                hostname=f"claim-{m}",
+            )
+        )
+    return SolverResult(placements=placements, claims=claims, errors=errors)

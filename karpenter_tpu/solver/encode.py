@@ -1,0 +1,421 @@
+"""Host-side encoder: SolverInput -> dense tensors for the TPU solver.
+
+This is the bridge between the control plane's object model and the device
+kernel (BASELINE.json north_star: "dense pod×instance-type resource-fit
+tensors plus boolean constraint masks"). It performs:
+
+  1. **Group compression** — pods with identical scheduling footprint dedupe
+    into groups (the reference batches identical pods the same way; SURVEY.md
+    §7 "hard parts": pairwise [P,P] terms explode at 50k pods otherwise).
+  2. **Run splitting** — the exact FFD pod order (SPEC.md) is cut into runs
+    of consecutive same-group pods, so the device scan processes "k identical
+    pods" per step while preserving bit-identical pod order.
+  3. **Quantization** — cpu milli / memory+storage MiB / counts, all int32.
+    Pod requests round UP, capacities round DOWN (conservative; never
+    over-packs). Both backends receive the SAME quantized numbers, so
+    decisions stay bit-identical (SPEC.md "Determinism").
+  4. **Mask precomputation** — [G,T] requirement compatibility, [G,E] existing
+    node compatibility, [G,P] nodepool admission, [P,T] pool-type admission,
+    [T,Z,C] offering availability/price, [G,G] pairwise group compatibility.
+
+Pods the v1 device kernel cannot express (OR'd node-affinity alternatives,
+preferred affinities needing relaxation, ScheduleAnyway TSCs, or ≥3-way
+custom-label joint conflicts) are flagged `fallback` — the hybrid solver
+routes those to the reference path (see karpenter_tpu/solver/backend.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api import wellknown as wk
+from ..api.objects import Pod, tolerates_all
+from ..provisioning.scheduler import ExistingNode, NodePoolSpec, SolverInput, ffd_key
+from ..scheduling.requirements import Requirements
+from ..utils.resources import CPU, EPHEMERAL_STORAGE, MEMORY, PODS, Resources
+
+MIB = 1024**2
+INT32_MAX = np.int32(2**31 - 1)
+
+# Resource keys quantized to MiB granularity.
+_MIB_KEYS = (MEMORY, EPHEMERAL_STORAGE)
+
+
+def _quantize(res: Resources, keys: Sequence[str], ceil: bool) -> List[int]:
+    out = []
+    for k in keys:
+        v = res.get_(k)
+        if k in _MIB_KEYS:
+            q, r = divmod(v, MIB)
+            v = q + (1 if (ceil and r) else 0)
+        out.append(min(int(v), int(INT32_MAX)))
+    return out
+
+
+def _pod_signature(pod: Pod) -> tuple:
+    """Scheduling-footprint identity: pods with equal signatures behave
+    identically in the solver (requests, constraints, AND labels — labels
+    affect other pods' TSC/affinity selectors)."""
+    return (
+        tuple(sorted((k, v) for k, v in pod.requests.items() if v)),
+        tuple(sorted(pod.node_selector.items())),
+        tuple(
+            tuple(sorted((r.key, r.complement, tuple(sorted(r.values)), r.greater_than, r.less_than, r.require_present) for r in term.values()))
+            for term in pod.node_affinity
+        ),
+        tuple(sorted((t.key, t.operator, t.value, t.effect) for t in pod.tolerations)),
+        tuple(
+            (t.max_skew, t.topology_key, t.when_unsatisfiable, tuple(sorted(t.label_selector.items())))
+            for t in pod.topology_spread
+        ),
+        tuple(
+            (tuple(sorted(t.label_selector.items())), t.topology_key, t.anti, t.weight)
+            for t in pod.affinity_terms
+        ),
+        tuple(
+            (w, tuple(sorted((r.key, tuple(sorted(r.values))) for r in reqs.values())))
+            for w, reqs in pod.preferred_node_affinity
+        ),
+        tuple(sorted(pod.meta.labels.items())),
+        pod.priority,
+    )
+
+
+@dataclass
+class EncodedInput:
+    # dimensions
+    resource_keys: List[str]  # the R axis
+    zones: List[str]  # Z axis
+    capacity_types: List[str]  # C axis
+    type_names: List[str]  # T axis (catalog order)
+    pool_names: List[str]  # P axis (weight desc, name asc — SPEC order)
+
+    # groups (G axis)
+    group_pods: List[List[Pod]]  # pods per group, in FFD order
+    group_req: np.ndarray  # [G, R] int32 (ceil)
+    group_compat_t: np.ndarray  # [G, T] bool (pod reqs vs type reqs)
+    group_zone: np.ndarray  # [G, Z] bool
+    group_ct: np.ndarray  # [G, C] bool
+    group_pool: np.ndarray  # [G, P] bool (tolerations + reqs compat)
+    group_pair: np.ndarray  # [G, G] bool (pairwise requirement compatibility)
+    group_fallback: np.ndarray  # [G] bool — route to reference path
+
+    # runs (S axis): FFD order split into same-group runs
+    run_group: np.ndarray  # [S] int32
+    run_count: np.ndarray  # [S] int32
+
+    # instance types
+    type_alloc: np.ndarray  # [T, R] int32 (floor)
+    type_capacity: np.ndarray  # [T, R] int32 — raw capacity, for limit charging
+    offer_avail: np.ndarray  # [T, Z, C] bool
+    offer_price: np.ndarray  # [T, Z, C] float32 (+inf where absent)
+    charge_axes: np.ndarray  # [R] bool — cpu/memory participate in limit charges
+
+    # nodepools
+    pool_type: np.ndarray  # [P, T] bool (pool reqs vs type reqs + offering overlap)
+    pool_zone: np.ndarray  # [P, Z] bool
+    pool_ct: np.ndarray  # [P, C] bool
+    pool_daemon: np.ndarray  # [P, R] int32 (daemonset overhead incl. pod count)
+    pool_limit: np.ndarray  # [P, R] int32 (INT32_MAX where unlimited)
+    pool_usage: np.ndarray  # [P, R] int32
+
+    # existing nodes (E axis)
+    node_free: np.ndarray  # [E, R] int32 (floor)
+    node_compat: np.ndarray  # [G, E] bool (labels+taints admission)
+    node_zone: np.ndarray  # [E] int32 (index into zones, -1 unknown)
+    node_ct: np.ndarray  # [E] int32
+    node_ids: List[str]
+
+    # topology / affinity (config 3-4) — filled by encode, used by tpu kernels
+    has_topology: bool = False
+    has_affinity: bool = False
+
+    @property
+    def G(self) -> int:
+        return len(self.group_pods)
+
+    @property
+    def T(self) -> int:
+        return len(self.type_names)
+
+    @property
+    def E(self) -> int:
+        return len(self.node_ids)
+
+    @property
+    def P(self) -> int:
+        return len(self.pool_names)
+
+
+def quantize_resources(res: Resources, ceil: bool) -> Resources:
+    """MiB-quantize memory-like values (requests ceil, capacities floor).
+
+    The canonical solver arithmetic is MiB-granular (SPEC.md); feeding both
+    backends identically-quantized inputs is what makes decisions
+    bit-identical. Conservative direction: never over-packs."""
+    out = Resources(res)
+    for k in _MIB_KEYS:
+        if k in out:
+            q, r = divmod(out[k], MIB)
+            out[k] = (q + (1 if (ceil and r) else 0)) * MIB
+    return out
+
+
+def quantize_input(inp: SolverInput) -> SolverInput:
+    """A copy of `inp` with all resources MiB-quantized — what the hybrid
+    production path and the parity tests feed the reference solver so both
+    backends see identical numbers."""
+    import copy
+
+    out = copy.deepcopy(inp)
+    for pod in list(out.pods) + list(out.daemonset_pods):
+        pod.requests = quantize_resources(pod.requests, ceil=True)
+    for n in out.nodes:
+        n.free = quantize_resources(n.free, ceil=False)
+    for pool in out.nodepools:
+        for it in pool.instance_types:
+            it.capacity = quantize_resources(it.capacity, ceil=False)
+            it.overhead = quantize_resources(it.overhead, ceil=True)
+    return out
+
+
+def encode(inp: SolverInput) -> EncodedInput:
+    # ---- axes -------------------------------------------------------------
+    zones = list(inp.zones)
+    cts = list(inp.capacity_types)
+    pools = sorted(inp.nodepools, key=lambda p: (-p.weight, p.name))
+    pool_names = [p.name for p in pools]
+
+    # union catalog over pools, preserving first-seen (catalog) order
+    type_names: List[str] = []
+    types_by_name: Dict[str, object] = {}
+    for p in pools:
+        for it in p.instance_types:
+            if it.name not in types_by_name:
+                types_by_name[it.name] = it
+                type_names.append(it.name)
+    T = len(type_names)
+
+    # ---- resource axis ----------------------------------------------------
+    rkeys = [CPU, MEMORY, PODS]
+    seen = set(rkeys)
+    for pod in list(inp.pods) + list(inp.daemonset_pods):
+        for k, v in pod.requests.items():
+            if v and k not in seen:
+                seen.add(k)
+                rkeys.append(k)
+    R = len(rkeys)
+
+    # ---- groups -----------------------------------------------------------
+    pods_sorted = sorted(
+        [p for p in inp.pods if not p.scheduling_gated and not p.bound], key=ffd_key
+    )
+    sig_to_gid: Dict[tuple, int] = {}
+    group_pods: List[List[Pod]] = []
+    pod_gids: List[int] = []
+    for pod in pods_sorted:
+        sig = _pod_signature(pod)
+        gid = sig_to_gid.get(sig)
+        if gid is None:
+            gid = len(group_pods)
+            sig_to_gid[sig] = gid
+            group_pods.append([])
+        group_pods[gid].append(pod)
+        pod_gids.append(gid)
+    G = len(group_pods)
+
+    # runs: consecutive same-group stretches of the sorted pod list
+    run_group: List[int] = []
+    run_count: List[int] = []
+    for gid in pod_gids:
+        if run_group and run_group[-1] == gid:
+            run_count[-1] += 1
+        else:
+            run_group.append(gid)
+            run_count.append(1)
+
+    group_req = np.zeros((G, R), dtype=np.int32)
+    for g, pl in enumerate(group_pods):
+        req = Resources(pl[0].requests)
+        req[PODS] = req.get_(PODS) + 1  # each pod consumes one pod slot
+        group_req[g] = _quantize(req, rkeys, ceil=True)
+
+    # representative requirement set per group (v1: single alternative)
+    group_reqsets: List[Requirements] = []
+    fallback = np.zeros(G, dtype=bool)
+    has_topo = False
+    has_aff = False
+    for g, pl in enumerate(group_pods):
+        pod = pl[0]
+        if len(pod.node_affinity) > 1 or pod.preferred_node_affinity:
+            fallback[g] = True
+        if any(t.when_unsatisfiable == "DoNotSchedule" for t in pod.topology_spread):
+            has_topo = True
+        if any(t.weight is None for t in pod.affinity_terms):
+            has_aff = True
+        group_reqsets.append(pod.scheduling_requirements())
+
+    # ---- instance-type tensors ---------------------------------------------
+    type_alloc = np.zeros((T, R), dtype=np.int32)
+    type_capacity = np.zeros((T, R), dtype=np.int32)
+    offer_avail = np.zeros((T, len(zones), len(cts)), dtype=bool)
+    offer_price = np.full((T, len(zones), len(cts)), np.inf, dtype=np.float32)
+    zid = {z: i for i, z in enumerate(zones)}
+    cid = {c: i for i, c in enumerate(cts)}
+    for t, name in enumerate(type_names):
+        it = types_by_name[name]
+        # alloc = floor(capacity) - ceil(overhead): matches quantize_input's
+        # per-field rounding exactly (allocatable() of quantized fields)
+        cap_q = np.asarray(_quantize(it.capacity, rkeys, ceil=False), dtype=np.int64)
+        ovh_q = np.asarray(_quantize(it.overhead, rkeys, ceil=True), dtype=np.int64)
+        type_alloc[t] = np.maximum(cap_q - ovh_q, 0).astype(np.int32)
+        type_capacity[t] = cap_q.astype(np.int32)
+        for o in it.offerings:
+            if o.zone in zid and o.capacity_type in cid:
+                zi, ci = zid[o.zone], cid[o.capacity_type]
+                if o.available:
+                    offer_avail[t, zi, ci] = True
+                    offer_price[t, zi, ci] = min(offer_price[t, zi, ci], o.price)
+
+    # ---- group×type / group×zone / group×ct --------------------------------
+    group_compat_t = np.zeros((G, T), dtype=bool)
+    group_zone = np.zeros((G, len(zones)), dtype=bool)
+    group_ct = np.zeros((G, len(cts)), dtype=bool)
+    for g, reqs in enumerate(group_reqsets):
+        zr = reqs.get(wk.ZONE_LABEL)
+        for i, z in enumerate(zones):
+            group_zone[g, i] = zr is None or zr.has(z)
+        cr = reqs.get(wk.CAPACITY_TYPE_LABEL)
+        for i, c in enumerate(cts):
+            group_ct[g, i] = cr is None or cr.has(c)
+        for t in range(T):
+            it = types_by_name[type_names[t]]
+            group_compat_t[g, t] = reqs.compatible(it.requirements)
+
+    # ---- pool tensors -------------------------------------------------------
+    P = len(pools)
+    pool_type = np.zeros((P, T), dtype=bool)
+    pool_zone = np.zeros((P, len(zones)), dtype=bool)
+    pool_ct = np.zeros((P, len(cts)), dtype=bool)
+    pool_daemon = np.zeros((P, R), dtype=np.int32)
+    pool_limit = np.full((P, R), INT32_MAX, dtype=np.int32)
+    pool_usage = np.zeros((P, R), dtype=np.int32)
+    group_pool = np.zeros((G, P), dtype=bool)
+    for p, pool in enumerate(pools):
+        in_pool = {it.name for it in pool.instance_types}
+        zr = pool.requirements.get(wk.ZONE_LABEL)
+        for i, z in enumerate(zones):
+            pool_zone[p, i] = zr is None or zr.has(z)
+        cr = pool.requirements.get(wk.CAPACITY_TYPE_LABEL)
+        for i, c in enumerate(cts):
+            pool_ct[p, i] = cr is None or cr.has(c)
+        for t, name in enumerate(type_names):
+            if name not in in_pool:
+                continue
+            it = types_by_name[name]
+            if not pool.requirements.compatible(it.requirements):
+                continue
+            # needs ≥1 available offering within pool zone/ct masks
+            ok = (offer_avail[t] & pool_zone[p][:, None] & pool_ct[p][None, :]).any()
+            pool_type[p, t] = ok
+        # daemonset overhead (SPEC: daemonsets admitted by pool requirements)
+        dres = Resources()
+        dcount = 0
+        for dp in inp.daemonset_pods:
+            if not tolerates_all(dp.tolerations, pool.taints):
+                continue
+            if not dp.scheduling_requirements().compatible(pool.requirements):
+                continue
+            dres = dres.add(dp.requests)
+            dcount += 1
+        dres[PODS] = dres.get_(PODS) + dcount
+        pool_daemon[p] = _quantize(dres, rkeys, ceil=True)
+        for i, k in enumerate(rkeys):
+            if k in pool.limits:
+                pool_limit[p, i] = min(int(pool.limits[k]), int(INT32_MAX))
+        pool_usage[p] = _quantize(pool.usage, rkeys, ceil=True)
+        for g, pl in enumerate(group_pods):
+            pod = pl[0]
+            if not tolerates_all(pod.tolerations, pool.taints):
+                continue
+            group_pool[g, p] = group_reqsets[g].compatible(pool.requirements)
+
+    # ---- pairwise group compatibility --------------------------------------
+    group_pair = np.ones((G, G), dtype=bool)
+    for a in range(G):
+        for b in range(a + 1, G):
+            ok = group_reqsets[a].compatible(group_reqsets[b])
+            group_pair[a, b] = group_pair[b, a] = ok
+    # ≥3-way custom-label joint conflicts the pairwise mask can't see:
+    # detect custom keys with ≥3 distinct finite value-sets among groups.
+    custom_sets: Dict[str, set] = {}
+    tracked = {wk.ZONE_LABEL, wk.CAPACITY_TYPE_LABEL, wk.INSTANCE_TYPE_LABEL}
+    for reqs in group_reqsets:
+        for k, r in reqs.items():
+            if k in tracked or r.complement:
+                continue
+            custom_sets.setdefault(k, set()).add(tuple(sorted(r.values)))
+    for k, vsets in custom_sets.items():
+        if len(vsets) >= 3:
+            for g, reqs in enumerate(group_reqsets):
+                if k in reqs:
+                    fallback[g] = True
+
+    # ---- existing nodes -----------------------------------------------------
+    E = len(inp.nodes)
+    node_free = np.zeros((E, R), dtype=np.int32)
+    node_compat = np.zeros((G, E), dtype=bool)
+    node_zone = np.full(E, -1, dtype=np.int32)
+    node_ct = np.full(E, -1, dtype=np.int32)
+    node_ids = [n.id for n in inp.nodes]
+    for e, n in enumerate(inp.nodes):
+        node_free[e] = _quantize(n.free, rkeys, ceil=False)
+        node_zone[e] = zid.get(n.labels.get(wk.ZONE_LABEL, ""), -1)
+        node_ct[e] = cid.get(n.labels.get(wk.CAPACITY_TYPE_LABEL, ""), -1)
+        if not n.schedulable:
+            continue
+        node_reqs = Requirements.from_labels(n.labels)
+        for g, pl in enumerate(group_pods):
+            pod = pl[0]
+            if not tolerates_all(pod.tolerations, n.taints):
+                continue
+            node_compat[g, e] = group_reqsets[g].strictly_compatible(node_reqs)
+
+    return EncodedInput(
+        resource_keys=rkeys,
+        zones=zones,
+        capacity_types=cts,
+        type_names=type_names,
+        pool_names=pool_names,
+        group_pods=group_pods,
+        group_req=group_req,
+        group_compat_t=group_compat_t,
+        group_zone=group_zone,
+        group_ct=group_ct,
+        group_pool=group_pool,
+        group_pair=group_pair,
+        group_fallback=fallback,
+        run_group=np.asarray(run_group, dtype=np.int32),
+        run_count=np.asarray(run_count, dtype=np.int32),
+        type_alloc=type_alloc,
+        type_capacity=type_capacity,
+        charge_axes=np.asarray([k in (CPU, MEMORY) for k in rkeys], dtype=bool),
+        offer_avail=offer_avail,
+        offer_price=offer_price,
+        pool_type=pool_type,
+        pool_zone=pool_zone,
+        pool_ct=pool_ct,
+        pool_daemon=pool_daemon,
+        pool_limit=pool_limit,
+        pool_usage=pool_usage,
+        node_free=node_free,
+        node_compat=node_compat,
+        node_zone=node_zone,
+        node_ct=node_ct,
+        node_ids=node_ids,
+        has_topology=has_topo,
+        has_affinity=has_aff,
+    )

@@ -1,0 +1,297 @@
+"""TPU FFD bin-packing kernel.
+
+The tensor re-expression of the reference's `Scheduler.Solve()` hot loop
+(SURVEY.md §3.1 HOT LOOP #1; designs/bin-packing.md:17-43). Key idea: FFD
+processes pods in sorted order; identical pods form *runs*, and pouring a run
+of k identical pods first-fit across open nodes is
+
+    take_n = clamp(k - prefix_sum(cap)_{n-1}, 0, cap_n)
+
+i.e. a vectorized per-node capacity computation + one prefix sum — no
+sequential inner loop. Opening new nodes is closed-form: each new node holds
+`kmax` pods (the best surviving instance type's capacity), so
+`ceil(remaining / kmax)` nodes open at once, with per-pool limit accounting
+in closed form as well. The only sequential axis is the run axis (≈ number
+of distinct pod specs), walked with `lax.scan`.
+
+Per-step work is O((E+M)·T·R) fully-vectorized integer ops — VPU-friendly,
+HBM-bandwidth-bound, no data-dependent Python control flow, static shapes
+(SPEC: compile once per (E, M, T, R, Z, C, P, G, S) bucket).
+
+Decisions are bit-identical to the reference path by construction: same FFD
+order (runs follow it), same first-fit node order (array index = creation
+order), same type-survival rule, same pool priority and limit charging
+(solver/SPEC.md).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+INT32_MAX = jnp.int32(2**31 - 1)
+BIG = jnp.int32(2**30)
+
+
+class FFDState(NamedTuple):
+    e_cum: jnp.ndarray  # [E, R] int32 — requests placed on existing nodes
+    c_cum: jnp.ndarray  # [M, R] int32 — requests on claim slots (incl daemon)
+    c_mask: jnp.ndarray  # [M, T] bool — surviving instance types
+    c_zone: jnp.ndarray  # [M, Z] bool
+    c_ct: jnp.ndarray  # [M, C] bool
+    c_gmask: jnp.ndarray  # [M, G] bool — groups placed on each claim
+    c_pool: jnp.ndarray  # [M] int32 — pool index, -1 if unopened
+    c_open: jnp.ndarray  # [M] bool
+    used: jnp.ndarray  # scalar int32 — claims opened so far
+    p_usage: jnp.ndarray  # [P, R] int32 — pool usage (limit accounting)
+
+
+class FFDOutput(NamedTuple):
+    take_e: jnp.ndarray  # [S, E] int32 — pods of run s placed per existing node
+    take_c: jnp.ndarray  # [S, M] int32 — pods of run s placed per claim slot
+    leftover: jnp.ndarray  # [S] int32 — pods of run s that failed to place
+    state: FFDState
+
+
+def _fit_count(alloc, cum, req):
+    """[N] per-node count of additional `req` pods fitting: min over R of
+    floor((alloc - cum) / req); req==0 axes don't constrain. Clamped >= 0."""
+    # alloc/cum: [N, R]; req: [R]
+    safe_req = jnp.maximum(req, 1)
+    k = jnp.where(req[None, :] > 0, (alloc - cum) // safe_req[None, :], BIG)
+    return jnp.maximum(jnp.min(k, axis=1), 0).astype(jnp.int32)
+
+
+def _fit_count_nt(alloc_t, cum_n, req):
+    """[N, T]: pods fitting per (node, type). alloc_t [T,R], cum_n [N,R].
+
+    Statically unrolled over R to avoid materializing [N,T,R] — each r-slice
+    is a rank-1 broadcast subtract + divide, which XLA fuses."""
+    N, R = cum_n.shape
+    T = alloc_t.shape[0]
+    k = jnp.full((N, T), BIG, jnp.int32)
+    for r in range(R):
+        kr = jnp.where(
+            req[r] > 0,
+            (alloc_t[None, :, r] - cum_n[:, r][:, None]) // jnp.maximum(req[r], 1),
+            BIG,
+        )
+        k = jnp.minimum(k, kr.astype(jnp.int32))
+    return jnp.maximum(k, 0)
+
+
+def _pour(cap, remaining):
+    """First-fit pour of `remaining` identical pods into nodes with per-node
+    capacity `cap` (in index order). Returns (take [N], left scalar)."""
+    prefix = jnp.cumsum(cap) - cap  # exclusive prefix
+    take = jnp.clip(remaining - prefix, 0, cap).astype(jnp.int32)
+    return take, remaining - jnp.sum(take)
+
+
+@functools.partial(jax.jit, static_argnames=("max_claims",))
+def ffd_solve(
+    # runs
+    run_group,  # [S] i32
+    run_count,  # [S] i32
+    # groups
+    group_req,  # [G, R] i32
+    group_compat_t,  # [G, T] bool
+    group_zone,  # [G, Z] bool
+    group_ct,  # [G, C] bool
+    group_pool,  # [G, P] bool
+    group_pair,  # [G, G] bool
+    group_device,  # [G] bool — False => fallback group, skip on device
+    # types
+    type_alloc,  # [T, R] i32
+    type_charge,  # [T, R] i32 — capacity on charge axes, 0 elsewhere
+    offer_avail,  # [T, Z, C] bool
+    # pools
+    pool_type,  # [P, T] bool
+    pool_zone,  # [P, Z] bool
+    pool_ct,  # [P, C] bool
+    pool_daemon,  # [P, R] i32
+    pool_limit,  # [P, R] i32
+    pool_usage0,  # [P, R] i32
+    # existing nodes
+    node_free,  # [E, R] i32
+    node_compat,  # [G, E] bool
+    *,
+    max_claims: int,
+) -> FFDOutput:
+    E, R = node_free.shape
+    G, T = group_compat_t.shape
+    P = pool_type.shape[0]
+    Z = group_zone.shape[1]
+    C = group_ct.shape[1]
+    M = max_claims
+
+    state = FFDState(
+        e_cum=jnp.zeros((E, R), jnp.int32),
+        c_cum=jnp.zeros((M, R), jnp.int32),
+        c_mask=jnp.zeros((M, T), bool),
+        c_zone=jnp.zeros((M, Z), bool),
+        c_ct=jnp.zeros((M, C), bool),
+        c_gmask=jnp.zeros((M, G), bool),
+        c_pool=jnp.full((M,), -1, jnp.int32),
+        c_open=jnp.zeros((M,), bool),
+        used=jnp.int32(0),
+        p_usage=pool_usage0.astype(jnp.int32),
+    )
+
+    def step(st: FFDState, run):
+        g, count = run
+        req = group_req[g]  # [R]
+        compat_t = group_compat_t[g]  # [T]
+        gz = group_zone[g]  # [Z]
+        gc = group_ct[g]  # [C]
+        gpool = group_pool[g]  # [P]
+        gpair = group_pair[g]  # [G]
+        on_device = group_device[g]
+
+        remaining = jnp.where(on_device, count, 0).astype(jnp.int32)
+
+        # ---- 1. existing nodes --------------------------------------------
+        e_cap = _fit_count(node_free, st.e_cum, req)
+        e_cap = jnp.where(node_compat[g], e_cap, 0)
+        take_e, remaining = _pour(e_cap, remaining)
+        e_cum = st.e_cum + take_e[:, None] * req[None, :]
+
+        # ---- 2. open claims -----------------------------------------------
+        # offering availability under group+node zone/ct masks — exact joint
+        # check: ok_off[n,t] = exists (z,c): avail & c_zone[n,z] & c_ct[n,c]
+        # & gz[z] & gc[c]. Flatten (z,c) and contract: [M,ZC] @ [ZC,T].
+        A = offer_avail & gz[None, :, None] & gc[None, None, :]  # [T, Z, C]
+        ZC = A.shape[1] * A.shape[2]
+        nzc = (st.c_zone[:, :, None] & st.c_ct[:, None, :]).reshape(-1, ZC)  # [M, ZC]
+        ok_off = (
+            jnp.einsum("nx,tx->nt", nzc.astype(jnp.int32), A.reshape(-1, ZC).astype(jnp.int32)) > 0
+        )  # [M, T]
+
+        # pairwise group compatibility with everything on the node
+        pair_ok = ~jnp.any(st.c_gmask & ~gpair[None, :], axis=1)  # [M]
+        # pod must tolerate the claim's pool taints
+        pool_ok = jnp.where(st.c_pool >= 0, gpool[jnp.clip(st.c_pool, 0, P - 1)], False)
+
+        k_nt = _fit_count_nt(type_alloc, st.c_cum, req)  # [M, T]
+        fit_nt = st.c_mask & compat_t[None, :] & ok_off  # [M, T]
+        node_ok = st.c_open & pair_ok & pool_ok  # [M]
+        k_nt = jnp.where(fit_nt & node_ok[:, None], k_nt, 0)
+        c_cap = jnp.max(k_nt, axis=1)  # [M]
+        take_c, remaining = _pour(c_cap, remaining)
+
+        added = take_c > 0
+        c_cum = st.c_cum + take_c[:, None] * req[None, :]
+        c_mask = jnp.where(added[:, None], fit_nt & (k_nt >= take_c[:, None]), st.c_mask)
+        c_zone = jnp.where(added[:, None], st.c_zone & gz[None, :], st.c_zone)
+        c_ct = jnp.where(added[:, None], st.c_ct & gc[None, :], st.c_ct)
+        c_gmask = st.c_gmask.at[:, g].set(st.c_gmask[:, g] | added)
+
+        # ---- 3. new claims, pool by pool in priority order ----------------
+        def open_pool(p, carry):
+            remaining, used, c_cum, c_mask, c_zone, c_ct, c_gmask, c_pool, c_open, p_usage, take_new = carry
+
+            # per-type pod capacity for a fresh node of pool p
+            pz = pool_zone[p] & gz  # [Z]
+            pc = pool_ct[p] & gc  # [C]
+            off_ok = jnp.any(offer_avail & pz[None, :, None] & pc[None, None, :], axis=(1, 2))  # [T]
+            fit_t = compat_t & pool_type[p] & off_ok  # [T]
+            daemon = pool_daemon[p]  # [R]
+            safe_req = jnp.maximum(req, 1)
+            k_t = jnp.where(
+                req[None, :] > 0, (type_alloc - daemon[None, :]) // safe_req[None, :], BIG
+            )
+            k_t = jnp.maximum(jnp.min(k_t, axis=1), 0).astype(jnp.int32)
+            k_t = jnp.where(fit_t, k_t, 0)
+            kmax = jnp.max(k_t)
+
+            # limit accounting (SPEC: claim blocked if any limited resource
+            # usage >= limit at creation; charge = min type charge among the
+            # full-node surviving set)
+            full_set = fit_t & (k_t >= jnp.maximum(kmax, 1))
+            charge_full = jnp.min(
+                jnp.where(full_set[:, None], type_charge, INT32_MAX), axis=0
+            )  # [R]
+            charge_full = jnp.where(charge_full == INT32_MAX, 0, charge_full)
+            headroom = pool_limit[p] - p_usage[p]  # [R] (may be negative)
+            # claims before resource r trips: ceil(headroom / charge)
+            trips = jnp.where(
+                charge_full > 0,
+                jnp.maximum(-(-headroom // jnp.maximum(charge_full, 1)), 0),
+                BIG,
+            )
+            already_over = jnp.any(p_usage[p] >= pool_limit[p])
+            allow = jnp.where(already_over, 0, jnp.min(trips)).astype(jnp.int32)
+
+            n_want = jnp.where(kmax > 0, -(-remaining // jnp.maximum(kmax, 1)), 0)
+            slots_left = M - used
+            n_new = jnp.minimum(jnp.minimum(n_want, allow), slots_left).astype(jnp.int32)
+            eligible = gpool[p] & (kmax > 0)
+            n_new = jnp.where(eligible, n_new, 0)
+
+            idx = jnp.arange(M, dtype=jnp.int32)
+            is_new = (idx >= used) & (idx < used + n_new)
+            # node j (0-based among new) takes min(kmax, remaining - j*kmax)
+            j = idx - used
+            take_j = jnp.where(is_new, jnp.clip(remaining - j * kmax, 0, kmax), 0).astype(jnp.int32)
+
+            c_cum = jnp.where(is_new[:, None], daemon[None, :] + take_j[:, None] * req[None, :], c_cum)
+            new_mask = fit_t[None, :] & (k_t[None, :] >= take_j[:, None])
+            c_mask = jnp.where(is_new[:, None], new_mask, c_mask)
+            c_zone = jnp.where(is_new[:, None], pz[None, :], c_zone)
+            c_ct = jnp.where(is_new[:, None], pc[None, :], c_ct)
+            c_gmask = c_gmask.at[:, g].set(c_gmask[:, g] | is_new)
+            c_pool = jnp.where(is_new, p, c_pool)
+            c_open = c_open | is_new
+
+            # charge pool usage: full claims charge charge_full; the last
+            # (possibly partial) claim charges min over its own surviving set
+            placed_new = jnp.sum(take_j)
+            last_take = jnp.where(n_new > 0, remaining - (n_new - 1) * kmax, 0)
+            part_set = fit_t & (k_t >= jnp.maximum(last_take, 1))
+            charge_part = jnp.min(jnp.where(part_set[:, None], type_charge, INT32_MAX), axis=0)
+            charge_part = jnp.where(charge_part == INT32_MAX, 0, charge_part)
+            n_full = jnp.maximum(n_new - 1, 0)
+            add_usage = charge_full * n_full + jnp.where(n_new > 0, charge_part, 0)
+            p_usage = p_usage.at[p].add(add_usage.astype(jnp.int32))
+
+            take_new = take_new + take_j
+            remaining = remaining - placed_new
+            used = used + n_new
+            return (remaining, used, c_cum, c_mask, c_zone, c_ct, c_gmask, c_pool, c_open, p_usage, take_new)
+
+        carry = (
+            remaining,
+            st.used,
+            c_cum,
+            c_mask,
+            c_zone,
+            c_ct,
+            c_gmask,
+            st.c_pool,
+            st.c_open,
+            st.p_usage,
+            jnp.zeros((M,), jnp.int32),
+        )
+        carry = jax.lax.fori_loop(0, P, open_pool, carry)
+        (remaining, used, c_cum, c_mask, c_zone, c_ct, c_gmask, c_pool2, c_open, p_usage, take_new) = carry
+
+        new_state = FFDState(
+            e_cum=e_cum,
+            c_cum=c_cum,
+            c_mask=c_mask,
+            c_zone=c_zone,
+            c_ct=c_ct,
+            c_gmask=c_gmask,
+            c_pool=c_pool2,
+            c_open=c_open,
+            used=used,
+            p_usage=p_usage,
+        )
+        return new_state, (take_e, take_c + take_new, remaining)
+
+    state, (take_e, take_c, leftover) = jax.lax.scan(step, state, (run_group, run_count))
+    return FFDOutput(take_e=take_e, take_c=take_c, leftover=leftover, state=state)

@@ -1,14 +1,25 @@
-"""Test harness: force a virtual 8-device CPU mesh before jax initializes.
+"""Test harness: force a virtual 8-device CPU mesh before any backend init.
 
 Multi-chip hardware is not available in CI; shardings are validated on a
-virtual CPU mesh (SURVEY.md §7 / driver contract). Must run before any
-`import jax` anywhere in the test process.
+virtual CPU mesh (SURVEY.md §7 / driver contract). NOTE: this environment's
+axon site hook force-sets jax_platforms="axon,cpu" (real-TPU tunnel first) in
+jax.config at interpreter start — env vars alone do NOT override it, so we
+update jax.config directly here, before any backend initializes. bench.py
+intentionally does NOT do this: it runs on the real chip.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Persistent compile cache: the solver kernels bucket their shapes, so
+# compilations amortize across tests and sessions.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_compile_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

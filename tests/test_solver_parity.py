@@ -1,0 +1,231 @@
+"""Differential parity: TPU tensor solver vs exact reference solver.
+
+BASELINE.json north_star correctness bar: "node-claim decisions bit-identical
+to the Go path on the kwok scheduling test suite" — here re-expressed as
+bit-identical decisions between karpenter_tpu's two backends on randomized
+and structured scenarios (configs 1-2: FFD + nodeSelector/taints masks).
+
+Comparison is exact: placements map, claim count/order, per-claim nodepool,
+surviving instance-type sets, zone/capacity-type domains, and error sets.
+"""
+
+import random
+
+import pytest
+
+from karpenter_tpu.api import wellknown as wk
+from karpenter_tpu.api.objects import ObjectMeta, Pod, Taint, Toleration
+from karpenter_tpu.catalog.catalog import CatalogSpec, generate
+from karpenter_tpu.provisioning.scheduler import ExistingNode, NodePoolSpec, SolverInput
+from karpenter_tpu.scheduling.requirements import IN, Requirement, Requirements
+from karpenter_tpu.solver.backend import ReferenceSolver, TPUSolver
+from karpenter_tpu.solver.encode import quantize_input
+from karpenter_tpu.utils.resources import Resources
+
+CATALOG = generate(CatalogSpec())
+ZONES = ("zone-1a", "zone-1b", "zone-1c")
+
+
+def pool(name="default", weight=0, reqs=None, taints=None, limits=None, types=None):
+    r = Requirements.of(Requirement.create(wk.NODEPOOL_LABEL, IN, [name]))
+    if reqs:
+        r = r.union(reqs)
+    return NodePoolSpec(
+        name=name, weight=weight, requirements=r, taints=taints or [],
+        instance_types=types if types is not None else CATALOG,
+        limits=limits or Resources(),
+    )
+
+
+def assert_parity(inp: SolverInput):
+    ref = ReferenceSolver().solve(quantize_input(inp))
+    tpu = TPUSolver().solve(inp)
+    assert set(ref.errors) == set(tpu.errors), (
+        f"errors diverge: ref={sorted(ref.errors)} tpu={sorted(tpu.errors)}"
+    )
+    assert ref.placements == tpu.placements, _diff(ref.placements, tpu.placements)
+    assert len(ref.claims) == len(tpu.claims)
+    for i, (rc, tc) in enumerate(zip(ref.claims, tpu.claims)):
+        assert rc.nodepool == tc.nodepool, f"claim {i} pool: {rc.nodepool} != {tc.nodepool}"
+        assert sorted(rc.instance_type_names) == sorted(tc.instance_type_names), (
+            f"claim {i} types diverge: ref={len(rc.instance_type_names)} tpu={len(tc.instance_type_names)}\n"
+            f"ref-only={set(rc.instance_type_names) - set(tc.instance_type_names)}\n"
+            f"tpu-only={set(tc.instance_type_names) - set(rc.instance_type_names)}"
+        )
+        assert rc.pod_uids == tc.pod_uids, f"claim {i} pods: {rc.pod_uids} != {tc.pod_uids}"
+        for key in (wk.ZONE_LABEL, wk.CAPACITY_TYPE_LABEL):
+            rv = rc.requirements.get(key)
+            tv = tc.requirements.get(key)
+            rset = set(rv.values_list()) if rv and not rv.complement else None
+            tset = set(tv.values_list()) if tv and not tv.complement else None
+            if rset is not None or tset is not None:
+                # compare effective domains (None = universe)
+                universe = set(ZONES) if key == wk.ZONE_LABEL else {"on-demand", "spot"}
+                assert (rset or universe) == (tset or universe), (
+                    f"claim {i} {key}: {rset} != {tset}"
+                )
+    return ref, tpu
+
+
+def _diff(a, b):
+    keys = set(a) | set(b)
+    lines = [f"{k}: ref={a.get(k)} tpu={b.get(k)}" for k in sorted(keys) if a.get(k) != b.get(k)]
+    return "placements diverge:\n" + "\n".join(lines[:20])
+
+
+def mkpod(name, cpu="1", mem="1Gi", labels=None, **kw):
+    return Pod(
+        meta=ObjectMeta(name=name, uid=name, labels=labels or {}),
+        requests=Resources.parse({"cpu": cpu, "memory": mem}),
+        **kw,
+    )
+
+
+class TestConfig1FFD:
+    """BASELINE config 1: cpu/mem-only pods, single NodePool, full catalog."""
+
+    def test_single_pod(self):
+        assert_parity(SolverInput(pods=[mkpod("p")], nodes=[], nodepools=[pool()], zones=ZONES))
+
+    def test_identical_pods(self):
+        pods = [mkpod(f"p{i:03d}", cpu="500m", mem="512Mi") for i in range(20)]
+        assert_parity(SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES))
+
+    def test_heterogeneous_sizes(self):
+        random.seed(1)
+        pods = [
+            mkpod(f"p{i:03d}", cpu=f"{random.choice([100, 250, 500, 1000, 2000, 4000])}m",
+                  mem=f"{random.choice([128, 256, 512, 1024, 4096])}Mi")
+            for i in range(60)
+        ]
+        ref, tpu = assert_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+        assert not ref.errors
+
+    def test_unschedulable_pod(self):
+        pods = [mkpod("big", cpu="999"), mkpod("ok", cpu="1")]
+        assert_parity(SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES))
+
+    def test_pods_capacity_axis(self):
+        # tiny pods bounded by the pods resource, not cpu/mem
+        small = [it for it in CATALOG if it.name == "m5.medium"]
+        pods = [mkpod(f"t{i:03d}", cpu="1m", mem="1Mi") for i in range(65)]
+        assert_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool(types=small)], zones=ZONES)
+        )
+
+
+class TestConfig2Masks:
+    """BASELINE config 2: nodeSelector + taints/tolerations over mixed pools."""
+
+    def test_arch_selector(self):
+        pods = [mkpod(f"a{i}", node_selector={wk.ARCH_LABEL: "arm64"}) for i in range(5)]
+        pods += [mkpod(f"b{i}", node_selector={wk.ARCH_LABEL: "amd64"}) for i in range(5)]
+        assert_parity(SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES))
+
+    def test_spot_ondemand_pools(self):
+        spot_pool = pool(
+            "spot", weight=10,
+            reqs=Requirements.of(Requirement.create(wk.CAPACITY_TYPE_LABEL, IN, ["spot"])),
+        )
+        od_pool = pool(
+            "od", weight=1,
+            reqs=Requirements.of(Requirement.create(wk.CAPACITY_TYPE_LABEL, IN, ["on-demand"])),
+        )
+        pods = [mkpod(f"p{i:02d}") for i in range(10)]
+        # an OD-only pod must skip the higher-weight spot pool
+        pods.append(mkpod("odonly", node_selector={wk.CAPACITY_TYPE_LABEL: "on-demand"}))
+        assert_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[spot_pool, od_pool], zones=ZONES)
+        )
+
+    def test_tainted_pool_with_tolerations(self):
+        t = Taint(key="gpu", value="true", effect=wk.EFFECT_NO_SCHEDULE)
+        gpu_pool = pool("gpu", weight=50, taints=[t])
+        cpu_pool = pool("cpu", weight=1)
+        tol = Toleration(key="gpu", value="true", effect=wk.EFFECT_NO_SCHEDULE)
+        pods = [mkpod(f"g{i}", tolerations=[tol]) for i in range(3)]
+        pods += [mkpod(f"c{i}") for i in range(3)]
+        assert_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[gpu_pool, cpu_pool], zones=ZONES)
+        )
+
+    def test_zone_selectors(self):
+        pods = [
+            mkpod(f"p{i}", node_selector={wk.ZONE_LABEL: ZONES[i % 3]}) for i in range(9)
+        ]
+        assert_parity(SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES))
+
+    def test_gpu_resource(self):
+        pods = [
+            Pod(
+                meta=ObjectMeta(name=f"g{i}", uid=f"g{i}"),
+                requests=Resources.parse({"cpu": "4", "memory": "8Gi", "nvidia.com/gpu": "1"}),
+            )
+            for i in range(3)
+        ]
+        assert_parity(SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES))
+
+    def test_limits(self):
+        capped = pool("capped", weight=10, limits=Resources.parse({"cpu": "8"}))
+        backup = pool("backup", weight=1)
+        pods = [mkpod(f"p{i:02d}", cpu="2", mem="2Gi") for i in range(12)]
+        assert_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[capped, backup], zones=ZONES)
+        )
+
+
+class TestExistingNodesParity:
+    def mknode(self, name, zone="zone-1a", cpu="8", mem="32Gi", pods=110):
+        lab = {
+            wk.ZONE_LABEL: zone,
+            wk.HOSTNAME_LABEL: name,
+            wk.CAPACITY_TYPE_LABEL: "on-demand",
+            wk.ARCH_LABEL: "amd64",
+            wk.OS_LABEL: "linux",
+        }
+        free = Resources.parse({"cpu": cpu, "memory": mem})
+        free["pods"] = pods
+        return ExistingNode(id=name, labels=lab, taints=[], free=free)
+
+    def test_fill_existing_then_spill(self):
+        nodes = [self.mknode("n1"), self.mknode("n2", zone="zone-1b")]
+        pods = [mkpod(f"p{i:02d}", cpu="3", mem="4Gi") for i in range(8)]
+        assert_parity(SolverInput(pods=pods, nodes=nodes, nodepools=[pool()], zones=ZONES))
+
+    def test_node_selector_vs_existing(self):
+        nodes = [self.mknode("n1", zone="zone-1a")]
+        pods = [mkpod(f"p{i}", node_selector={wk.ZONE_LABEL: "zone-1b"}) for i in range(3)]
+        assert_parity(SolverInput(pods=pods, nodes=nodes, nodepools=[pool()], zones=ZONES))
+
+
+class TestRandomizedParity:
+    @pytest.mark.parametrize("seed", range(6))
+    def test_fuzz(self, seed):
+        rng = random.Random(seed)
+        pods = []
+        for i in range(rng.randint(10, 80)):
+            kw = {}
+            r = rng.random()
+            if r < 0.2:
+                kw["node_selector"] = {wk.ARCH_LABEL: rng.choice(["amd64", "arm64"])}
+            elif r < 0.3:
+                kw["node_selector"] = {wk.ZONE_LABEL: rng.choice(ZONES)}
+            elif r < 0.35:
+                kw["node_selector"] = {wk.CAPACITY_TYPE_LABEL: rng.choice(["spot", "on-demand"])}
+            pods.append(
+                mkpod(
+                    f"p{i:03d}",
+                    cpu=f"{rng.choice([50, 100, 500, 1000, 2000, 7000])}m",
+                    mem=f"{rng.choice([64, 300, 1024, 3000, 9000])}Mi",
+                    **kw,
+                )
+            )
+        pools = [pool("a", weight=5), pool("b", weight=1)]
+        if seed % 2:
+            pools[0] = pool(
+                "a", weight=5,
+                reqs=Requirements.of(Requirement.create(wk.CAPACITY_TYPE_LABEL, IN, ["spot"])),
+            )
+        assert_parity(SolverInput(pods=pods, nodes=[], nodepools=pools, zones=ZONES))
