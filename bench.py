@@ -1,0 +1,156 @@
+"""Headline benchmark: Solve() at 50k pending pods x ~700 instance types.
+
+BASELINE.md target: p99 < 100 ms on one TPU v5e chip (the reference publishes
+no numbers; 100 ms is the north-star bound from BASELINE.json, and the
+qualitative bar is "retry in milliseconds", concepts/_index.md:89).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": p99_ms, "unit": "ms", "vs_baseline": 100/p99}
+(vs_baseline > 1 means better than the 100 ms target.)
+
+Runs on the real chip (does NOT force cpu — the axon site hook's
+"axon,cpu" platform order stands). Diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def build_input(num_pods: int = 50_000):
+    from karpenter_tpu.api import wellknown as wk
+    from karpenter_tpu.api.objects import ObjectMeta, Pod
+    from karpenter_tpu.catalog.catalog import generate
+    from karpenter_tpu.provisioning.scheduler import NodePoolSpec, SolverInput
+    from karpenter_tpu.scheduling.requirements import IN, Requirement, Requirements
+    from karpenter_tpu.utils.resources import Resources
+
+    catalog = generate()
+    pools = [
+        NodePoolSpec(
+            name="general",
+            weight=10,
+            requirements=Requirements.of(
+                Requirement.create(wk.NODEPOOL_LABEL, IN, ["general"])
+            ),
+            taints=[],
+            instance_types=catalog,
+        ),
+        NodePoolSpec(
+            name="spot",
+            weight=50,
+            requirements=Requirements.of(
+                Requirement.create(wk.NODEPOOL_LABEL, IN, ["spot"]),
+                Requirement.create(wk.CAPACITY_TYPE_LABEL, IN, ["spot"]),
+            ),
+            taints=[],
+            instance_types=catalog,
+        ),
+    ]
+    # ~40 distinct pod specs (deployments), heterogeneous sizes + selectors —
+    # the shape of a production pending-pod surge.
+    sizes = [
+        ("100m", "128Mi"), ("250m", "256Mi"), ("250m", "512Mi"), ("500m", "512Mi"),
+        ("500m", "1Gi"), ("1", "1Gi"), ("1", "2Gi"), ("2", "2Gi"), ("2", "4Gi"),
+        ("4", "8Gi"), ("500m", "2Gi"), ("1500m", "3Gi"), ("3", "6Gi"), ("8", "16Gi"),
+    ]
+    selectors = [
+        {},
+        {},
+        {},
+        {wk.ARCH_LABEL: "arm64"},
+        {},
+        {wk.CAPACITY_TYPE_LABEL: "on-demand"},
+        {},
+        {wk.ZONE_LABEL: "zone-1b"},
+    ]
+    pods = []
+    spec_id = 0
+    for i in range(num_pods):
+        spec = spec_id % (len(sizes) * 3)
+        cpu, mem = sizes[spec % len(sizes)]
+        sel = selectors[spec % len(selectors)]
+        pods.append(
+            Pod(
+                meta=ObjectMeta(name=f"p{i:06d}", uid=f"p{i:06d}"),
+                requests=Resources.parse({"cpu": cpu, "memory": mem}),
+                node_selector=dict(sel),
+            )
+        )
+        if i % 1250 == 1249:
+            spec_id += 1
+    return SolverInput(
+        pods=pods, nodes=[], nodepools=pools, zones=("zone-1a", "zone-1b", "zone-1c")
+    )
+
+
+def main() -> None:
+    t0 = time.perf_counter()
+    import jax
+
+    from karpenter_tpu.solver.backend import TPUSolver
+    from karpenter_tpu.solver.encode import encode, quantize_input
+
+    dev = jax.devices()[0]
+    print(f"[bench] device: {dev.platform}/{dev.device_kind} "
+          f"(init {time.perf_counter()-t0:.1f}s)", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    inp = build_input(50_000)
+    print(f"[bench] built 50k pods in {time.perf_counter()-t0:.1f}s", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    enc = encode(quantize_input(inp))
+    print(
+        f"[bench] encode: {time.perf_counter()-t0:.1f}s — G={enc.G} runs={len(enc.run_group)} "
+        f"T={enc.T} P={enc.P}",
+        file=sys.stderr,
+    )
+
+    solver = TPUSolver(max_claims=8192)
+    import __graft_entry__ as ge
+
+    args = ge._kernel_args(enc, solver)
+    from karpenter_tpu.solver.tpu.ffd import ffd_solve
+
+    jargs = [jax.device_put(np.asarray(a)) for a in args]
+    t0 = time.perf_counter()
+    out = ffd_solve(*jargs, max_claims=8192)
+    jax.block_until_ready(out.state.used)
+    compile_s = time.perf_counter() - t0
+    used = int(out.state.used)
+    unplaced = int(np.asarray(out.leftover).sum())
+    print(
+        f"[bench] first call (compile+run): {compile_s:.1f}s — claims={used} unplaced={unplaced}",
+        file=sys.stderr,
+    )
+
+    times = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        out = ffd_solve(*jargs, max_claims=8192)
+        jax.block_until_ready(out.state.used)
+        times.append((time.perf_counter() - t0) * 1000)
+    times = np.asarray(times)
+    p50, p99 = float(np.percentile(times, 50)), float(np.percentile(times, 99))
+    print(f"[bench] device solve: p50={p50:.1f}ms p99={p99:.1f}ms over {len(times)} iters",
+          file=sys.stderr)
+
+    print(
+        json.dumps(
+            {
+                "metric": "solve_p99_50k_pods_x_700_types",
+                "value": round(p99, 2),
+                "unit": "ms",
+                "vs_baseline": round(100.0 / p99, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

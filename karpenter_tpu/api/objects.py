@@ -237,6 +237,8 @@ class NodeClaim:
     startup_taints: List[Taint] = field(default_factory=list)
     expire_after_s: Optional[float] = None
     termination_grace_period_s: Optional[float] = None
+    # instance types the scheduler found viable, cheapest-first at launch
+    instance_type_options: List[str] = field(default_factory=list)
 
     # status
     provider_id: str = ""

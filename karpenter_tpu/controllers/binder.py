@@ -1,0 +1,58 @@
+"""Binder: the kube-scheduler stand-in for the hermetic loop.
+
+The reference relies on the real kube-scheduler to bind pods once capacity
+registers (kwok replaces kubelet; nothing replaces kube-scheduler because a
+real cluster runs one). In this fully hermetic framework the binder closes
+that gap — and rather than duplicating admission logic, it reuses the
+scheduler itself in existing-nodes-only mode (no nodepools), so binding
+honors the exact same requirements/taints/resources/topology/affinity
+semantics the solver planned with.
+"""
+
+from __future__ import annotations
+
+from ..api import wellknown as wk
+from ..controllers import store as st
+from ..provisioning.scheduler import SolverInput, solve
+from ..state.cluster import Cluster
+
+
+class Binder:
+    name = "binder"
+
+    def __init__(self, store: st.Store, cluster: Cluster):
+        self.store = store
+        self.cluster = cluster
+
+    def reconcile(self) -> bool:
+        pending = self.cluster.pending_pods()
+        if not pending:
+            return False
+        nodes = [
+            n
+            for n in self.cluster.existing_nodes_for_scheduler()
+            # bind only to truly ready nodes (existing_nodes_for_scheduler
+            # also yields in-flight claims for the provisioner's benefit)
+            if (lambda node: node is not None and node.ready)(self.store.try_get(st.NODES, n.id))
+        ]
+        if not nodes:
+            return False
+        result = solve(
+            SolverInput(pods=pending, nodes=nodes, nodepools=[], zones=self._zones(nodes))
+        )
+        did = False
+        for uid, placement in result.placements.items():
+            if placement[0] != "node":
+                continue
+            pod = next((p for p in pending if p.meta.uid == uid), None)
+            if pod is None:
+                continue
+            pod.node_name = placement[1]
+            pod.phase = "Running"
+            self.store.update(st.PODS, pod)
+            did = True
+        return did
+
+    @staticmethod
+    def _zones(nodes) -> tuple:
+        return tuple(sorted({n.labels.get(wk.ZONE_LABEL) for n in nodes if n.labels.get(wk.ZONE_LABEL)}))

@@ -1,0 +1,41 @@
+"""Garbage collection: cloud instances with no NodeClaim.
+
+Mirrors pkg/controllers/nodeclaim/garbagecollection (controller.go:55-91):
+instances older than a grace window (30s, :82) whose NodeClaim vanished are
+terminated, catching leaked capacity from crashes between launch and
+NodeClaim persistence.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..controllers import store as st
+from ..kwok.cloud import KwokCloud
+
+
+class GarbageCollectionController:
+    name = "nodeclaim.garbagecollection"
+
+    def __init__(self, store: st.Store, cloud: KwokCloud, grace_s: float = 30.0, clock=time.monotonic):
+        self.store = store
+        self.cloud = cloud
+        self.grace_s = grace_s
+        self.clock = clock
+
+    def reconcile(self) -> bool:
+        claim_ids = set()
+        for c in self.store.list(st.NODECLAIMS):
+            if c.provider_id:
+                claim_ids.add(c.provider_id.rsplit("/", 1)[-1])
+        orphans = []
+        for inst in self.cloud.describe_instances():
+            if inst.id in claim_ids:
+                continue
+            if self.clock() - inst.launch_time < self.grace_s:
+                continue
+            orphans.append(inst.id)
+        if orphans:
+            self.cloud.terminate_instances(orphans)
+            return True
+        return False

@@ -1,0 +1,65 @@
+"""Deterministic tick-based controller runtime.
+
+The reference runs controller-runtime reconcilers on workqueues with
+per-controller concurrency (SURVEY.md §2.10). This framework's runtime is a
+deterministic tick engine: each registered controller exposes `reconcile() ->
+bool` (did work); `tick()` runs every controller once; `settle()` ticks until
+a fixed point (no controller did work) — giving tests the exact semantics the
+reference gets from `ExpectProvisioned`-style eventually-blocks without
+sleeps or races. A threaded `run()` drives the same controllers continuously
+for live operation.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Protocol
+
+
+class Controller(Protocol):
+    name: str
+
+    def reconcile(self) -> bool:  # returns True if it changed anything
+        ...
+
+
+class Manager:
+    def __init__(self):
+        self.controllers: List[Controller] = []
+        self._stop = threading.Event()
+
+    def register(self, *controllers: Controller) -> None:
+        self.controllers.extend(controllers)
+
+    def tick(self) -> bool:
+        did = False
+        for c in self.controllers:
+            try:
+                did = bool(c.reconcile()) or did
+            except Exception as e:  # a controller crash must not kill the loop
+                import logging
+
+                logging.getLogger("karpenter_tpu").exception("controller %s: %s", c.name, e)
+        return did
+
+    def settle(self, max_ticks: int = 200) -> int:
+        """Tick until fixed point; returns tick count. Raises if not settled
+        (a controller livelock is a bug worth failing loudly on)."""
+        for i in range(max_ticks):
+            if not self.tick():
+                return i + 1
+        raise RuntimeError(f"manager did not settle in {max_ticks} ticks")
+
+    def run(self, interval_s: float = 1.0) -> threading.Thread:
+        def loop():
+            while not self._stop.is_set():
+                self.tick()
+                self._stop.wait(interval_s)
+
+        t = threading.Thread(target=loop, daemon=True, name="karpenter-tpu-manager")
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._stop.set()

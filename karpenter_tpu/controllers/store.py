@@ -1,0 +1,131 @@
+"""In-process object store with watch semantics — the message bus.
+
+The reference's layers communicate exclusively through watch/reconcile on the
+kube API server (SURVEY.md §1: "Kubernetes API server is the message bus";
+no custom RPC). This store is the hermetic stand-in: typed collections,
+optimistic resource versions, finalizer-gated deletion, and watch events
+feeding controller work queues.
+
+Deletion semantics mirror kube: delete() sets deletion_timestamp; the object
+remains until every finalizer is removed, then is purged (the reference's
+termination flow relies on this — designs/termination.md, SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+WatchFn = Callable[[str, str, Any], None]  # (event, kind, obj); event in ADDED|MODIFIED|DELETED
+
+
+class Conflict(Exception):
+    """Optimistic-concurrency conflict (stale resource_version)."""
+
+
+class NotFound(Exception):
+    pass
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._objects: Dict[str, Dict[str, Any]] = defaultdict(dict)  # kind -> key -> obj
+        self._watchers: List[Tuple[Optional[str], WatchFn]] = []
+        self._rv = itertools.count(1)
+
+    @staticmethod
+    def _key(obj: Any) -> str:
+        m = obj.meta
+        return f"{m.namespace}/{m.name}"
+
+    # -- crud ---------------------------------------------------------------
+
+    def create(self, kind: str, obj: Any) -> Any:
+        with self._lock:
+            key = self._key(obj)
+            if key in self._objects[kind]:
+                raise Conflict(f"{kind} {key} already exists")
+            obj.meta.resource_version = next(self._rv)
+            self._objects[kind][key] = obj
+            self._notify("ADDED", kind, obj)
+            return obj
+
+    def update(self, kind: str, obj: Any) -> Any:
+        with self._lock:
+            key = self._key(obj)
+            cur = self._objects[kind].get(key)
+            if cur is None:
+                raise NotFound(f"{kind} {key}")
+            obj.meta.resource_version = next(self._rv)
+            self._objects[kind][key] = obj
+            # finalizer-gated purge: a deleting object with no finalizers goes away
+            if obj.meta.deleting and not obj.meta.finalizers:
+                del self._objects[kind][key]
+                self._notify("DELETED", kind, obj)
+            else:
+                self._notify("MODIFIED", kind, obj)
+            return obj
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+        """Kube-style: mark deleting; purge only when finalizers are gone."""
+        with self._lock:
+            key = f"{namespace}/{name}"
+            cur = self._objects[kind].get(key)
+            if cur is None:
+                raise NotFound(f"{kind} {key}")
+            if cur.meta.finalizers:
+                if not cur.meta.deleting:
+                    cur.meta.deletion_timestamp = time.monotonic()
+                    cur.meta.resource_version = next(self._rv)
+                    self._notify("MODIFIED", kind, cur)
+                return
+            del self._objects[kind][key]
+            cur.meta.deletion_timestamp = cur.meta.deletion_timestamp or time.monotonic()
+            self._notify("DELETED", kind, cur)
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> Any:
+        with self._lock:
+            obj = self._objects[kind].get(f"{namespace}/{name}")
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name}")
+            return obj
+
+    def try_get(self, kind: str, name: str, namespace: str = "default") -> Optional[Any]:
+        with self._lock:
+            return self._objects[kind].get(f"{namespace}/{name}")
+
+    def list(self, kind: str) -> List[Any]:
+        with self._lock:
+            return list(self._objects[kind].values())
+
+    # -- watch --------------------------------------------------------------
+
+    def watch(self, kind: Optional[str], fn: WatchFn) -> None:
+        """Register a watcher; kind=None watches everything. Existing objects
+        are replayed as ADDED (informer-style initial list)."""
+        with self._lock:
+            self._watchers.append((kind, fn))
+            kinds = [kind] if kind else list(self._objects)
+            for k in kinds:
+                for obj in self._objects[k].values():
+                    fn("ADDED", k, obj)
+
+    def _notify(self, event: str, kind: str, obj: Any) -> None:
+        for k, fn in list(self._watchers):
+            if k is None or k == kind:
+                fn(event, kind, obj)
+
+
+# Canonical kind names
+PODS = "pods"
+NODES = "nodes"
+NODEPOOLS = "nodepools"
+NODECLAIMS = "nodeclaims"
+NODECLASSES = "nodeclasses"
+PDBS = "poddisruptionbudgets"
+DAEMONSETS = "daemonsets"

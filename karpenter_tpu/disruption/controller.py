@@ -1,0 +1,443 @@
+"""Disruption engine: drift, emptiness, multi- and single-node consolidation.
+
+The single-actor loop of karpenter core's disruption controller (SURVEY.md
+§2.1 disruption, §3.2; website/.../concepts/disruption.md;
+designs/consolidation.md:5-36, designs/deprovisioning.md:3-33):
+
+  - Methods evaluated in order Drift -> Emptiness -> MultiNodeConsolidation
+    -> SingleNodeConsolidation; ONE command executes per loop.
+  - Consolidation = delete (pods fit on remaining capacity) or replace
+    (remaining capacity + exactly one cheaper new node). Multi-node deletes
+    >=2 nodes with <=1 cheaper replacement, searching the largest
+    cost-ordered candidate prefix (heuristic subset, disruption.md:104-106)
+    via binary search.
+  - Spot->spot single-node replacement requires >=15 cheaper instance types
+    (disruption.md:133-137).
+  - Rate-limited by NodePool budgets (% or count per reason,
+    disruption.md:274-330; default nodes=10%).
+  - Control flow: taint karpenter.sh/disrupted, pre-spin replacements, wait
+    for initialization, then delete candidates; rollback on failed init
+    (disruption.md:15-28).
+  - Blockers: karpenter.sh/do-not-disrupt on pod or node, PDB-blocked
+    eviction, nominated nodes (disruption.md:335-409).
+
+Every simulation is a re-solve through the pluggable Solver backend — on the
+TPU backend, candidate subsets batch as a leading vmap axis (SURVEY.md §2.10
+"TPU-equivalent").
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..api import wellknown as wk
+from ..api.objects import Node, NodeClaim, NodePool, Pod, Taint
+from ..cloudprovider.types import CloudProvider
+from ..controllers import store as st
+from ..metrics.registry import DISRUPTION_DECISIONS, DISRUPTION_EVAL_DURATION
+from ..provisioning.provisioner import Provisioner
+from ..scheduling.requirements import IN, Requirement
+from ..solver.backend import Solver
+from ..state.cluster import Cluster
+from ..termination.controller import EvictionQueue
+
+
+@dataclass
+class Candidate:
+    claim: NodeClaim
+    node: Node
+    pods: List[Pod]
+    price: float
+    cost: float  # disruption cost (ranking key, ascending = disrupt first)
+
+
+@dataclass
+class Command:
+    method: str  # drifted | empty | multi-consolidation | single-consolidation
+    candidates: List[Candidate]
+    replacement_names: List[str] = field(default_factory=list)
+    created_at: float = 0.0
+
+
+class DisruptionController:
+    name = "disruption"
+
+    def __init__(
+        self,
+        store: st.Store,
+        cluster: Cluster,
+        cloud_provider: CloudProvider,
+        solver: Solver,
+        clock=time.monotonic,
+        replacement_timeout_s: float = 10 * 60,
+        multi_node_max_candidates: int = 100,
+    ):
+        self.store = store
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.solver = solver
+        self.clock = clock
+        self.eviction = EvictionQueue(store)
+        self.replacement_timeout_s = replacement_timeout_s
+        self.multi_node_max_candidates = multi_node_max_candidates
+        self._command: Optional[Command] = None
+        self._provisioner_helper: Optional[Provisioner] = None
+
+    # ------------------------------------------------------------------ main
+
+    def reconcile(self) -> bool:
+        if self._command is not None:
+            return self._progress_command()
+        candidates = self._candidates()
+        if not candidates:
+            return False
+        budgets = self._budget_allowance(candidates)
+        t0 = time.perf_counter()
+        for method in ("drifted", "empty", "multi-consolidation", "single-consolidation"):
+            cmd = self._evaluate(method, candidates, budgets)
+            if cmd is not None:
+                DISRUPTION_EVAL_DURATION.observe(time.perf_counter() - t0, method=method)
+                self._execute(cmd)
+                return True
+        DISRUPTION_EVAL_DURATION.observe(time.perf_counter() - t0, method="none")
+        return False
+
+    # ------------------------------------------------------------ candidates
+
+    def _candidates(self) -> List[Candidate]:
+        pods_by_node = self.cluster.bound_pods()
+        nodepools = {p.name: p for p in self.store.list(st.NODEPOOLS)}
+        out: List[Candidate] = []
+        for sn in self.cluster.state_nodes():
+            claim, node = sn.claim, sn.node
+            if claim is None or node is None:
+                continue
+            if not claim.initialized or claim.meta.deleting or node.meta.deleting:
+                continue
+            np_obj = nodepools.get(claim.nodepool)
+            if np_obj is None:
+                continue
+            if node.meta.annotations.get(wk.DO_NOT_DISRUPT_ANNOTATION) == "true":
+                continue
+            if self.cluster.is_nominated(node.meta.name):
+                continue
+            pods = pods_by_node.get(node.meta.name, [])
+            if any(p.meta.annotations.get(wk.DO_NOT_DISRUPT_ANNOTATION) == "true" for p in pods):
+                continue
+            if any(not self.eviction.can_evict(p) for p in pods if p.owner_kind != "DaemonSet"):
+                continue  # PDB-blocked (disruption.md:335-409)
+            resched = [p for p in pods if p.owner_kind != "DaemonSet"]
+            age = self.clock() - claim.meta.creation_timestamp
+            # disruption cost: fewer/cheaper-to-move pods first; ties by age
+            # (older first) then name for determinism
+            cost = float(
+                sum(1 + p.priority / 1000.0 for p in resched)
+            )
+            out.append(
+                Candidate(claim=claim, node=node, pods=resched, price=claim.price, cost=cost)
+            )
+        out.sort(key=lambda c: (c.cost, -(self.clock() - c.claim.meta.creation_timestamp), c.claim.name))
+        return out
+
+    # ---------------------------------------------------------------- budget
+
+    def _budget_allowance(self, candidates: List[Candidate]) -> Dict[Tuple[str, str], int]:
+        """(nodepool, reason) -> how many more nodes may be disrupted now
+        (disruption.md:274-330; default 10%)."""
+        nodepools = {p.name: p for p in self.store.list(st.NODEPOOLS)}
+        total_by_pool: Dict[str, int] = {}
+        disrupting_by_pool: Dict[str, int] = {}
+        for sn in self.cluster.state_nodes():
+            if sn.claim is None:
+                continue
+            pool = sn.claim.nodepool
+            total_by_pool[pool] = total_by_pool.get(pool, 0) + 1
+            if sn.claim.meta.deleting or (
+                sn.node is not None and any(t.key == wk.DISRUPTED_TAINT_KEY for t in sn.node.taints)
+            ):
+                disrupting_by_pool[pool] = disrupting_by_pool.get(pool, 0) + 1
+        out: Dict[Tuple[str, str], int] = {}
+        for pool_name, np_obj in nodepools.items():
+            total = total_by_pool.get(pool_name, 0)
+            disrupting = disrupting_by_pool.get(pool_name, 0)
+            for reason in ("Drifted", "Empty", "Underutilized"):
+                allowed = None
+                for b in np_obj.disruption.budgets:
+                    if b.reasons is not None and reason not in b.reasons:
+                        continue
+                    if b.nodes.endswith("%"):
+                        cap = math.ceil(total * int(b.nodes[:-1]) / 100.0)
+                    else:
+                        cap = int(b.nodes)
+                    allowed = cap if allowed is None else min(allowed, cap)
+                if allowed is None:
+                    allowed = math.ceil(total * 0.10)
+                out[(pool_name, reason)] = max(0, allowed - disrupting)
+        return out
+
+    @staticmethod
+    def _reason(method: str) -> str:
+        return {
+            "drifted": "Drifted",
+            "empty": "Empty",
+            "multi-consolidation": "Underutilized",
+            "single-consolidation": "Underutilized",
+        }[method]
+
+    def _within_budget(self, cands: Sequence[Candidate], method: str, budgets) -> bool:
+        reason = self._reason(method)
+        need: Dict[str, int] = {}
+        for c in cands:
+            need[c.claim.nodepool] = need.get(c.claim.nodepool, 0) + 1
+        return all(budgets.get((pool, reason), 0) >= n for pool, n in need.items())
+
+    # ------------------------------------------------------------- evaluate
+
+    def _evaluate(self, method: str, candidates: List[Candidate], budgets) -> Optional[Command]:
+        if method == "drifted":
+            for c in candidates:
+                if not c.claim.drifted:
+                    continue
+                if not self._within_budget([c], method, budgets):
+                    continue
+                ok, claim_res = self._simulate([c], allow_replacement=True, require_cheaper=False)
+                if ok:
+                    names = [self._create_replacement(claim_res)] if claim_res else []
+                    return Command(method, [c], replacement_names=names)
+            return None
+
+        if method == "empty":
+            policies = {p.name: p.disruption for p in self.store.list(st.NODEPOOLS)}
+            empties = []
+            for c in candidates:
+                if c.pods:
+                    continue
+                pol = policies.get(c.claim.nodepool)
+                if pol is None or pol.consolidation_policy not in (
+                    "WhenEmpty",
+                    "WhenEmptyOrUnderutilized",
+                ):
+                    continue
+                if self.clock() - c.claim.last_transition < pol.consolidate_after_s:
+                    continue
+                empties.append(c)
+            # batch all in-budget empties into one command (reference deletes
+            # empty nodes in bulk)
+            allowed = [c for c in empties if self._within_budget([c], method, budgets)]
+            picked: List[Candidate] = []
+            for c in allowed:
+                if self._within_budget(picked + [c], method, budgets):
+                    picked.append(c)
+            if picked:
+                return Command(method, picked)
+            return None
+
+        consolidatable = [
+            c
+            for c in candidates
+            if self._consolidation_enabled(c) and self._consolidate_after_ok(c)
+        ]
+        if method == "multi-consolidation":
+            pool = consolidatable[: self.multi_node_max_candidates]
+            # binary search the largest cost-ordered prefix that consolidates
+            # (>=2 deletes, <=1 cheaper replacement)
+            lo, hi = 2, len(pool)
+            best = None
+            while lo <= hi:
+                mid = (lo + hi) // 2
+                subset = pool[:mid]
+                if self._within_budget(subset, method, budgets):
+                    ok, claim_res = self._simulate(subset, allow_replacement=True, require_cheaper=True)
+                else:
+                    ok, claim_res = False, None
+                if ok:
+                    best = (subset, claim_res)
+                    lo = mid + 1
+                else:
+                    hi = mid - 1
+            if best is not None:
+                subset, claim_res = best
+                names = [self._create_replacement(claim_res)] if claim_res else []
+                return Command(method, subset, replacement_names=names)
+            return None
+
+        # single-node consolidation
+        for c in consolidatable:
+            if not self._within_budget([c], method, budgets):
+                continue
+            ok, claim_res = self._simulate([c], allow_replacement=True, require_cheaper=True)
+            if ok and self._spot_flexibility_ok_res(c, claim_res):
+                names = [self._create_replacement(claim_res)] if claim_res else []
+                return Command(method, [c], replacement_names=names)
+        return None
+
+    def _consolidation_enabled(self, c: Candidate) -> bool:
+        for p in self.store.list(st.NODEPOOLS):
+            if p.name == c.claim.nodepool:
+                return p.disruption.consolidation_policy == "WhenEmptyOrUnderutilized"
+        return False
+
+    def _consolidate_after_ok(self, c: Candidate) -> bool:
+        for p in self.store.list(st.NODEPOOLS):
+            if p.name == c.claim.nodepool:
+                return self.clock() - c.claim.last_transition >= p.disruption.consolidate_after_s
+        return False
+
+    def _spot_flexibility_ok_res(self, c: Candidate, claim_res) -> bool:
+        """Spot->spot replacement needs >=15 cheaper types (disruption.md:
+        133-137) so consolidation doesn't chase the spot market's tail."""
+        if c.claim.capacity_type != wk.CAPACITY_TYPE_SPOT or claim_res is None:
+            return True
+        ct = claim_res.requirements.get(wk.CAPACITY_TYPE_LABEL)
+        if ct is not None and not ct.has(wk.CAPACITY_TYPE_SPOT):
+            return True
+        return len(claim_res.instance_type_names) >= 15
+
+    # ------------------------------------------------------------- simulate
+
+    def _simulate(
+        self, cands: List[Candidate], allow_replacement: bool, require_cheaper: bool
+    ):
+        """Re-solve with the candidates' pods pending and the candidates
+        removed (SURVEY.md §3.2 HOT LOOP #2). Success iff nothing is
+        unschedulable, <=1 new claim results, and (if required) the
+        replacement is cheaper than the removed capacity. Returns
+        (ok, claim_result_or_None); the caller materializes the replacement
+        NodeClaim only for the command it actually executes (binary-search
+        probes must not leak claims)."""
+        if self._provisioner_helper is None:
+            self._provisioner_helper = Provisioner(
+                self.store, self.cluster, self.cloud_provider, self.solver,
+                batch_idle_s=0, batch_max_s=0, clock=self.clock,
+            )
+        import dataclasses
+
+        # simulate the candidates' pods as pending (they are bound right now;
+        # the scheduler rightly ignores bound pods)
+        pods = [
+            dataclasses.replace(p, node_name=None, phase="Pending")
+            for c in cands
+            for p in c.pods
+        ]
+        removed = {c.node.meta.name for c in cands}
+        inp = self._provisioner_helper.build_input(pods)
+        inp.nodes = [n for n in inp.nodes if n.id not in removed]
+        result = self.solver.solve(inp)
+        if result.errors:
+            return False, None
+        if len(result.claims) > 1:
+            return False, None
+        if not allow_replacement and result.claims:
+            return False, None
+        if result.claims:
+            claim_res = result.claims[0]
+            if require_cheaper:
+                new_price = self._min_price(claim_res)
+                old_price = sum(c.price for c in cands)
+                if new_price is None or new_price >= old_price:
+                    return False, None
+            return True, claim_res
+        return True, None
+
+    def _min_price(self, claim_res) -> Optional[float]:
+        types = {it.name: it for it in self.cloud_provider.get_instance_types("")}
+        best = None
+        for tn in claim_res.instance_type_names:
+            it = types.get(tn)
+            if it is None:
+                continue
+            o = it.cheapest_available(claim_res.requirements)
+            if o is not None and (best is None or o.price < best):
+                best = o.price
+        return best
+
+    def _create_replacement(self, claim_res) -> str:
+        nodepools = {p.name: p for p in self.store.list(st.NODEPOOLS)}
+        np_obj = nodepools[claim_res.nodepool]
+        self._provisioner_helper._claim_seq += 1
+        name = f"{claim_res.nodepool}-r{self._provisioner_helper._claim_seq:05d}"
+        reqs = type(claim_res.requirements)(claim_res.requirements)
+        reqs.add(Requirement.create(wk.INSTANCE_TYPE_LABEL, IN, claim_res.instance_type_names))
+        from ..api.objects import NodeClaim, ObjectMeta
+
+        claim = NodeClaim(
+            meta=ObjectMeta(
+                name=name,
+                labels={wk.NODEPOOL_LABEL: claim_res.nodepool},
+                finalizers=[wk.TERMINATION_FINALIZER],
+            ),
+            nodepool=claim_res.nodepool,
+            node_class_ref=np_obj.template.node_class_ref,
+            requirements=reqs,
+            resource_requests=claim_res.requests,
+            taints=list(np_obj.template.taints),
+            startup_taints=list(np_obj.template.startup_taints),
+            expire_after_s=np_obj.template.expire_after_s,
+            instance_type_options=list(claim_res.instance_type_names),
+        )
+        self.store.create(st.NODECLAIMS, claim)
+        return name
+
+    # -------------------------------------------------------------- execute
+
+    def _execute(self, cmd: Command) -> None:
+        for c in cmd.candidates:
+            node = self.store.try_get(st.NODES, c.node.meta.name)
+            if node is not None and not any(t.key == wk.DISRUPTED_TAINT_KEY for t in node.taints):
+                node.taints.append(Taint(key=wk.DISRUPTED_TAINT_KEY, effect=wk.EFFECT_NO_SCHEDULE))
+                node.unschedulable = True
+                self.store.update(st.NODES, node)
+        cmd.created_at = self.clock()
+        self._command = cmd
+        DISRUPTION_DECISIONS.inc(decision="delete" if not cmd.replacement_names else "replace",
+                                 reason=self._reason(cmd.method))
+        if not cmd.replacement_names:
+            self._finish_command()  # no replacement to wait for
+
+    def _progress_command(self) -> bool:
+        cmd = self._command
+        assert cmd is not None
+        replacements = [self.store.try_get(st.NODECLAIMS, n) for n in cmd.replacement_names]
+        if any(r is None for r in replacements):
+            self._rollback("replacement disappeared")
+            return True
+        if all(r.initialized for r in replacements):
+            self._finish_command()
+            return True
+        if self.clock() - cmd.created_at > self.replacement_timeout_s:
+            self._rollback("replacement failed to initialize in time")
+            return True
+        return False  # keep waiting
+
+    def _finish_command(self) -> None:
+        cmd = self._command
+        self._command = None
+        if cmd is None:
+            return
+        for c in cmd.candidates:
+            try:
+                self.store.delete(st.NODECLAIMS, c.claim.name)
+            except st.NotFound:
+                pass
+
+    def _rollback(self, why: str) -> None:
+        """Untaint candidates; delete replacements (disruption.md:15-28)."""
+        cmd = self._command
+        self._command = None
+        if cmd is None:
+            return
+        for c in cmd.candidates:
+            node = self.store.try_get(st.NODES, c.node.meta.name)
+            if node is not None:
+                node.taints = [t for t in node.taints if t.key != wk.DISRUPTED_TAINT_KEY]
+                node.unschedulable = False
+                self.store.update(st.NODES, node)
+        for name in cmd.replacement_names:
+            if self.store.try_get(st.NODECLAIMS, name) is not None:
+                try:
+                    self.store.delete(st.NODECLAIMS, name)
+                except st.NotFound:
+                    pass

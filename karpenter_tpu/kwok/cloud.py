@@ -1,0 +1,222 @@
+"""kwok fake cloud: the hermetic benchmark substrate.
+
+Behavioral mirror of the reference's in-memory EC2 (kwok/ec2/ec2.go:55-110,
+374-628): CreateFleet picks the lowest-price override (kwok/strategy/
+strategy.go:28-60), fabricates an instance record, and **directly creates the
+Node object** in the store with kwok labels, the unregistered taint, and
+capacity/allocatable from the instance-type model (ec2.go:865-897 toNode) —
+so nodes run kubelet-less and the whole control loop closes without real
+hardware. A node-killer purges Nodes whose instance vanished
+(ec2.go:219-262); per-API token buckets mimic EC2 throttling.
+
+Fault injection mirrors pkg/fake/ec2api.go:41-76: capacity pools that, when
+exhausted, produce InsufficientCapacity fleet errors for specific
+(instance-type, zone, capacity-type) offerings.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..api import wellknown as wk
+from ..api.objects import Node, ObjectMeta, Taint
+from ..cloudprovider.types import InstanceType
+from ..controllers import store as st
+from ..utils.resources import Resources
+from .ratelimit import ApiLimits
+
+KWOK_LABEL_KEY = "kwok.x-k8s.io/node"
+KWOK_LABEL_VALUE = "fake"
+KWOK_PARTITION_LABEL_KEY = "kwok-partition"
+
+
+@dataclass
+class FleetOverride:
+    instance_type: str
+    zone: str
+    capacity_type: str
+    price: float
+
+
+@dataclass
+class Instance:
+    id: str
+    instance_type: str
+    zone: str
+    capacity_type: str
+    price: float
+    tags: Dict[str, str] = field(default_factory=dict)
+    state: str = "running"  # running | shutting-down | terminated
+    launch_time: float = field(default_factory=time.monotonic)
+    node_name: str = ""
+
+
+@dataclass
+class FleetError:
+    instance_type: str
+    zone: str
+    capacity_type: str
+    code: str  # InsufficientInstanceCapacity | ...
+    message: str = ""
+
+
+class KwokCloud:
+    """In-memory cloud with direct Node fabrication."""
+
+    def __init__(
+        self,
+        store: st.Store,
+        instance_types: Sequence[InstanceType],
+        rate_limits: bool = False,
+        auto_register_delay_s: float = 0.0,
+    ):
+        self.store = store
+        self.types = {it.name: it for it in instance_types}
+        self.limits = ApiLimits(enabled=rate_limits)
+        self.auto_register_delay_s = auto_register_delay_s
+        self._instances: Dict[str, Instance] = {}
+        self._lock = threading.RLock()
+        self._seq = itertools.count(1)
+        # fault injection: capacity pools keyed (type, zone, capacity_type);
+        # -1 = unlimited
+        self._capacity_pools: Dict[Tuple[str, str, str], int] = {}
+
+    # -- fault injection ----------------------------------------------------
+
+    def set_capacity(self, instance_type: str, zone: str, capacity_type: str, count: int) -> None:
+        with self._lock:
+            self._capacity_pools[(instance_type, zone, capacity_type)] = count
+
+    def _take_capacity(self, key: Tuple[str, str, str]) -> bool:
+        cur = self._capacity_pools.get(key, -1)
+        if cur < 0:
+            return True
+        if cur == 0:
+            return False
+        self._capacity_pools[key] = cur - 1
+        return True
+
+    # -- fleet API ----------------------------------------------------------
+
+    def create_fleet(
+        self, overrides: Sequence[FleetOverride], tags: Optional[Dict[str, str]] = None
+    ) -> Tuple[Optional[Instance], List[FleetError]]:
+        """Launch ONE instance choosing the lowest-price override (the
+        reference strategy), walking up the price list past ICE'd offerings."""
+        self.limits.mutating.take_or_raise("CreateFleet")
+        errors: List[FleetError] = []
+        with self._lock:
+            for ov in sorted(overrides, key=lambda o: (o.price, o.instance_type, o.zone)):
+                key = (ov.instance_type, ov.zone, ov.capacity_type)
+                if ov.instance_type not in self.types:
+                    errors.append(FleetError(*key, code="InvalidParameterValue"))
+                    continue
+                if not self._take_capacity(key):
+                    errors.append(
+                        FleetError(*key, code="InsufficientInstanceCapacity",
+                                   message="We currently do not have sufficient capacity")
+                    )
+                    continue
+                inst = Instance(
+                    id=f"i-{next(self._seq):017x}",
+                    instance_type=ov.instance_type,
+                    zone=ov.zone,
+                    capacity_type=ov.capacity_type,
+                    price=ov.price,
+                    tags=dict(tags or {}),
+                )
+                self._instances[inst.id] = inst
+                self._create_node(inst)
+                return inst, errors
+        return None, errors
+
+    # -- node fabrication (ec2.go:865-897 toNode) ---------------------------
+
+    def _create_node(self, inst: Instance) -> None:
+        it = self.types[inst.instance_type]
+        name = f"kwok-{inst.id}"
+        inst.node_name = name
+        labels = {
+            KWOK_LABEL_KEY: KWOK_LABEL_VALUE,
+            wk.INSTANCE_TYPE_LABEL: inst.instance_type,
+            wk.ZONE_LABEL: inst.zone,
+            wk.CAPACITY_TYPE_LABEL: inst.capacity_type,
+            wk.HOSTNAME_LABEL: name,
+            wk.REGION_LABEL: "region-1",
+        }
+        for key, req in it.requirements.items():
+            vals = req.values_list()
+            if len(vals) == 1 and key not in labels:
+                labels[key] = vals[0]
+        node = Node(
+            meta=ObjectMeta(
+                name=name,
+                labels=labels,
+                annotations={},
+            ),
+            capacity=Resources(it.capacity),
+            allocatable=it.allocatable(),
+            taints=[Taint(key=wk.UNREGISTERED_TAINT_KEY, effect=wk.EFFECT_NO_EXECUTE)],
+            ready=False,
+            provider_id=f"kwok:///{inst.zone}/{inst.id}",
+        )
+        self.store.create(st.NODES, node)
+
+    # -- describe/terminate --------------------------------------------------
+
+    def describe_instances(self, ids: Optional[Sequence[str]] = None) -> List[Instance]:
+        self.limits.non_mutating.take_or_raise("DescribeInstances")
+        with self._lock:
+            if ids is None:
+                return [i for i in self._instances.values() if i.state != "terminated"]
+            return [
+                self._instances[i]
+                for i in ids
+                if i in self._instances and self._instances[i].state != "terminated"
+            ]
+
+    def terminate_instances(self, ids: Sequence[str]) -> List[str]:
+        self.limits.terminate.take_or_raise("TerminateInstances")
+        done = []
+        with self._lock:
+            for iid in ids:
+                inst = self._instances.get(iid)
+                if inst is None or inst.state == "terminated":
+                    continue
+                inst.state = "terminated"
+                done.append(iid)
+                # node-killer: purge the Node backing a vanished instance
+                if inst.node_name and self.store.try_get(st.NODES, inst.node_name):
+                    node = self.store.get(st.NODES, inst.node_name)
+                    node.meta.finalizers = [
+                        f for f in node.meta.finalizers if f != wk.TERMINATION_FINALIZER
+                    ]
+                    try:
+                        self.store.delete(st.NODES, inst.node_name)
+                    except st.NotFound:
+                        pass
+        return done
+
+    def create_tags(self, instance_id: str, tags: Dict[str, str]) -> None:
+        self.limits.tags.take_or_raise("CreateTags")
+        with self._lock:
+            inst = self._instances.get(instance_id)
+            if inst:
+                inst.tags.update(tags)
+
+    # -- registration simulation (kwok nodes have no kubelet) ---------------
+
+    def register_node(self, node_name: str) -> bool:
+        """Flip a fabricated node to Ready and drop the unregistered taint —
+        what kubelet+node-lifecycle would do on a real node."""
+        node = self.store.try_get(st.NODES, node_name)
+        if node is None:
+            return False
+        node.taints = [t for t in node.taints if t.key != wk.UNREGISTERED_TAINT_KEY]
+        node.ready = True
+        self.store.update(st.NODES, node)
+        return True

@@ -1,0 +1,196 @@
+"""kwok CloudProvider: the L2 adapter backed by the fake cloud.
+
+Implements the CloudProvider contract (pkg/cloudprovider/cloudprovider.go:
+56-305 behaviorally) against KwokCloud:
+
+- create(): instance-type options filtered by claim requirements →
+  truncate(60) (pkg/providers/instance/instance.go:60) → offerings expanded
+  to fleet overrides (cross-product, instance.go:399-448) → lowest-price
+  CreateFleet → fleet ICE errors feed the UnavailableOfferings cache
+  (instance.go:450-486) → claim status filled from the launched instance.
+- delete(): skip if already shutting down (instance.go:203-221).
+- get_instance_types(): catalog with ICE-masked offering availability.
+- is_drifted(): nodeclass-hash drift (drift.go:34-74 behaviorally).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from ..api import wellknown as wk
+from ..api.objects import NodeClaim
+from ..cloudprovider.types import (
+    CloudProvider,
+    InstanceType,
+    InsufficientCapacityError,
+    NodeClaimNotFoundError,
+    Offering,
+    truncate,
+)
+from ..providers.unavailable import UnavailableOfferings
+from ..scheduling.requirements import Requirements
+from ..utils.resources import Resources
+from .cloud import FleetOverride, KwokCloud
+
+
+class KwokCloudProvider(CloudProvider):
+    def __init__(
+        self,
+        cloud: KwokCloud,
+        instance_types: Sequence[InstanceType],
+        unavailable: Optional[UnavailableOfferings] = None,
+        max_launch_types: int = 60,
+    ):
+        self.cloud = cloud
+        self._types = list(instance_types)
+        self._by_name = {it.name: it for it in instance_types}
+        self.unavailable = unavailable or UnavailableOfferings()
+        self.max_launch_types = max_launch_types
+        self._lock = threading.Lock()
+        self._ice_seq = -1
+        self._masked_cache: List[InstanceType] = []
+
+    # -- instance types -----------------------------------------------------
+
+    def get_instance_types(self, nodepool_name: str = "") -> List[InstanceType]:
+        """Catalog with per-offering availability masked by the ICE cache.
+        Rebuilt only when the ICE SeqNum moves (offering/offering.go:181-199
+        cache-key protocol)."""
+        with self._lock:
+            seq = self.unavailable.seq_num
+            if seq == self._ice_seq:
+                return self._masked_cache
+            out: List[InstanceType] = []
+            for it in self._types:
+                offerings = [
+                    Offering(
+                        zone=o.zone,
+                        capacity_type=o.capacity_type,
+                        price=o.price,
+                        available=o.available
+                        and not self.unavailable.is_unavailable(o.capacity_type, it.name, o.zone),
+                        reservation_capacity=o.reservation_capacity,
+                        reservation_id=o.reservation_id,
+                    )
+                    for o in it.offerings
+                ]
+                out.append(
+                    InstanceType(
+                        name=it.name,
+                        requirements=it.requirements,
+                        capacity=it.capacity,
+                        overhead=it.overhead,
+                        offerings=offerings,
+                    )
+                )
+            self._ice_seq = seq
+            self._masked_cache = out
+            return out
+
+    # -- create -------------------------------------------------------------
+
+    def create(self, claim: NodeClaim, instance_type_names: Optional[Sequence[str]] = None) -> NodeClaim:
+        types = self.get_instance_types(claim.nodepool)
+        by_name = {it.name: it for it in types}
+        candidates = (
+            [by_name[n] for n in instance_type_names if n in by_name]
+            if instance_type_names
+            else types
+        )
+        reqs = claim.requirements
+        compatible = [
+            it
+            for it in candidates
+            if reqs.compatible(it.requirements) and it.available(reqs)
+        ]
+        if not compatible:
+            raise InsufficientCapacityError("no compatible offering is available")
+        kept = truncate(compatible, reqs, self.max_launch_types)
+        overrides: List[FleetOverride] = []
+        for it in kept:
+            for o in it.offerings:
+                if not o.available:
+                    continue
+                if not reqs.compatible(o.requirements()):
+                    continue
+                overrides.append(
+                    FleetOverride(
+                        instance_type=it.name,
+                        zone=o.zone,
+                        capacity_type=o.capacity_type,
+                        price=o.price,
+                    )
+                )
+        if not overrides:
+            raise InsufficientCapacityError("no launchable offering after filtering")
+        inst, errors = self.cloud.create_fleet(
+            overrides, tags={"karpenter.sh/nodeclaim": claim.name}
+        )
+        for err in errors:
+            if err.code == "InsufficientInstanceCapacity":
+                self.unavailable.mark_unavailable(err.capacity_type, err.instance_type, err.zone)
+        if inst is None:
+            raise InsufficientCapacityError(
+                f"all {len(overrides)} offerings failed",
+                offerings=[(e.instance_type, e.zone, e.capacity_type) for e in errors],
+            )
+        it = self._by_name[inst.instance_type]
+        claim.provider_id = f"kwok:///{inst.zone}/{inst.id}"
+        claim.instance_type = inst.instance_type
+        claim.zone = inst.zone
+        claim.capacity_type = inst.capacity_type
+        claim.price = inst.price
+        claim.capacity = Resources(it.capacity)
+        claim.allocatable = it.allocatable()
+        claim.node_name = inst.node_name
+        claim.launched = True
+        return claim
+
+    # -- get/list/delete ----------------------------------------------------
+
+    @staticmethod
+    def _instance_id(provider_id: str) -> str:
+        return provider_id.rsplit("/", 1)[-1]
+
+    def get(self, provider_id: str) -> NodeClaim:
+        insts = self.cloud.describe_instances([self._instance_id(provider_id)])
+        if not insts:
+            raise NodeClaimNotFoundError(provider_id)
+        return self._to_claim(insts[0])
+
+    def list(self) -> List[NodeClaim]:
+        return [self._to_claim(i) for i in self.cloud.describe_instances()]
+
+    def delete(self, claim: NodeClaim) -> None:
+        iid = self._instance_id(claim.provider_id)
+        insts = self.cloud.describe_instances([iid])
+        if not insts:
+            raise NodeClaimNotFoundError(claim.provider_id)
+        if insts[0].state == "shutting-down":
+            return  # already terminating (instance.go:203-221 dedup)
+        self.cloud.terminate_instances([iid])
+
+    def _to_claim(self, inst) -> NodeClaim:
+        from ..api.objects import ObjectMeta
+
+        it = self._by_name.get(inst.instance_type)
+        claim = NodeClaim(
+            meta=ObjectMeta(name=inst.tags.get("karpenter.sh/nodeclaim", inst.id)),
+            provider_id=f"kwok:///{inst.zone}/{inst.id}",
+            instance_type=inst.instance_type,
+            zone=inst.zone,
+            capacity_type=inst.capacity_type,
+            price=inst.price,
+            launched=True,
+        )
+        if it is not None:
+            claim.capacity = Resources(it.capacity)
+            claim.allocatable = it.allocatable()
+        claim.node_name = inst.node_name
+        return claim
+
+    # -- drift --------------------------------------------------------------
+
+    def is_drifted(self, claim: NodeClaim) -> Optional[str]:
+        return claim.drifted

@@ -1,0 +1,61 @@
+"""Token-bucket rate limiters mimicking cloud API throttling.
+
+Mirrors the reference's kwok per-API token buckets (kwok/ec2/ratelimiting.go:
+86-107: non-mutating 20/100, mutating 5/50, TerminateInstances 5/100,
+CreateTags 10/100) so the hermetic benchmark exercises the same backpressure
+the real cloud applies. A Nop limiter exists for pure-throughput benches
+(ratelimiting.go:33-60).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class ThrottleError(Exception):
+    """Equivalent of EC2 RequestLimitExceeded."""
+
+
+class TokenBucket:
+    def __init__(self, rate: float, burst: int):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._tokens = float(burst)
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def try_take(self, n: float = 1.0) -> bool:
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(self.burst, self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+    def take_or_raise(self, api: str) -> None:
+        if not self.try_take():
+            raise ThrottleError(f"rate limit exceeded for {api}")
+
+
+class NopLimiter:
+    def try_take(self, n: float = 1.0) -> bool:
+        return True
+
+    def take_or_raise(self, api: str) -> None:
+        return None
+
+
+class ApiLimits:
+    """The reference's per-API-class buckets."""
+
+    def __init__(self, enabled: bool = True):
+        if enabled:
+            self.non_mutating = TokenBucket(20, 100)
+            self.mutating = TokenBucket(5, 50)
+            self.terminate = TokenBucket(5, 100)
+            self.tags = TokenBucket(10, 100)
+        else:
+            self.non_mutating = self.mutating = self.terminate = self.tags = NopLimiter()

@@ -1,0 +1,176 @@
+"""NodeClaim lifecycle: launch -> register -> initialize (+liveness, expiry).
+
+The NodeClaim state machine of karpenter core (SURVEY.md §2.1 "node
+lifecycle"; website/.../concepts/nodeclaims.md):
+
+  Create() -> launched (cloud capacity exists)
+          -> registered (node joined; unregistered taint removed)
+          -> initialized (startup taints cleared, resources posted)
+
+plus liveness GC for claims whose node never registers, and forced expiry
+(`expireAfter`). Launch failures with InsufficientCapacityError delete the
+claim so the provisioner re-solves against the updated ICE mask — the
+"retry in milliseconds" loop (concepts/_index.md:89).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..api import wellknown as wk
+from ..api.objects import NodeClaim
+from ..cloudprovider.types import CloudProvider, InsufficientCapacityError
+from ..controllers import store as st
+from ..metrics.registry import NODECLAIMS_CREATED, NODECLAIMS_TERMINATED
+
+
+class LaunchController:
+    name = "nodeclaim.launch"
+
+    def __init__(self, store: st.Store, cloud_provider: CloudProvider):
+        self.store = store
+        self.cloud_provider = cloud_provider
+
+    def reconcile(self) -> bool:
+        did = False
+        for claim in self.store.list(st.NODECLAIMS):
+            if claim.launched or claim.meta.deleting:
+                continue
+            try:
+                self.cloud_provider.create(claim, claim.instance_type_options)
+                NODECLAIMS_CREATED.inc(nodepool=claim.nodepool)
+            except InsufficientCapacityError:
+                # ICE: delete the claim; the provisioner re-solves with the
+                # failed offerings masked (instance.go:450-486 flow)
+                claim.meta.finalizers = []
+                self.store.update(st.NODECLAIMS, claim)
+                try:
+                    self.store.delete(st.NODECLAIMS, claim.name)
+                except st.NotFound:
+                    pass
+                NODECLAIMS_TERMINATED.inc(nodepool=claim.nodepool, reason="insufficient_capacity")
+                did = True
+                continue
+            claim.last_transition = time.monotonic()
+            self.store.update(st.NODECLAIMS, claim)
+            did = True
+        return did
+
+
+class RegistrationController:
+    """Remove the unregistered taint and adopt the node once it appears
+    (core lifecycle: registration — the kwok node was fabricated with
+    karpenter.sh/unregistered:NoExecute, kwok/ec2/ec2.go:865-897)."""
+
+    name = "nodeclaim.registration"
+
+    def __init__(self, store: st.Store, clock=time.monotonic):
+        self.store = store
+        self.clock = clock
+
+    def reconcile(self) -> bool:
+        did = False
+        for claim in self.store.list(st.NODECLAIMS):
+            if not claim.launched or claim.registered or claim.meta.deleting:
+                continue
+            if not claim.node_name:
+                continue
+            node = self.store.try_get(st.NODES, claim.node_name)
+            if node is None:
+                continue
+            node.taints = [t for t in node.taints if t.key != wk.UNREGISTERED_TAINT_KEY]
+            node.taints.extend(claim.taints)
+            node.taints.extend(claim.startup_taints)
+            node.meta.labels[wk.NODEPOOL_LABEL] = claim.nodepool
+            node.meta.labels[wk.REGISTERED_LABEL] = "true"
+            for k, v in claim.requirements.labels().items():
+                node.meta.labels.setdefault(k, v)
+            if wk.TERMINATION_FINALIZER not in node.meta.finalizers:
+                node.meta.finalizers.append(wk.TERMINATION_FINALIZER)
+            node.ready = True
+            self.store.update(st.NODES, node)
+            claim.registered = True
+            claim.last_transition = self.clock()
+            self.store.update(st.NODECLAIMS, claim)
+            did = True
+        return did
+
+
+class InitializationController:
+    """registered -> initialized once startup taints are gone and the node
+    posts capacity (core lifecycle: initialization)."""
+
+    name = "nodeclaim.initialization"
+
+    def __init__(self, store: st.Store, clock=time.monotonic):
+        self.store = store
+        self.clock = clock
+
+    def reconcile(self) -> bool:
+        did = False
+        for claim in self.store.list(st.NODECLAIMS):
+            if not claim.registered or claim.initialized or claim.meta.deleting:
+                continue
+            node = self.store.try_get(st.NODES, claim.node_name) if claim.node_name else None
+            if node is None or not node.ready:
+                continue
+            startup_keys = {t.key for t in claim.startup_taints}
+            if any(t.key in startup_keys for t in node.taints):
+                continue
+            if not node.allocatable:
+                continue
+            node.meta.labels[wk.INITIALIZED_LABEL] = "true"
+            self.store.update(st.NODES, node)
+            claim.initialized = True
+            claim.last_transition = self.clock()
+            self.store.update(st.NODECLAIMS, claim)
+            did = True
+        return did
+
+
+class LivenessController:
+    """Delete claims whose node never registered within the TTL (core
+    liveness GC; reference default 15m)."""
+
+    name = "nodeclaim.liveness"
+
+    def __init__(self, store: st.Store, ttl_s: float = 15 * 60, clock=time.monotonic):
+        self.store = store
+        self.ttl_s = ttl_s
+        self.clock = clock
+
+    def reconcile(self) -> bool:
+        did = False
+        for claim in self.store.list(st.NODECLAIMS):
+            if claim.registered or claim.meta.deleting:
+                continue
+            if self.clock() - claim.last_transition < self.ttl_s:
+                continue
+            self.store.delete(st.NODECLAIMS, claim.name)
+            NODECLAIMS_TERMINATED.inc(nodepool=claim.nodepool, reason="liveness")
+            did = True
+        return did
+
+
+class ExpirationController:
+    """Forceful expiry after `expireAfter` (disruption.md:208-234 'expiration
+    is forceful; it does not wait for replacement')."""
+
+    name = "nodeclaim.expiration"
+
+    def __init__(self, store: st.Store, clock=time.monotonic):
+        self.store = store
+        self.clock = clock
+
+    def reconcile(self) -> bool:
+        did = False
+        for claim in self.store.list(st.NODECLAIMS):
+            if claim.meta.deleting or claim.expire_after_s is None:
+                continue
+            if self.clock() - claim.meta.creation_timestamp < claim.expire_after_s:
+                continue
+            self.store.delete(st.NODECLAIMS, claim.name)
+            NODECLAIMS_TERMINATED.inc(nodepool=claim.nodepool, reason="expired")
+            did = True
+        return did

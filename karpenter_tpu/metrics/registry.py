@@ -1,0 +1,222 @@
+"""Prometheus-style metrics registry.
+
+Series names follow the reference's documented metrics
+(website/content/en/preview/reference/metrics.md) so dashboards translate:
+karpenter_scheduler_scheduling_duration_seconds (metrics.md:190-194),
+karpenter_scheduler_queue_depth (:196-198), karpenter_voluntary_disruption_*
+(:168-188), karpenter_cloudprovider_* (:298-322), batcher series (:324-332).
+Text exposition format is Prometheus-compatible.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        return tuple(labels.get(k, "") for k in self.label_names)
+
+
+class Counter(_Metric):
+    def __init__(self, name, help_, label_names=()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        with self._lock:
+            k = self._key(labels)
+            self._values[k] = self._values.get(k, 0.0) + value
+
+    def value(self, **labels) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for k, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(self.label_names, k)} {v}")
+        return out
+
+
+class Gauge(_Metric):
+    def __init__(self, name, help_, label_names=()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = float(value)
+
+    def add(self, value: float, **labels) -> None:
+        with self._lock:
+            k = self._key(labels)
+            self._values[k] = self._values.get(k, 0.0) + value
+
+    def value(self, **labels) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        for k, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(self.label_names, k)} {v}")
+        return out
+
+
+_DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60)
+
+
+class Histogram(_Metric):
+    def __init__(self, name, help_, label_names=(), buckets: Sequence[float] = _DEFAULT_BUCKETS):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(buckets)
+        self._counts: Dict[Tuple[str, ...], List[int]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+        self._totals: Dict[Tuple[str, ...], int] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        with self._lock:
+            k = self._key(labels)
+            counts = self._counts.setdefault(k, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._totals[k] = self._totals.get(k, 0) + 1
+
+    def count(self, **labels) -> int:
+        return self._totals.get(self._key(labels), 0)
+
+    def sum(self, **labels) -> float:
+        return self._sums.get(self._key(labels), 0.0)
+
+    def percentile(self, q: float, **labels) -> float:
+        """Approximate quantile from bucket counts (upper-bound estimate)."""
+        k = self._key(labels)
+        total = self._totals.get(k, 0)
+        if total == 0:
+            return math.nan
+        target = q * total
+        cum = 0
+        counts = self._counts.get(k, [])
+        for i, b in enumerate(self.buckets):
+            cum = counts[i]
+            if cum >= target:
+                return b
+        return math.inf
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        for k in sorted(self._totals):
+            for i, b in enumerate(self.buckets):
+                lbl = _fmt_labels(self.label_names + ("le",), k + (_fmt_float(b),))
+                out.append(f"{self.name}_bucket{lbl} {self._counts[k][i]}")
+            lbl_inf = _fmt_labels(self.label_names + ("le",), k + ("+Inf",))
+            out.append(f"{self.name}_bucket{lbl_inf} {self._totals[k]}")
+            out.append(f"{self.name}_sum{_fmt_labels(self.label_names, k)} {self._sums[k]}")
+            out.append(f"{self.name}_count{_fmt_labels(self.label_names, k)} {self._totals[k]}")
+        return out
+
+
+def _fmt_float(b: float) -> str:
+    return f"{b:g}"
+
+
+def _fmt_labels(names: Sequence[str], values: Sequence[str]) -> str:
+    pairs = [f'{n}="{v}"' for n, v in zip(names, values) if v != "" or n == "le"]
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+class Registry:
+    def __init__(self):
+        self.metrics: List[_Metric] = []
+
+    def register(self, m):
+        self.metrics.append(m)
+        return m
+
+    def expose(self) -> str:
+        lines: List[str] = []
+        for m in self.metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+# -- the reference's documented series (metrics.md) --------------------------
+
+PROVISIONER_SCHEDULING_DURATION = REGISTRY.register(
+    Histogram(
+        "karpenter_scheduler_scheduling_duration_seconds",
+        "Duration of scheduling simulations (metrics.md:190-194)",
+    )
+)
+SCHEDULER_QUEUE_DEPTH = REGISTRY.register(
+    Gauge("karpenter_scheduler_queue_depth", "Pending pods awaiting scheduling (metrics.md:196-198)")
+)
+NODECLAIMS_CREATED = REGISTRY.register(
+    Counter("karpenter_nodeclaims_created_total", "NodeClaims created", ("nodepool",))
+)
+NODECLAIMS_TERMINATED = REGISTRY.register(
+    Counter("karpenter_nodeclaims_terminated_total", "NodeClaims terminated", ("nodepool", "reason"))
+)
+DISRUPTION_EVAL_DURATION = REGISTRY.register(
+    Histogram(
+        "karpenter_voluntary_disruption_decision_evaluation_duration_seconds",
+        "Disruption decision evaluation latency (metrics.md:182-184)",
+        ("method",),
+    )
+)
+DISRUPTION_DECISIONS = REGISTRY.register(
+    Counter(
+        "karpenter_voluntary_disruption_decisions_total",
+        "Disruption decisions executed (metrics.md:168-188)",
+        ("decision", "reason"),
+    )
+)
+CLOUDPROVIDER_DURATION = REGISTRY.register(
+    Histogram(
+        "karpenter_cloudprovider_duration_seconds",
+        "CloudProvider method latency (metrics.md:298-322)",
+        ("method",),
+    )
+)
+CLOUDPROVIDER_ERRORS = REGISTRY.register(
+    Counter(
+        "karpenter_cloudprovider_errors_total",
+        "CloudProvider errors (metrics.md:298-322)",
+        ("method", "error"),
+    )
+)
+BATCHER_BATCH_SIZE = REGISTRY.register(
+    Histogram(
+        "karpenter_cloudprovider_batcher_batch_size",
+        "Request batch sizes (metrics.md:324-332)",
+        ("batcher",),
+        buckets=(1, 2, 5, 10, 25, 50, 100, 250, 500, 1000),
+    )
+)
+BATCHER_BATCH_TIME = REGISTRY.register(
+    Histogram(
+        "karpenter_cloudprovider_batcher_batch_time_seconds",
+        "Batch window durations (metrics.md:324-332)",
+        ("batcher",),
+    )
+)
+CLUSTER_STATE_NODE_COUNT = REGISTRY.register(
+    Gauge("karpenter_cluster_state_node_count", "Nodes tracked in cluster state (metrics.md:286-296)")
+)
+PODS_UNSCHEDULABLE = REGISTRY.register(
+    Gauge("karpenter_pods_state", "Pod scheduling states", ("state",))
+)
+ICE_CACHE_SIZE = REGISTRY.register(
+    Gauge("karpenter_unavailable_offerings_count", "ICE-cached unavailable offerings")
+)

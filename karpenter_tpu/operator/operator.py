@@ -1,0 +1,96 @@
+"""Operator: dependency wiring for the full control loop.
+
+The stand-in for cmd/controller/main.go + kwok/main.go (SURVEY.md §3.5):
+builds the store, fake cloud, cloud provider, cluster state, solver backend,
+and registers every controller on the deterministic manager. `new_kwok_operator`
+is the hermetic configuration used by tests and benchmarks (the reference's
+kwok binary, kwok/main.go:32-100).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from ..catalog.catalog import CatalogSpec, generate
+from ..cloudprovider.types import InstanceType
+from ..controllers import store as st
+from ..controllers.binder import Binder
+from ..controllers.garbagecollection import GarbageCollectionController
+from ..controllers.manager import Manager
+from ..kwok.cloud import KwokCloud
+from ..kwok.cloudprovider import KwokCloudProvider
+from ..lifecycle.controller import (
+    ExpirationController,
+    InitializationController,
+    LaunchController,
+    LivenessController,
+    RegistrationController,
+)
+from ..provisioning.provisioner import Provisioner
+from ..solver.backend import ReferenceSolver, Solver, TPUSolver
+from ..state.cluster import Cluster
+from ..termination.controller import TerminationController
+
+
+@dataclass
+class Operator:
+    store: st.Store
+    cloud: KwokCloud
+    cloud_provider: KwokCloudProvider
+    cluster: Cluster
+    provisioner: Provisioner
+    manager: Manager
+    solver: Solver
+
+
+def new_kwok_operator(
+    instance_types: Optional[Sequence[InstanceType]] = None,
+    solver: Optional[Solver] = None,
+    batch_idle_s: float = 0.0,
+    batch_max_s: float = 0.0,
+    rate_limits: bool = False,
+    clock=time.monotonic,
+    disruption: bool = True,
+) -> Operator:
+    store = st.Store()
+    types = list(instance_types) if instance_types is not None else generate(CatalogSpec())
+    cloud = KwokCloud(store, types, rate_limits=rate_limits)
+    cloud_provider = KwokCloudProvider(cloud, types)
+    cluster = Cluster(store, clock=clock)
+    solver = solver or ReferenceSolver()
+    provisioner = Provisioner(
+        store,
+        cluster,
+        cloud_provider,
+        solver,
+        batch_idle_s=batch_idle_s,
+        batch_max_s=batch_max_s,
+        clock=clock,
+    )
+    manager = Manager()
+    manager.register(
+        provisioner,
+        LaunchController(store, cloud_provider),
+        RegistrationController(store, clock=clock),
+        InitializationController(store, clock=clock),
+        Binder(store, cluster),
+        TerminationController(store, cloud_provider, clock=clock),
+        LivenessController(store, clock=clock),
+        ExpirationController(store, clock=clock),
+        GarbageCollectionController(store, cloud, clock=clock),
+    )
+    if disruption:
+        from ..disruption.controller import DisruptionController
+
+        manager.register(DisruptionController(store, cluster, cloud_provider, solver, clock=clock))
+    return Operator(
+        store=store,
+        cloud=cloud,
+        cloud_provider=cloud_provider,
+        cluster=cluster,
+        provisioner=provisioner,
+        manager=manager,
+        solver=solver,
+    )

@@ -1,0 +1,48 @@
+"""Multi-chip sharding for batched solves.
+
+The solver's scale-out axis is *independent solves* (SURVEY.md §2.10): the
+disruption engine simulates thousands of candidate subsets, each a re-solve
+(HOT LOOP #2, SURVEY.md §3.2). Batching candidates as a leading vmap axis and
+sharding that axis across a `jax.sharding.Mesh` is the whole point of the TPU
+backend — each chip evaluates its shard of candidates, results gather back.
+No cross-candidate communication is needed during the solve, so collectives
+(an all-gather of per-candidate costs) ride ICI only at the end.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..solver.tpu.ffd import ffd_solve
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "candidates") -> Mesh:
+    devs = jax.devices()[: n_devices or len(jax.devices())]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def batched_solve(mesh: Mesh, batched_args: tuple, max_claims: int):
+    """vmap ffd_solve over a leading candidate axis, sharded across the mesh.
+
+    `batched_args`: the 20 positional ffd_solve arrays, each with a leading
+    batch axis B divisible by the mesh size. Returns FFDOutput with leading
+    batch axes, sharded the same way.
+    """
+    axis = mesh.axis_names[0]
+    sharding = NamedSharding(mesh, P(axis))
+
+    fn = jax.vmap(functools.partial(ffd_solve.__wrapped__, max_claims=max_claims))
+    jfn = jax.jit(fn, in_shardings=(sharding,) * len(batched_args), out_shardings=sharding)
+    placed = tuple(jax.device_put(a, sharding) for a in batched_args)
+    return jfn(*placed)
+
+
+def replicate_args(args: tuple, batch: int) -> tuple:
+    """Tile single-solve args to a batch (test/dryrun helper)."""
+    return tuple(np.broadcast_to(np.asarray(a)[None], (batch,) + np.asarray(a).shape).copy() for a in args)

@@ -1,0 +1,62 @@
+"""UnavailableOfferings — the ICE (insufficient capacity) cache.
+
+Mirrors pkg/cache/unavailableofferings.go:33-101: keyed
+`capacityType:instanceType:zone`, TTL 3 minutes (pkg/cache/cache.go:29), with
+a SeqNum bumped on every change so downstream offering caches (and the TPU
+solver's availability masks) invalidate cheaply — the SeqNum protocol from
+SURVEY.md §7 "staleness windows": the solver sidecar re-derives masks only
+when the SeqNum moved.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+DEFAULT_TTL_S = 180.0  # 3m, cache.go:29
+
+
+class UnavailableOfferings:
+    def __init__(self, ttl_s: float = DEFAULT_TTL_S, clock=time.monotonic):
+        self._ttl = ttl_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple[str, str, str], float] = {}  # key -> expiry
+        self.seq_num = 0
+
+    @staticmethod
+    def _key(capacity_type: str, instance_type: str, zone: str) -> Tuple[str, str, str]:
+        return (capacity_type, instance_type, zone)
+
+    def mark_unavailable(self, capacity_type: str, instance_type: str, zone: str) -> None:
+        with self._lock:
+            self._entries[self._key(capacity_type, instance_type, zone)] = (
+                self._clock() + self._ttl
+            )
+            self.seq_num += 1
+
+    def is_unavailable(self, capacity_type: str, instance_type: str, zone: str) -> bool:
+        with self._lock:
+            k = self._key(capacity_type, instance_type, zone)
+            exp = self._entries.get(k)
+            if exp is None:
+                return False
+            if exp <= self._clock():
+                del self._entries[k]
+                self.seq_num += 1
+                return False
+            return True
+
+    def flush_expired(self) -> None:
+        with self._lock:
+            now = self._clock()
+            dead = [k for k, exp in self._entries.items() if exp <= now]
+            for k in dead:
+                del self._entries[k]
+            if dead:
+                self.seq_num += 1
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._entries)

@@ -1,0 +1,159 @@
+"""Provisioner: pending pods -> solver -> NodeClaims.
+
+The main loop of SURVEY.md §3.1: batch pending pods (idle/max windows,
+settings.md:15-16 — defaults 1s/10s, 0 in tests), assemble the SolverInput
+from cluster state + NodePools + ICE-masked instance types, run the pluggable
+Solver backend (TPU or reference), then create NodeClaim objects; the
+lifecycle launch controller turns claims into cloud capacity asynchronously
+(NodeClaim state machine, concepts/nodeclaims.md).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ..api import wellknown as wk
+from ..api.objects import NodeClaim, NodePool, ObjectMeta, Pod
+from ..cloudprovider.types import CloudProvider
+from ..controllers import store as st
+from ..metrics.registry import PROVISIONER_SCHEDULING_DURATION, SCHEDULER_QUEUE_DEPTH
+from ..scheduling.requirements import IN, Requirement
+from ..solver.backend import Solver
+from ..state.cluster import Cluster
+from .scheduler import NodePoolSpec, SolverInput
+
+
+class Provisioner:
+    name = "provisioner"
+
+    def __init__(
+        self,
+        store: st.Store,
+        cluster: Cluster,
+        cloud_provider: CloudProvider,
+        solver: Solver,
+        batch_idle_s: float = 1.0,
+        batch_max_s: float = 10.0,
+        clock=time.monotonic,
+    ):
+        self.store = store
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.solver = solver
+        self.batch_idle_s = batch_idle_s
+        self.batch_max_s = batch_max_s
+        self.clock = clock
+        self._first_seen: Optional[float] = None
+        self._last_count = 0
+        self._claim_seq = 0
+
+    # -- batching (settings.md:15-16) ---------------------------------------
+
+    def _batch_ready(self, pending: List[Pod]) -> bool:
+        now = self.clock()
+        if not pending:
+            self._first_seen = None
+            self._last_count = 0
+            return False
+        if self._first_seen is None:
+            self._first_seen = now
+            self._last_count = len(pending)
+            self._idle_since = now
+            return self.batch_idle_s == 0
+        if len(pending) != self._last_count:
+            self._last_count = len(pending)
+            self._idle_since = now
+        return (now - self._idle_since) >= self.batch_idle_s or (
+            now - self._first_seen
+        ) >= self.batch_max_s
+
+    # -- input assembly -----------------------------------------------------
+
+    def build_input(self, pending: List[Pod]) -> SolverInput:
+        usage = self.cluster.nodepool_usage()
+        pools: List[NodePoolSpec] = []
+        zones: set = set()
+        cts: set = set()
+        for np_obj in self.store.list(st.NODEPOOLS):
+            if np_obj.meta.deleting:
+                continue
+            types = self.cloud_provider.get_instance_types(np_obj.name)
+            reqs = np_obj.scheduling_requirements()
+            pools.append(
+                NodePoolSpec(
+                    name=np_obj.name,
+                    weight=np_obj.weight,
+                    requirements=reqs,
+                    taints=list(np_obj.template.taints),
+                    instance_types=types,
+                    limits=np_obj.limits,
+                    usage=usage.get(np_obj.name, type(np_obj.limits)()),
+                )
+            )
+            for it in types:
+                zr = it.requirements.get(wk.ZONE_LABEL)
+                if zr:
+                    zones.update(zr.values_list())
+                cr = it.requirements.get(wk.CAPACITY_TYPE_LABEL)
+                if cr:
+                    cts.update(cr.values_list())
+        daemonsets = [d for d in self.store.list(st.DAEMONSETS)]
+        return SolverInput(
+            pods=pending,
+            nodes=self.cluster.existing_nodes_for_scheduler(),
+            nodepools=pools,
+            daemonset_pods=daemonsets,
+            zones=tuple(sorted(zones)),
+            capacity_types=tuple(sorted(cts)) or ("on-demand", "spot"),
+        )
+
+    # -- reconcile ----------------------------------------------------------
+
+    def reconcile(self) -> bool:
+        pending = self.cluster.pending_pods()
+        SCHEDULER_QUEUE_DEPTH.set(len(pending))
+        if not self._batch_ready(pending):
+            return False
+        self._first_seen = None
+        t0 = time.perf_counter()
+        inp = self.build_input(pending)
+        result = self.solver.solve(inp)
+        PROVISIONER_SCHEDULING_DURATION.observe(time.perf_counter() - t0)
+
+        nodepools: Dict[str, NodePool] = {p.name: p for p in self.store.list(st.NODEPOOLS)}
+        did = False
+        for claim_res in result.claims:
+            np_obj = nodepools.get(claim_res.nodepool)
+            if np_obj is None:
+                continue
+            self._claim_seq += 1
+            name = f"{claim_res.nodepool}-{self._claim_seq:05d}"
+            reqs = type(claim_res.requirements)(claim_res.requirements)
+            reqs.add(
+                Requirement.create(
+                    wk.INSTANCE_TYPE_LABEL, IN, claim_res.instance_type_names
+                )
+            )
+            claim = NodeClaim(
+                meta=ObjectMeta(
+                    name=name,
+                    labels={wk.NODEPOOL_LABEL: claim_res.nodepool},
+                    finalizers=[wk.TERMINATION_FINALIZER],
+                ),
+                nodepool=claim_res.nodepool,
+                node_class_ref=np_obj.template.node_class_ref,
+                requirements=reqs,
+                resource_requests=claim_res.requests,
+                taints=list(np_obj.template.taints),
+                startup_taints=list(np_obj.template.startup_taints),
+                expire_after_s=np_obj.template.expire_after_s,
+                termination_grace_period_s=np_obj.template.termination_grace_period_s,
+                instance_type_options=list(claim_res.instance_type_names),
+            )
+            self.store.create(st.NODECLAIMS, claim)
+            did = True
+        for uid, placement in result.placements.items():
+            if placement[0] == "node":
+                self.cluster.nominate(placement[1])
+        return did

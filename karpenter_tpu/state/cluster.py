@@ -1,0 +1,185 @@
+"""Cluster state: the scheduler/disruption view of current capacity.
+
+Mirrors karpenter core `pkg/controllers/state` (state.Cluster — SURVEY.md
+§2.1): nodes + nodeclaims + pod bindings + daemonset overhead, feeding both
+the provisioner and the disruption engine. Because this framework's API store
+is in-process (no network), state is computed from the store on demand rather
+than via a separate event-driven cache — same interface, simpler consistency
+(the reference needs `karpenter_cluster_state_synced`; we are synced by
+construction).
+
+Nomination tracking prevents the disruption engine from deleting capacity the
+provisioner just targeted (reference behavior: nominated nodes are excluded
+from consolidation for a window).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..api import wellknown as wk
+from ..api.objects import Node, NodeClaim, Pod
+from ..controllers import store as st
+from ..provisioning.scheduler import ExistingNode
+from ..utils.resources import PODS, Resources
+
+
+@dataclass
+class StateNode:
+    """A unified view over (Node, NodeClaim) — either may be missing while
+    the other exists (in-flight claim, or unmanaged node)."""
+
+    node: Optional[Node]
+    claim: Optional[NodeClaim]
+
+    @property
+    def name(self) -> str:
+        if self.node is not None:
+            return self.node.meta.name
+        return self.claim.node_name or self.claim.name
+
+    @property
+    def provider_id(self) -> str:
+        if self.node is not None and self.node.provider_id:
+            return self.node.provider_id
+        return self.claim.provider_id if self.claim else ""
+
+    @property
+    def nodepool(self) -> Optional[str]:
+        if self.claim is not None:
+            return self.claim.nodepool
+        if self.node is not None:
+            return self.node.meta.labels.get(wk.NODEPOOL_LABEL)
+        return None
+
+    @property
+    def initialized(self) -> bool:
+        return bool(self.claim and self.claim.initialized) or (
+            self.node is not None and self.node.ready and self.claim is None
+        )
+
+    def labels(self) -> Dict[str, str]:
+        if self.node is not None:
+            return self.node.meta.labels
+        if self.claim is not None:
+            lab = dict(self.claim.requirements.labels())
+            lab[wk.NODEPOOL_LABEL] = self.claim.nodepool
+            return lab
+        return {}
+
+    def allocatable(self) -> Resources:
+        if self.node is not None and self.node.allocatable:
+            return self.node.allocatable
+        if self.claim is not None:
+            return self.claim.allocatable
+        return Resources()
+
+
+class Cluster:
+    def __init__(self, store: st.Store, clock=time.monotonic):
+        self.store = store
+        self.clock = clock
+        self._nominations: Dict[str, float] = {}  # node name -> expiry
+        self.nomination_window_s = 20.0
+
+    # -- assembly -----------------------------------------------------------
+
+    def state_nodes(self) -> List[StateNode]:
+        nodes = {n.meta.name: n for n in self.store.list(st.NODES)}
+        out: List[StateNode] = []
+        claimed_nodes = set()
+        for c in self.store.list(st.NODECLAIMS):
+            node = nodes.get(c.node_name) if c.node_name else None
+            if node is not None:
+                claimed_nodes.add(node.meta.name)
+            out.append(StateNode(node=node, claim=c))
+        for name, n in nodes.items():
+            if name not in claimed_nodes:
+                out.append(StateNode(node=n, claim=None))
+        return out
+
+    def bound_pods(self) -> Dict[str, List[Pod]]:
+        by_node: Dict[str, List[Pod]] = {}
+        for p in self.store.list(st.PODS):
+            if p.node_name:
+                by_node.setdefault(p.node_name, []).append(p)
+        return by_node
+
+    def pending_pods(self) -> List[Pod]:
+        return [
+            p
+            for p in self.store.list(st.PODS)
+            if not p.bound and not p.scheduling_gated and p.phase == "Pending"
+            and not p.meta.deleting
+        ]
+
+    # -- scheduler inputs ---------------------------------------------------
+
+    def existing_nodes_for_scheduler(self) -> List[ExistingNode]:
+        """Schedulable capacity: ready nodes and in-flight claims, with free =
+        allocatable − bound pod requests (the daemonset share is included in
+        bound pods once they bind)."""
+        by_node = self.bound_pods()
+        out: List[ExistingNode] = []
+        for sn in self.state_nodes():
+            if sn.node is not None and (sn.node.meta.deleting or sn.node.unschedulable):
+                continue
+            if sn.claim is not None and sn.claim.meta.deleting:
+                continue
+            alloc = sn.allocatable()
+            if not alloc:
+                continue
+            pods = by_node.get(sn.name, [])
+            free = Resources(alloc)
+            for p in pods:
+                free = free.sub(p.requests)
+            free[PODS] = alloc.get_(PODS) - len(pods)
+            taints = list(sn.node.taints) if sn.node is not None else list(
+                (sn.claim.taints if sn.claim else [])
+            )
+            # the unregistered taint is lifecycle plumbing, not a scheduling
+            # constraint for the simulated scheduler (pods will land once
+            # registration removes it)
+            taints = [t for t in taints if t.key != wk.UNREGISTERED_TAINT_KEY]
+            out.append(
+                ExistingNode(
+                    id=sn.name,
+                    labels=dict(sn.labels()),
+                    taints=taints,
+                    free=free,
+                    pod_labels=[dict(p.meta.labels) for p in pods],
+                )
+            )
+        out.sort(key=lambda n: n.id)
+        return out
+
+    def nodepool_usage(self) -> Dict[str, Resources]:
+        usage: Dict[str, Resources] = {}
+        for sn in self.state_nodes():
+            np_name = sn.nodepool
+            if not np_name:
+                continue
+            cap = None
+            if sn.claim is not None and sn.claim.capacity:
+                cap = sn.claim.capacity
+            elif sn.node is not None:
+                cap = sn.node.capacity
+            if cap:
+                usage[np_name] = usage.get(np_name, Resources()).add(cap)
+        return usage
+
+    # -- nominations --------------------------------------------------------
+
+    def nominate(self, node_name: str) -> None:
+        self._nominations[node_name] = self.clock() + self.nomination_window_s
+
+    def is_nominated(self, node_name: str) -> bool:
+        exp = self._nominations.get(node_name)
+        if exp is None:
+            return False
+        if exp <= self.clock():
+            del self._nominations[node_name]
+            return False
+        return True

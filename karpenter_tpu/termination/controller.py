@@ -1,0 +1,173 @@
+"""Termination: finalizer-based graceful drain.
+
+Mirrors the reference's termination flow (designs/termination.md;
+website/.../concepts/disruption.md:30-38,244-270; SURVEY.md §3.3):
+
+  deletion requested -> finalizer blocks -> taint karpenter.sh/disrupted
+  -> evict pods via the (PDB-aware) eviction path, skipping daemonset-like
+  and tolerating pods -> when drained (or past terminationGracePeriod,
+  which force-deletes) -> delete the cloud instance -> remove finalizers.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from ..api import wellknown as wk
+from ..api.objects import Node, NodeClaim, Pod, PodDisruptionBudget, Taint
+from ..cloudprovider.types import CloudProvider, NodeClaimNotFoundError
+from ..controllers import store as st
+from ..metrics.registry import NODECLAIMS_TERMINATED
+
+
+class EvictionQueue:
+    """PDB-aware pod eviction (the Eviction API stand-in)."""
+
+    def __init__(self, store: st.Store):
+        self.store = store
+
+    def can_evict(self, pod: Pod) -> bool:
+        for pdb in self.store.list(st.PDBS):
+            if not pdb.matches(pod):
+                continue
+            peers = [
+                p
+                for p in self.store.list(st.PODS)
+                if pdb.matches(p) and not p.meta.deleting and p.phase != "Failed"
+            ]
+            healthy = [p for p in peers if p.node_name is not None]
+            if pdb.min_available is not None:
+                if len(healthy) - 1 < pdb.min_available:
+                    return False
+            if pdb.max_unavailable is not None:
+                unavailable = len(peers) - len(healthy) + 1
+                if unavailable > pdb.max_unavailable:
+                    return False
+        return True
+
+    def evict(self, pod: Pod) -> bool:
+        if not self.can_evict(pod):
+            return False
+        # eviction unbinds; the pod returns to Pending for the provisioner
+        # (mirrors a ReplicaSet recreating the pod elsewhere)
+        pod.node_name = None
+        pod.phase = "Pending"
+        self.store.update(st.PODS, pod)
+        return True
+
+
+class TerminationController:
+    name = "termination"
+
+    def __init__(
+        self,
+        store: st.Store,
+        cloud_provider: CloudProvider,
+        clock=time.monotonic,
+    ):
+        self.store = store
+        self.cloud_provider = cloud_provider
+        self.eviction = EvictionQueue(store)
+        self.clock = clock
+
+    # -- helpers ------------------------------------------------------------
+
+    def _pods_on(self, node_name: str) -> List[Pod]:
+        return [p for p in self.store.list(st.PODS) if p.node_name == node_name]
+
+    def _drainable(self, pod: Pod, node: Optional[Node]) -> bool:
+        if pod.owner_kind == "DaemonSet":
+            return False  # daemonsets are not drained (disruption.md:30-38)
+        if node is not None and any(
+            tol.tolerates(Taint(key=wk.DISRUPTED_TAINT_KEY, effect=wk.EFFECT_NO_SCHEDULE))
+            for tol in pod.tolerations
+        ):
+            # pods tolerating the disruption taint opted in to staying
+            return False
+        return True
+
+    # -- reconcile ----------------------------------------------------------
+
+    def reconcile(self) -> bool:
+        did = False
+        for claim in self.store.list(st.NODECLAIMS):
+            if not claim.meta.deleting:
+                continue
+            did = self._terminate(claim) or did
+        # nodes deleted directly (kubectl delete node) also drain via their claim
+        for node in self.store.list(st.NODES):
+            if node.meta.deleting and wk.TERMINATION_FINALIZER in node.meta.finalizers:
+                claim = self._claim_for(node)
+                if claim is not None and not claim.meta.deleting:
+                    self.store.delete(st.NODECLAIMS, claim.name)
+                    did = True
+                elif claim is None:
+                    node.meta.finalizers.remove(wk.TERMINATION_FINALIZER)
+                    self.store.update(st.NODES, node)
+                    did = True
+        return did
+
+    def _claim_for(self, node: Node) -> Optional[NodeClaim]:
+        for c in self.store.list(st.NODECLAIMS):
+            if c.node_name == node.meta.name or (
+                c.provider_id and c.provider_id == node.provider_id
+            ):
+                return c
+        return None
+
+    def _terminate(self, claim: NodeClaim) -> bool:
+        did = False
+        node = self.store.try_get(st.NODES, claim.node_name) if claim.node_name else None
+        if node is not None:
+            # 1. taint so nothing reschedules here (disruption.md:15-28)
+            if not any(t.key == wk.DISRUPTED_TAINT_KEY for t in node.taints):
+                node.taints.append(Taint(key=wk.DISRUPTED_TAINT_KEY, effect=wk.EFFECT_NO_SCHEDULE))
+                node.unschedulable = True
+                self.store.update(st.NODES, node)
+                did = True
+            # 2. drain
+            force = (
+                claim.termination_grace_period_s is not None
+                and claim.meta.deletion_timestamp is not None
+                and self.clock() - claim.meta.deletion_timestamp
+                > claim.termination_grace_period_s
+            )
+            remaining = []
+            for pod in self._pods_on(node.meta.name):
+                if not self._drainable(pod, node):
+                    continue
+                if force:
+                    pod.node_name = None
+                    pod.phase = "Pending"
+                    self.store.update(st.PODS, pod)
+                    did = True
+                elif self.eviction.evict(pod):
+                    did = True
+                else:
+                    remaining.append(pod)
+            if remaining:
+                # PDB-blocked: report progress only if something moved this
+                # tick (returning True forever would livelock settle())
+                return did
+        # 3. delete the instance
+        if claim.provider_id:
+            try:
+                self.cloud_provider.delete(claim)
+            except NodeClaimNotFoundError:
+                pass
+        # 4. release finalizers (node object may already be gone via the
+        # cloud's node-killer)
+        if node is not None and self.store.try_get(st.NODES, node.meta.name):
+            if wk.TERMINATION_FINALIZER in node.meta.finalizers:
+                node.meta.finalizers.remove(wk.TERMINATION_FINALIZER)
+                self.store.update(st.NODES, node)
+            try:
+                self.store.delete(st.NODES, node.meta.name)
+            except st.NotFound:
+                pass
+        if wk.TERMINATION_FINALIZER in claim.meta.finalizers:
+            claim.meta.finalizers.remove(wk.TERMINATION_FINALIZER)
+            self.store.update(st.NODECLAIMS, claim)
+            NODECLAIMS_TERMINATED.inc(nodepool=claim.nodepool, reason="terminated")
+        return True
