@@ -1,0 +1,110 @@
+"""TPU-batched consolidation evaluation.
+
+Wraps solver/tpu/consolidate.py for the disruption controller: encodes the
+simulation universe ONCE (all candidates' pods pending, all nodes present),
+then evaluates candidate subsets as one vmapped batch. Used as a fast filter
+— the winning subset is re-materialized through the sequential simulate path,
+so command construction (and therefore behavior) is bit-identical to the
+reference-style sequential evaluation; only wall-clock changes.
+
+Falls back (returns None) when the universe contains constructs the device
+kernel can't express (topology/affinity/fallback groups — encode.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..provisioning.scheduler import SolverInput, ffd_key
+from ..solver.backend import TPUSolver, kernel_args
+from ..solver.encode import encode, quantize_input
+from ..solver.tpu.consolidate import replacement_min_price, simulate_subsets
+
+
+@dataclasses.dataclass
+class SubsetVerdict:
+    ok: bool  # feasible (everything reschedules, <=1 new claim)
+    has_replacement: bool
+    replacement_price: Optional[float]  # cheapest offering of the new claim
+    replacement_type_count: int  # surviving instance types (spot >=15 rule)
+
+
+class BatchedConsolidationEvaluator:
+    def __init__(self, solver: TPUSolver, max_claims: int = 16):
+        self.solver = solver
+        self.max_claims = max_claims
+
+    def evaluate(
+        self,
+        base_input: SolverInput,
+        candidate_pods: Dict[int, list],  # candidate id -> pods (unbound copies)
+        candidate_node: Dict[int, str],  # candidate id -> existing-node id
+        subsets: Sequence[Sequence[int]],
+    ) -> Optional[List[SubsetVerdict]]:
+        all_pods = [p for pods in candidate_pods.values() for p in pods]
+        inp = dataclasses.replace(base_input, pods=all_pods)
+        enc = encode(quantize_input(inp))
+        if enc.group_fallback.any() or enc.has_topology or enc.has_affinity or enc.G == 0:
+            return None
+
+        # (group, candidate)-granular runs following the exact FFD order
+        uid_to_cid = {
+            p.meta.uid: cid for cid, pods in candidate_pods.items() for p in pods
+        }
+        uid_to_gid = {
+            p.meta.uid: g for g, pods in enumerate(enc.group_pods) for p in pods
+        }
+        pods_sorted = sorted(all_pods, key=ffd_key)
+        run_group: List[int] = []
+        run_count: List[int] = []
+        run_cand: List[int] = []
+        for p in pods_sorted:
+            g, c = uid_to_gid[p.meta.uid], uid_to_cid[p.meta.uid]
+            if run_group and run_group[-1] == g and run_cand[-1] == c:
+                run_count[-1] += 1
+            else:
+                run_group.append(g)
+                run_count.append(1)
+                run_cand.append(c)
+        enc.run_group = np.asarray(run_group, dtype=np.int32)
+        enc.run_count = np.asarray(run_count, dtype=np.int32)
+
+        args, dims = kernel_args(enc, self.solver._bucket)
+        Sp = len(np.asarray(args[0]))
+        run_candidate = np.full(Sp, -1, dtype=np.int32)
+        run_candidate[: len(run_cand)] = run_cand
+
+        node_idx = {cid: enc.node_ids.index(nid) for cid, nid in candidate_node.items()
+                    if nid in enc.node_ids}
+        out = simulate_subsets(args, run_candidate, subsets, node_idx, self.max_claims)
+
+        T, Z, C = enc.T, len(enc.zones), len(enc.capacity_types)
+        used = np.asarray(out.state.used)
+        leftover = np.asarray(out.leftover).sum(axis=1)
+        c_mask = np.asarray(out.state.c_mask)[:, :, :T]
+        c_zone = np.asarray(out.state.c_zone)
+        c_ct = np.asarray(out.state.c_ct)
+        verdicts: List[SubsetVerdict] = []
+        for b in range(len(subsets)):
+            feasible = leftover[b] == 0 and used[b] <= 1
+            price = None
+            type_count = 0
+            if feasible and used[b] == 1:
+                price = replacement_min_price(
+                    c_mask[b, 0], c_zone[b, 0], c_ct[b, 0], enc.offer_avail, enc.offer_price
+                )
+                type_count = int(c_mask[b, 0].sum())
+                if price is None:
+                    feasible = False
+            verdicts.append(
+                SubsetVerdict(
+                    ok=bool(feasible),
+                    has_replacement=bool(used[b] == 1),
+                    replacement_price=price,
+                    replacement_type_count=type_count,
+                )
+            )
+        return verdicts

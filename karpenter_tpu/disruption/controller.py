@@ -85,6 +85,15 @@ class DisruptionController:
         self.multi_node_max_candidates = multi_node_max_candidates
         self._command: Optional[Command] = None
         self._provisioner_helper: Optional[Provisioner] = None
+        # TPU backend: evaluate candidate subsets as one vmapped batch
+        # (solver/tpu/consolidate.py); sequential path remains ground truth
+        from ..solver.backend import TPUSolver
+
+        self._batched = None
+        if isinstance(solver, TPUSolver):
+            from .batched import BatchedConsolidationEvaluator
+
+            self._batched = BatchedConsolidationEvaluator(solver)
 
     # ------------------------------------------------------------------ main
 
@@ -240,10 +249,28 @@ class DisruptionController:
             for c in candidates
             if self._consolidation_enabled(c) and self._consolidate_after_ok(c)
         ]
+        verdicts = self._batched_verdicts(method, consolidatable, budgets)
         if method == "multi-consolidation":
             pool = consolidatable[: self.multi_node_max_candidates]
-            # binary search the largest cost-ordered prefix that consolidates
-            # (>=2 deletes, <=1 cheaper replacement)
+            if verdicts is not None:
+                # all prefixes were evaluated in one vmapped batch; take the
+                # largest feasible one (same answer the binary search finds)
+                for k in range(len(pool), 1, -1):
+                    v = verdicts.get(k)
+                    if v is None or not self._within_budget(pool[:k], method, budgets):
+                        continue
+                    old_price = sum(c.price for c in pool[:k])
+                    if v.has_replacement and (
+                        v.replacement_price is None or v.replacement_price >= old_price
+                    ):
+                        continue
+                    ok, claim_res = self._simulate(pool[:k], allow_replacement=True, require_cheaper=True)
+                    if ok:
+                        names = [self._create_replacement(claim_res)] if claim_res else []
+                        return Command(method, pool[:k], replacement_names=names)
+                return None
+            # sequential: binary search the largest cost-ordered prefix that
+            # consolidates (>=2 deletes, <=1 cheaper replacement)
             lo, hi = 2, len(pool)
             best = None
             while lo <= hi:
@@ -265,14 +292,64 @@ class DisruptionController:
             return None
 
         # single-node consolidation
-        for c in consolidatable:
+        for i, c in enumerate(consolidatable):
             if not self._within_budget([c], method, budgets):
                 continue
+            if verdicts is not None:
+                v = verdicts.get(i)
+                if v is None or not v.ok:
+                    continue
+                if v.has_replacement:
+                    if v.replacement_price is None or v.replacement_price >= c.price:
+                        continue
+                    if (
+                        c.claim.capacity_type == wk.CAPACITY_TYPE_SPOT
+                        and v.replacement_type_count < 15
+                    ):
+                        continue
             ok, claim_res = self._simulate([c], allow_replacement=True, require_cheaper=True)
             if ok and self._spot_flexibility_ok_res(c, claim_res):
                 names = [self._create_replacement(claim_res)] if claim_res else []
                 return Command(method, [c], replacement_names=names)
         return None
+
+    def _batched_verdicts(self, method: str, consolidatable: List[Candidate], budgets):
+        """One vmapped evaluation of every subset this method will consider.
+        Returns {key: SubsetVerdict} or None (no TPU backend / inexpressible
+        constraints). Keys: candidate index (single) or prefix length (multi)."""
+        if self._batched is None or not consolidatable:
+            return None
+        if method not in ("multi-consolidation", "single-consolidation"):
+            return None
+        import dataclasses as _dc
+
+        if self._provisioner_helper is None:
+            self._provisioner_helper = Provisioner(
+                self.store, self.cluster, self.cloud_provider, self.solver,
+                batch_idle_s=0, batch_max_s=0, clock=self.clock,
+            )
+        base = self._provisioner_helper.build_input([])
+        candidate_pods = {
+            i: [_dc.replace(p, node_name=None, phase="Pending") for p in c.pods]
+            for i, c in enumerate(consolidatable)
+        }
+        candidate_node = {i: c.node.meta.name for i, c in enumerate(consolidatable)}
+        if method == "single-consolidation":
+            subsets = [[i] for i in range(len(consolidatable))]
+            keys = list(range(len(consolidatable)))
+        else:
+            pool_n = min(len(consolidatable), self.multi_node_max_candidates)
+            if pool_n < 2:
+                return None
+            subsets = [list(range(k)) for k in range(2, pool_n + 1)]
+            keys = list(range(2, pool_n + 1))
+        try:
+            verdicts = self._batched.evaluate(base, candidate_pods, candidate_node, subsets)
+        except Exception:
+            return None
+        if verdicts is None:
+            return None
+        return dict(zip(keys, verdicts))
 
     def _consolidation_enabled(self, c: Candidate) -> bool:
         for p in self.store.list(st.NODEPOOLS):

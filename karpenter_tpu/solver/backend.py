@@ -43,6 +43,59 @@ class ReferenceSolver(Solver):
         return Scheduler(inp).solve()
 
 
+def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
+    """The 20 padded positional arrays for tpu.ffd.ffd_solve, plus dims.
+
+    Shapes bucket to bounded sizes so compilations cache across solves
+    (SURVEY.md §7: bucketed padding avoids recompilation storms). Shared by
+    the single-solve path, the driver entry points, and the batched
+    consolidation evaluator.
+    """
+    import jax.numpy as jnp
+
+    INT32_MAX_NP = np.int32(2**31 - 1)
+    S, G, T, E, P = len(enc.run_group), enc.G, enc.T, enc.E, enc.P
+    R, Z, C = enc.group_req.shape[1], len(enc.zones), len(enc.capacity_types)
+    Sp, Gp, Tp, Ep, Pp = (
+        bucket(S, 64, 64),
+        bucket(G, 16, 16),
+        bucket(T, 128, 128),
+        bucket(E, 64, 64),
+        bucket(P, 4, 4),
+    )
+
+    def pad(a, shape, fill=0):
+        out = np.full(shape, fill, dtype=a.dtype)
+        out[tuple(slice(0, s) for s in a.shape)] = a
+        return out
+
+    type_charge = np.where(enc.charge_axes[None, :], enc.type_capacity, 0).astype(np.int32)
+    args = (
+        jnp.asarray(pad(enc.run_group, (Sp,))),
+        jnp.asarray(pad(enc.run_count, (Sp,))),
+        jnp.asarray(pad(enc.group_req, (Gp, R))),
+        jnp.asarray(pad(enc.group_compat_t, (Gp, Tp))),
+        jnp.asarray(pad(enc.group_zone, (Gp, Z))),
+        jnp.asarray(pad(enc.group_ct, (Gp, C))),
+        jnp.asarray(pad(enc.group_pool, (Gp, Pp))),
+        jnp.asarray(pad(enc.group_pair, (Gp, Gp), fill=True)),
+        jnp.asarray(pad(~enc.group_fallback, (Gp,))),
+        jnp.asarray(pad(enc.type_alloc, (Tp, R))),
+        jnp.asarray(pad(type_charge, (Tp, R))),
+        jnp.asarray(pad(enc.offer_avail, (Tp, Z, C))),
+        jnp.asarray(pad(enc.pool_type, (Pp, Tp))),
+        jnp.asarray(pad(enc.pool_zone, (Pp, Z))),
+        jnp.asarray(pad(enc.pool_ct, (Pp, C))),
+        jnp.asarray(pad(enc.pool_daemon, (Pp, R))),
+        jnp.asarray(pad(enc.pool_limit, (Pp, R), fill=INT32_MAX_NP)),
+        jnp.asarray(pad(enc.pool_usage, (Pp, R))),
+        jnp.asarray(pad(enc.node_free, (Ep, R))),
+        jnp.asarray(pad(enc.node_compat, (Gp, Ep))),
+    )
+    dims = dict(S=S, G=G, T=T, E=E, P=P, R=R, Z=Z, C=C, Sp=Sp, Gp=Gp, Tp=Tp, Ep=Ep, Pp=Pp)
+    return args, dims
+
+
 class TPUSolver(Solver):
     """Tensorized FFD on device (JAX/XLA; see tpu/ffd.py).
 
@@ -86,53 +139,17 @@ class TPUSolver(Solver):
         return max(floor, ((n + mult - 1) // mult) * mult)
 
     def _device_solve(self, enc: EncodedInput) -> Optional[SolverResult]:
-        import jax.numpy as jnp
-
         from .tpu.ffd import ffd_solve
 
-        INT32_MAX_NP = np.int32(2**31 - 1)
-        S, G, T, E, P = len(enc.run_group), enc.G, enc.T, enc.E, enc.P
-        R, Z, C = enc.group_req.shape[1], len(enc.zones), len(enc.capacity_types)
-        Sp = self._bucket(S, 64, 64)
-        Gp = self._bucket(G, 16, 16)
-        Tp = self._bucket(T, 128, 128)
-        Ep = self._bucket(E, 64, 64)
-        Pp = self._bucket(P, 4, 4)
+        args, dims = kernel_args(enc, self._bucket)
+        S, E, T, G = dims["S"], dims["E"], dims["T"], dims["G"]
         total_pods = int(sum(len(p) for p in enc.group_pods))
         m = 64
         while m < min(total_pods + 1, self.max_claims):
             m *= 2
         M = min(m, max(self.max_claims, 64))
 
-        def pad(a, shape, fill=0):
-            out = np.full(shape, fill, dtype=a.dtype)
-            out[tuple(slice(0, s) for s in a.shape)] = a
-            return out
-
-        type_charge = np.where(enc.charge_axes[None, :], enc.type_capacity, 0).astype(np.int32)
-        out = ffd_solve(
-            jnp.asarray(pad(enc.run_group, (Sp,))),
-            jnp.asarray(pad(enc.run_count, (Sp,))),
-            jnp.asarray(pad(enc.group_req, (Gp, R))),
-            jnp.asarray(pad(enc.group_compat_t, (Gp, Tp))),
-            jnp.asarray(pad(enc.group_zone, (Gp, Z))),
-            jnp.asarray(pad(enc.group_ct, (Gp, C))),
-            jnp.asarray(pad(enc.group_pool, (Gp, Pp))),
-            jnp.asarray(pad(enc.group_pair, (Gp, Gp), fill=True)),
-            jnp.asarray(pad(~enc.group_fallback, (Gp,))),
-            jnp.asarray(pad(enc.type_alloc, (Tp, R))),
-            jnp.asarray(pad(type_charge, (Tp, R))),
-            jnp.asarray(pad(enc.offer_avail, (Tp, Z, C))),
-            jnp.asarray(pad(enc.pool_type, (Pp, Tp))),
-            jnp.asarray(pad(enc.pool_zone, (Pp, Z))),
-            jnp.asarray(pad(enc.pool_ct, (Pp, C))),
-            jnp.asarray(pad(enc.pool_daemon, (Pp, R))),
-            jnp.asarray(pad(enc.pool_limit, (Pp, R), fill=INT32_MAX_NP)),
-            jnp.asarray(pad(enc.pool_usage, (Pp, R))),
-            jnp.asarray(pad(enc.node_free, (Ep, R))),
-            jnp.asarray(pad(enc.node_compat, (Gp, Ep))),
-            max_claims=M,
-        )
+        out = ffd_solve(*args, max_claims=M)
         used = int(out.state.used)
         if used >= M:
             return None  # possible overflow — replay on fallback

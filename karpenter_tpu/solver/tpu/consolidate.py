@@ -1,0 +1,108 @@
+"""Batched consolidation simulation (BASELINE config 5).
+
+The disruption engine's inner loop re-solves scheduling once per candidate
+subset (SURVEY.md §3.2 HOT LOOP #2). The reference evaluates candidates
+SEQUENTIALLY (single-node: one simulation per node; multi-node: a binary
+search over cost-ordered prefixes, disruption.md:104-106). Here every subset
+is a row of a leading batch axis evaluated in ONE vmapped kernel call:
+
+  - per-subset pods: the union of the subset's reschedulable pods, expressed
+    as (group, candidate)-granular runs with per-row run counts zeroed for
+    candidates outside the subset;
+  - per-subset capacity: the shared existing-node tensors with the subset's
+    nodes masked out of [G, E] compat;
+  - everything else (groups, types, pools) broadcasts unbatched.
+
+Decisions are identical to the sequential path — each row IS the sequential
+simulation — so the controller's semantics (first-success ordering, largest
+feasible prefix) are preserved while wall-clock drops from O(subsets) kernel
+launches to O(1).
+
+max_claims for simulations is small (a subset needing >1 replacement is
+rejected anyway); slot saturation can only under-count claims for rows that
+are already rejected (used > 1), never flip a reject into an accept.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .ffd import ffd_solve
+
+
+# in_axes layout for the 20 positional ffd_solve args:
+#   run_group      None   (shared FFD run order)
+#   run_count      0      (per-subset membership zeroing)
+#   group_*        None
+#   type_*/offer_* None
+#   pool_*         None
+#   node_free      None
+#   node_compat    0      (per-subset node removal)
+_IN_AXES = (None, 0) + (None,) * 7 + (None,) * 3 + (None,) * 6 + (None, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("max_claims",))
+def _batched_ffd(args_shared_and_batched, *, max_claims: int):
+    fn = jax.vmap(
+        functools.partial(ffd_solve.__wrapped__, max_claims=max_claims), in_axes=_IN_AXES
+    )
+    return fn(*args_shared_and_batched)
+
+
+def simulate_subsets(
+    kernel_args: tuple,
+    run_candidate: np.ndarray,  # [S] int32 — candidate id owning each run (-1 = none)
+    subsets: Sequence[Sequence[int]],  # candidate-id subsets to evaluate
+    candidate_node_idx: dict,  # candidate id -> existing-node index (E axis)
+    max_claims: int = 16,
+):
+    """Evaluate each subset; returns FFDOutput with leading batch axis B.
+
+    kernel_args: the 20 shared (padded) ffd_solve arrays for the FULL
+    simulation universe (all candidates' pods as runs, all nodes present).
+    """
+    run_count = np.asarray(kernel_args[1])
+    node_compat = np.asarray(kernel_args[19])
+    B = len(subsets)
+    S = run_count.shape[0]
+    G, E = node_compat.shape
+
+    b_run_count = np.zeros((B, S), dtype=run_count.dtype)
+    b_node_compat = np.broadcast_to(node_compat, (B, G, E)).copy()
+    for b, subset in enumerate(subsets):
+        member = np.isin(run_candidate, np.asarray(list(subset), dtype=np.int64))
+        b_run_count[b] = np.where(member, run_count, 0)
+        for cid in subset:
+            e = candidate_node_idx.get(cid)
+            if e is not None and e < E:
+                b_node_compat[b, :, e] = False
+
+    args = list(kernel_args)
+    args[1] = jnp.asarray(b_run_count)
+    args[19] = jnp.asarray(b_node_compat)
+    return _batched_ffd(tuple(args), max_claims=max_claims)
+
+
+def replacement_min_price(
+    c_mask_row: np.ndarray,  # [T] bool (sliced to real T)
+    c_zone_row: np.ndarray,  # [Z] bool
+    c_ct_row: np.ndarray,  # [C] bool
+    offer_avail: np.ndarray,  # [T, Z, C]
+    offer_price: np.ndarray,  # [T, Z, C]
+) -> Optional[float]:
+    """Cheapest offering reachable by the simulated replacement claim."""
+    ok = (
+        offer_avail
+        & c_mask_row[:, None, None]
+        & c_zone_row[None, :, None]
+        & c_ct_row[None, None, :]
+    )
+    if not ok.any():
+        return None
+    return float(offer_price[ok].min())
