@@ -1,0 +1,52 @@
+"""KwokNodeClass: the provider-specific node configuration object.
+
+The analog of the reference's EC2NodeClass CRD (pkg/apis/crds/
+karpenter.k8s.aws_ec2nodeclasses.yaml; resolved by the nodeclass status
+controller, pkg/controllers/nodeclass/controller.go:62-100): where EC2NodeClass
+selects AMIs/subnets/security-groups, KwokNodeClass selects the slices of the
+synthetic catalog (families, generations, zones) and an image version whose
+change constitutes drift — the same role AMI drift plays in the reference
+(drift.go:34-74).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .objects import ObjectMeta
+
+
+@dataclass
+class KwokNodeClass:
+    meta: ObjectMeta
+    # catalog selection (subnet/SG/AMI-selector analogs)
+    instance_families: Optional[List[str]] = None  # None = all
+    min_generation: int = 0
+    zones: Optional[List[str]] = None  # None = all
+    # image version: bumping it drifts every node built from this class
+    image_version: str = "v1"
+    # kubelet-ish knobs that participate in the static hash
+    max_pods_override: Optional[int] = None
+
+    # status
+    ready: bool = True
+    status_message: str = ""
+
+    @property
+    def name(self) -> str:
+        return self.meta.name
+
+    def static_hash(self) -> str:
+        """Drift hash over the spec (the reference's EC2NodeClass hash
+        annotation, cloudprovider.go:128-131)."""
+        spec = {
+            "instance_families": sorted(self.instance_families) if self.instance_families else None,
+            "min_generation": self.min_generation,
+            "zones": sorted(self.zones) if self.zones else None,
+            "image_version": self.image_version,
+            "max_pods_override": self.max_pods_override,
+        }
+        return hashlib.sha256(json.dumps(spec, sort_keys=True).encode()).hexdigest()[:16]

@@ -1,0 +1,115 @@
+"""Generic request-coalescing engine.
+
+Behavioral mirror of pkg/batcher (SURVEY.md §2.6, batcher.go:59-196):
+requests hash into buckets; a bucket flushes when idle for `idle_s` or after
+`max_s` since the first request (or at `max_items`); one backend call serves
+the whole batch and per-request results split back to callers. The reference
+instantiates this for CreateFleet (35ms/1s/1000, createfleet.go:37-117),
+DescribeInstances and TerminateInstances — kwok's cloud here is in-process,
+so the default windows are 0 and batching's value is call-count amortization
+against the rate-limited cloud APIs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Generic, Hashable, List, Optional, Tuple, TypeVar
+
+from ..metrics.registry import BATCHER_BATCH_SIZE, BATCHER_BATCH_TIME
+
+Req = TypeVar("Req")
+Resp = TypeVar("Resp")
+
+# exec_fn: (bucket_key, [requests]) -> [responses] (same order/length)
+ExecFn = Callable[[Hashable, List[Any]], List[Any]]
+
+
+@dataclass
+class _Bucket:
+    requests: List[Any] = field(default_factory=list)
+    events: List[threading.Event] = field(default_factory=list)
+    results: List[Any] = field(default_factory=list)
+    first_at: float = 0.0
+    last_at: float = 0.0
+
+
+class Batcher(Generic[Req, Resp]):
+    def __init__(
+        self,
+        name: str,
+        exec_fn: ExecFn,
+        idle_s: float = 0.035,  # createfleet.go:39-41
+        max_s: float = 1.0,
+        max_items: int = 1000,
+        clock=time.monotonic,
+    ):
+        self.name = name
+        self.exec_fn = exec_fn
+        self.idle_s = idle_s
+        self.max_s = max_s
+        self.max_items = max_items
+        self.clock = clock
+        self._buckets: Dict[Hashable, _Bucket] = defaultdict(_Bucket)
+        self._lock = threading.Lock()
+
+    def add(self, key: Hashable, request: Req) -> Callable[[], Resp]:
+        """Queue a request; returns a waiter that blocks until the batch
+        flushes and yields this request's response."""
+        with self._lock:
+            b = self._buckets[key]
+            now = self.clock()
+            if not b.requests:
+                b.first_at = now
+            b.last_at = now
+            idx = len(b.requests)
+            b.requests.append(request)
+            ev = threading.Event()
+            b.events.append(ev)
+            flush_now = len(b.requests) >= self.max_items or (
+                self.idle_s == 0 and self.max_s == 0
+            )
+        if flush_now:
+            self.flush(key)
+
+        def wait(timeout: Optional[float] = None) -> Resp:
+            if not ev.wait(timeout if timeout is not None else max(self.max_s * 4, 1.0)):
+                raise TimeoutError(f"batcher {self.name} flush timed out")
+            res = ev.result  # type: ignore[attr-defined]
+            if isinstance(res, Exception):
+                raise res
+            return res
+
+        return wait
+
+    def poll(self) -> bool:
+        """Flush any bucket whose idle/max window elapsed (call from the
+        controller tick loop)."""
+        now = self.clock()
+        due = []
+        with self._lock:
+            for key, b in self._buckets.items():
+                if not b.requests:
+                    continue
+                if (now - b.last_at) >= self.idle_s or (now - b.first_at) >= self.max_s:
+                    due.append(key)
+        for key in due:
+            self.flush(key)
+        return bool(due)
+
+    def flush(self, key: Hashable) -> None:
+        with self._lock:
+            b = self._buckets.pop(key, None)
+        if b is None or not b.requests:
+            return
+        BATCHER_BATCH_SIZE.observe(len(b.requests), batcher=self.name)
+        BATCHER_BATCH_TIME.observe(self.clock() - b.first_at, batcher=self.name)
+        try:
+            results = self.exec_fn(key, b.requests)
+        except Exception as e:  # deliver the error to every waiter
+            results = [e] * len(b.requests)
+        for ev, res in zip(b.events, results):
+            ev.result = res  # type: ignore[attr-defined]
+            ev.set()

@@ -1,0 +1,144 @@
+"""Interruption handling: queue events -> drain ahead of reclaim.
+
+Behavioral mirror of pkg/controllers/interruption (SURVEY.md §2.4, §3.4):
+an in-memory queue stands in for SQS (10-message receive batches, visibility
+semantics — pkg/providers/sqs/sqs.go:57-77); the controller parses four
+message kinds + noop (messages/{spotinterruption, rebalancerecommendation,
+scheduledchange, statechange, noop}), resolves the NodeClaim by instance id
+(the reference's status.instanceID field index, operator.go:284-305), marks
+the interrupted offering unavailable for spot interruptions (ICE cache,
+controller.go:219-225), and cordon-and-drains by deleting the NodeClaim
+(-> termination flow §3.3; replacement via provisioning §3.1).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..api import wellknown as wk
+from ..controllers import store as st
+from ..metrics.registry import NODECLAIMS_TERMINATED
+from ..providers.unavailable import UnavailableOfferings
+
+# message kinds (messages/* in the reference)
+SPOT_INTERRUPTION = "spot_interruption"  # 2-minute reclaim warning
+REBALANCE_RECOMMENDATION = "rebalance_recommendation"
+SCHEDULED_CHANGE = "scheduled_change"  # host maintenance
+STATE_CHANGE = "state_change"  # stopping/terminating outside karpenter
+NOOP = "noop"
+
+KINDS = (SPOT_INTERRUPTION, REBALANCE_RECOMMENDATION, SCHEDULED_CHANGE, STATE_CHANGE, NOOP)
+
+
+@dataclass
+class Message:
+    kind: str
+    instance_id: str = ""
+    state: str = ""  # for state_change: stopping | terminating | ...
+    received_at: float = field(default_factory=time.monotonic)
+
+
+class InterruptionQueue:
+    """In-memory SQS stand-in: send / receive(max 10) / delete."""
+
+    MAX_RECEIVE = 10  # sqs.go:57-77 batch size
+
+    def __init__(self):
+        self._q: deque = deque()
+        self._inflight: Dict[int, Message] = {}
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def send(self, msg: Message) -> None:
+        with self._lock:
+            self._q.append(msg)
+
+    def receive(self) -> List[tuple]:
+        """Returns [(handle, Message)] up to MAX_RECEIVE."""
+        out = []
+        with self._lock:
+            while self._q and len(out) < self.MAX_RECEIVE:
+                msg = self._q.popleft()
+                self._seq += 1
+                self._inflight[self._seq] = msg
+                out.append((self._seq, msg))
+        return out
+
+    def delete(self, handle: int) -> None:
+        with self._lock:
+            self._inflight.pop(handle, None)
+
+    def requeue_inflight(self) -> None:
+        """Visibility timeout expiry: undeleted messages return to the queue."""
+        with self._lock:
+            for h in sorted(self._inflight):
+                self._q.appendleft(self._inflight.pop(h))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+
+class InterruptionController:
+    name = "interruption"
+
+    # which kinds trigger cordon-and-drain (controller.go:96-137: all but noop;
+    # state_change only for stopping/terminating states)
+    _ACTIONABLE_STATES = {"stopping", "stopped", "shutting-down", "terminated"}
+
+    def __init__(
+        self,
+        store: st.Store,
+        queue: InterruptionQueue,
+        unavailable: Optional[UnavailableOfferings] = None,
+    ):
+        self.store = store
+        self.queue = queue
+        self.unavailable = unavailable or UnavailableOfferings()
+
+    def reconcile(self) -> bool:
+        batch = self.queue.receive()
+        if not batch:
+            return False
+        for handle, msg in batch:
+            try:
+                self._handle(msg)
+            finally:
+                self.queue.delete(handle)
+        return True
+
+    # -- per-message --------------------------------------------------------
+
+    def _handle(self, msg: Message) -> None:
+        if msg.kind == NOOP:
+            return
+        if msg.kind == STATE_CHANGE and msg.state not in self._ACTIONABLE_STATES:
+            return
+        claim = self._claim_by_instance(msg.instance_id)
+        if claim is None:
+            return
+        if msg.kind == SPOT_INTERRUPTION and claim.capacity_type == wk.CAPACITY_TYPE_SPOT:
+            # the spot pool just proved unavailable: mask the offering so the
+            # replacement solve avoids it (controller.go:219-225)
+            self.unavailable.mark_unavailable(
+                wk.CAPACITY_TYPE_SPOT, claim.instance_type, claim.zone
+            )
+        # cordon-and-drain == delete the NodeClaim; termination handles the rest
+        if not claim.meta.deleting:
+            try:
+                self.store.delete(st.NODECLAIMS, claim.name)
+            except st.NotFound:
+                pass
+            NODECLAIMS_TERMINATED.inc(nodepool=claim.nodepool, reason="interrupted")
+
+    def _claim_by_instance(self, instance_id: str):
+        if not instance_id:
+            return None
+        for c in self.store.list(st.NODECLAIMS):
+            if c.provider_id and c.provider_id.rsplit("/", 1)[-1] == instance_id:
+                return c
+        return None

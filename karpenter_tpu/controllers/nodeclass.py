@@ -1,0 +1,111 @@
+"""NodeClass status + drift controllers.
+
+- NodeClassController: the status reconciler (readiness) of
+  pkg/controllers/nodeclass/controller.go:62-100 — resolves the class's
+  catalog selection, validates it matches something, sets readiness.
+- DriftController: hash-based drift detection (cloudprovider.go IsDrifted +
+  drift.go:34-74 behaviorally): a claim drifts when its recorded
+  nodepool-hash or nodeclass-hash no longer matches the live objects, or its
+  instance no longer satisfies the class selection (AMI-drift analog via
+  image_version). The disruption engine's Drift method then replaces it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import wellknown as wk
+from ..api.nodeclass import KwokNodeClass
+from ..api.objects import NodePool
+from ..catalog.catalog import generate
+from ..controllers import store as st
+
+
+def nodepool_static_hash(np_obj: NodePool) -> str:
+    import hashlib
+    import json
+
+    t = np_obj.template
+    spec = {
+        "labels": sorted(t.labels.items()),
+        "annotations": sorted(t.annotations.items()),
+        "taints": sorted((x.key, x.value, x.effect) for x in t.taints),
+        "startup_taints": sorted((x.key, x.value, x.effect) for x in t.startup_taints),
+        "requirements": sorted(
+            (k, r.complement, sorted(r.values), r.greater_than, r.less_than)
+            for k, r in t.requirements.items()
+        ),
+        "node_class_ref": t.node_class_ref,
+        "expire_after_s": t.expire_after_s,
+    }
+    return hashlib.sha256(json.dumps(spec, sort_keys=True).encode()).hexdigest()[:16]
+
+
+class NodeClassController:
+    name = "nodeclass.status"
+
+    def __init__(self, store: st.Store, catalog=None):
+        self.store = store
+        self.catalog = catalog if catalog is not None else generate()
+
+    def reconcile(self) -> bool:
+        did = False
+        for nc in self.store.list(st.NODECLASSES):
+            ready, msg = self._resolve(nc)
+            if nc.ready != ready or nc.status_message != msg:
+                nc.ready = ready
+                nc.status_message = msg
+                self.store.update(st.NODECLASSES, nc)
+                did = True
+        return did
+
+    def _resolve(self, nc: KwokNodeClass):
+        matched = 0
+        for it in self.catalog:
+            fam = it.name.split(".")[0]
+            if nc.instance_families is not None and fam not in nc.instance_families:
+                continue
+            gen_req = it.requirements.get("karpenter.tpu/instance-generation")
+            if gen_req is not None:
+                gen = int(gen_req.values_list()[0]) if gen_req.values_list() else 0
+                if gen < nc.min_generation:
+                    continue
+            matched += 1
+        if matched == 0:
+            return False, "no instance types match the class selection"
+        return True, f"{matched} instance types resolved"
+
+
+class DriftController:
+    name = "nodeclaim.drift"
+
+    def __init__(self, store: st.Store):
+        self.store = store
+
+    def reconcile(self) -> bool:
+        nodepools = {p.name: p for p in self.store.list(st.NODEPOOLS)}
+        classes = {c.name: c for c in self.store.list(st.NODECLASSES)}
+        did = False
+        for claim in self.store.list(st.NODECLAIMS):
+            if not claim.initialized or claim.meta.deleting:
+                continue
+            reason = self._drift_reason(claim, nodepools, classes)
+            if reason != claim.drifted:
+                claim.drifted = reason
+                self.store.update(st.NODECLAIMS, claim)
+                did = True
+        return did
+
+    def _drift_reason(self, claim, nodepools, classes) -> Optional[str]:
+        np_obj = nodepools.get(claim.nodepool)
+        if np_obj is None:
+            return None  # ownerless claims are GC'd elsewhere, not drifted
+        recorded_np = claim.meta.annotations.get(wk.NODEPOOL_HASH_ANNOTATION)
+        if recorded_np is not None and recorded_np != nodepool_static_hash(np_obj):
+            return "NodePoolDrifted"
+        nc = classes.get(claim.node_class_ref)
+        if nc is not None:
+            recorded_nc = claim.meta.annotations.get(wk.NODECLASS_HASH_ANNOTATION)
+            if recorded_nc is not None and recorded_nc != nc.static_hash():
+                return "NodeClassDrifted"
+        return None

@@ -1,0 +1,75 @@
+"""Event recorder with dedupe.
+
+Mirror of karpenter core pkg/events (SURVEY.md §2.1): typed events attached
+to objects, with a dedupe window so hot reconcile loops don't flood the
+stream (the reference's recorder drops identical events within a TTL).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Event:
+    kind: str  # object kind
+    name: str  # object name
+    type: str  # Normal | Warning
+    reason: str
+    message: str
+
+
+class Recorder:
+    def __init__(self, dedupe_ttl_s: float = 60.0, max_events: int = 10_000, clock=time.monotonic):
+        self.dedupe_ttl_s = dedupe_ttl_s
+        self.max_events = max_events
+        self.clock = clock
+        self._events: List[Tuple[float, Event]] = []
+        self._last_seen: Dict[Event, float] = {}
+        self._lock = threading.Lock()
+
+    def publish(self, event: Event) -> bool:
+        """Record unless an identical event fired within the dedupe TTL.
+        Returns True if recorded."""
+        with self._lock:
+            now = self.clock()
+            last = self._last_seen.get(event)
+            if last is not None and now - last < self.dedupe_ttl_s:
+                return False
+            self._last_seen[event] = now
+            self._events.append((now, event))
+            if len(self._events) > self.max_events:
+                self._events = self._events[-self.max_events :]
+            return True
+
+    def events(self, kind: Optional[str] = None, name: Optional[str] = None) -> List[Event]:
+        with self._lock:
+            return [
+                e
+                for _, e in self._events
+                if (kind is None or e.kind == kind) and (name is None or e.name == name)
+            ]
+
+
+# Typed event constructors (the reference's per-subsystem events packages)
+def nominated(pod_name: str, node_name: str) -> Event:
+    return Event("pods", pod_name, "Normal", "Nominated", f"Pod should schedule on {node_name}")
+
+
+def unschedulable(pod_name: str, reason: str) -> Event:
+    return Event("pods", pod_name, "Warning", "FailedScheduling", reason)
+
+
+def launched(claim_name: str, instance_type: str) -> Event:
+    return Event("nodeclaims", claim_name, "Normal", "Launched", f"Launched {instance_type}")
+
+
+def disrupted(node_name: str, reason: str) -> Event:
+    return Event("nodes", node_name, "Normal", "DisruptionBlocked" if "blocked" in reason else "Disrupted", reason)
+
+
+def interrupted(claim_name: str, kind: str) -> Event:
+    return Event("nodeclaims", claim_name, "Warning", "Interrupted", f"Interruption: {kind}")

@@ -18,7 +18,9 @@ from ..cloudprovider.types import InstanceType
 from ..controllers import store as st
 from ..controllers.binder import Binder
 from ..controllers.garbagecollection import GarbageCollectionController
+from ..controllers.interruption import InterruptionController, InterruptionQueue
 from ..controllers.manager import Manager
+from ..controllers.nodeclass import DriftController, NodeClassController
 from ..kwok.cloud import KwokCloud
 from ..kwok.cloudprovider import KwokCloudProvider
 from ..lifecycle.controller import (
@@ -43,6 +45,7 @@ class Operator:
     provisioner: Provisioner
     manager: Manager
     solver: Solver
+    interruption_queue: InterruptionQueue = field(default_factory=InterruptionQueue)
 
 
 def new_kwok_operator(
@@ -69,6 +72,7 @@ def new_kwok_operator(
         batch_max_s=batch_max_s,
         clock=clock,
     )
+    queue = InterruptionQueue()
     manager = Manager()
     manager.register(
         provisioner,
@@ -80,6 +84,9 @@ def new_kwok_operator(
         LivenessController(store, clock=clock),
         ExpirationController(store, clock=clock),
         GarbageCollectionController(store, cloud, clock=clock),
+        NodeClassController(store, catalog=types),
+        DriftController(store),
+        InterruptionController(store, queue, unavailable=cloud_provider.unavailable),
     )
     if disruption:
         from ..disruption.controller import DisruptionController
@@ -93,4 +100,5 @@ def new_kwok_operator(
         provisioner=provisioner,
         manager=manager,
         solver=solver,
+        interruption_queue=queue,
     )

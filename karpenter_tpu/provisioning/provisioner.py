@@ -135,10 +135,18 @@ class Provisioner:
                     wk.INSTANCE_TYPE_LABEL, IN, claim_res.instance_type_names
                 )
             )
+            annotations = {}
+            from ..controllers.nodeclass import nodepool_static_hash
+
+            annotations[wk.NODEPOOL_HASH_ANNOTATION] = nodepool_static_hash(np_obj)
+            nc = self.store.try_get(st.NODECLASSES, np_obj.template.node_class_ref)
+            if nc is not None:
+                annotations[wk.NODECLASS_HASH_ANNOTATION] = nc.static_hash()
             claim = NodeClaim(
                 meta=ObjectMeta(
                     name=name,
                     labels={wk.NODEPOOL_LABEL: claim_res.nodepool},
+                    annotations=annotations,
                     finalizers=[wk.TERMINATION_FINALIZER],
                 ),
                 nodepool=claim_res.nodepool,

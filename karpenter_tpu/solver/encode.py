@@ -164,22 +164,48 @@ def quantize_resources(res: Resources, ceil: bool) -> Resources:
     return out
 
 
-def quantize_input(inp: SolverInput) -> SolverInput:
-    """A copy of `inp` with all resources MiB-quantized — what the hybrid
-    production path and the parity tests feed the reference solver so both
-    backends see identical numbers."""
-    import copy
+_QUANTIZED_TYPE_CACHE: dict = {}
 
-    out = copy.deepcopy(inp)
-    for pod in list(out.pods) + list(out.daemonset_pods):
-        pod.requests = quantize_resources(pod.requests, ceil=True)
-    for n in out.nodes:
-        n.free = quantize_resources(n.free, ceil=False)
-    for pool in out.nodepools:
-        for it in pool.instance_types:
-            it.capacity = quantize_resources(it.capacity, ceil=False)
-            it.overhead = quantize_resources(it.overhead, ceil=True)
-    return out
+
+def _quantize_type(it):
+    """Per-InstanceType quantization, cached by object identity (the catalog
+    is static across solves; 50k-pod solves must not pay a deepcopy)."""
+    cached = _QUANTIZED_TYPE_CACHE.get(id(it))
+    if cached is not None and cached[0] is it:
+        return cached[1]
+    from dataclasses import replace as _replace
+
+    q = _replace(
+        it,
+        capacity=quantize_resources(it.capacity, ceil=False),
+        overhead=quantize_resources(it.overhead, ceil=True),
+    )
+    _QUANTIZED_TYPE_CACHE[id(it)] = (it, q)
+    return q
+
+
+def quantize_input(inp: SolverInput) -> SolverInput:
+    """A structurally-shared copy of `inp` with all resources MiB-quantized —
+    what the hybrid production path and the parity tests feed the reference
+    solver so both backends see identical numbers. Only the quantized fields
+    are fresh objects; everything else is shared (nothing downstream mutates
+    pods/types)."""
+    from dataclasses import replace as _replace
+
+    return SolverInput(
+        pods=[_replace(p, requests=quantize_resources(p.requests, ceil=True)) for p in inp.pods],
+        nodes=[_replace(n, free=quantize_resources(n.free, ceil=False)) for n in inp.nodes],
+        nodepools=[
+            _replace(pool, instance_types=[_quantize_type(it) for it in pool.instance_types])
+            for pool in inp.nodepools
+        ],
+        daemonset_pods=[
+            _replace(p, requests=quantize_resources(p.requests, ceil=True))
+            for p in inp.daemonset_pods
+        ],
+        zones=inp.zones,
+        capacity_types=inp.capacity_types,
+    )
 
 
 def encode(inp: SolverInput) -> EncodedInput:

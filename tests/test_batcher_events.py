@@ -1,0 +1,84 @@
+"""Batcher coalescing engine + event recorder dedupe."""
+
+import threading
+
+from karpenter_tpu.batcher.batcher import Batcher
+from karpenter_tpu.events.recorder import Event, Recorder
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestBatcher:
+    def test_coalesces_and_splits_results(self):
+        calls = []
+
+        def exec_fn(key, reqs):
+            calls.append((key, list(reqs)))
+            return [r * 10 for r in reqs]
+
+        clock = FakeClock()
+        b = Batcher("test", exec_fn, idle_s=0.1, max_s=1.0, clock=clock)
+        w1 = b.add("k", 1)
+        w2 = b.add("k", 2)
+        clock.t = 0.2  # idle window elapsed
+        assert b.poll()
+        assert w1() == 10 and w2() == 20
+        assert len(calls) == 1 and calls[0][1] == [1, 2]
+
+    def test_max_items_flushes_immediately(self):
+        def exec_fn(key, reqs):
+            return list(reqs)
+
+        b = Batcher("test", exec_fn, idle_s=10, max_s=10, max_items=3)
+        waiters = [b.add("k", i) for i in range(3)]
+        # third add hit max_items -> flushed without poll
+        assert [w() for w in waiters] == [0, 1, 2]
+
+    def test_buckets_are_independent(self):
+        def exec_fn(key, reqs):
+            return [f"{key}:{r}" for r in reqs]
+
+        clock = FakeClock()
+        b = Batcher("test", exec_fn, idle_s=0.01, max_s=1, clock=clock)
+        wa = b.add("a", 1)
+        wb = b.add("b", 2)
+        clock.t = 0.2
+        b.poll()
+        assert wa() == "a:1" and wb() == "b:2"
+
+    def test_errors_propagate_to_all_waiters(self):
+        def exec_fn(key, reqs):
+            raise RuntimeError("cloud down")
+
+        b = Batcher("test", exec_fn, idle_s=0, max_s=0)
+        w = b.add("k", 1)
+        try:
+            w()
+            assert False, "should raise"
+        except RuntimeError as e:
+            assert "cloud down" in str(e)
+
+
+class TestRecorder:
+    def test_dedupe_window(self):
+        clock = FakeClock()
+        r = Recorder(dedupe_ttl_s=60, clock=clock)
+        e = Event("pods", "p", "Warning", "FailedScheduling", "nope")
+        assert r.publish(e)
+        assert not r.publish(e)  # deduped
+        clock.t = 61
+        assert r.publish(e)  # TTL elapsed
+        assert len(r.events("pods", "p")) == 2
+
+    def test_filtering(self):
+        r = Recorder()
+        r.publish(Event("pods", "a", "Normal", "X", "m"))
+        r.publish(Event("nodes", "b", "Normal", "Y", "m"))
+        assert len(r.events("pods")) == 1
+        assert r.events("nodes", "b")[0].reason == "Y"

@@ -1,0 +1,134 @@
+"""Interruption handling and drift detection e2e.
+
+Mirrors the reference's interruption controller behavior (SURVEY.md §3.4:
+SQS event -> ICE-cache spot offering -> delete NodeClaim -> replacement) and
+hash-based drift (drift.go:34-74 behaviorally): bumping the NodeClass image
+version drifts and replaces nodes.
+"""
+
+import pytest
+
+from karpenter_tpu.api import wellknown as wk
+from karpenter_tpu.api.nodeclass import KwokNodeClass
+from karpenter_tpu.api.objects import ObjectMeta
+from karpenter_tpu.controllers import store as st
+from karpenter_tpu.controllers.interruption import (
+    NOOP,
+    SPOT_INTERRUPTION,
+    STATE_CHANGE,
+    InterruptionQueue,
+    Message,
+)
+from karpenter_tpu.operator.operator import new_kwok_operator
+
+from tests.test_e2e_kwok import FakeClock, mkpod, mkpool
+
+
+@pytest.fixture
+def op():
+    clock = FakeClock()
+    o = new_kwok_operator(clock=clock)
+    o.clock = clock
+    return o
+
+
+def provision_one(op, pod_name="p", **kw):
+    op.store.create(st.PODS, mkpod(pod_name, **kw))
+    op.manager.settle()
+    return op.store.list(st.NODECLAIMS)[0]
+
+
+class TestInterruption:
+    def test_spot_interruption_replaces_and_ices(self, op):
+        pool = mkpool()
+        from karpenter_tpu.scheduling.requirements import IN, Requirement
+
+        pool.template.requirements.add(
+            Requirement.create(wk.CAPACITY_TYPE_LABEL, IN, [wk.CAPACITY_TYPE_SPOT])
+        )
+        op.store.create(st.NODEPOOLS, pool)
+        claim = provision_one(op)
+        assert claim.capacity_type == wk.CAPACITY_TYPE_SPOT
+        old_instance = claim.provider_id.rsplit("/", 1)[-1]
+        op.interruption_queue.send(
+            Message(kind=SPOT_INTERRUPTION, instance_id=old_instance)
+        )
+        op.manager.settle()
+        # offering ICE'd
+        assert op.cloud_provider.unavailable.is_unavailable(
+            wk.CAPACITY_TYPE_SPOT, claim.instance_type, claim.zone
+        )
+        # old instance gone, replacement exists, pod rebound
+        assert not op.cloud.describe_instances([old_instance])
+        claims = op.store.list(st.NODECLAIMS)
+        assert len(claims) == 1 and claims[0].name != claim.name
+        # replacement avoided the ICE'd offering
+        assert (claims[0].instance_type, claims[0].zone) != (claim.instance_type, claim.zone)
+        assert op.store.get(st.PODS, "p").node_name == claims[0].node_name
+
+    def test_noop_and_benign_state_change_ignored(self, op):
+        op.store.create(st.NODEPOOLS, mkpool())
+        claim = provision_one(op)
+        iid = claim.provider_id.rsplit("/", 1)[-1]
+        op.interruption_queue.send(Message(kind=NOOP, instance_id=iid))
+        op.interruption_queue.send(Message(kind=STATE_CHANGE, instance_id=iid, state="running"))
+        op.manager.settle()
+        assert op.store.list(st.NODECLAIMS)[0].name == claim.name  # untouched
+
+    def test_state_change_stopping_drains(self, op):
+        op.store.create(st.NODEPOOLS, mkpool())
+        claim = provision_one(op)
+        iid = claim.provider_id.rsplit("/", 1)[-1]
+        op.interruption_queue.send(Message(kind=STATE_CHANGE, instance_id=iid, state="stopping"))
+        op.manager.settle()
+        claims = op.store.list(st.NODECLAIMS)
+        assert claims and claims[0].name != claim.name  # replaced
+
+    def test_queue_visibility(self):
+        q = InterruptionQueue()
+        for i in range(25):
+            q.send(Message(kind=NOOP, instance_id=str(i)))
+        batch = q.receive()
+        assert len(batch) == 10  # 10-message batches (sqs.go:57-77)
+        q.requeue_inflight()
+        assert len(q) == 25  # undeleted messages return
+
+
+class TestDrift:
+    def test_nodeclass_image_bump_drifts_and_replaces(self, op):
+        nc = KwokNodeClass(meta=ObjectMeta(name="default"), image_version="v1")
+        op.store.create(st.NODECLASSES, nc)
+        op.store.create(st.NODEPOOLS, mkpool())
+        claim = provision_one(op)
+        assert claim.drifted is None
+        # bump the image version -> hash changes -> drift -> replacement
+        nc.image_version = "v2"
+        op.store.update(st.NODECLASSES, nc)
+        op.clock.advance(30)
+        op.manager.settle()
+        claims = op.store.list(st.NODECLAIMS)
+        assert len(claims) == 1
+        assert claims[0].name != claim.name
+        assert claims[0].drifted is None  # fresh claim records the new hash
+        assert op.store.get(st.PODS, "p").node_name == claims[0].node_name
+
+    def test_nodepool_template_change_drifts(self, op):
+        op.store.create(st.NODEPOOLS, mkpool())
+        claim = provision_one(op)
+        pool = op.store.list(st.NODEPOOLS)[0]
+        pool.template.labels["team"] = "new-team"
+        op.store.update(st.NODEPOOLS, pool)
+        op.clock.advance(30)
+        op.manager.settle()
+        claims = op.store.list(st.NODECLAIMS)
+        assert claims[0].name != claim.name  # replaced due to NodePoolDrifted
+
+    def test_nodeclass_readiness(self, op):
+        bad = KwokNodeClass(meta=ObjectMeta(name="bad"), instance_families=["nonexistent"])
+        op.store.create(st.NODECLASSES, bad)
+        op.manager.settle()
+        assert not op.store.get(st.NODECLASSES, "bad").ready
+        good = KwokNodeClass(meta=ObjectMeta(name="good"), instance_families=["m5", "c5"])
+        op.store.create(st.NODECLASSES, good)
+        op.manager.settle()
+        assert op.store.get(st.NODECLASSES, "good").ready
