@@ -145,6 +145,15 @@ class Node:
     ready: bool = False
     provider_id: str = ""
     unschedulable: bool = False
+    # node conditions: type -> status ("True"/"False"/"Unknown"), with the
+    # last transition time per type (drives the repair controller)
+    conditions: Dict[str, str] = field(default_factory=dict)
+    condition_since: Dict[str, float] = field(default_factory=dict)
+
+    def set_condition(self, ctype: str, status: str, now: float) -> None:
+        if self.conditions.get(ctype) != status:
+            self.conditions[ctype] = status
+            self.condition_since[ctype] = now
 
     @property
     def name(self) -> str:

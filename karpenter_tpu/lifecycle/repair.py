@@ -1,0 +1,73 @@
+"""Node auto-repair.
+
+Mirrors the reference's repair flow (pkg/cloudprovider/cloudprovider.go:
+264-305 RepairPolicies; website/.../concepts/disruption.md:208-234): when a
+node condition matches a repair policy and has persisted past the policy's
+toleration duration, the NodeClaim is force-deleted (repair is forceful — no
+pre-spun replacement; provisioning replaces reactively). A circuit breaker
+refuses to repair when >20% of the fleet is unhealthy — mass-unhealthiness
+usually means a controller/infra problem, not node problems.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List
+
+from ..api import wellknown as wk
+from ..cloudprovider.types import CloudProvider, RepairPolicy
+from ..controllers import store as st
+from ..metrics.registry import NODECLAIMS_TERMINATED
+
+UNHEALTHY_BREAKER_FRACTION = 0.2  # disruption.md:208-234
+
+
+class RepairController:
+    name = "node.repair"
+
+    def __init__(self, store: st.Store, cloud_provider: CloudProvider, clock=time.monotonic):
+        self.store = store
+        self.cloud_provider = cloud_provider
+        self.clock = clock
+
+    def reconcile(self) -> bool:
+        policies: List[RepairPolicy] = self.cloud_provider.repair_policies()
+        nodes = self.store.list(st.NODES)
+        if not nodes:
+            return False
+        now = self.clock()
+
+        def matches(node) -> bool:
+            for pol in policies:
+                if node.conditions.get(pol.condition_type) == pol.condition_status:
+                    return True
+            return False
+
+        unhealthy = [n for n in nodes if matches(n)]
+        if not unhealthy:
+            return False
+        if len(unhealthy) / len(nodes) > UNHEALTHY_BREAKER_FRACTION and len(nodes) > 1:
+            return False  # circuit breaker: fleet-wide problem, do nothing
+
+        claims_by_node = {c.node_name: c for c in self.store.list(st.NODECLAIMS) if c.node_name}
+        did = False
+        for node in unhealthy:
+            claim = claims_by_node.get(node.meta.name)
+            if claim is None or claim.meta.deleting:
+                continue
+            ripe = any(
+                node.conditions.get(pol.condition_type) == pol.condition_status
+                and now - node.condition_since.get(pol.condition_type, now)
+                >= pol.toleration_duration_s
+                for pol in policies
+            )
+            if not ripe:
+                continue
+            # forceful: no graceful drain wait (terminationGracePeriod ignored)
+            try:
+                self.store.delete(st.NODECLAIMS, claim.name)
+            except st.NotFound:
+                continue
+            NODECLAIMS_TERMINATED.inc(nodepool=claim.nodepool, reason="repaired")
+            did = True
+        return did

@@ -1,0 +1,107 @@
+"""karpenter-tpu controller binary (kwok configuration).
+
+The stand-in for kwok/main.go:32-100: flags -> operator wiring -> metrics +
+health endpoints -> controller loop. Runs the full hermetic control plane; a
+demo NodePool and pods can be injected via --demo for a self-contained
+smoke run.
+
+Usage:
+    python -m karpenter_tpu.operator [--solver-backend tpu|reference]
+                                     [--metrics-port 8080] [--demo]
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..controllers import store as st
+from ..metrics.registry import REGISTRY
+from ..solver.backend import ReferenceSolver, TPUSolver
+from . import options as opts
+from .operator import new_kwok_operator
+
+
+def serve_endpoints(port: int, health_port: int) -> None:
+    """Prometheus metrics + health probes (operator manager equivalents)."""
+
+    class MetricsHandler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path == "/metrics":
+                body = REGISTRY.expose().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path in ("/healthz", "/readyz"):
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"ok")
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", port), MetricsHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+
+def main(argv=None) -> int:
+    o = opts.parse(argv if argv is not None else sys.argv[1:])
+    logging.basicConfig(
+        level=getattr(logging, o.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    log = logging.getLogger("karpenter_tpu")
+    solver = TPUSolver() if o.solver_backend == "tpu" else ReferenceSolver()
+    op = new_kwok_operator(
+        solver=solver,
+        batch_idle_s=o.batch_idle_duration_s,
+        batch_max_s=o.batch_max_duration_s,
+        rate_limits=o.kwok_rate_limits,
+    )
+    serve_endpoints(o.metrics_port, o.health_probe_port)
+    log.info("karpenter-tpu starting: solver=%s metrics=:%d", o.solver_backend, o.metrics_port)
+
+    if o.demo:
+        _inject_demo(op, log)
+
+    op.manager.run(interval_s=0.5)
+    try:
+        while True:
+            time.sleep(5)
+            log.info(
+                "nodes=%d nodeclaims=%d pending=%d",
+                len(op.store.list(st.NODES)),
+                len(op.store.list(st.NODECLAIMS)),
+                len(op.cluster.pending_pods()),
+            )
+    except KeyboardInterrupt:
+        op.manager.stop()
+        return 0
+
+
+def _inject_demo(op, log) -> None:
+    from ..api.objects import NodePool, NodeClaimTemplate, ObjectMeta, Pod
+    from ..utils.resources import Resources
+
+    op.store.create(st.NODEPOOLS, NodePool(meta=ObjectMeta(name="demo"), template=NodeClaimTemplate()))
+    for i in range(10):
+        op.store.create(
+            st.PODS,
+            Pod(
+                meta=ObjectMeta(name=f"demo-{i}", uid=f"demo-{i}"),
+                requests=Resources.parse({"cpu": "500m", "memory": "512Mi"}),
+            ),
+        )
+    log.info("injected demo nodepool + 10 pods")
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)

@@ -30,6 +30,7 @@ from ..lifecycle.controller import (
     LivenessController,
     RegistrationController,
 )
+from ..lifecycle.repair import RepairController
 from ..provisioning.provisioner import Provisioner
 from ..solver.backend import ReferenceSolver, Solver, TPUSolver
 from ..state.cluster import Cluster
@@ -87,6 +88,7 @@ def new_kwok_operator(
         NodeClassController(store, catalog=types),
         DriftController(store),
         InterruptionController(store, queue, unavailable=cloud_provider.unavailable),
+        RepairController(store, cloud_provider, clock=clock),
     )
     if disruption:
         from ..disruption.controller import DisruptionController

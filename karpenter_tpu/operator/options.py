@@ -1,0 +1,87 @@
+"""Layered options/flag system.
+
+Mirrors the reference's flag surface (pkg/operator/options/options.go:30-56 +
+core settings, website/.../reference/settings.md:13-41): every option has a
+flag name, an env-var default (KARPENTER_<NAME>), and a code default; feature
+gates parse from a comma-separated string (settings.md:44-55). Provider
+options inject the same way the reference's `coreoptions.Injectables` do —
+register an Options subclass and it parses from the same argv/env layers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict, List, Optional, Sequence
+
+
+def _env_name(flag: str) -> str:
+    return "KARPENTER_" + flag.upper().replace("-", "_")
+
+
+@dataclass
+class Options:
+    """Core options (subset mirroring settings.md:13-41)."""
+
+    # batching (settings.md:15-16)
+    batch_idle_duration_s: float = 1.0
+    batch_max_duration_s: float = 10.0
+    # client throughput analog (settings.md:29-30)
+    kube_client_qps: int = 200
+    kube_client_burst: int = 300
+    # endpoints
+    metrics_port: int = 8080
+    health_probe_port: int = 8081
+    # behavior
+    log_level: str = "info"
+    preference_policy: str = "Respect"  # settings.md:38
+    feature_gates: str = ""
+    leader_elect: bool = True
+    # solver backend: tpu | reference
+    solver_backend: str = "tpu"
+    max_launch_instance_types: int = 60  # instance.go:60
+    # kwok provider
+    kwok_rate_limits: bool = False
+    vm_memory_overhead_percent: float = 0.075  # options.go:36-56
+    # self-contained smoke run (inject a demo nodepool + pods)
+    demo: bool = False
+
+    def gates(self) -> Dict[str, bool]:
+        out: Dict[str, bool] = {}
+        for part in self.feature_gates.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            k, _, v = part.partition("=")
+            out[k] = v.lower() != "false"
+        return out
+
+
+def parse(argv: Optional[Sequence[str]] = None, cls=Options) -> Options:
+    """argv > env (KARPENTER_*) > dataclass default."""
+    parser = argparse.ArgumentParser(prog="karpenter-tpu")
+    for f in fields(cls):
+        flag = "--" + f.name.replace("_", "-")
+        env = os.environ.get(_env_name(f.name))
+        default = f.default
+        if env is not None:
+            if f.type in ("bool", bool):
+                default = env.lower() in ("1", "true", "yes")
+            elif f.type in ("int", int):
+                default = int(env)
+            elif f.type in ("float", float):
+                default = float(env)
+            else:
+                default = env
+        if f.type in ("bool", bool):
+            parser.add_argument(flag, type=lambda s: s.lower() in ("1", "true", "yes"),
+                                default=default)
+        elif f.type in ("int", int):
+            parser.add_argument(flag, type=int, default=default)
+        elif f.type in ("float", float):
+            parser.add_argument(flag, type=float, default=default)
+        else:
+            parser.add_argument(flag, type=str, default=default)
+    ns = parser.parse_args(list(argv) if argv is not None else [])
+    return cls(**vars(ns))

@@ -1,0 +1,64 @@
+"""TTL caches with the reference's documented consistency windows.
+
+Mirror of pkg/cache/cache.go:19-59: each cache names its TTL so the staleness
+window is explicit. Defaults: 1m default, 5m instance types/offerings, 3m ICE
+(in unavailable.py), 24h SSM-analog, 60d discovered capacity, 10m validation.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Generic, Hashable, Optional, Tuple, TypeVar
+
+V = TypeVar("V")
+
+# cache.go:19-59
+DEFAULT_TTL_S = 60.0
+INSTANCE_TYPES_TTL_S = 5 * 60.0
+UNAVAILABLE_OFFERINGS_TTL_S = 3 * 60.0
+DISCOVERED_CAPACITY_TTL_S = 60 * 24 * 3600.0
+VALIDATION_TTL_S = 10 * 60.0
+
+
+class TTLCache(Generic[V]):
+    def __init__(self, ttl_s: float = DEFAULT_TTL_S, clock=time.monotonic):
+        self.ttl_s = ttl_s
+        self.clock = clock
+        self._data: Dict[Hashable, Tuple[float, V]] = {}
+        self._lock = threading.Lock()
+
+    def get(self, key: Hashable) -> Optional[V]:
+        with self._lock:
+            ent = self._data.get(key)
+            if ent is None:
+                return None
+            exp, val = ent
+            if exp <= self.clock():
+                del self._data[key]
+                return None
+            return val
+
+    def set(self, key: Hashable, value: V, ttl_s: Optional[float] = None) -> None:
+        with self._lock:
+            self._data[key] = (self.clock() + (ttl_s if ttl_s is not None else self.ttl_s), value)
+
+    def get_or_compute(self, key: Hashable, fn: Callable[[], V]) -> V:
+        val = self.get(key)
+        if val is None:
+            val = fn()
+            self.set(key, val)
+        return val
+
+    def invalidate(self, key: Hashable) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+
+    def flush(self) -> None:
+        with self._lock:
+            self._data.clear()
+
+    def __len__(self) -> int:
+        now = self.clock()
+        with self._lock:
+            return sum(1 for exp, _ in self._data.values() if exp > now)

@@ -1,0 +1,85 @@
+"""Pricing provider.
+
+Mirror of pkg/providers/pricing (SURVEY.md §2.2): on-demand prices refreshed
+on a 12h cadence from the price source, spot prices per (type, zone) on the
+same loop, with the generated static tables as fallback when the source is
+unreachable (the reference ships static price tables per partition). Here the
+"source" is pluggable: the synthetic catalog is the static table, and tests/
+simulations can inject live price movements (spot market drift) that flow
+into offerings on the next refresh.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..api import wellknown as wk
+from ..cloudprovider.types import InstanceType
+
+REFRESH_INTERVAL_S = 12 * 3600.0  # providers/pricing/controller.go:59
+
+
+class PricingProvider:
+    def __init__(
+        self,
+        instance_types: Sequence[InstanceType],
+        live_source: Optional[Callable[[], Dict[Tuple[str, str, str], float]]] = None,
+        clock=time.monotonic,
+    ):
+        self._lock = threading.Lock()
+        self.clock = clock
+        self.live_source = live_source
+        self._last_refresh = -REFRESH_INTERVAL_S
+        # static fallback tables from the catalog (the generated-price-table
+        # analog): (instance_type, zone, capacity_type) -> $/hr
+        self._static: Dict[Tuple[str, str, str], float] = {}
+        for it in instance_types:
+            for o in it.offerings:
+                self._static[(it.name, o.zone, o.capacity_type)] = o.price
+        self._live: Dict[Tuple[str, str, str], float] = {}
+
+    # -- refresh loop (12h cadence) -----------------------------------------
+
+    def refresh_if_due(self) -> bool:
+        if self.clock() - self._last_refresh < REFRESH_INTERVAL_S:
+            return False
+        return self.refresh()
+
+    def refresh(self) -> bool:
+        self._last_refresh = self.clock()
+        if self.live_source is None:
+            return False
+        try:
+            updates = self.live_source()
+        except Exception:
+            return False  # static fallback stays authoritative
+        with self._lock:
+            self._live.update(updates)
+        return bool(updates)
+
+    # -- queries -------------------------------------------------------------
+
+    def on_demand_price(self, instance_type: str, zone: str) -> Optional[float]:
+        return self.price(instance_type, zone, wk.CAPACITY_TYPE_ON_DEMAND)
+
+    def spot_price(self, instance_type: str, zone: str) -> Optional[float]:
+        return self.price(instance_type, zone, wk.CAPACITY_TYPE_SPOT)
+
+    def price(self, instance_type: str, zone: str, capacity_type: str) -> Optional[float]:
+        key = (instance_type, zone, capacity_type)
+        with self._lock:
+            if key in self._live:
+                return self._live[key]
+        return self._static.get(key)
+
+    def apply(self, instance_types: Sequence[InstanceType]) -> None:
+        """Rewrite offering prices in place from current tables (the analog
+        of offering injection reading the pricing provider,
+        offering/offering.go:119-126)."""
+        for it in instance_types:
+            for o in it.offerings:
+                p = self.price(it.name, o.zone, o.capacity_type)
+                if p is not None:
+                    o.price = p

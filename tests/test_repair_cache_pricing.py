@@ -1,0 +1,138 @@
+"""Repair controller, TTL caches, pricing provider, options parsing."""
+
+import pytest
+
+from karpenter_tpu.api import wellknown as wk
+from karpenter_tpu.controllers import store as st
+from karpenter_tpu.operator import options as opts
+from karpenter_tpu.operator.operator import new_kwok_operator
+from karpenter_tpu.providers.cache import TTLCache
+from karpenter_tpu.providers.pricing import PricingProvider
+from karpenter_tpu.catalog.catalog import generate
+
+from tests.test_e2e_kwok import FakeClock, mkpod, mkpool
+
+
+class TestRepair:
+    @pytest.fixture
+    def op(self):
+        clock = FakeClock()
+        o = new_kwok_operator(clock=clock)
+        o.clock = clock
+        return o
+
+    def _provision(self, op, n=1):
+        op.store.create(st.NODEPOOLS, mkpool())
+        from karpenter_tpu.api.objects import TopologySpreadConstraint
+
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.HOSTNAME_LABEL, label_selector={"app": "x"}
+        )
+        for i in range(n):
+            op.store.create(
+                st.PODS,
+                mkpod(f"p{i}", cpu="200m", labels={"app": "x"},
+                      topology_spread=[tsc] if n > 1 else []),
+            )
+        op.manager.settle()
+
+    def test_unhealthy_node_repaired_after_toleration(self, op):
+        self._provision(op)
+        node = op.store.list(st.NODES)[0]
+        node.set_condition("Ready", "False", op.clock())
+        op.store.update(st.NODES, node)
+        # not ripe yet (toleration 30m)
+        op.manager.settle()
+        assert op.store.try_get(st.NODES, node.meta.name) is not None
+        op.clock.advance(31 * 60)
+        op.manager.settle()
+        nodes = op.store.list(st.NODES)
+        assert all(n.meta.name != node.meta.name for n in nodes)  # replaced
+        assert op.store.get(st.PODS, "p0").node_name  # pod rescheduled
+
+    def test_circuit_breaker_on_mass_unhealthy(self, op):
+        self._provision(op, n=3)
+        nodes = op.store.list(st.NODES)
+        assert len(nodes) == 3
+        for n in nodes:  # 100% unhealthy > 20% breaker
+            n.set_condition("Ready", "False", op.clock())
+            op.store.update(st.NODES, n)
+        op.clock.advance(31 * 60)
+        op.manager.settle()
+        assert len(op.store.list(st.NODES)) == 3  # breaker held
+
+
+class TestTTLCache:
+    def test_expiry(self):
+        clock = FakeClock()
+        c = TTLCache(ttl_s=10, clock=clock)
+        c.set("k", 1)
+        assert c.get("k") == 1
+        clock.advance(11)
+        assert c.get("k") is None
+
+    def test_get_or_compute(self):
+        c = TTLCache(ttl_s=100, clock=FakeClock())
+        calls = []
+        assert c.get_or_compute("k", lambda: calls.append(1) or 42) == 42
+        assert c.get_or_compute("k", lambda: calls.append(1) or 43) == 42
+        assert len(calls) == 1
+
+
+class TestPricing:
+    def test_static_fallback_and_live_updates(self):
+        clock = FakeClock()
+        catalog = generate()
+        it = catalog[0]
+        o = it.offerings[0]
+        live = {}
+        p = PricingProvider(catalog, live_source=lambda: dict(live), clock=clock)
+        assert p.price(it.name, o.zone, o.capacity_type) == o.price
+        # live spot movement applies after refresh
+        live[(it.name, o.zone, o.capacity_type)] = 9.99
+        assert p.price(it.name, o.zone, o.capacity_type) == o.price  # not yet
+        clock.advance(13 * 3600)
+        assert p.refresh_if_due()
+        assert p.price(it.name, o.zone, o.capacity_type) == 9.99
+
+    def test_source_failure_keeps_static(self):
+        def boom():
+            raise RuntimeError("api down")
+
+        catalog = generate()
+        p = PricingProvider(catalog, live_source=boom)
+        assert not p.refresh()
+        it = catalog[0]
+        assert p.price(it.name, it.offerings[0].zone, it.offerings[0].capacity_type) is not None
+
+    def test_apply_rewrites_offerings(self):
+        catalog = generate()
+        it = catalog[0]
+        key = (it.name, it.offerings[0].zone, it.offerings[0].capacity_type)
+        p = PricingProvider(catalog, live_source=lambda: {key: 1.23})
+        p._last_refresh = -1e12
+        p.refresh()
+        p.apply([it])
+        assert it.offerings[0].price == 1.23
+
+
+class TestOptions:
+    def test_defaults(self):
+        o = opts.parse([])
+        assert o.batch_idle_duration_s == 1.0
+        assert o.solver_backend == "tpu"
+        assert o.kube_client_qps == 200
+
+    def test_argv_overrides(self):
+        o = opts.parse(["--solver-backend", "reference", "--batch-idle-duration-s", "0"])
+        assert o.solver_backend == "reference"
+        assert o.batch_idle_duration_s == 0.0
+
+    def test_env_layer(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_METRICS_PORT", "9999")
+        o = opts.parse([])
+        assert o.metrics_port == 9999
+
+    def test_feature_gates(self):
+        o = opts.parse(["--feature-gates", "SpotToSpotConsolidation=true,Other=false"])
+        assert o.gates() == {"SpotToSpotConsolidation": True, "Other": False}

@@ -1,0 +1,102 @@
+"""Scale harness: provisioning / deprovisioning wall-clock measurement.
+
+The hermetic analog of the reference's scale e2e suite (test/suites/scale/
+provisioning_test.go:76-240 + MeasureProvisioningDurationFor, SURVEY.md §4.4):
+drives node-dense and pod-dense scale-ups through the full control loop on
+kwok and emits duration measurements with the same dimensions (test name,
+node count, pods-per-node) as JSON lines on stderr — the Timestream-emission
+stand-in. Sizes are scaled to CI (1 core); the shape, not the absolute
+numbers, is what the harness preserves.
+"""
+
+import json
+import sys
+import time
+
+import pytest
+
+from karpenter_tpu.api import wellknown as wk
+from karpenter_tpu.controllers import store as st
+from karpenter_tpu.operator.operator import new_kwok_operator
+
+from tests.test_e2e_kwok import FakeClock, mkpod, mkpool
+
+
+def emit(test: str, seconds: float, nodes: int, pods_per_node: int) -> None:
+    print(
+        json.dumps(
+            {
+                "measurement": "provisioning_duration_s",
+                "test": test,
+                "value": round(seconds, 3),
+                "node_count": nodes,
+                "pods_per_node": pods_per_node,
+            }
+        ),
+        file=sys.stderr,
+    )
+
+
+class TestScale:
+    def test_node_dense_scale_up(self):
+        """N nodes x 1 pod/node (provisioning_test.go:76-121 shape)."""
+        clock = FakeClock()
+        op = new_kwok_operator(clock=clock, disruption=False)
+        op.store.create(st.NODEPOOLS, mkpool())
+        from karpenter_tpu.api.objects import TopologySpreadConstraint
+
+        n = 30
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.HOSTNAME_LABEL, label_selector={"app": "dense"}
+        )
+        for i in range(n):
+            op.store.create(
+                st.PODS,
+                mkpod(f"p{i:03d}", cpu="200m", mem="256Mi", labels={"app": "dense"},
+                      topology_spread=[tsc]),
+            )
+        t0 = time.perf_counter()
+        op.manager.settle(max_ticks=500)
+        dt = time.perf_counter() - t0
+        emit("node_dense", dt, n, 1)
+        assert len(op.store.list(st.NODES)) == n
+        assert all(p.node_name for p in op.store.list(st.PODS))
+
+    def test_pod_dense_scale_up(self):
+        """few nodes x many pods/node (provisioning_test.go:123-240 shape)."""
+        clock = FakeClock()
+        op = new_kwok_operator(clock=clock, disruption=False)
+        op.store.create(st.NODEPOOLS, mkpool())
+        pods = 300
+        for i in range(pods):
+            op.store.create(st.PODS, mkpod(f"p{i:03d}", cpu="100m", mem="128Mi"))
+        t0 = time.perf_counter()
+        op.manager.settle(max_ticks=500)
+        dt = time.perf_counter() - t0
+        nodes = op.store.list(st.NODES)
+        emit("pod_dense", dt, len(nodes), pods // max(len(nodes), 1))
+        assert all(p.node_name for p in op.store.list(st.PODS))
+        # density proves packing: far fewer nodes than pods
+        assert len(nodes) <= 4
+
+    def test_deprovisioning(self):
+        """consolidation tear-down wall-clock (deprovisioning measurement)."""
+        clock = FakeClock()
+        op = new_kwok_operator(clock=clock)
+        op.clock = clock
+        op.store.create(st.NODEPOOLS, mkpool())
+        for i in range(60):
+            op.store.create(st.PODS, mkpod(f"p{i:03d}", cpu="500m", mem="512Mi"))
+        op.manager.settle(max_ticks=500)
+        n_before = len(op.store.list(st.NODES))
+        # workload shrinks: delete half the pods
+        for i in range(0, 60, 2):
+            p = op.store.get(st.PODS, f"p{i:03d}")
+            p.meta.finalizers = []
+            op.store.delete(st.PODS, f"p{i:03d}")
+        clock.advance(30)
+        t0 = time.perf_counter()
+        op.manager.settle(max_ticks=500)
+        dt = time.perf_counter() - t0
+        emit("deprovision_half", dt, n_before, 0)
+        assert all(p.node_name for p in op.store.list(st.PODS))
