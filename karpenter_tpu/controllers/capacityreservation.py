@@ -1,0 +1,63 @@
+"""Reserved -> on-demand capacity-type flips.
+
+Mirror of pkg/controllers/nodeclaim/capacityreservation (controller.go:45-107,
+SURVEY.md §2.4): when a node's backing capacity reservation expires, the
+instance keeps running but is now billed on-demand — the claim and node flip
+their karpenter.sh/capacity-type from `reserved` to `on-demand` (and pricing
+updates) so consolidation sees the true cost.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..api import wellknown as wk
+from ..controllers import store as st
+from ..kwok.cloud import KwokCloud
+from ..providers.capacityreservation import CapacityReservationProvider
+
+
+class CapacityReservationFlipController:
+    name = "nodeclaim.capacityreservation"
+
+    def __init__(
+        self,
+        store: st.Store,
+        cloud: KwokCloud,
+        reservations: CapacityReservationProvider,
+        clock=time.monotonic,
+    ):
+        self.store = store
+        self.cloud = cloud
+        self.reservations = reservations
+        self.clock = clock
+
+    def reconcile(self) -> bool:
+        did = False
+        active = {r.id for r in self.reservations.list()}
+        for claim in self.store.list(st.NODECLAIMS):
+            if claim.capacity_type != wk.CAPACITY_TYPE_RESERVED or claim.meta.deleting:
+                continue
+            iid = claim.provider_id.rsplit("/", 1)[-1] if claim.provider_id else ""
+            insts = self.cloud.describe_instances([iid]) if iid else []
+            if not insts:
+                continue
+            inst = insts[0]
+            if inst.reservation_id and inst.reservation_id in active:
+                continue
+            # reservation gone: flip to on-demand at the od price
+            claim.capacity_type = wk.CAPACITY_TYPE_ON_DEMAND
+            it = self.cloud.types.get(claim.instance_type)
+            if it is not None:
+                for o in it.offerings:
+                    if o.zone == claim.zone and o.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND:
+                        claim.price = o.price
+                        break
+            self.store.update(st.NODECLAIMS, claim)
+            if claim.node_name:
+                node = self.store.try_get(st.NODES, claim.node_name)
+                if node is not None:
+                    node.meta.labels[wk.CAPACITY_TYPE_LABEL] = wk.CAPACITY_TYPE_ON_DEMAND
+                    self.store.update(st.NODES, node)
+            did = True
+        return did

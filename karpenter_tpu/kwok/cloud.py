@@ -40,6 +40,7 @@ class FleetOverride:
     zone: str
     capacity_type: str
     price: float
+    reservation_id: str = ""
 
 
 @dataclass
@@ -49,6 +50,7 @@ class Instance:
     zone: str
     capacity_type: str
     price: float
+    reservation_id: str = ""
     tags: Dict[str, str] = field(default_factory=dict)
     state: str = "running"  # running | shutting-down | terminated
     launch_time: float = field(default_factory=time.monotonic)
@@ -127,6 +129,7 @@ class KwokCloud:
                     zone=ov.zone,
                     capacity_type=ov.capacity_type,
                     price=ov.price,
+                    reservation_id=ov.reservation_id,
                     tags=dict(tags or {}),
                 )
                 self._instances[inst.id] = inst

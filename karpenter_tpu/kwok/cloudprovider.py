@@ -28,6 +28,7 @@ from ..cloudprovider.types import (
     Offering,
     truncate,
 )
+from ..providers.capacityreservation import CapacityReservationProvider
 from ..providers.unavailable import UnavailableOfferings
 from ..scheduling.requirements import Requirements
 from ..utils.resources import Resources
@@ -40,15 +41,17 @@ class KwokCloudProvider(CloudProvider):
         cloud: KwokCloud,
         instance_types: Sequence[InstanceType],
         unavailable: Optional[UnavailableOfferings] = None,
+        reservations: Optional[CapacityReservationProvider] = None,
         max_launch_types: int = 60,
     ):
         self.cloud = cloud
         self._types = list(instance_types)
         self._by_name = {it.name: it for it in instance_types}
         self.unavailable = unavailable or UnavailableOfferings()
+        self.reservations = reservations or CapacityReservationProvider()
         self.max_launch_types = max_launch_types
         self._lock = threading.Lock()
-        self._ice_seq = -1
+        self._ice_seq = (-1, -1)
         self._masked_cache: List[InstanceType] = []
 
     # -- instance types -----------------------------------------------------
@@ -58,7 +61,7 @@ class KwokCloudProvider(CloudProvider):
         Rebuilt only when the ICE SeqNum moves (offering/offering.go:181-199
         cache-key protocol)."""
         with self._lock:
-            seq = self.unavailable.seq_num
+            seq = (self.unavailable.seq_num, self._reservation_version())
             if seq == self._ice_seq:
                 return self._masked_cache
             out: List[InstanceType] = []
@@ -78,15 +81,19 @@ class KwokCloudProvider(CloudProvider):
                 out.append(
                     InstanceType(
                         name=it.name,
-                        requirements=it.requirements,
+                        requirements=Requirements(it.requirements),
                         capacity=it.capacity,
                         overhead=it.overhead,
                         offerings=offerings,
                     )
                 )
+            self.reservations.inject(out)
             self._ice_seq = seq
             self._masked_cache = out
             return out
+
+    def _reservation_version(self) -> int:
+        return sum((r.total + 1) * 1000 + r.available for r in self.reservations.list())
 
     # -- create -------------------------------------------------------------
 
@@ -120,6 +127,7 @@ class KwokCloudProvider(CloudProvider):
                         zone=o.zone,
                         capacity_type=o.capacity_type,
                         price=o.price,
+                        reservation_id=o.reservation_id,
                     )
                 )
         if not overrides:
@@ -135,6 +143,8 @@ class KwokCloudProvider(CloudProvider):
                 f"all {len(overrides)} offerings failed",
                 offerings=[(e.instance_type, e.zone, e.capacity_type) for e in errors],
             )
+        if inst.capacity_type == wk.CAPACITY_TYPE_RESERVED and inst.reservation_id:
+            self.reservations.mark_launched(inst.reservation_id)
         it = self._by_name[inst.instance_type]
         claim.provider_id = f"kwok:///{inst.zone}/{inst.id}"
         claim.instance_type = inst.instance_type
@@ -169,7 +179,10 @@ class KwokCloudProvider(CloudProvider):
             raise NodeClaimNotFoundError(claim.provider_id)
         if insts[0].state == "shutting-down":
             return  # already terminating (instance.go:203-221 dedup)
+        inst = insts[0]
         self.cloud.terminate_instances([iid])
+        if inst.capacity_type == wk.CAPACITY_TYPE_RESERVED and inst.reservation_id:
+            self.reservations.mark_terminated(inst.reservation_id)
 
     def _to_claim(self, inst) -> NodeClaim:
         from ..api.objects import ObjectMeta

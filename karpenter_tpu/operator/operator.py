@@ -18,9 +18,11 @@ from ..cloudprovider.types import InstanceType
 from ..controllers import store as st
 from ..controllers.binder import Binder
 from ..controllers.garbagecollection import GarbageCollectionController
+from ..controllers.capacityreservation import CapacityReservationFlipController
 from ..controllers.interruption import InterruptionController, InterruptionQueue
 from ..controllers.manager import Manager
 from ..controllers.nodeclass import DriftController, NodeClassController
+from ..providers.capacityreservation import CapacityReservationProvider
 from ..kwok.cloud import KwokCloud
 from ..kwok.cloudprovider import KwokCloudProvider
 from ..lifecycle.controller import (
@@ -61,7 +63,8 @@ def new_kwok_operator(
     store = st.Store()
     types = list(instance_types) if instance_types is not None else generate(CatalogSpec())
     cloud = KwokCloud(store, types, rate_limits=rate_limits)
-    cloud_provider = KwokCloudProvider(cloud, types)
+    reservations = CapacityReservationProvider(clock=clock)
+    cloud_provider = KwokCloudProvider(cloud, types, reservations=reservations)
     cluster = Cluster(store, clock=clock)
     solver = solver or ReferenceSolver()
     provisioner = Provisioner(
@@ -89,6 +92,7 @@ def new_kwok_operator(
         DriftController(store),
         InterruptionController(store, queue, unavailable=cloud_provider.unavailable),
         RepairController(store, cloud_provider, clock=clock),
+        CapacityReservationFlipController(store, cloud, reservations, clock=clock),
     )
     if disruption:
         from ..disruption.controller import DisruptionController

@@ -1,0 +1,117 @@
+"""Capacity reservations — the `reserved` capacity type.
+
+Mirror of pkg/providers/capacityreservation (SURVEY.md §2.2): on-demand
+capacity reservation (ODCR-analog) discovery plus available-instance-count
+bookkeeping (MarkLaunched / MarkTerminated / MarkUnavailable,
+provider.go:34-40). Reserved offerings are injected priced at
+odPrice/10_000_000 — "nearly free" so price ordering always prefers them,
+while remaining ordered among themselves (offering/offering.go:96-179).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ..api import wellknown as wk
+from ..cloudprovider.types import InstanceType, Offering
+from ..scheduling.requirements import IN, Requirement
+
+RESERVED_PRICE_DIVISOR = 10_000_000  # offering.go reserved pricing rule
+
+
+@dataclass
+class CapacityReservation:
+    id: str
+    instance_type: str
+    zone: str
+    total: int
+    available: int
+    expires_at: Optional[float] = None  # monotonic deadline; None = no expiry
+
+    def active(self, now: float) -> bool:
+        return self.expires_at is None or now < self.expires_at
+
+
+class CapacityReservationProvider:
+    def __init__(self, clock=time.monotonic):
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._reservations: Dict[str, CapacityReservation] = {}
+
+    # -- discovery ----------------------------------------------------------
+
+    def add(self, res: CapacityReservation) -> None:
+        with self._lock:
+            self._reservations[res.id] = res
+
+    def list(self) -> List[CapacityReservation]:
+        now = self.clock()
+        with self._lock:
+            return [r for r in self._reservations.values() if r.active(now)]
+
+    def get(self, res_id: str) -> Optional[CapacityReservation]:
+        with self._lock:
+            return self._reservations.get(res_id)
+
+    # -- bookkeeping (provider.go:34-40) -------------------------------------
+
+    def mark_launched(self, res_id: str) -> bool:
+        with self._lock:
+            r = self._reservations.get(res_id)
+            if r is None or r.available <= 0:
+                return False
+            r.available -= 1
+            return True
+
+    def mark_terminated(self, res_id: str) -> None:
+        with self._lock:
+            r = self._reservations.get(res_id)
+            if r is not None:
+                r.available = min(r.total, r.available + 1)
+
+    def mark_unavailable(self, res_id: str) -> None:
+        with self._lock:
+            r = self._reservations.get(res_id)
+            if r is not None:
+                r.available = 0
+
+    # -- offering injection ---------------------------------------------------
+
+    def inject(self, instance_types: Sequence[InstanceType]) -> None:
+        """Append reserved offerings (and widen the capacity-type requirement)
+        for types with active reservations. Mutates the given (already-copied)
+        catalog view — call on the ICE-masked copy, not the shared catalog."""
+        by_type: Dict[str, List[CapacityReservation]] = {}
+        for r in self.list():
+            by_type.setdefault(r.instance_type, []).append(r)
+        for it in instance_types:
+            rs = by_type.get(it.name)
+            if not rs:
+                continue
+            od = {
+                o.zone: o.price
+                for o in it.offerings
+                if o.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND
+            }
+            for r in rs:
+                base = od.get(r.zone)
+                if base is None:
+                    continue
+                it.offerings.append(
+                    Offering(
+                        zone=r.zone,
+                        capacity_type=wk.CAPACITY_TYPE_RESERVED,
+                        price=base / RESERVED_PRICE_DIVISOR,
+                        available=r.available > 0,
+                        reservation_capacity=r.available,
+                        reservation_id=r.id,
+                    )
+                )
+            cts = sorted({o.capacity_type for o in it.offerings})
+            # widen (replace, not intersect) the capacity-type domain
+            it.requirements[wk.CAPACITY_TYPE_LABEL] = Requirement.create(
+                wk.CAPACITY_TYPE_LABEL, IN, cts
+            )

@@ -1,0 +1,99 @@
+"""Capacity reservations: reserved capacity type end-to-end.
+
+Mirrors the reference's ODCR behavior (SURVEY.md §2.2 capacityreservation,
+§2.2 offering: reserved offerings priced odPrice/10M so they always win price
+ordering; launch/terminate bookkeeping; reserved->on-demand flip on expiry,
+§2.4 nodeclaim/capacityreservation).
+"""
+
+import pytest
+
+from karpenter_tpu.api import wellknown as wk
+from karpenter_tpu.controllers import store as st
+from karpenter_tpu.operator.operator import new_kwok_operator
+from karpenter_tpu.providers.capacityreservation import CapacityReservation
+
+from tests.test_e2e_kwok import FakeClock, mkpod, mkpool
+
+
+@pytest.fixture
+def op():
+    clock = FakeClock()
+    o = new_kwok_operator(clock=clock)
+    o.clock = clock
+    return o
+
+
+def add_reservation(op, instance_type="m5.large", zone="zone-1a", count=2, expires=None):
+    op.cloud_provider.reservations.add(
+        CapacityReservation(
+            id=f"cr-{instance_type}-{zone}",
+            instance_type=instance_type,
+            zone=zone,
+            total=count,
+            available=count,
+            expires_at=expires,
+        )
+    )
+    return f"cr-{instance_type}-{zone}"
+
+
+class TestReservations:
+    def test_reserved_offering_preferred(self, op):
+        add_reservation(op, "m5.large", "zone-1a", count=2)
+        op.store.create(st.NODEPOOLS, mkpool())
+        op.store.create(st.PODS, mkpod("p", cpu="500m", mem="1Gi"))
+        op.manager.settle()
+        claim = op.store.list(st.NODECLAIMS)[0]
+        assert claim.capacity_type == wk.CAPACITY_TYPE_RESERVED
+        assert claim.instance_type == "m5.large"
+        assert claim.zone == "zone-1a"
+        # bookkeeping decremented
+        res = op.cloud_provider.reservations.get("cr-m5.large-zone-1a")
+        assert res.available == 1
+
+    def test_exhausted_reservation_falls_back(self, op):
+        add_reservation(op, "m5.large", "zone-1a", count=1)
+        op.store.create(st.NODEPOOLS, mkpool())
+        for i in range(2):
+            op.store.create(
+                st.PODS,
+                mkpod(f"p{i}", cpu="1500m", mem="6Gi",
+                      node_selector={wk.ZONE_LABEL: "zone-1a" if i == 0 else "zone-1b"}),
+            )
+        op.manager.settle()
+        claims = sorted(op.store.list(st.NODECLAIMS), key=lambda c: c.zone)
+        assert claims[0].capacity_type == wk.CAPACITY_TYPE_RESERVED  # zone-1a used it
+        assert claims[1].capacity_type != wk.CAPACITY_TYPE_RESERVED  # zone-1b: none there
+
+    def test_terminate_returns_capacity(self, op):
+        rid = add_reservation(op, "m5.large", "zone-1a", count=1)
+        op.store.create(st.NODEPOOLS, mkpool())
+        op.store.create(st.PODS, mkpod("p", cpu="500m", mem="1Gi"))
+        op.manager.settle()
+        assert op.cloud_provider.reservations.get(rid).available == 0
+        claim = op.store.list(st.NODECLAIMS)[0]
+        pod = op.store.get(st.PODS, "p")
+        pod.meta.finalizers = []
+        op.store.delete(st.PODS, "p")
+        op.store.delete(st.NODECLAIMS, claim.name)
+        op.manager.settle()
+        assert op.cloud_provider.reservations.get(rid).available == 1
+
+    def test_expiry_flips_to_on_demand(self, op):
+        add_reservation(op, "m5.large", "zone-1a", count=1, expires=2000.0)
+        # WhenEmpty: keep consolidation from immediately replacing the
+        # flipped (now expensive) node with spot — the flip itself is under test
+        op.store.create(st.NODEPOOLS, mkpool(consolidation="WhenEmpty"))
+        op.store.create(st.PODS, mkpod("p", cpu="500m", mem="1Gi"))
+        op.manager.settle()
+        claim = op.store.list(st.NODECLAIMS)[0]
+        assert claim.capacity_type == wk.CAPACITY_TYPE_RESERVED
+        assert claim.price < 0.001  # nearly-free reserved pricing
+        op.clock.advance(1500)  # past expires_at=2000 (clock starts at 1000)
+        op.manager.settle()
+        claim = op.store.list(st.NODECLAIMS)[0]
+        assert claim.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND
+        assert claim.price > 0.01  # od price now
+        node = op.store.get(st.NODES, claim.node_name)
+        assert node.meta.labels[wk.CAPACITY_TYPE_LABEL] == wk.CAPACITY_TYPE_ON_DEMAND
