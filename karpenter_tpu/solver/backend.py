@@ -105,7 +105,14 @@ class TPUSolver(Solver):
 
     def __init__(self, max_claims: int = 1024, fallback: Optional[Solver] = None):
         self.max_claims = max_claims
-        self.fallback = fallback or ReferenceSolver()
+        if fallback is None:
+            # fallback chain: native C++ core (compiled-class speed), which
+            # itself degrades to the python oracle for constructs neither
+            # encoded path expresses (topology/affinity, pending kernels)
+            from .native import NativeSolver
+
+            fallback = NativeSolver()
+        self.fallback = fallback
         self.stats: Dict[str, int] = {"device_solves": 0, "fallback_solves": 0}
 
     def solve(self, inp: SolverInput) -> SolverResult:

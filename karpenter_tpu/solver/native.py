@@ -1,0 +1,130 @@
+"""ctypes bridge to the native FFD core (native/ffd_core.cpp).
+
+Builds the shared library on first use (g++ -O2, cached by source mtime) and
+exposes `NativeSolver` — the compiled CPU fallback implementing the same
+encoded-tensor contract as the TPU kernel. Third leg of the differential
+parity suite (python oracle == native == TPU).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..provisioning.scheduler import SolverInput, SolverResult
+from .backend import ReferenceSolver, Solver, decode
+from .encode import EncodedInput, encode, quantize_input
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "ffd_core.cpp")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_LIB = os.path.join(_BUILD_DIR, "libffd_core.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build() -> str:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", _LIB, _SRC],
+            check=True,
+            capture_output=True,
+        )
+    return _LIB
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is None:
+            lib = ctypes.CDLL(_build())
+            i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+            u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+            lib.ffd_solve_native.restype = ctypes.c_int
+            lib.ffd_solve_native.argtypes = (
+                [ctypes.c_int32] * 9
+                + [i32p, i32p]  # runs
+                + [i32p, u8p, u8p, u8p, u8p, u8p, u8p]  # groups
+                + [i32p, i32p, u8p]  # types
+                + [u8p, u8p, u8p, i32p, i32p, i32p]  # pools
+                + [i32p, u8p]  # nodes
+                + [i32p, i32p, i32p, u8p, u8p, u8p, u8p, i32p, i32p, i32p]  # outputs
+            )
+            _lib = lib
+    return _lib
+
+
+def solve_encoded(enc: EncodedInput, max_claims: int = 1024):
+    """Run the native core on an (unpadded) EncodedInput; returns the same
+    tuple decode() consumes, or None on slot overflow."""
+    lib = load()
+    S, G, T, E, P = len(enc.run_group), enc.G, enc.T, enc.E, enc.P
+    R = enc.group_req.shape[1]
+    Z, C = len(enc.zones), len(enc.capacity_types)
+    M = max_claims
+    u8 = lambda a: np.ascontiguousarray(a, dtype=np.uint8)
+    i32 = lambda a: np.ascontiguousarray(a, dtype=np.int32)
+    INT32_MAX = np.int32(2**31 - 1)
+    type_charge = np.where(enc.charge_axes[None, :], enc.type_capacity, 0).astype(np.int32)
+
+    take_e = np.zeros((S, E), np.int32)
+    take_c = np.zeros((S, M), np.int32)
+    leftover = np.zeros(S, np.int32)
+    c_mask = np.zeros((M, T), np.uint8)
+    c_zone = np.zeros((M, Z), np.uint8)
+    c_ct = np.zeros((M, C), np.uint8)
+    c_gmask = np.zeros((M, G), np.uint8)
+    c_pool = np.zeros(M, np.int32)
+    c_cum = np.zeros((M, R), np.int32)
+    used = np.zeros(1, np.int32)
+
+    rc = lib.ffd_solve_native(
+        S, G, T, E, P, R, Z, C, M,
+        i32(enc.run_group), i32(enc.run_count),
+        i32(enc.group_req), u8(enc.group_compat_t), u8(enc.group_zone), u8(enc.group_ct),
+        u8(enc.group_pool), u8(enc.group_pair), u8(~enc.group_fallback),
+        i32(enc.type_alloc), i32(type_charge), u8(enc.offer_avail),
+        u8(enc.pool_type), u8(enc.pool_zone), u8(enc.pool_ct),
+        i32(enc.pool_daemon),
+        i32(np.where(enc.pool_limit < 0, INT32_MAX, enc.pool_limit)),
+        i32(enc.pool_usage),
+        i32(enc.node_free), u8(enc.node_compat),
+        take_e, take_c, leftover, c_mask, c_zone, c_ct, c_gmask, c_pool, c_cum, used,
+    )
+    if rc != 0:
+        return None
+    # decode() argument order: ..., c_pool, c_gmask, c_cum, used
+    return take_e, take_c, leftover, c_mask.astype(bool), c_zone.astype(bool), \
+        c_ct.astype(bool), c_pool, c_gmask.astype(bool), c_cum, int(used[0])
+
+
+class NativeSolver(Solver):
+    """Compiled CPU solver behind the same seam (fallback: python oracle)."""
+
+    def __init__(self, max_claims: int = 4096, fallback: Optional[Solver] = None):
+        self.max_claims = max_claims
+        self.fallback = fallback or ReferenceSolver()
+        self.stats = {"native_solves": 0, "fallback_solves": 0}
+
+    def solve(self, inp: SolverInput) -> SolverResult:
+        qinp = quantize_input(inp)
+        enc = encode(qinp)
+        if enc.group_fallback.any() or enc.has_topology or enc.has_affinity or enc.G == 0:
+            self.stats["fallback_solves"] += 1
+            return self.fallback.solve(qinp)
+        try:
+            out = solve_encoded(enc, self.max_claims)
+        except (OSError, subprocess.CalledProcessError):
+            out = None  # no toolchain / build failure: degrade gracefully
+        if out is None:
+            self.stats["fallback_solves"] += 1
+            return self.fallback.solve(qinp)
+        self.stats["native_solves"] += 1
+        return decode(enc, *out)

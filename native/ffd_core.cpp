@@ -1,0 +1,279 @@
+// Native FFD solver core.
+//
+// The compiled host-side implementation of solver/SPEC.md's FFD semantics
+// over the SAME encoded int32 tensors the TPU kernel consumes
+// (karpenter_tpu/solver/encode.py). Role in the framework:
+//
+//   * the fast CPU fallback when the device is unavailable or the input is
+//     below the device-dispatch threshold — matching the compiled-language
+//     performance class of the reference's Go scheduler rather than the
+//     Python oracle's;
+//   * a third leg for differential testing (python-oracle == C++ == TPU).
+//
+// Pure C ABI (ctypes-loaded, no pybind11 in this image). Single-threaded by
+// design: one solve is inherently sequential; parallelism lives above
+// (batched candidate simulation) and below (vectorized device kernel).
+//
+// Algorithm: identical to solver/tpu/ffd.py — runs of identical pods pour
+// first-fit over existing nodes, then open claims, then closed-form new-node
+// opening per pool in priority order with limit accounting. Arrays are
+// row-major int32/uint8 exactly as encode.py lays them out (unpadded).
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+#include <vector>
+
+namespace {
+
+constexpr int32_t BIG = 1 << 30;
+
+struct Dims {
+  int32_t S, G, T, E, P, R, Z, C, M;
+};
+
+inline int32_t fit_count_row(const int32_t* alloc, const int32_t* cum,
+                             const int32_t* req, int32_t R) {
+  int32_t k = BIG;
+  for (int32_t r = 0; r < R; ++r) {
+    if (req[r] > 0) {
+      int32_t rem = alloc[r] - cum[r];
+      int32_t kr = rem >= 0 ? rem / req[r] : -1;
+      k = std::min(k, kr);
+    }
+  }
+  return std::max(k, 0);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success, 1 on claim-slot overflow.
+// Outputs: take_e [S,E], take_c [S,M], leftover [S], c_mask [M,T] u8,
+//          c_zone [M,Z] u8, c_ct [M,C] u8, c_gmask [M,G] u8, c_pool [M],
+//          c_cum [M,R], used [1].
+int ffd_solve_native(
+    // dims
+    int32_t S, int32_t G, int32_t T, int32_t E, int32_t P, int32_t R,
+    int32_t Z, int32_t C, int32_t M,
+    // runs
+    const int32_t* run_group, const int32_t* run_count,
+    // groups
+    const int32_t* group_req,       // [G,R]
+    const uint8_t* group_compat_t,  // [G,T]
+    const uint8_t* group_zone,      // [G,Z]
+    const uint8_t* group_ct,        // [G,C]
+    const uint8_t* group_pool,      // [G,P]
+    const uint8_t* group_pair,      // [G,G]
+    const uint8_t* group_device,    // [G] (1 = handle here)
+    // types
+    const int32_t* type_alloc,      // [T,R]
+    const int32_t* type_charge,     // [T,R]
+    const uint8_t* offer_avail,     // [T,Z,C]
+    // pools
+    const uint8_t* pool_type,       // [P,T]
+    const uint8_t* pool_zone,       // [P,Z]
+    const uint8_t* pool_ct,         // [P,C]
+    const int32_t* pool_daemon,     // [P,R]
+    const int32_t* pool_limit,      // [P,R]
+    const int32_t* pool_usage0,     // [P,R]
+    // existing nodes
+    const int32_t* node_free,       // [E,R]
+    const uint8_t* node_compat,     // [G,E]
+    // outputs
+    int32_t* take_e, int32_t* take_c, int32_t* leftover,
+    uint8_t* c_mask, uint8_t* c_zone, uint8_t* c_ct, uint8_t* c_gmask,
+    int32_t* c_pool, int32_t* c_cum, int32_t* used_out) {
+  std::vector<int32_t> e_cum(static_cast<size_t>(E) * R, 0);
+  std::vector<int32_t> p_usage(pool_usage0, pool_usage0 + static_cast<size_t>(P) * R);
+  std::memset(take_e, 0, sizeof(int32_t) * S * E);
+  std::memset(take_c, 0, sizeof(int32_t) * S * M);
+  std::memset(leftover, 0, sizeof(int32_t) * S);
+  std::memset(c_mask, 0, static_cast<size_t>(M) * T);
+  std::memset(c_zone, 0, static_cast<size_t>(M) * Z);
+  std::memset(c_ct, 0, static_cast<size_t>(M) * C);
+  std::memset(c_gmask, 0, static_cast<size_t>(M) * G);
+  std::memset(c_cum, 0, sizeof(int32_t) * M * R);
+  for (int32_t m = 0; m < M; ++m) c_pool[m] = -1;
+  int32_t used = 0;
+  bool overflow = false;
+
+  std::vector<int32_t> k_t(T);          // per-type capacity scratch
+  std::vector<uint8_t> fit_t(T);
+
+  for (int32_t s = 0; s < S; ++s) {
+    const int32_t g = run_group[s];
+    int32_t remaining = group_device[g] ? run_count[s] : 0;
+    const int32_t* req = group_req + static_cast<size_t>(g) * R;
+    const uint8_t* gz = group_zone + static_cast<size_t>(g) * Z;
+    const uint8_t* gc = group_ct + static_cast<size_t>(g) * C;
+
+    // ---- 1. existing nodes ----------------------------------------------
+    for (int32_t e = 0; e < E && remaining > 0; ++e) {
+      if (!node_compat[static_cast<size_t>(g) * E + e]) continue;
+      int32_t cap = fit_count_row(node_free + static_cast<size_t>(e) * R,
+                                  e_cum.data() + static_cast<size_t>(e) * R, req, R);
+      int32_t take = std::min(cap, remaining);
+      if (take > 0) {
+        take_e[static_cast<size_t>(s) * E + e] = take;
+        for (int32_t r = 0; r < R; ++r)
+          e_cum[static_cast<size_t>(e) * R + r] += take * req[r];
+        remaining -= take;
+      }
+    }
+
+    // ---- 2. open claims --------------------------------------------------
+    for (int32_t m = 0; m < used && remaining > 0; ++m) {
+      const int32_t p = c_pool[m];
+      if (p < 0 || !group_pool[static_cast<size_t>(g) * P + p]) continue;
+      // pairwise compat with everything already on the node
+      bool pair_ok = true;
+      for (int32_t g2 = 0; g2 < G && pair_ok; ++g2)
+        if (c_gmask[static_cast<size_t>(m) * G + g2] &&
+            !group_pair[static_cast<size_t>(g) * G + g2])
+          pair_ok = false;
+      if (!pair_ok) continue;
+      // per-type fit under node+group zone/ct masks with joint (z,c) check
+      int32_t cap = 0;
+      for (int32_t t = 0; t < T; ++t) {
+        fit_t[t] = 0;
+        if (!c_mask[static_cast<size_t>(m) * T + t]) continue;
+        if (!group_compat_t[static_cast<size_t>(g) * T + t]) continue;
+        bool off_ok = false;
+        for (int32_t z = 0; z < Z && !off_ok; ++z) {
+          if (!(c_zone[static_cast<size_t>(m) * Z + z] && gz[z])) continue;
+          for (int32_t c = 0; c < C; ++c) {
+            if (c_ct[static_cast<size_t>(m) * C + c] && gc[c] &&
+                offer_avail[(static_cast<size_t>(t) * Z + z) * C + c]) {
+              off_ok = true;
+              break;
+            }
+          }
+        }
+        if (!off_ok) continue;
+        int32_t kt = fit_count_row(type_alloc + static_cast<size_t>(t) * R,
+                                   c_cum + static_cast<size_t>(m) * R, req, R);
+        k_t[t] = kt;
+        fit_t[t] = 1;
+        cap = std::max(cap, kt);
+      }
+      int32_t take = std::min(cap, remaining);
+      if (take > 0) {
+        take_c[static_cast<size_t>(s) * M + m] = take;
+        for (int32_t r = 0; r < R; ++r)
+          c_cum[static_cast<size_t>(m) * R + r] += take * req[r];
+        for (int32_t t = 0; t < T; ++t)
+          c_mask[static_cast<size_t>(m) * T + t] =
+              (fit_t[t] && k_t[t] >= take) ? 1 : 0;
+        for (int32_t z = 0; z < Z; ++z)
+          c_zone[static_cast<size_t>(m) * Z + z] &= gz[z];
+        for (int32_t c = 0; c < C; ++c)
+          c_ct[static_cast<size_t>(m) * C + c] &= gc[c];
+        c_gmask[static_cast<size_t>(m) * G + g] = 1;
+        remaining -= take;
+      }
+    }
+
+    // ---- 3. new claims, pool by pool ------------------------------------
+    for (int32_t p = 0; p < P && remaining > 0; ++p) {
+      if (!group_pool[static_cast<size_t>(g) * P + p]) continue;
+      // limit gate: blocked if any resource already at/over limit
+      bool over = false;
+      for (int32_t r = 0; r < R; ++r)
+        if (p_usage[static_cast<size_t>(p) * R + r] >= pool_limit[static_cast<size_t>(p) * R + r])
+          over = true;
+      if (over) continue;
+      const int32_t* daemon = pool_daemon + static_cast<size_t>(p) * R;
+      int32_t kmax = 0;
+      for (int32_t t = 0; t < T; ++t) {
+        fit_t[t] = 0;
+        if (!group_compat_t[static_cast<size_t>(g) * T + t]) continue;
+        if (!pool_type[static_cast<size_t>(p) * T + t]) continue;
+        bool off_ok = false;
+        for (int32_t z = 0; z < Z && !off_ok; ++z) {
+          if (!(pool_zone[static_cast<size_t>(p) * Z + z] && gz[z])) continue;
+          for (int32_t c = 0; c < C; ++c)
+            if (pool_ct[static_cast<size_t>(p) * C + c] && gc[c] &&
+                offer_avail[(static_cast<size_t>(t) * Z + z) * C + c]) {
+              off_ok = true;
+              break;
+            }
+        }
+        if (!off_ok) continue;
+        int32_t k = BIG;
+        for (int32_t r = 0; r < R; ++r)
+          if (req[r] > 0) {
+            int32_t rem = type_alloc[static_cast<size_t>(t) * R + r] - daemon[r];
+            k = std::min(k, rem >= 0 ? rem / req[r] : -1);
+          }
+        k = std::max(k, 0);
+        k_t[t] = k;
+        fit_t[t] = 1;
+        kmax = std::max(kmax, k);
+      }
+      if (kmax <= 0) continue;
+
+      // per-claim charge for limit accounting: min charge among the
+      // FULL-node surviving set
+      std::vector<int32_t> charge_full(R, 0);
+      for (int32_t r = 0; r < R; ++r) {
+        int32_t mn = BIG;
+        for (int32_t t = 0; t < T; ++t)
+          if (fit_t[t] && k_t[t] >= kmax)
+            mn = std::min(mn, type_charge[static_cast<size_t>(t) * R + r]);
+        charge_full[r] = (mn == BIG) ? 0 : mn;
+      }
+
+      while (remaining > 0) {
+        // limit check before EACH claim creation
+        bool blocked = false;
+        for (int32_t r = 0; r < R; ++r)
+          if (p_usage[static_cast<size_t>(p) * R + r] >=
+              pool_limit[static_cast<size_t>(p) * R + r])
+            blocked = true;
+        if (blocked) break;
+        if (used >= M) {
+          overflow = true;
+          break;
+        }
+        const int32_t m = used++;
+        const int32_t take = std::min(kmax, remaining);
+        take_c[static_cast<size_t>(s) * M + m] = take;
+        c_pool[m] = p;
+        for (int32_t r = 0; r < R; ++r)
+          c_cum[static_cast<size_t>(m) * R + r] = daemon[r] + take * req[r];
+        for (int32_t t = 0; t < T; ++t)
+          c_mask[static_cast<size_t>(m) * T + t] = (fit_t[t] && k_t[t] >= take) ? 1 : 0;
+        for (int32_t z = 0; z < Z; ++z)
+          c_zone[static_cast<size_t>(m) * Z + z] =
+              pool_zone[static_cast<size_t>(p) * Z + z] && gz[z];
+        for (int32_t c = 0; c < C; ++c)
+          c_ct[static_cast<size_t>(m) * C + c] =
+              pool_ct[static_cast<size_t>(p) * C + c] && gc[c];
+        c_gmask[static_cast<size_t>(m) * G + g] = 1;
+        // charge: full claims charge charge_full; a partial (last) claim
+        // charges the min over ITS surviving set
+        for (int32_t r = 0; r < R; ++r) {
+          int32_t ch = charge_full[r];
+          if (take < kmax) {
+            int32_t mn = BIG;
+            for (int32_t t = 0; t < T; ++t)
+              if (fit_t[t] && k_t[t] >= take)
+                mn = std::min(mn, type_charge[static_cast<size_t>(t) * R + r]);
+            ch = (mn == BIG) ? 0 : mn;
+          }
+          p_usage[static_cast<size_t>(p) * R + r] += ch;
+        }
+        remaining -= take;
+      }
+      if (overflow) break;
+    }
+    leftover[s] = remaining;
+    if (overflow) break;
+  }
+  *used_out = used;
+  return overflow ? 1 : 0;
+}
+
+}  // extern "C"

@@ -229,3 +229,69 @@ class TestRandomizedParity:
                 reqs=Requirements.of(Requirement.create(wk.CAPACITY_TYPE_LABEL, IN, ["spot"])),
             )
         assert_parity(SolverInput(pods=pods, nodes=[], nodepools=pools, zones=ZONES))
+
+
+class TestNativeParity:
+    """Third leg: the compiled C++ core must match the python oracle too."""
+
+    def _assert_native(self, inp):
+        from karpenter_tpu.solver.native import NativeSolver
+
+        ref = ReferenceSolver().solve(quantize_input(inp))
+        nat_solver = NativeSolver()
+        nat = nat_solver.solve(inp)
+        assert nat_solver.stats["native_solves"] == 1
+        assert set(ref.errors) == set(nat.errors)
+        assert ref.placements == nat.placements
+        assert len(ref.claims) == len(nat.claims)
+        for rc, tc in zip(ref.claims, nat.claims):
+            assert rc.nodepool == tc.nodepool
+            assert sorted(rc.instance_type_names) == sorted(tc.instance_type_names)
+            assert rc.pod_uids == tc.pod_uids
+
+    def test_basic(self):
+        pods = [mkpod(f"p{i:03d}", cpu="500m", mem="512Mi") for i in range(20)]
+        self._assert_native(SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES))
+
+    def test_heterogeneous_with_selectors(self):
+        random.seed(7)
+        pods = []
+        for i in range(50):
+            kw = {}
+            if i % 5 == 0:
+                kw["node_selector"] = {wk.ARCH_LABEL: random.choice(["amd64", "arm64"])}
+            pods.append(
+                mkpod(f"p{i:03d}", cpu=f"{random.choice([100, 500, 2000])}m",
+                      mem=f"{random.choice([128, 1024, 4096])}Mi", **kw)
+            )
+        pools = [pool("a", weight=5), pool("b", weight=1)]
+        self._assert_native(SolverInput(pods=pods, nodes=[], nodepools=pools, zones=ZONES))
+
+    def test_limits_and_existing_nodes(self):
+        from karpenter_tpu.utils.resources import Resources as Rs
+
+        nodes = [TestExistingNodesParity().mknode("n1"), TestExistingNodesParity().mknode("n2", zone="zone-1b")]
+        capped = pool("capped", weight=10, limits=Rs.parse({"cpu": "8"}))
+        backup = pool("backup", weight=1)
+        pods = [mkpod(f"p{i:02d}", cpu="2", mem="2Gi") for i in range(12)]
+        self._assert_native(SolverInput(pods=pods, nodes=nodes, nodepools=[capped, backup], zones=ZONES))
+
+    def test_native_speed_at_scale(self):
+        import sys as _sys
+        import time as _time
+
+        _sys.path.insert(0, ".")
+        from bench import build_input
+        from karpenter_tpu.solver.native import solve_encoded
+        from karpenter_tpu.solver.encode import encode as _encode, quantize_input as _q
+
+        inp = build_input(10_000)
+        enc = _encode(_q(inp))
+        t0 = _time.perf_counter()
+        out = solve_encoded(enc, 4096)
+        dt = _time.perf_counter() - t0
+        assert out is not None
+        leftover = out[2]
+        assert leftover.sum() == 0
+        print(f"\nnative 10k-pod solve: {dt*1000:.1f}ms", file=_sys.stderr)
+        assert dt < 5.0  # compiled-class performance
