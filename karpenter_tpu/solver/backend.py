@@ -63,6 +63,7 @@ def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
         bucket(E, 64, 64),
         bucket(P, 4, 4),
     )
+    Qp = bucket(enc.Q, 8, 8)
 
     def pad(a, shape, fill=0):
         out = np.full(shape, fill, dtype=a.dtype)
@@ -91,8 +92,14 @@ def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
         jnp.asarray(pad(enc.pool_usage, (Pp, R))),
         jnp.asarray(pad(enc.node_free, (Ep, R))),
         jnp.asarray(pad(enc.node_compat, (Gp, Ep))),
+        jnp.asarray(pad(enc.q_member, (Gp, Qp))),
+        jnp.asarray(pad(enc.q_owner, (Gp, Qp))),
+        jnp.asarray(pad(enc.q_kind, (Qp,))),
+        jnp.asarray(pad(enc.q_cap, (Qp,), fill=1)),
+        jnp.asarray(pad(enc.node_q_member, (Ep, Qp))),
+        jnp.asarray(pad(enc.node_q_owner, (Ep, Qp))),
     )
-    dims = dict(S=S, G=G, T=T, E=E, P=P, R=R, Z=Z, C=C, Sp=Sp, Gp=Gp, Tp=Tp, Ep=Ep, Pp=Pp)
+    dims = dict(S=S, G=G, T=T, E=E, P=P, R=R, Z=Z, C=C, Sp=Sp, Gp=Gp, Tp=Tp, Ep=Ep, Pp=Pp, Qp=Qp)
     return args, dims
 
 

@@ -133,6 +133,21 @@ class EncodedInput:
     has_topology: bool = False
     has_affinity: bool = False
 
+    # hostname-granular constraints (Q axis), handled closed-form on device:
+    # per-(node, sig) matching-pod counts cap the pour. q_kind 0 = hostname
+    # TSC (cap = maxSkew, floor-0 rule per SPEC.md), 1 = hostname
+    # anti-affinity (owner blocked where members present and vice versa).
+    q_member: Optional[np.ndarray] = None  # [G, Q] bool — group's pods match sig selector
+    q_owner: Optional[np.ndarray] = None  # [G, Q] bool — group's pods carry the constraint
+    q_kind: Optional[np.ndarray] = None  # [Q] int32
+    q_cap: Optional[np.ndarray] = None  # [Q] int32 (maxSkew for TSC; 1 for anti)
+    node_q_member: Optional[np.ndarray] = None  # [E, Q] int32 initial matching-pod counts
+    node_q_owner: Optional[np.ndarray] = None  # [E, Q] int32 initial owner-pod presence
+
+    @property
+    def Q(self) -> int:
+        return 0 if self.q_kind is None else len(self.q_kind)
+
     @property
     def G(self) -> int:
         return len(self.group_pods)
@@ -274,15 +289,62 @@ def encode(inp: SolverInput) -> EncodedInput:
     fallback = np.zeros(G, dtype=bool)
     has_topo = False
     has_aff = False
+    hostname_sigs: Dict[tuple, int] = {}  # (kind, sel_sig, cap) -> q index
     for g, pl in enumerate(group_pods):
         pod = pl[0]
         if len(pod.node_affinity) > 1 or pod.preferred_node_affinity:
             fallback[g] = True
-        if any(t.when_unsatisfiable == "DoNotSchedule" for t in pod.topology_spread):
-            has_topo = True
-        if any(t.weight is None for t in pod.affinity_terms):
-            has_aff = True
+        for t in pod.topology_spread:
+            if t.when_unsatisfiable != "DoNotSchedule":
+                continue
+            if t.topology_key == wk.HOSTNAME_LABEL:
+                # closed-form on device (per-node matching-pod cap = maxSkew,
+                # SPEC.md hostname floor-0 rule)
+                sig = (0, tuple(sorted(t.label_selector.items())), t.max_skew)
+                hostname_sigs.setdefault(sig, len(hostname_sigs))
+            else:
+                has_topo = True  # zone/capacity-type spread: fallback path
+        for t in pod.affinity_terms:
+            if t.weight is not None:
+                continue
+            if t.anti and t.topology_key == wk.HOSTNAME_LABEL:
+                sig = (1, tuple(sorted(t.label_selector.items())), 1)
+                hostname_sigs.setdefault(sig, len(hostname_sigs))
+            else:
+                has_aff = True  # zone terms / positive affinity: fallback path
         group_reqsets.append(pod.scheduling_requirements())
+
+    Q = len(hostname_sigs)
+    q_member = np.zeros((G, Q), dtype=bool)
+    q_owner = np.zeros((G, Q), dtype=bool)
+    q_kind = np.zeros(Q, dtype=np.int32)
+    q_cap = np.ones(Q, dtype=np.int32)
+    for (kind, sel_sig, cap), q in hostname_sigs.items():
+        q_kind[q] = kind
+        q_cap[q] = cap
+        sel = dict(sel_sig)
+        for g, pl in enumerate(group_pods):
+            pod = pl[0]
+            if all(pod.meta.labels.get(k) == v for k, v in sel.items()):
+                q_member[g, q] = True
+            for t in pod.topology_spread:
+                if (
+                    kind == 0
+                    and t.when_unsatisfiable == "DoNotSchedule"
+                    and t.topology_key == wk.HOSTNAME_LABEL
+                    and tuple(sorted(t.label_selector.items())) == sel_sig
+                    and t.max_skew == cap
+                ):
+                    q_owner[g, q] = True
+            for t in pod.affinity_terms:
+                if (
+                    kind == 1
+                    and t.weight is None
+                    and t.anti
+                    and t.topology_key == wk.HOSTNAME_LABEL
+                    and tuple(sorted(t.label_selector.items())) == sel_sig
+                ):
+                    q_owner[g, q] = True
 
     # ---- instance-type tensors ---------------------------------------------
     type_alloc = np.zeros((T, R), dtype=np.int32)
@@ -397,10 +459,18 @@ def encode(inp: SolverInput) -> EncodedInput:
     node_zone = np.full(E, -1, dtype=np.int32)
     node_ct = np.full(E, -1, dtype=np.int32)
     node_ids = [n.id for n in inp.nodes]
+    node_q_member = np.zeros((E, Q), dtype=np.int32)
+    node_q_owner = np.zeros((E, Q), dtype=np.int32)  # unknowable from labels
+    sig_list = sorted(hostname_sigs.items(), key=lambda kv: kv[1])
     for e, n in enumerate(inp.nodes):
         node_free[e] = _quantize(n.free, rkeys, ceil=False)
         node_zone[e] = zid.get(n.labels.get(wk.ZONE_LABEL, ""), -1)
         node_ct[e] = cid.get(n.labels.get(wk.CAPACITY_TYPE_LABEL, ""), -1)
+        for (kind, sel_sig, cap), q in sig_list:
+            sel = dict(sel_sig)
+            node_q_member[e, q] = sum(
+                1 for pl in n.pod_labels if all(pl.get(k) == v for k, v in sel.items())
+            )
         if not n.schedulable:
             continue
         node_reqs = Requirements.from_labels(n.labels)
@@ -444,4 +514,10 @@ def encode(inp: SolverInput) -> EncodedInput:
         node_ids=node_ids,
         has_topology=has_topo,
         has_affinity=has_aff,
+        q_member=q_member,
+        q_owner=q_owner,
+        q_kind=q_kind,
+        q_cap=q_cap,
+        node_q_member=node_q_member,
+        node_q_owner=node_q_owner,
     )

@@ -116,7 +116,13 @@ class NativeSolver(Solver):
     def solve(self, inp: SolverInput) -> SolverResult:
         qinp = quantize_input(inp)
         enc = encode(qinp)
-        if enc.group_fallback.any() or enc.has_topology or enc.has_affinity or enc.G == 0:
+        if (
+            enc.group_fallback.any()
+            or enc.has_topology
+            or enc.has_affinity
+            or enc.Q > 0  # hostname caps: device kernel only (C++ port pending)
+            or enc.G == 0
+        ):
             self.stats["fallback_solves"] += 1
             return self.fallback.solve(qinp)
         try:

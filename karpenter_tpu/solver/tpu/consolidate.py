@@ -36,7 +36,7 @@ import jax.numpy as jnp
 from .ffd import ffd_solve
 
 
-# in_axes layout for the 20 positional ffd_solve args:
+# in_axes layout for the 26 positional ffd_solve args:
 #   run_group      None   (shared FFD run order)
 #   run_count      0      (per-subset membership zeroing)
 #   group_*        None
@@ -44,7 +44,9 @@ from .ffd import ffd_solve
 #   pool_*         None
 #   node_free      None
 #   node_compat    0      (per-subset node removal)
-_IN_AXES = (None, 0) + (None,) * 7 + (None,) * 3 + (None,) * 6 + (None, 0)
+#   q_* / node_q_* None   (hostname-cap sigs shared; removed nodes are
+#                          already compat-masked so their counts are inert)
+_IN_AXES = (None, 0) + (None,) * 7 + (None,) * 3 + (None,) * 6 + (None, 0) + (None,) * 6
 
 
 @functools.partial(jax.jit, static_argnames=("max_claims",))

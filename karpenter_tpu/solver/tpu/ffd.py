@@ -48,6 +48,11 @@ class FFDState(NamedTuple):
     c_open: jnp.ndarray  # [M] bool
     used: jnp.ndarray  # scalar int32 — claims opened so far
     p_usage: jnp.ndarray  # [P, R] int32 — pool usage (limit accounting)
+    # hostname-constraint counts (Q axis; see encode.py):
+    e_cm: jnp.ndarray  # [E, Q] int32 — matching (member) pods per sig
+    e_co: jnp.ndarray  # [E, Q] int32 — anti-owner pod presence per sig
+    c_cm: jnp.ndarray  # [M, Q] int32
+    c_co: jnp.ndarray  # [M, Q] int32
 
 
 class FFDOutput(NamedTuple):
@@ -92,6 +97,35 @@ def _pour(cap, remaining):
     return take, remaining - jnp.sum(take)
 
 
+def _hostname_allowance(cm, co, q_kind, q_cap, member_g, owner_g):
+    """[N] per-node additional-pod allowance for group g under the hostname
+    constraint sigs (encode.py Q axis; SPEC.md hostname floor-0 rule):
+
+      TSC (kind 0), owner+member : cap − cm
+      TSC (kind 0), owner only   : ∞ while cm+1 ≤ cap, else 0
+      anti (kind 1), owner       : 1 if member else ∞ — while cm == 0, else 0
+      anti (kind 1), member only : ∞ while no owner pod present, else 0
+    """
+    kind0 = q_kind[None, :] == 0
+    relevant = owner_g[None, :] | ((q_kind[None, :] == 1) & member_g[None, :])
+    tsc_allow = jnp.where(
+        member_g[None, :],
+        q_cap[None, :] - cm,
+        jnp.where(cm + 1 <= q_cap[None, :], BIG, 0),
+    )
+    anti_owner_allow = jnp.where(
+        cm == 0, jnp.where(member_g[None, :], 1, BIG), 0
+    )
+    anti_member_allow = jnp.where(co == 0, BIG, 0)
+    per_q = jnp.where(
+        kind0,
+        tsc_allow,
+        jnp.where(owner_g[None, :], anti_owner_allow, anti_member_allow),
+    )
+    per_q = jnp.where(relevant, per_q, BIG)
+    return jnp.maximum(jnp.min(per_q, axis=1), 0).astype(jnp.int32)
+
+
 @functools.partial(jax.jit, static_argnames=("max_claims",))
 def ffd_solve(
     # runs
@@ -119,6 +153,13 @@ def ffd_solve(
     # existing nodes
     node_free,  # [E, R] i32
     node_compat,  # [G, E] bool
+    # hostname constraint sigs (Q axis; encode.py)
+    q_member,  # [G, Q] bool
+    q_owner,  # [G, Q] bool
+    q_kind,  # [Q] i32
+    q_cap,  # [Q] i32
+    node_q_member,  # [E, Q] i32
+    node_q_owner,  # [E, Q] i32
     *,
     max_claims: int,
 ) -> FFDOutput:
@@ -127,6 +168,7 @@ def ffd_solve(
     P = pool_type.shape[0]
     Z = group_zone.shape[1]
     C = group_ct.shape[1]
+    Q = q_kind.shape[0]
     M = max_claims
 
     state = FFDState(
@@ -140,6 +182,10 @@ def ffd_solve(
         c_open=jnp.zeros((M,), bool),
         used=jnp.int32(0),
         p_usage=pool_usage0.astype(jnp.int32),
+        e_cm=node_q_member.astype(jnp.int32),
+        e_co=node_q_owner.astype(jnp.int32),
+        c_cm=jnp.zeros((M, Q), jnp.int32),
+        c_co=jnp.zeros((M, Q), jnp.int32),
     )
 
     def step(st: FFDState, run):
@@ -150,6 +196,8 @@ def ffd_solve(
         gc = group_ct[g]  # [C]
         gpool = group_pool[g]  # [P]
         gpair = group_pair[g]  # [G]
+        member_g = q_member[g]  # [Q]
+        owner_g = q_owner[g]  # [Q]
         on_device = group_device[g]
 
         remaining = jnp.where(on_device, count, 0).astype(jnp.int32)
@@ -157,8 +205,11 @@ def ffd_solve(
         # ---- 1. existing nodes --------------------------------------------
         e_cap = _fit_count(node_free, st.e_cum, req)
         e_cap = jnp.where(node_compat[g], e_cap, 0)
+        e_cap = jnp.minimum(e_cap, _hostname_allowance(st.e_cm, st.e_co, q_kind, q_cap, member_g, owner_g))
         take_e, remaining = _pour(e_cap, remaining)
         e_cum = st.e_cum + take_e[:, None] * req[None, :]
+        e_cm = st.e_cm + take_e[:, None] * member_g[None, :].astype(jnp.int32)
+        e_co = st.e_co + ((take_e[:, None] > 0) & owner_g[None, :] & (q_kind[None, :] == 1)).astype(jnp.int32)
 
         # ---- 2. open claims -----------------------------------------------
         # offering availability under group+node zone/ct masks — exact joint
@@ -181,6 +232,7 @@ def ffd_solve(
         node_ok = st.c_open & pair_ok & pool_ok  # [M]
         k_nt = jnp.where(fit_nt & node_ok[:, None], k_nt, 0)
         c_cap = jnp.max(k_nt, axis=1)  # [M]
+        c_cap = jnp.minimum(c_cap, _hostname_allowance(st.c_cm, st.c_co, q_kind, q_cap, member_g, owner_g))
         take_c, remaining = _pour(c_cap, remaining)
 
         added = take_c > 0
@@ -189,10 +241,19 @@ def ffd_solve(
         c_zone = jnp.where(added[:, None], st.c_zone & gz[None, :], st.c_zone)
         c_ct = jnp.where(added[:, None], st.c_ct & gc[None, :], st.c_ct)
         c_gmask = st.c_gmask.at[:, g].set(st.c_gmask[:, g] | added)
+        c_cm = st.c_cm + take_c[:, None] * member_g[None, :].astype(jnp.int32)
+        c_co = st.c_co + (added[:, None] & owner_g[None, :] & (q_kind[None, :] == 1)).astype(jnp.int32)
 
         # ---- 3. new claims, pool by pool in priority order ----------------
+        # fresh-node allowance under hostname constraints (counts start at 0)
+        fresh_allow = _hostname_allowance(
+            jnp.zeros((1, Q), jnp.int32), jnp.zeros((1, Q), jnp.int32),
+            q_kind, q_cap, member_g, owner_g,
+        )[0]
+
         def open_pool(p, carry):
-            remaining, used, c_cum, c_mask, c_zone, c_ct, c_gmask, c_pool, c_open, p_usage, take_new = carry
+            (remaining, used, c_cum, c_mask, c_zone, c_ct, c_gmask, c_pool,
+             c_open, p_usage, take_new, c_cm, c_co) = carry
 
             # per-type pod capacity for a fresh node of pool p
             pz = pool_zone[p] & gz  # [Z]
@@ -207,11 +268,14 @@ def ffd_solve(
             k_t = jnp.maximum(jnp.min(k_t, axis=1), 0).astype(jnp.int32)
             k_t = jnp.where(fit_t, k_t, 0)
             kmax = jnp.max(k_t)
+            # hostname constraints cap pods-per-fresh-node below the
+            # resource capacity (e.g. hostname spread: maxSkew per node)
+            full_take = jnp.minimum(kmax, fresh_allow)
 
             # limit accounting (SPEC: claim blocked if any limited resource
             # usage >= limit at creation; charge = min type charge among the
             # full-node surviving set)
-            full_set = fit_t & (k_t >= jnp.maximum(kmax, 1))
+            full_set = fit_t & (k_t >= jnp.maximum(full_take, 1))
             charge_full = jnp.min(
                 jnp.where(full_set[:, None], type_charge, INT32_MAX), axis=0
             )  # [R]
@@ -226,17 +290,19 @@ def ffd_solve(
             already_over = jnp.any(p_usage[p] >= pool_limit[p])
             allow = jnp.where(already_over, 0, jnp.min(trips)).astype(jnp.int32)
 
-            n_want = jnp.where(kmax > 0, -(-remaining // jnp.maximum(kmax, 1)), 0)
+            n_want = jnp.where(full_take > 0, -(-remaining // jnp.maximum(full_take, 1)), 0)
             slots_left = M - used
             n_new = jnp.minimum(jnp.minimum(n_want, allow), slots_left).astype(jnp.int32)
-            eligible = gpool[p] & (kmax > 0)
+            eligible = gpool[p] & (full_take > 0)
             n_new = jnp.where(eligible, n_new, 0)
 
             idx = jnp.arange(M, dtype=jnp.int32)
             is_new = (idx >= used) & (idx < used + n_new)
-            # node j (0-based among new) takes min(kmax, remaining - j*kmax)
+            # node j (0-based among new) takes min(full_take, remaining - j*full_take)
             j = idx - used
-            take_j = jnp.where(is_new, jnp.clip(remaining - j * kmax, 0, kmax), 0).astype(jnp.int32)
+            take_j = jnp.where(
+                is_new, jnp.clip(remaining - j * full_take, 0, full_take), 0
+            ).astype(jnp.int32)
 
             c_cum = jnp.where(is_new[:, None], daemon[None, :] + take_j[:, None] * req[None, :], c_cum)
             new_mask = fit_t[None, :] & (k_t[None, :] >= take_j[:, None])
@@ -246,11 +312,19 @@ def ffd_solve(
             c_gmask = c_gmask.at[:, g].set(c_gmask[:, g] | is_new)
             c_pool = jnp.where(is_new, p, c_pool)
             c_open = c_open | is_new
+            c_cm = jnp.where(
+                is_new[:, None], take_j[:, None] * member_g[None, :].astype(jnp.int32), c_cm
+            )
+            c_co = jnp.where(
+                is_new[:, None],
+                ((take_j[:, None] > 0) & owner_g[None, :] & (q_kind[None, :] == 1)).astype(jnp.int32),
+                c_co,
+            )
 
             # charge pool usage: full claims charge charge_full; the last
             # (possibly partial) claim charges min over its own surviving set
             placed_new = jnp.sum(take_j)
-            last_take = jnp.where(n_new > 0, remaining - (n_new - 1) * kmax, 0)
+            last_take = jnp.where(n_new > 0, remaining - (n_new - 1) * full_take, 0)
             part_set = fit_t & (k_t >= jnp.maximum(last_take, 1))
             charge_part = jnp.min(jnp.where(part_set[:, None], type_charge, INT32_MAX), axis=0)
             charge_part = jnp.where(charge_part == INT32_MAX, 0, charge_part)
@@ -261,7 +335,8 @@ def ffd_solve(
             take_new = take_new + take_j
             remaining = remaining - placed_new
             used = used + n_new
-            return (remaining, used, c_cum, c_mask, c_zone, c_ct, c_gmask, c_pool, c_open, p_usage, take_new)
+            return (remaining, used, c_cum, c_mask, c_zone, c_ct, c_gmask, c_pool,
+                    c_open, p_usage, take_new, c_cm, c_co)
 
         carry = (
             remaining,
@@ -275,9 +350,12 @@ def ffd_solve(
             st.c_open,
             st.p_usage,
             jnp.zeros((M,), jnp.int32),
+            c_cm,
+            c_co,
         )
         carry = jax.lax.fori_loop(0, P, open_pool, carry)
-        (remaining, used, c_cum, c_mask, c_zone, c_ct, c_gmask, c_pool2, c_open, p_usage, take_new) = carry
+        (remaining, used, c_cum, c_mask, c_zone, c_ct, c_gmask, c_pool2, c_open,
+         p_usage, take_new, c_cm, c_co) = carry
 
         new_state = FFDState(
             e_cum=e_cum,
@@ -290,6 +368,10 @@ def ffd_solve(
             c_open=c_open,
             used=used,
             p_usage=p_usage,
+            e_cm=e_cm,
+            e_co=e_co,
+            c_cm=c_cm,
+            c_co=c_co,
         )
         return new_state, (take_e, take_c + take_new, remaining)
 

@@ -295,3 +295,77 @@ class TestNativeParity:
         assert leftover.sum() == 0
         print(f"\nnative 10k-pod solve: {dt*1000:.1f}ms", file=_sys.stderr)
         assert dt < 5.0  # compiled-class performance
+
+
+class TestHostnameConstraintsParity:
+    """Hostname TSC + anti-affinity now run ON DEVICE (closed-form caps)."""
+
+    def test_hostname_spread_on_device(self):
+        from karpenter_tpu.api.objects import TopologySpreadConstraint
+
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.HOSTNAME_LABEL, label_selector={"app": "web"}
+        )
+        pods = [
+            mkpod(f"p{i:02d}", cpu="200m", mem="256Mi", labels={"app": "web"},
+                  topology_spread=[tsc])
+            for i in range(6)
+        ]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        solver = TPUSolver()
+        ref, tpu = assert_parity(inp)
+        # and confirm it actually took the device path
+        solver.solve(inp)
+        assert solver.stats["device_solves"] == 1
+        assert len(tpu.claims) == 6  # one pod per hostname at skew 1
+
+    def test_hostname_spread_skew2(self):
+        from karpenter_tpu.api.objects import TopologySpreadConstraint
+
+        tsc = TopologySpreadConstraint(
+            max_skew=2, topology_key=wk.HOSTNAME_LABEL, label_selector={"app": "web"}
+        )
+        pods = [
+            mkpod(f"p{i:02d}", cpu="100m", mem="128Mi", labels={"app": "web"},
+                  topology_spread=[tsc])
+            for i in range(7)
+        ]
+        ref, tpu = assert_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+        assert len(tpu.claims) == 4  # ceil(7/2)
+
+    def test_hostname_anti_affinity_on_device(self):
+        from karpenter_tpu.api.objects import PodAffinityTerm
+
+        term = PodAffinityTerm(
+            label_selector={"app": "db"}, topology_key=wk.HOSTNAME_LABEL, anti=True
+        )
+        pods = [
+            mkpod(f"db{i}", cpu="250m", mem="512Mi", labels={"app": "db"},
+                  affinity_terms=[term])
+            for i in range(4)
+        ]
+        # plus unconstrained filler pods that share nodes freely
+        pods += [mkpod(f"f{i}", cpu="100m", mem="128Mi") for i in range(4)]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        solver = TPUSolver()
+        ref, tpu = assert_parity(inp)
+        solver.solve(inp)
+        assert solver.stats["device_solves"] == 1
+
+    def test_mixed_with_existing_nodes(self):
+        from karpenter_tpu.api.objects import TopologySpreadConstraint
+
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.HOSTNAME_LABEL, label_selector={"app": "x"}
+        )
+        helper = TestExistingNodesParity()
+        n1 = helper.mknode("n1")
+        n1.pod_labels.append({"app": "x"})  # existing matching pod counts
+        pods = [
+            mkpod(f"p{i}", cpu="200m", mem="256Mi", labels={"app": "x"},
+                  topology_spread=[tsc])
+            for i in range(3)
+        ]
+        assert_parity(SolverInput(pods=pods, nodes=[n1], nodepools=[pool()], zones=ZONES))
