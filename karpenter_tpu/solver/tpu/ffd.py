@@ -274,17 +274,18 @@ def ffd_solve(
 
             # limit accounting (SPEC: claim blocked if any limited resource
             # usage >= limit at creation; charge = min type charge among the
-            # full-node surviving set)
-            full_set = fit_t & (k_t >= jnp.maximum(full_take, 1))
-            charge_full = jnp.min(
-                jnp.where(full_set[:, None], type_charge, INT32_MAX), axis=0
+            # survivors AT CREATION, i.e. after the claim's FIRST pod — the
+            # oracle charges right after the opening pod lands)
+            one_set = fit_t & (k_t >= 1)
+            charge_one = jnp.min(
+                jnp.where(one_set[:, None], type_charge, INT32_MAX), axis=0
             )  # [R]
-            charge_full = jnp.where(charge_full == INT32_MAX, 0, charge_full)
+            charge_one = jnp.where(charge_one == INT32_MAX, 0, charge_one)
             headroom = pool_limit[p] - p_usage[p]  # [R] (may be negative)
             # claims before resource r trips: ceil(headroom / charge)
             trips = jnp.where(
-                charge_full > 0,
-                jnp.maximum(-(-headroom // jnp.maximum(charge_full, 1)), 0),
+                charge_one > 0,
+                jnp.maximum(-(-headroom // jnp.maximum(charge_one, 1)), 0),
                 BIG,
             )
             already_over = jnp.any(p_usage[p] >= pool_limit[p])
@@ -321,16 +322,10 @@ def ffd_solve(
                 c_co,
             )
 
-            # charge pool usage: full claims charge charge_full; the last
-            # (possibly partial) claim charges min over its own surviving set
+            # charge pool usage: every claim charges its at-creation (1-pod
+            # survivor) minimum — n_new claims, charge_one each
             placed_new = jnp.sum(take_j)
-            last_take = jnp.where(n_new > 0, remaining - (n_new - 1) * full_take, 0)
-            part_set = fit_t & (k_t >= jnp.maximum(last_take, 1))
-            charge_part = jnp.min(jnp.where(part_set[:, None], type_charge, INT32_MAX), axis=0)
-            charge_part = jnp.where(charge_part == INT32_MAX, 0, charge_part)
-            n_full = jnp.maximum(n_new - 1, 0)
-            add_usage = charge_full * n_full + jnp.where(n_new > 0, charge_part, 0)
-            p_usage = p_usage.at[p].add(add_usage.astype(jnp.int32))
+            p_usage = p_usage.at[p].add((charge_one * n_new).astype(jnp.int32))
 
             take_new = take_new + take_j
             remaining = remaining - placed_new

@@ -215,14 +215,15 @@ int ffd_solve_native(
       if (kmax <= 0) continue;
 
       // per-claim charge for limit accounting: min charge among the
-      // FULL-node surviving set
-      std::vector<int32_t> charge_full(R, 0);
+      // at-creation surviving set (after the claim's FIRST pod) — the oracle
+      // charges right after the opening pod lands
+      std::vector<int32_t> charge_one(R, 0);
       for (int32_t r = 0; r < R; ++r) {
         int32_t mn = BIG;
         for (int32_t t = 0; t < T; ++t)
-          if (fit_t[t] && k_t[t] >= kmax)
+          if (fit_t[t] && k_t[t] >= 1)
             mn = std::min(mn, type_charge[static_cast<size_t>(t) * R + r]);
-        charge_full[r] = (mn == BIG) ? 0 : mn;
+        charge_one[r] = (mn == BIG) ? 0 : mn;
       }
 
       while (remaining > 0) {
@@ -252,19 +253,9 @@ int ffd_solve_native(
           c_ct[static_cast<size_t>(m) * C + c] =
               pool_ct[static_cast<size_t>(p) * C + c] && gc[c];
         c_gmask[static_cast<size_t>(m) * G + g] = 1;
-        // charge: full claims charge charge_full; a partial (last) claim
-        // charges the min over ITS surviving set
-        for (int32_t r = 0; r < R; ++r) {
-          int32_t ch = charge_full[r];
-          if (take < kmax) {
-            int32_t mn = BIG;
-            for (int32_t t = 0; t < T; ++t)
-              if (fit_t[t] && k_t[t] >= take)
-                mn = std::min(mn, type_charge[static_cast<size_t>(t) * R + r]);
-            ch = (mn == BIG) ? 0 : mn;
-          }
-          p_usage[static_cast<size_t>(p) * R + r] += ch;
-        }
+        // charge: every claim charges its at-creation (1-pod survivor) min
+        for (int32_t r = 0; r < R; ++r)
+          p_usage[static_cast<size_t>(p) * R + r] += charge_one[r];
         remaining -= take;
       }
       if (overflow) break;

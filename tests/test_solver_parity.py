@@ -175,6 +175,36 @@ class TestConfig2Masks:
             SolverInput(pods=pods, nodes=[], nodepools=[capped, backup], zones=ZONES)
         )
 
+    def test_limit_charge_uses_first_pod_survivors(self):
+        # SPEC: a claim charges the min capacity over its surviving options AT
+        # CREATION (= after its first pod). A small type that survives one pod
+        # but not a full node must lower the charge — heterogeneous capacities
+        # expose any backend that charges the full-node surviving set instead.
+        from karpenter_tpu.cloudprovider.types import InstanceType, Offering
+
+        def mktype(name, cpu, mem_gib, pods_cap, price):
+            reqs = Requirements.of(
+                Requirement.create(wk.INSTANCE_TYPE_LABEL, IN, [name]),
+                Requirement.create(wk.ARCH_LABEL, IN, ["amd64"]),
+                Requirement.create(wk.OS_LABEL, IN, ["linux"]),
+                Requirement.create(wk.ZONE_LABEL, IN, list(ZONES)),
+                Requirement.create(wk.CAPACITY_TYPE_LABEL, IN, ["on-demand"]),
+            )
+            cap = Resources.parse({"cpu": str(cpu), "memory": f"{mem_gib}Gi"})
+            cap["pods"] = pods_cap
+            return InstanceType(
+                name=name, requirements=reqs, capacity=cap, overhead=Resources(),
+                offerings=[Offering(zone=z, capacity_type="on-demand", price=price) for z in ZONES],
+            )
+
+        big = mktype("big.4xlarge", 16, 64, 100, 2.0)
+        small = mktype("small.large", 2, 8, 10, 0.3)
+        capped = pool("capped", limits=Resources.parse({"cpu": "10"}), types=[big, small])
+        pods = [mkpod(f"p{i:02d}", cpu="1", mem="1Gi") for i in range(20)]
+        ref, _ = assert_parity(SolverInput(pods=pods, nodes=[], nodepools=[capped], zones=ZONES))
+        # oracle semantics: every claim charges small's 2 cpu -> both claims fit
+        assert not ref.errors and len(ref.claims) == 2
+
 
 class TestExistingNodesParity:
     def mknode(self, name, zone="zone-1a", cpu="8", mem="32Gi", pods=110):
