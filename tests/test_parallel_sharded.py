@@ -1,0 +1,117 @@
+"""Multi-device sharding correctness (SURVEY.md §2.10 TPU-equivalent row).
+
+The disruption engine's scale axis is independent candidate solves; sharding
+that batch axis across a `jax.sharding.Mesh` must not change any decision.
+conftest.py forces an 8-device virtual CPU mesh, so these tests exercise the
+same sharded program `dryrun_multichip` compiles — per-shard results must be
+bit-identical to the unsharded sequential kernel.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from karpenter_tpu.api import wellknown as wk
+from karpenter_tpu.api.objects import ObjectMeta, Pod
+from karpenter_tpu.catalog.catalog import CatalogSpec, generate
+from karpenter_tpu.parallel.sharded import batched_solve, make_mesh, replicate_args
+from karpenter_tpu.provisioning.scheduler import NodePoolSpec, SolverInput
+from karpenter_tpu.scheduling.requirements import IN, Requirement, Requirements
+from karpenter_tpu.solver.backend import TPUSolver, kernel_args
+from karpenter_tpu.solver.encode import encode, quantize_input
+from karpenter_tpu.solver.tpu.ffd import ffd_solve
+from karpenter_tpu.utils.resources import Resources
+
+CATALOG = generate(CatalogSpec())
+ZONES = ("zone-1a", "zone-1b", "zone-1c")
+N_DEV = 8
+
+
+def _scenario(num_pods=40):
+    pool = NodePoolSpec(
+        name="default",
+        weight=0,
+        requirements=Requirements.of(
+            Requirement.create(wk.NODEPOOL_LABEL, IN, ["default"])
+        ),
+        taints=[],
+        instance_types=CATALOG,
+    )
+    sizes = [("250m", "512Mi"), ("500m", "1Gi"), ("1", "2Gi"), ("2", "4Gi")]
+    pods = []
+    for i in range(num_pods):
+        cpu, mem = sizes[i % len(sizes)]
+        pods.append(
+            Pod(
+                meta=ObjectMeta(name=f"p{i:04d}", uid=f"p{i:04d}"),
+                requests=Resources.parse({"cpu": cpu, "memory": mem}),
+            )
+        )
+    inp = SolverInput(pods=pods, nodes=[], nodepools=[pool], zones=ZONES)
+    enc = encode(quantize_input(inp))
+    solver = TPUSolver(max_claims=64)
+    args, _dims = kernel_args(enc, solver._bucket)
+    return args
+
+
+def test_mesh_has_eight_devices():
+    assert len(jax.devices()) >= N_DEV, jax.devices()
+    mesh = make_mesh(N_DEV)
+    assert mesh.devices.size == N_DEV
+
+
+def test_sharded_replicated_batch_matches_sequential():
+    """Identical rows sharded across 8 devices == one unsharded solve."""
+    args = _scenario(40)
+    seq = ffd_solve(*args, max_claims=64)
+
+    mesh = make_mesh(N_DEV)
+    batched = replicate_args(args, N_DEV)
+    out = batched_solve(mesh, batched, max_claims=64)
+
+    used = np.asarray(out.state.used)
+    assert used.shape == (N_DEV,)
+    assert (used == int(seq.state.used)).all()
+    for b in range(N_DEV):
+        np.testing.assert_array_equal(np.asarray(out.take_e)[b], np.asarray(seq.take_e))
+        np.testing.assert_array_equal(np.asarray(out.take_c)[b], np.asarray(seq.take_c))
+        np.testing.assert_array_equal(np.asarray(out.leftover)[b], np.asarray(seq.leftover))
+        np.testing.assert_array_equal(
+            np.asarray(out.state.c_mask)[b], np.asarray(seq.state.c_mask)
+        )
+
+
+def test_sharded_heterogeneous_batch_matches_per_row_sequential():
+    """Each shard solves a DIFFERENT subset (run counts zeroed per row —
+    exactly the consolidation evaluator's batching); every row must equal
+    the sequential solve of that row's inputs."""
+    args = _scenario(40)
+    run_count = np.asarray(args[1])
+    S = run_count.shape[0]
+
+    rng = np.random.RandomState(7)
+    batched = list(replicate_args(args, N_DEV))
+    b_counts = np.broadcast_to(run_count, (N_DEV, S)).copy()
+    for b in range(1, N_DEV):
+        mask = rng.rand(S) < 0.5
+        b_counts[b] = np.where(mask, run_count, 0)
+    batched[1] = b_counts
+
+    mesh = make_mesh(N_DEV)
+    out = batched_solve(mesh, tuple(batched), max_claims=64)
+
+    for b in range(N_DEV):
+        row_args = list(args)
+        row_args[1] = b_counts[b]
+        seq = ffd_solve(*row_args, max_claims=64)
+        assert int(np.asarray(out.state.used)[b]) == int(seq.state.used), f"row {b}"
+        np.testing.assert_array_equal(
+            np.asarray(out.take_c)[b], np.asarray(seq.take_c), err_msg=f"row {b}"
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out.leftover)[b], np.asarray(seq.leftover), err_msg=f"row {b}"
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out.state.c_cum)[b], np.asarray(seq.state.c_cum), err_msg=f"row {b}"
+        )
