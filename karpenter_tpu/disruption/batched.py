@@ -72,7 +72,10 @@ class BatchedConsolidationEvaluator:
         enc.run_group = np.asarray(run_group, dtype=np.int32)
         enc.run_count = np.asarray(run_count, dtype=np.int32)
 
-        args, dims = kernel_args(enc, self.solver._bucket)
+        try:
+            args, dims = kernel_args(enc, self.solver._bucket)
+        except ValueError:
+            return None  # e.g. Z*C > 32: unpackable — sequential path takes over
         Sp = len(np.asarray(args[0]))
         run_candidate = np.full(Sp, -1, dtype=np.int32)
         run_candidate[: len(run_cand)] = run_cand
@@ -85,8 +88,13 @@ class BatchedConsolidationEvaluator:
         used = np.asarray(out.state.used)
         leftover = np.asarray(out.leftover).sum(axis=1)
         c_mask = np.asarray(out.state.c_mask)[:, :, :T]
-        c_zone = np.asarray(out.state.c_zone)
-        c_ct = np.asarray(out.state.c_ct)
+        from ..solver.backend import unpack_zc_bits
+
+        zc_bits = np.asarray(out.state.c_zc_bits)  # [B, M]
+        B_, M_ = zc_bits.shape
+        c_zone_flat, c_ct_flat = unpack_zc_bits(zc_bits.reshape(-1), Z, C)
+        c_zone = c_zone_flat.reshape(B_, M_, Z)
+        c_ct = c_ct_flat.reshape(B_, M_, C)
         verdicts: List[SubsetVerdict] = []
         for b in range(len(subsets)):
             feasible = leftover[b] == 0 and used[b] <= 1

@@ -30,9 +30,9 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "candidates") -> Mesh
 def batched_solve(mesh: Mesh, batched_args: tuple, max_claims: int):
     """vmap ffd_solve over a leading candidate axis, sharded across the mesh.
 
-    `batched_args`: the 20 positional ffd_solve arrays, each with a leading
-    batch axis B divisible by the mesh size. Returns FFDOutput with leading
-    batch axes, sharded the same way.
+    `batched_args`: the positional ffd_solve arrays (order/arity defined by
+    ffd.ARG_SPEC), each with a leading batch axis B divisible by the mesh
+    size. Returns FFDOutput with leading batch axes, sharded the same way.
     """
     axis = mesh.axis_names[0]
     sharding = NamedSharding(mesh, P(axis))

@@ -95,6 +95,10 @@ def _sel_sig(selector: Mapping[str, str]) -> tuple:
     return tuple(sorted(selector.items()))
 
 
+def node_hostname(n: "ExistingNode") -> str:
+    return n.labels.get(wk.HOSTNAME_LABEL, n.id)
+
+
 def _matches(selector: Mapping[str, str], labels: Mapping[str, str]) -> bool:
     return all(labels.get(k) == v for k, v in selector.items())
 
@@ -103,7 +107,10 @@ class TopologyState:
     def __init__(self, inp: SolverInput):
         self._zones = tuple(inp.zones)
         self._capacity_types = tuple(inp.capacity_types)
-        self._hostnames: List[str] = [n.id for n in inp.nodes]
+        # hostname domain of an existing node = its hostname label, defaulting
+        # to its id (real nodes always carry kubernetes.io/hostname; kwok
+        # fabricates it equal to the node name) — SPEC.md "Topology spread"
+        self._hostnames: List[str] = [node_hostname(n) for n in inp.nodes]
         # spread counts: (key, sel_sig, max_skew) -> {domain: count}
         self._spread: Dict[tuple, Dict[str, int]] = {}
         # matching-pod counts per (sel_sig, topo_key) -> {domain: count}
@@ -138,7 +145,10 @@ class TopologyState:
         if g is None:
             g = {d: 0 for d in self.universe(tsc.topology_key)}
             for n in self._existing:
-                d = n.labels.get(tsc.topology_key)
+                if tsc.topology_key == wk.HOSTNAME_LABEL:
+                    d = node_hostname(n)
+                else:
+                    d = n.labels.get(tsc.topology_key)
                 if d is None:
                     continue
                 g.setdefault(d, 0)
@@ -183,7 +193,10 @@ class TopologyState:
         if g is None:
             g = {}
             for n in self._existing:
-                d = n.labels.get(key)
+                if key == wk.HOSTNAME_LABEL:
+                    d = node_hostname(n)
+                else:
+                    d = n.labels.get(key)
                 if d is None:
                     continue
                 for pl in n.pod_labels:
@@ -458,6 +471,7 @@ class Scheduler:
         if free.get_(PODS) < 1:
             return False
         domains = {k: n.labels[k] for k in wk.TOPOLOGY_KEYS if k in n.labels}
+        domains.setdefault(wk.HOSTNAME_LABEL, n.id)
         if not self._topo_admits_fixed(pod, pod_reqs, domains):
             return False
         # commit (the placement log in TopologyState.record covers topology

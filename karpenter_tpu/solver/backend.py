@@ -43,27 +43,62 @@ class ReferenceSolver(Solver):
         return Scheduler(inp).solve()
 
 
+def pack_bits32(rows: np.ndarray) -> np.ndarray:
+    """Pack a trailing bool axis (≤32 bits) into one uint32 per row."""
+    nb = rows.shape[-1]
+    if nb > 32:
+        raise ValueError(f"cannot pack {nb} bits into uint32")
+    pw = (np.uint64(1) << np.arange(nb, dtype=np.uint64)).astype(np.uint64)
+    return (rows.astype(np.uint64) * pw).sum(axis=-1).astype(np.uint32)
+
+
+def pack_words(rows: np.ndarray, width: int) -> np.ndarray:
+    """Pack a trailing bool axis into ceil(width/32) uint32 words per row."""
+    W = (width + 31) // 32
+    out = np.zeros(rows.shape[:-1] + (W,), dtype=np.uint32)
+    for w in range(W):
+        chunk = rows[..., w * 32 : min((w + 1) * 32, rows.shape[-1])]
+        if chunk.shape[-1]:
+            out[..., w] = pack_bits32(chunk)
+    return out
+
+
+def unpack_zc_bits(bits: np.ndarray, Z: int, C: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Recover per-row zone/ct masks from packed joint (z*C+c) bits. Joint
+    sets are always PRODUCTS (zones × cts) — intersections of products stay
+    products — so the marginals reconstruct the state exactly."""
+    joint = ((bits[:, None] >> np.arange(Z * C, dtype=np.uint32)[None, :]) & 1).astype(bool)
+    joint = joint.reshape(-1, Z, C)
+    return joint.any(axis=2), joint.any(axis=1)
+
+
 def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
-    """The 20 padded positional arrays for tpu.ffd.ffd_solve, plus dims.
+    """The padded positional arrays for tpu.ffd.ffd_solve (order = ffd.ARG_SPEC),
+    plus dims.
 
     Shapes bucket to bounded sizes so compilations cache across solves
-    (SURVEY.md §7: bucketed padding avoids recompilation storms). Shared by
-    the single-solve path, the driver entry points, and the batched
-    consolidation evaluator.
+    (SURVEY.md §7: bucketed padding avoids recompilation storms). Zone ×
+    capacity-type admission and offering availability are packed into uint32
+    bit masks (ffd.py "Bit-packing"); raises ValueError when Z*C > 32 (the
+    hybrid solver falls back). Shared by the single-solve path, the driver
+    entry points, and the batched consolidation evaluator.
     """
     import jax.numpy as jnp
 
     INT32_MAX_NP = np.int32(2**31 - 1)
     S, G, T, E, P = len(enc.run_group), enc.G, enc.T, enc.E, enc.P
     R, Z, C = enc.group_req.shape[1], len(enc.zones), len(enc.capacity_types)
+    if Z * C > 32:
+        raise ValueError(f"Z*C = {Z * C} exceeds the 32-bit joint-offering packing")
     Sp, Gp, Tp, Ep, Pp = (
-        bucket(S, 64, 64),
+        bucket(S, 16, 16),
         bucket(G, 16, 16),
         bucket(T, 128, 128),
-        bucket(E, 64, 64),
+        bucket(E, 32, 8),
         bucket(P, 4, 4),
     )
     Qp = bucket(enc.Q, 8, 8)
+    W = (Gp + 31) // 32
 
     def pad(a, shape, fill=0):
         out = np.full(shape, fill, dtype=a.dtype)
@@ -71,22 +106,30 @@ def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
         return out
 
     type_charge = np.where(enc.charge_axes[None, :], enc.type_capacity, 0).astype(np.int32)
+    group_zc = pack_bits32(
+        (enc.group_zone[:, :, None] & enc.group_ct[:, None, :]).reshape(G, Z * C)
+    )
+    pool_zc = pack_bits32(
+        (enc.pool_zone[:, :, None] & enc.pool_ct[:, None, :]).reshape(P, Z * C)
+    )
+    offer_zc = pack_bits32(enc.offer_avail.reshape(T, Z * C))
+    # pairwise-INcompatibility words; padded groups are compatible with all
+    pair_nok = pack_words(~pad(enc.group_pair, (Gp, Gp), fill=True), Gp)
+
     args = (
         jnp.asarray(pad(enc.run_group, (Sp,))),
         jnp.asarray(pad(enc.run_count, (Sp,))),
         jnp.asarray(pad(enc.group_req, (Gp, R))),
         jnp.asarray(pad(enc.group_compat_t, (Gp, Tp))),
-        jnp.asarray(pad(enc.group_zone, (Gp, Z))),
-        jnp.asarray(pad(enc.group_ct, (Gp, C))),
+        jnp.asarray(pad(group_zc, (Gp,))),
         jnp.asarray(pad(enc.group_pool, (Gp, Pp))),
-        jnp.asarray(pad(enc.group_pair, (Gp, Gp), fill=True)),
+        jnp.asarray(pair_nok),
         jnp.asarray(pad(~enc.group_fallback, (Gp,))),
         jnp.asarray(pad(enc.type_alloc, (Tp, R))),
         jnp.asarray(pad(type_charge, (Tp, R))),
-        jnp.asarray(pad(enc.offer_avail, (Tp, Z, C))),
+        jnp.asarray(pad(offer_zc, (Tp,))),
         jnp.asarray(pad(enc.pool_type, (Pp, Tp))),
-        jnp.asarray(pad(enc.pool_zone, (Pp, Z))),
-        jnp.asarray(pad(enc.pool_ct, (Pp, C))),
+        jnp.asarray(pad(pool_zc, (Pp,))),
         jnp.asarray(pad(enc.pool_daemon, (Pp, R))),
         jnp.asarray(pad(enc.pool_limit, (Pp, R), fill=INT32_MAX_NP)),
         jnp.asarray(pad(enc.pool_usage, (Pp, R))),
@@ -99,8 +142,25 @@ def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
         jnp.asarray(pad(enc.node_q_member, (Ep, Qp))),
         jnp.asarray(pad(enc.node_q_owner, (Ep, Qp))),
     )
-    dims = dict(S=S, G=G, T=T, E=E, P=P, R=R, Z=Z, C=C, Sp=Sp, Gp=Gp, Tp=Tp, Ep=Ep, Pp=Pp, Qp=Qp)
+    from .tpu.ffd import ARG_SPEC
+
+    assert len(args) == len(ARG_SPEC), "kernel_args out of sync with ffd.ARG_SPEC"
+    dims = dict(
+        S=S, G=G, T=T, E=E, P=P, R=R, Z=Z, C=C,
+        Sp=Sp, Gp=Gp, Tp=Tp, Ep=Ep, Pp=Pp, Qp=Qp, W=W,
+    )
     return args, dims
+
+
+def initial_claim_bucket(total_pods: int, max_claims: int) -> int:
+    """First claim-slot bucket M for a solve of `total_pods` pods: the
+    smallest power-of-two ≥ min(total_pods+1, 512), capped at max_claims.
+    The solver doubles on saturation (overflow retry); bench.py uses the
+    same helper so the benchmarked bucket can't drift from production."""
+    M = 64
+    while M < min(total_pods + 1, 512):
+        M *= 2
+    return min(M, max(max_claims, 64))
 
 
 class TPUSolver(Solver):
@@ -155,23 +215,44 @@ class TPUSolver(Solver):
     def _device_solve(self, enc: EncodedInput) -> Optional[SolverResult]:
         from .tpu.ffd import ffd_solve
 
-        args, dims = kernel_args(enc, self._bucket)
+        try:
+            args, dims = kernel_args(enc, self._bucket)
+        except ValueError:
+            return None  # e.g. Z*C > 32: unpackable — replay on fallback
         S, E, T, G = dims["S"], dims["E"], dims["T"], dims["G"]
+        Z, C = dims["Z"], dims["C"]
         total_pods = int(sum(len(p) for p in enc.group_pods))
-        m = 64
-        while m < min(total_pods + 1, self.max_claims):
-            m *= 2
-        M = min(m, max(self.max_claims, 64))
+        # Claim slots sized from the input with overflow retry: start small
+        # (most solves open far fewer claims than pods) and double on
+        # saturation — each M is a cached compile bucket, and a too-big M
+        # inflates every [M,T] intermediate (VERDICT r1: M=8192 for a
+        # 462-claim solve was ~17× wasted bandwidth).
+        M = initial_claim_bucket(total_pods, self.max_claims)
+        while True:
+            out = ffd_solve(*args, max_claims=M)
+            used = int(out.state.used)
+            if used < M:
+                break
+            if M >= self.max_claims:
+                return None  # true overflow — replay on fallback
+            M = min(M * 2, self.max_claims)
 
-        out = ffd_solve(*args, max_claims=M)
-        used = int(out.state.used)
-        if used >= M:
-            return None  # possible overflow — replay on fallback
+        c_zone, c_ct = unpack_zc_bits(np.asarray(out.state.c_zc_bits), Z, C)
+        c_gmask = _unpack_gmask(np.asarray(out.state.c_gbits), G)
         return decode(enc, np.asarray(out.take_e)[:S, :E], np.asarray(out.take_c)[:S],
                       np.asarray(out.leftover)[:S], np.asarray(out.state.c_mask)[:, :T],
-                      np.asarray(out.state.c_zone), np.asarray(out.state.c_ct),
-                      np.asarray(out.state.c_pool), np.asarray(out.state.c_gmask)[:, :G],
+                      c_zone, c_ct,
+                      np.asarray(out.state.c_pool), c_gmask,
                       np.asarray(out.state.c_cum), used)
+
+
+def _unpack_gmask(gbits: np.ndarray, G: int) -> np.ndarray:
+    """[M, W] uint32 words -> [M, G] bool group-membership mask."""
+    M, W = gbits.shape
+    out = np.zeros((M, G), dtype=bool)
+    for g in range(G):
+        out[:, g] = (gbits[:, g >> 5] >> np.uint32(g & 31)) & 1
+    return out
 
 
 def decode(

@@ -462,6 +462,13 @@ def encode(inp: SolverInput) -> EncodedInput:
     node_q_member = np.zeros((E, Q), dtype=np.int32)
     node_q_owner = np.zeros((E, Q), dtype=np.int32)  # unknowable from labels
     sig_list = sorted(hostname_sigs.items(), key=lambda kv: kv[1])
+    if Q:
+        # The device Q axis treats each node ROW as one hostname domain; if
+        # two nodes share a kubernetes.io/hostname label they are ONE domain
+        # per SPEC.md, which the per-row counts can't express — fallback.
+        hostnames = [n.labels.get(wk.HOSTNAME_LABEL, n.id) for n in inp.nodes]
+        if len(set(hostnames)) < len(hostnames):
+            has_topo = True
     for e, n in enumerate(inp.nodes):
         node_free[e] = _quantize(n.free, rkeys, ceil=False)
         node_zone[e] = zid.get(n.labels.get(wk.ZONE_LABEL, ""), -1)

@@ -33,20 +33,17 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .ffd import ffd_solve
+from .ffd import ARG_INDEX, IN_AXES, ffd_solve
 
-
-# in_axes layout for the 26 positional ffd_solve args:
-#   run_group      None   (shared FFD run order)
-#   run_count      0      (per-subset membership zeroing)
-#   group_*        None
-#   type_*/offer_* None
-#   pool_*         None
-#   node_free      None
-#   node_compat    0      (per-subset node removal)
-#   q_* / node_q_* None   (hostname-cap sigs shared; removed nodes are
-#                          already compat-masked so their counts are inert)
-_IN_AXES = (None, 0) + (None,) * 7 + (None,) * 3 + (None,) * 6 + (None, 0) + (None,) * 6
+# vmap axes derived from ffd.ARG_SPEC — the single signature table — so a
+# kernel-signature change can never silently skew the batch layout again:
+#   run_count    batched (per-subset membership zeroing)
+#   node_compat  batched (per-subset node removal)
+#   everything else broadcasts (hostname-cap sigs shared; removed nodes are
+#   already compat-masked so their counts are inert)
+_IN_AXES = IN_AXES
+_RUN_COUNT = ARG_INDEX["run_count"]
+_NODE_COMPAT = ARG_INDEX["node_compat"]
 
 
 @functools.partial(jax.jit, static_argnames=("max_claims",))
@@ -66,11 +63,12 @@ def simulate_subsets(
 ):
     """Evaluate each subset; returns FFDOutput with leading batch axis B.
 
-    kernel_args: the 20 shared (padded) ffd_solve arrays for the FULL
-    simulation universe (all candidates' pods as runs, all nodes present).
+    kernel_args: the shared (padded) ffd_solve arrays (order = ffd.ARG_SPEC)
+    for the FULL simulation universe (all candidates' pods as runs, all
+    nodes present).
     """
-    run_count = np.asarray(kernel_args[1])
-    node_compat = np.asarray(kernel_args[19])
+    run_count = np.asarray(kernel_args[_RUN_COUNT])
+    node_compat = np.asarray(kernel_args[_NODE_COMPAT])
     B = len(subsets)
     S = run_count.shape[0]
     G, E = node_compat.shape
@@ -86,8 +84,8 @@ def simulate_subsets(
                 b_node_compat[b, :, e] = False
 
     args = list(kernel_args)
-    args[1] = jnp.asarray(b_run_count)
-    args[19] = jnp.asarray(b_node_compat)
+    args[_RUN_COUNT] = jnp.asarray(b_run_count)
+    args[_NODE_COMPAT] = jnp.asarray(b_node_compat)
     return _batched_ffd(tuple(args), max_claims=max_claims)
 
 

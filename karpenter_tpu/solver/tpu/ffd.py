@@ -14,9 +14,20 @@ sequential inner loop. Opening new nodes is closed-form: each new node holds
 in closed form as well. The only sequential axis is the run axis (≈ number
 of distinct pod specs), walked with `lax.scan`.
 
+Bit-packing (v2): the (zone × capacity-type) offering feasibility of a claim
+is a PRODUCT SET (zones ∩ … ) × (cts ∩ …), and intersections of product sets
+intersect componentwise — so each claim's joint feasibility is one uint32
+(`c_zc_bits`, bit z*C+c), each instance type's availability is one uint32,
+and the joint "does any offering survive" check is a single [M,T] bitwise
+AND instead of an [M,ZC]×[ZC,T] contraction. Group-membership state packs
+the same way into ceil(G/32) words. This collapsed the step's dominant
+memory traffic and the XLA graph size (the round-1 kernel compiled in ~15
+minutes and ran 2× over the latency target; see BENCH_r01).
+
 Per-step work is O((E+M)·T·R) fully-vectorized integer ops — VPU-friendly,
 HBM-bandwidth-bound, no data-dependent Python control flow, static shapes
-(SPEC: compile once per (E, M, T, R, Z, C, P, G, S) bucket).
+(SPEC: compile once per (E, M, T, R, P, S, Q, W) bucket). Padded scan steps
+(run_count == 0) skip their body via `lax.cond`.
 
 Decisions are bit-identical to the reference path by construction: same FFD
 order (runs follow it), same first-fit node order (array index = creation
@@ -36,16 +47,49 @@ import numpy as np
 INT32_MAX = jnp.int32(2**31 - 1)
 BIG = jnp.int32(2**30)
 
+# Positional argument table for ffd_solve. The second element is the batch
+# axis used by the consolidation evaluator's vmap (None = shared/broadcast,
+# 0 = per-candidate row). consolidate.py and backend.py derive indices from
+# THIS table — never hand-count positions (the round-1 hand-counted indices
+# silently skewed when the signature grew; VERDICT "what's weak" #6).
+ARG_SPEC = (
+    ("run_group", None),
+    ("run_count", 0),
+    ("group_req", None),
+    ("group_compat_t", None),
+    ("group_zc_bits", None),
+    ("group_pool", None),
+    ("group_pair_nok", None),
+    ("group_device", None),
+    ("type_alloc", None),
+    ("type_charge", None),
+    ("offer_zc_bits", None),
+    ("pool_type", None),
+    ("pool_zc_bits", None),
+    ("pool_daemon", None),
+    ("pool_limit", None),
+    ("pool_usage0", None),
+    ("node_free", None),
+    ("node_compat", 0),
+    ("q_member", None),
+    ("q_owner", None),
+    ("q_kind", None),
+    ("q_cap", None),
+    ("node_q_member", None),
+    ("node_q_owner", None),
+)
+
+ARG_INDEX = {name: i for i, (name, _ax) in enumerate(ARG_SPEC)}
+IN_AXES = tuple(ax for _name, ax in ARG_SPEC)
+
 
 class FFDState(NamedTuple):
     e_cum: jnp.ndarray  # [E, R] int32 — requests placed on existing nodes
     c_cum: jnp.ndarray  # [M, R] int32 — requests on claim slots (incl daemon)
     c_mask: jnp.ndarray  # [M, T] bool — surviving instance types
-    c_zone: jnp.ndarray  # [M, Z] bool
-    c_ct: jnp.ndarray  # [M, C] bool
-    c_gmask: jnp.ndarray  # [M, G] bool — groups placed on each claim
+    c_zc_bits: jnp.ndarray  # [M] uint32 — joint (zone, ct) feasibility bits
+    c_gbits: jnp.ndarray  # [M, W] uint32 — groups placed on each claim
     c_pool: jnp.ndarray  # [M] int32 — pool index, -1 if unopened
-    c_open: jnp.ndarray  # [M] bool
     used: jnp.ndarray  # scalar int32 — claims opened so far
     p_usage: jnp.ndarray  # [P, R] int32 — pool usage (limit accounting)
     # hostname-constraint counts (Q axis; see encode.py):
@@ -126,6 +170,15 @@ def _hostname_allowance(cm, co, q_kind, q_cap, member_g, owner_g):
     return jnp.maximum(jnp.min(per_q, axis=1), 0).astype(jnp.int32)
 
 
+def _gbit_word(g, W):
+    """[W] uint32 one-hot word for group index g."""
+    word = (g >> 5).astype(jnp.int32)
+    bit = (g & 31).astype(jnp.uint32)
+    return jnp.where(
+        jnp.arange(W, dtype=jnp.int32) == word, jnp.uint32(1) << bit, jnp.uint32(0)
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("max_claims",))
 def ffd_solve(
     # runs
@@ -134,19 +187,17 @@ def ffd_solve(
     # groups
     group_req,  # [G, R] i32
     group_compat_t,  # [G, T] bool
-    group_zone,  # [G, Z] bool
-    group_ct,  # [G, C] bool
+    group_zc_bits,  # [G] u32 — packed (zone × ct) admission bits
     group_pool,  # [G, P] bool
-    group_pair,  # [G, G] bool
+    group_pair_nok,  # [G, W] u32 — packed ~pairwise-compatibility words
     group_device,  # [G] bool — False => fallback group, skip on device
     # types
     type_alloc,  # [T, R] i32
     type_charge,  # [T, R] i32 — capacity on charge axes, 0 elsewhere
-    offer_avail,  # [T, Z, C] bool
+    offer_zc_bits,  # [T] u32 — packed offering availability bits
     # pools
     pool_type,  # [P, T] bool
-    pool_zone,  # [P, Z] bool
-    pool_ct,  # [P, C] bool
+    pool_zc_bits,  # [P] u32
     pool_daemon,  # [P, R] i32
     pool_limit,  # [P, R] i32
     pool_usage0,  # [P, R] i32
@@ -166,20 +217,17 @@ def ffd_solve(
     E, R = node_free.shape
     G, T = group_compat_t.shape
     P = pool_type.shape[0]
-    Z = group_zone.shape[1]
-    C = group_ct.shape[1]
     Q = q_kind.shape[0]
+    W = group_pair_nok.shape[1]
     M = max_claims
 
     state = FFDState(
         e_cum=jnp.zeros((E, R), jnp.int32),
         c_cum=jnp.zeros((M, R), jnp.int32),
         c_mask=jnp.zeros((M, T), bool),
-        c_zone=jnp.zeros((M, Z), bool),
-        c_ct=jnp.zeros((M, C), bool),
-        c_gmask=jnp.zeros((M, G), bool),
+        c_zc_bits=jnp.zeros((M,), jnp.uint32),
+        c_gbits=jnp.zeros((M, W), jnp.uint32),
         c_pool=jnp.full((M,), -1, jnp.int32),
-        c_open=jnp.zeros((M,), bool),
         used=jnp.int32(0),
         p_usage=pool_usage0.astype(jnp.int32),
         e_cm=node_q_member.astype(jnp.int32),
@@ -188,14 +236,12 @@ def ffd_solve(
         c_co=jnp.zeros((M, Q), jnp.int32),
     )
 
-    def step(st: FFDState, run):
-        g, count = run
+    def step_body(st: FFDState, g, count):
         req = group_req[g]  # [R]
         compat_t = group_compat_t[g]  # [T]
-        gz = group_zone[g]  # [Z]
-        gc = group_ct[g]  # [C]
+        g_zc = group_zc_bits[g]  # u32
         gpool = group_pool[g]  # [P]
-        gpair = group_pair[g]  # [G]
+        g_nok = group_pair_nok[g]  # [W]
         member_g = q_member[g]  # [Q]
         owner_g = q_owner[g]  # [Q]
         on_device = group_device[g]
@@ -205,60 +251,66 @@ def ffd_solve(
         # ---- 1. existing nodes --------------------------------------------
         e_cap = _fit_count(node_free, st.e_cum, req)
         e_cap = jnp.where(node_compat[g], e_cap, 0)
-        e_cap = jnp.minimum(e_cap, _hostname_allowance(st.e_cm, st.e_co, q_kind, q_cap, member_g, owner_g))
+        e_cap = jnp.minimum(
+            e_cap, _hostname_allowance(st.e_cm, st.e_co, q_kind, q_cap, member_g, owner_g)
+        )
         take_e, remaining = _pour(e_cap, remaining)
         e_cum = st.e_cum + take_e[:, None] * req[None, :]
         e_cm = st.e_cm + take_e[:, None] * member_g[None, :].astype(jnp.int32)
-        e_co = st.e_co + ((take_e[:, None] > 0) & owner_g[None, :] & (q_kind[None, :] == 1)).astype(jnp.int32)
+        e_co = st.e_co + (
+            (take_e[:, None] > 0) & owner_g[None, :] & (q_kind[None, :] == 1)
+        ).astype(jnp.int32)
 
         # ---- 2. open claims -----------------------------------------------
-        # offering availability under group+node zone/ct masks — exact joint
-        # check: ok_off[n,t] = exists (z,c): avail & c_zone[n,z] & c_ct[n,c]
-        # & gz[z] & gc[c]. Flatten (z,c) and contract: [M,ZC] @ [ZC,T].
-        A = offer_avail & gz[None, :, None] & gc[None, None, :]  # [T, Z, C]
-        ZC = A.shape[1] * A.shape[2]
-        nzc = (st.c_zone[:, :, None] & st.c_ct[:, None, :]).reshape(-1, ZC)  # [M, ZC]
-        ok_off = (
-            jnp.einsum("nx,tx->nt", nzc.astype(jnp.int32), A.reshape(-1, ZC).astype(jnp.int32)) > 0
-        )  # [M, T]
+        # joint offering feasibility: one bitwise AND per (claim, type)
+        A_bits = offer_zc_bits & g_zc  # [T] u32
+        ok_off = (st.c_zc_bits[:, None] & A_bits[None, :]) != 0  # [M, T]
 
         # pairwise group compatibility with everything on the node
-        pair_ok = ~jnp.any(st.c_gmask & ~gpair[None, :], axis=1)  # [M]
+        pair_ok = ~jnp.any((st.c_gbits & g_nok[None, :]) != 0, axis=1)  # [M]
         # pod must tolerate the claim's pool taints
-        pool_ok = jnp.where(st.c_pool >= 0, gpool[jnp.clip(st.c_pool, 0, P - 1)], False)
+        is_open = st.c_pool >= 0
+        pool_ok = jnp.where(is_open, gpool[jnp.clip(st.c_pool, 0, P - 1)], False)
 
         k_nt = _fit_count_nt(type_alloc, st.c_cum, req)  # [M, T]
         fit_nt = st.c_mask & compat_t[None, :] & ok_off  # [M, T]
-        node_ok = st.c_open & pair_ok & pool_ok  # [M]
+        node_ok = is_open & pair_ok & pool_ok  # [M]
         k_nt = jnp.where(fit_nt & node_ok[:, None], k_nt, 0)
         c_cap = jnp.max(k_nt, axis=1)  # [M]
-        c_cap = jnp.minimum(c_cap, _hostname_allowance(st.c_cm, st.c_co, q_kind, q_cap, member_g, owner_g))
+        c_cap = jnp.minimum(
+            c_cap, _hostname_allowance(st.c_cm, st.c_co, q_kind, q_cap, member_g, owner_g)
+        )
         take_c, remaining = _pour(c_cap, remaining)
 
         added = take_c > 0
         c_cum = st.c_cum + take_c[:, None] * req[None, :]
         c_mask = jnp.where(added[:, None], fit_nt & (k_nt >= take_c[:, None]), st.c_mask)
-        c_zone = jnp.where(added[:, None], st.c_zone & gz[None, :], st.c_zone)
-        c_ct = jnp.where(added[:, None], st.c_ct & gc[None, :], st.c_ct)
-        c_gmask = st.c_gmask.at[:, g].set(st.c_gmask[:, g] | added)
+        c_zc_bits = jnp.where(added, st.c_zc_bits & g_zc, st.c_zc_bits)
+        gword = _gbit_word(g, W)  # [W]
+        c_gbits = st.c_gbits | jnp.where(added[:, None], gword[None, :], jnp.uint32(0))
         c_cm = st.c_cm + take_c[:, None] * member_g[None, :].astype(jnp.int32)
-        c_co = st.c_co + (added[:, None] & owner_g[None, :] & (q_kind[None, :] == 1)).astype(jnp.int32)
+        c_co = st.c_co + (
+            added[:, None] & owner_g[None, :] & (q_kind[None, :] == 1)
+        ).astype(jnp.int32)
 
         # ---- 3. new claims, pool by pool in priority order ----------------
         # fresh-node allowance under hostname constraints (counts start at 0)
         fresh_allow = _hostname_allowance(
-            jnp.zeros((1, Q), jnp.int32), jnp.zeros((1, Q), jnp.int32),
-            q_kind, q_cap, member_g, owner_g,
+            jnp.zeros((1, Q), jnp.int32),
+            jnp.zeros((1, Q), jnp.int32),
+            q_kind,
+            q_cap,
+            member_g,
+            owner_g,
         )[0]
 
         def open_pool(p, carry):
-            (remaining, used, c_cum, c_mask, c_zone, c_ct, c_gmask, c_pool,
-             c_open, p_usage, take_new, c_cm, c_co) = carry
+            (remaining, used, c_cum, c_mask, c_zc_bits, c_gbits, c_pool,
+             p_usage, take_new, c_cm, c_co) = carry
 
             # per-type pod capacity for a fresh node of pool p
-            pz = pool_zone[p] & gz  # [Z]
-            pc = pool_ct[p] & gc  # [C]
-            off_ok = jnp.any(offer_avail & pz[None, :, None] & pc[None, None, :], axis=(1, 2))  # [T]
+            new_bits = pool_zc_bits[p] & g_zc  # u32
+            off_ok = (offer_zc_bits & new_bits) != 0  # [T]
             fit_t = compat_t & pool_type[p] & off_ok  # [T]
             daemon = pool_daemon[p]  # [R]
             safe_req = jnp.maximum(req, 1)
@@ -297,70 +349,82 @@ def ffd_solve(
             eligible = gpool[p] & (full_take > 0)
             n_new = jnp.where(eligible, n_new, 0)
 
-            idx = jnp.arange(M, dtype=jnp.int32)
-            is_new = (idx >= used) & (idx < used + n_new)
-            # node j (0-based among new) takes min(full_take, remaining - j*full_take)
-            j = idx - used
-            take_j = jnp.where(
-                is_new, jnp.clip(remaining - j * full_take, 0, full_take), 0
-            ).astype(jnp.int32)
+            def apply(ops):
+                (c_cum, c_mask, c_zc_bits, c_gbits, c_pool, p_usage, take_new,
+                 c_cm, c_co) = ops
+                idx = jnp.arange(M, dtype=jnp.int32)
+                is_new = (idx >= used) & (idx < used + n_new)
+                # node j (0-based among new) takes min(full_take, remaining - j*full_take)
+                j = idx - used
+                take_j = jnp.where(
+                    is_new, jnp.clip(remaining - j * full_take, 0, full_take), 0
+                ).astype(jnp.int32)
 
-            c_cum = jnp.where(is_new[:, None], daemon[None, :] + take_j[:, None] * req[None, :], c_cum)
-            new_mask = fit_t[None, :] & (k_t[None, :] >= take_j[:, None])
-            c_mask = jnp.where(is_new[:, None], new_mask, c_mask)
-            c_zone = jnp.where(is_new[:, None], pz[None, :], c_zone)
-            c_ct = jnp.where(is_new[:, None], pc[None, :], c_ct)
-            c_gmask = c_gmask.at[:, g].set(c_gmask[:, g] | is_new)
-            c_pool = jnp.where(is_new, p, c_pool)
-            c_open = c_open | is_new
-            c_cm = jnp.where(
-                is_new[:, None], take_j[:, None] * member_g[None, :].astype(jnp.int32), c_cm
+                c_cum = jnp.where(
+                    is_new[:, None], daemon[None, :] + take_j[:, None] * req[None, :], c_cum
+                )
+                new_mask = fit_t[None, :] & (k_t[None, :] >= take_j[:, None])
+                c_mask = jnp.where(is_new[:, None], new_mask, c_mask)
+                c_zc_bits = jnp.where(is_new, new_bits, c_zc_bits)
+                c_gbits = jnp.where(is_new[:, None], gword[None, :], c_gbits)
+                c_pool = jnp.where(is_new, p, c_pool)
+                c_cm = jnp.where(
+                    is_new[:, None], take_j[:, None] * member_g[None, :].astype(jnp.int32), c_cm
+                )
+                c_co = jnp.where(
+                    is_new[:, None],
+                    ((take_j[:, None] > 0) & owner_g[None, :] & (q_kind[None, :] == 1)).astype(
+                        jnp.int32
+                    ),
+                    c_co,
+                )
+                # charge pool usage: every claim charges its at-creation
+                # (1-pod survivor) minimum — n_new claims, charge_one each
+                p_usage = p_usage.at[p].add((charge_one * n_new).astype(jnp.int32))
+                take_new = take_new + take_j
+                return (c_cum, c_mask, c_zc_bits, c_gbits, c_pool, p_usage, take_new,
+                        c_cm, c_co, jnp.sum(take_j))
+
+            def skip(ops):
+                return ops + (jnp.int32(0),)
+
+            (c_cum, c_mask, c_zc_bits, c_gbits, c_pool, p_usage, take_new, c_cm,
+             c_co, placed_new) = jax.lax.cond(
+                n_new > 0,
+                apply,
+                skip,
+                (c_cum, c_mask, c_zc_bits, c_gbits, c_pool, p_usage, take_new, c_cm, c_co),
             )
-            c_co = jnp.where(
-                is_new[:, None],
-                ((take_j[:, None] > 0) & owner_g[None, :] & (q_kind[None, :] == 1)).astype(jnp.int32),
-                c_co,
-            )
 
-            # charge pool usage: every claim charges its at-creation (1-pod
-            # survivor) minimum — n_new claims, charge_one each
-            placed_new = jnp.sum(take_j)
-            p_usage = p_usage.at[p].add((charge_one * n_new).astype(jnp.int32))
-
-            take_new = take_new + take_j
             remaining = remaining - placed_new
             used = used + n_new
-            return (remaining, used, c_cum, c_mask, c_zone, c_ct, c_gmask, c_pool,
-                    c_open, p_usage, take_new, c_cm, c_co)
+            return (remaining, used, c_cum, c_mask, c_zc_bits, c_gbits, c_pool,
+                    p_usage, take_new, c_cm, c_co)
 
         carry = (
             remaining,
             st.used,
             c_cum,
             c_mask,
-            c_zone,
-            c_ct,
-            c_gmask,
+            c_zc_bits,
+            c_gbits,
             st.c_pool,
-            st.c_open,
             st.p_usage,
             jnp.zeros((M,), jnp.int32),
             c_cm,
             c_co,
         )
         carry = jax.lax.fori_loop(0, P, open_pool, carry)
-        (remaining, used, c_cum, c_mask, c_zone, c_ct, c_gmask, c_pool2, c_open,
-         p_usage, take_new, c_cm, c_co) = carry
+        (remaining, used, c_cum, c_mask, c_zc_bits, c_gbits, c_pool2, p_usage,
+         take_new, c_cm, c_co) = carry
 
         new_state = FFDState(
             e_cum=e_cum,
             c_cum=c_cum,
             c_mask=c_mask,
-            c_zone=c_zone,
-            c_ct=c_ct,
-            c_gmask=c_gmask,
+            c_zc_bits=c_zc_bits,
+            c_gbits=c_gbits,
             c_pool=c_pool2,
-            c_open=c_open,
             used=used,
             p_usage=p_usage,
             e_cm=e_cm,
@@ -369,6 +433,24 @@ def ffd_solve(
             c_co=c_co,
         )
         return new_state, (take_e, take_c + take_new, remaining)
+
+    def step(st: FFDState, run):
+        g, count = run
+        # padded runs (count == 0) skip the whole body — bucketed S padding
+        # costs ~nothing at runtime
+        return jax.lax.cond(
+            count > 0,
+            lambda s: step_body(s, g, count),
+            lambda s: (
+                s,
+                (
+                    jnp.zeros((E,), jnp.int32),
+                    jnp.zeros((M,), jnp.int32),
+                    jnp.int32(0),
+                ),
+            ),
+            st,
+        )
 
     state, (take_e, take_c, leftover) = jax.lax.scan(step, state, (run_group, run_count))
     return FFDOutput(take_e=take_e, take_c=take_c, leftover=leftover, state=state)

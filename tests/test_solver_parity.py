@@ -399,3 +399,39 @@ class TestHostnameConstraintsParity:
             for i in range(3)
         ]
         assert_parity(SolverInput(pods=pods, nodes=[n1], nodepools=[pool()], zones=ZONES))
+
+    def test_nodes_without_hostname_label(self):
+        """A node missing kubernetes.io/hostname still forms a hostname
+        domain (defaults to its id) — SPEC.md; both backends must agree.
+        Regression: the oracle used to reject such nodes for TSC pods while
+        the device kernel admitted them."""
+        from karpenter_tpu.api.objects import TopologySpreadConstraint
+
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.HOSTNAME_LABEL, label_selector={"app": "w"}
+        )
+        free = Resources.parse({"cpu": "4", "memory": "16Gi"})
+        free["pods"] = 20
+        nodes = [
+            ExistingNode(
+                id=f"n{j}",
+                labels={wk.ZONE_LABEL: "zone-1a", wk.CAPACITY_TYPE_LABEL: "on-demand"},
+                taints=[],
+                free=Resources(free),
+            )
+            for j in range(2)
+        ]
+        pods = [
+            mkpod(f"p{i}", cpu="500m", mem="512Mi", labels={"app": "w"},
+                  topology_spread=[tsc])
+            for i in range(4)
+        ] + [mkpod(f"f{i}", cpu="250m", mem="256Mi") for i in range(3)]
+        ref, tpu = assert_parity(
+            SolverInput(pods=pods, nodes=nodes, nodepools=[pool()], zones=ZONES)
+        )
+        # skew-1 spread: at most one matching pod lands per (unlabeled) node
+        per_node = {}
+        for uid, tgt in tpu.placements.items():
+            if uid.startswith("p") and tgt[0] == "node":
+                per_node[tgt[1]] = per_node.get(tgt[1], 0) + 1
+        assert all(v <= 1 for v in per_node.values()), per_node
