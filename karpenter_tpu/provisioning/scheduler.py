@@ -83,7 +83,14 @@ class SolverResult:
 
 
 def ffd_key(pod: Pod):
-    return (-pod.requests.get_("cpu"), -pod.requests.get_("memory"), pod.meta.uid)
+    # cached on the pod: sort keys are an O(pods·log pods) Python cost per
+    # solve; pods are immutable once admitted (objects are replaced on
+    # update), so the key survives across solves like the encoder signature
+    k = pod.__dict__.get("_ffd_key")
+    if k is None:
+        k = (-pod.requests.get_("cpu"), -pod.requests.get_("memory"), pod.meta.uid)
+        pod.__dict__["_ffd_key"] = k
+    return k
 
 
 # ---------------------------------------------------------------------------

@@ -29,7 +29,7 @@ from ..provisioning.scheduler import (
 )
 from ..scheduling.requirements import IN, Requirement, Requirements
 from ..utils.resources import PODS, Resources
-from .encode import EncodedInput, encode, quantize_input
+from .encode import EncodedInput, UnpackableInput, encode, quantize_input
 
 
 class Solver(abc.ABC):
@@ -89,7 +89,7 @@ def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
     S, G, T, E, P = len(enc.run_group), enc.G, enc.T, enc.E, enc.P
     R, Z, C = enc.group_req.shape[1], len(enc.zones), len(enc.capacity_types)
     if Z * C > 32:
-        raise ValueError(f"Z*C = {Z * C} exceeds the 32-bit joint-offering packing")
+        raise UnpackableInput(f"Z*C = {Z * C} exceeds the 32-bit joint-offering packing")
     Sp, Gp, Tp, Ep, Pp = (
         bucket(S, 16, 16),
         bucket(G, 16, 16),
@@ -217,8 +217,8 @@ class TPUSolver(Solver):
 
         try:
             args, dims = kernel_args(enc, self._bucket)
-        except ValueError:
-            return None  # e.g. Z*C > 32: unpackable — replay on fallback
+        except UnpackableInput:
+            return None  # Z*C > 32 — replay on fallback
         S, E, T, G = dims["S"], dims["E"], dims["T"], dims["G"]
         Z, C = dims["Z"], dims["C"]
         total_pods = int(sum(len(p) for p in enc.group_pods))
@@ -281,16 +281,23 @@ def decode(
         n = int(enc.run_count[s])
         pods = enc.group_pods[g][cursor[g] : cursor[g] + n]
         cursor[g] += n
+        # pods are assigned in index order: existing nodes, then claim slots,
+        # then leftovers — np.repeat expands per-target counts to one target
+        # per pod position (array-side; no per-pod Python arithmetic)
+        te, tc = take_e[s], take_c[s]
+        e_idx = np.nonzero(te)[0]
+        c_idx = np.nonzero(tc)[0]
+        e_rep = np.repeat(e_idx, te[e_idx])
+        c_rep = np.repeat(c_idx, tc[c_idx])
         i = 0
-        for e in np.nonzero(take_e[s])[0]:
-            for _ in range(int(take_e[s, e])):
-                placements[pods[i].meta.uid] = ("node", enc.node_ids[e])
-                i += 1
-        for m in np.nonzero(take_c[s])[0]:
-            for _ in range(int(take_c[s, m])):
-                placements[pods[i].meta.uid] = ("claim", int(m))
-                claim_pods[int(m)].append(pods[i].meta.uid)
-                i += 1
+        for e in e_rep:
+            placements[pods[i].meta.uid] = ("node", enc.node_ids[e])
+            i += 1
+        for m in c_rep:
+            m = int(m)
+            placements[pods[i].meta.uid] = ("claim", m)
+            claim_pods[m].append(pods[i].meta.uid)
+            i += 1
         for _ in range(int(leftover[s])):
             errors[pods[i].meta.uid] = "no instance type in any nodepool satisfies the pod"
             i += 1

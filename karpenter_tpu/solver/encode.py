@@ -40,6 +40,12 @@ from ..utils.resources import CPU, EPHEMERAL_STORAGE, MEMORY, PODS, Resources
 MIB = 1024**2
 INT32_MAX = np.int32(2**31 - 1)
 
+
+class UnpackableInput(ValueError):
+    """The input exceeds a device-kernel packing bound (e.g. Z*C > 32 joint
+    offering bits); the hybrid solver falls back to a host path. A dedicated
+    type so fallback handlers don't swallow unrelated ValueErrors."""
+
 # Resource keys quantized to MiB granularity.
 _MIB_KEYS = (MEMORY, EPHEMERAL_STORAGE)
 
@@ -58,7 +64,21 @@ def _quantize(res: Resources, keys: Sequence[str], ceil: bool) -> List[int]:
 def _pod_signature(pod: Pod) -> tuple:
     """Scheduling-footprint identity: pods with equal signatures behave
     identically in the solver (requests, constraints, AND labels — labels
-    affect other pods' TSC/affinity selectors)."""
+    affect other pods' TSC/affinity selectors).
+
+    Cached on the pod object: signatures are the encoder's only O(pods)
+    Python cost, and pods are immutable during/between solves (controllers
+    replace objects on update, never mutate scheduling fields in place), so
+    the 50k-pod surge pays signature construction once, not once per solve."""
+    sig = pod.__dict__.get("_solver_sig")
+    if sig is not None:
+        return sig
+    sig = _pod_signature_uncached(pod)
+    pod.__dict__["_solver_sig"] = sig
+    return sig
+
+
+def _pod_signature_uncached(pod: Pod) -> tuple:
     return (
         tuple(sorted((k, v) for k, v in pod.requests.items() if v)),
         tuple(sorted(pod.node_selector.items())),
@@ -181,6 +201,9 @@ def quantize_resources(res: Resources, ceil: bool) -> Resources:
 
 _QUANTIZED_TYPE_CACHE: dict = {}
 
+# pod-signature -> (catalog id-tuple, pinned types, [T] bool compat row)
+_GROUP_COMPAT_CACHE: dict = {}
+
 
 def _quantize_type(it):
     """Per-InstanceType quantization, cached by object identity (the catalog
@@ -199,25 +222,42 @@ def _quantize_type(it):
     return q
 
 
+def _already_mib_aligned(res: Resources) -> bool:
+    for k in _MIB_KEYS:
+        v = res.get(k)
+        if v is not None and v % MIB:
+            return False
+    return True
+
+
 def quantize_input(inp: SolverInput) -> SolverInput:
     """A structurally-shared copy of `inp` with all resources MiB-quantized —
     what the hybrid production path and the parity tests feed the reference
-    solver so both backends see identical numbers. Only the quantized fields
-    are fresh objects; everything else is shared (nothing downstream mutates
-    pods/types)."""
+    solver so both backends see identical numbers. Only fields that actually
+    need quantizing become fresh objects; everything else is shared IDENTITY
+    (nothing downstream mutates pods/types), which keeps per-pod caches
+    (signature, FFD key) warm across solves — typical requests like "1Gi"
+    are already MiB-aligned, so a 50k-pod surge copies nothing."""
     from dataclasses import replace as _replace
 
+    def qpod(p):
+        if _already_mib_aligned(p.requests):
+            return p
+        return _replace(p, requests=quantize_resources(p.requests, ceil=True))
+
+    def qnode(n):
+        if _already_mib_aligned(n.free):
+            return n
+        return _replace(n, free=quantize_resources(n.free, ceil=False))
+
     return SolverInput(
-        pods=[_replace(p, requests=quantize_resources(p.requests, ceil=True)) for p in inp.pods],
-        nodes=[_replace(n, free=quantize_resources(n.free, ceil=False)) for n in inp.nodes],
+        pods=[qpod(p) for p in inp.pods],
+        nodes=[qnode(n) for n in inp.nodes],
         nodepools=[
             _replace(pool, instance_types=[_quantize_type(it) for it in pool.instance_types])
             for pool in inp.nodepools
         ],
-        daemonset_pods=[
-            _replace(p, requests=quantize_resources(p.requests, ceil=True))
-            for p in inp.daemonset_pods
-        ],
+        daemonset_pods=[qpod(p) for p in inp.daemonset_pods],
         zones=inp.zones,
         capacity_types=inp.capacity_types,
     )
@@ -369,9 +409,19 @@ def encode(inp: SolverInput) -> EncodedInput:
                     offer_price[t, zi, ci] = min(offer_price[t, zi, ci], o.price)
 
     # ---- group×type / group×zone / group×ct --------------------------------
+    # group×type compatibility rows cache by pod signature: a recurring group
+    # (same deployment, next solve) costs a dict hit instead of T
+    # requirement-algebra calls. The catalog is identified by object ids,
+    # with the referenced types pinned in the cache entry so ids can't be
+    # recycled under us.
+    group_sigs = {gid: sig for sig, gid in sig_to_gid.items()}
+    types_tuple = tuple(types_by_name[n] for n in type_names)
+    types_ids = tuple(map(id, types_tuple))
     group_compat_t = np.zeros((G, T), dtype=bool)
     group_zone = np.zeros((G, len(zones)), dtype=bool)
     group_ct = np.zeros((G, len(cts)), dtype=bool)
+    if len(_GROUP_COMPAT_CACHE) > 8192:
+        _GROUP_COMPAT_CACHE.clear()
     for g, reqs in enumerate(group_reqsets):
         zr = reqs.get(wk.ZONE_LABEL)
         for i, z in enumerate(zones):
@@ -379,9 +429,18 @@ def encode(inp: SolverInput) -> EncodedInput:
         cr = reqs.get(wk.CAPACITY_TYPE_LABEL)
         for i, c in enumerate(cts):
             group_ct[g, i] = cr is None or cr.has(c)
-        for t in range(T):
-            it = types_by_name[type_names[t]]
-            group_compat_t[g, t] = reqs.compatible(it.requirements)
+        sig = group_sigs[g]
+        ent = _GROUP_COMPAT_CACHE.get(sig)
+        if ent is not None and ent[0] == types_ids:
+            group_compat_t[g] = ent[2]
+        else:
+            row = np.fromiter(
+                (reqs.compatible(it.requirements) for it in types_tuple),
+                dtype=bool,
+                count=T,
+            )
+            group_compat_t[g] = row
+            _GROUP_COMPAT_CACHE[sig] = (types_ids, types_tuple, row)
 
     # ---- pool tensors -------------------------------------------------------
     P = len(pools)
@@ -466,7 +525,9 @@ def encode(inp: SolverInput) -> EncodedInput:
         # The device Q axis treats each node ROW as one hostname domain; if
         # two nodes share a kubernetes.io/hostname label they are ONE domain
         # per SPEC.md, which the per-row counts can't express — fallback.
-        hostnames = [n.labels.get(wk.HOSTNAME_LABEL, n.id) for n in inp.nodes]
+        from ..provisioning.scheduler import node_hostname
+
+        hostnames = [node_hostname(n) for n in inp.nodes]
         if len(set(hostnames)) < len(hostnames):
             has_topo = True
     for e, n in enumerate(inp.nodes):
