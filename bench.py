@@ -118,6 +118,82 @@ def build_e2e_input(num_pods: int = 50_000, num_nodes: int = 200):
     return inp
 
 
+def build_config3_input(num_pods: int = 50_000):
+    """BASELINE config 3: topologySpreadConstraints across 3 AZs."""
+    from karpenter_tpu.api import wellknown as wk
+    from karpenter_tpu.api.objects import TopologySpreadConstraint
+
+    inp = build_input(num_pods)
+    for i, p in enumerate(inp.pods):
+        app = f"app-{(i // 1250) % 40}"
+        p.meta.labels["app"] = app
+        p.topology_spread = [
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=wk.ZONE_LABEL,
+                label_selector={"app": app},
+            )
+        ]
+        p.node_selector = {}  # pure spread config
+    return inp
+
+
+def build_config4_input(num_pods: int = 50_000):
+    """BASELINE config 4: inter-pod affinity/anti-affinity. A third of the
+    pods follow a leader label into one zone; a few anti singletons spread
+    one-per-zone; the rest are plain."""
+    from karpenter_tpu.api import wellknown as wk
+    from karpenter_tpu.api.objects import PodAffinityTerm
+
+    inp = build_input(num_pods)
+    for i, p in enumerate(inp.pods):
+        p.node_selector = {}
+        if i % 3 == 0:
+            p.meta.labels["svc"] = "web"
+            p.affinity_terms = [
+                PodAffinityTerm(
+                    label_selector={"svc": "web"},
+                    topology_key=wk.ZONE_LABEL,
+                    anti=False,
+                )
+            ]
+        elif i < 9:
+            p.meta.labels["svc"] = f"lock-{i}"
+            p.affinity_terms = [
+                PodAffinityTerm(
+                    label_selector={"svc": f"lock-{i}"},
+                    topology_key=wk.ZONE_LABEL,
+                    anti=True,
+                )
+            ]
+    return inp
+
+
+def _bench_config(tag, inp, iters=5):
+    import sys
+    import time
+
+    from karpenter_tpu.solver.backend import TPUSolver
+
+    solver = TPUSolver(max_claims=8192)
+    t0 = time.perf_counter()
+    res = solver.solve(inp)
+    first = time.perf_counter() - t0
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        res = solver.solve(inp)
+        times.append((time.perf_counter() - t0) * 1000)
+    p50 = float(np.percentile(np.asarray(times), 50))
+    print(
+        f"[bench] {tag}: first={first:.1f}s p50={p50:.0f}ms — claims={len(res.claims)} "
+        f"errors={len(res.errors)} device_solves={solver.stats['device_solves']}",
+        file=sys.stderr,
+    )
+    assert solver.stats["device_solves"] > 0, f"{tag} fell back off-device"
+    return p50
+
+
 def main() -> None:
     t0 = time.perf_counter()
     import jax
@@ -232,6 +308,10 @@ def main() -> None:
     )
     assert e2e_solver.stats["device_solves"] > 0, "e2e bench fell back off-device"
 
+    # ---- configs 3-4: zone topology spread / inter-pod affinity ----------
+    c3_p50 = _bench_config("config3 zone-TSC e2e (50k pods)", build_config3_input(50_000))
+    c4_p50 = _bench_config("config4 affinity e2e (50k pods)", build_config4_input(50_000))
+
     print(
         json.dumps(
             {
@@ -243,6 +323,8 @@ def main() -> None:
                 "link_roundtrip_ms": round(rtt, 2),
                 "e2e_p50_ms": round(e2e_p50, 2),
                 "e2e_p99_ms": round(e2e_p99, 2),
+                "config3_e2e_p50_ms": round(c3_p50, 2),
+                "config4_e2e_p50_ms": round(c4_p50, 2),
                 "first_call_s": round(compile_s, 2),
             }
         )

@@ -18,7 +18,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..provisioning.scheduler import SolverInput, ffd_key
+from ..provisioning.scheduler import SolverInput, ffd_sort
 from ..solver.backend import TPUSolver, kernel_args
 from ..solver.encode import UnpackableInput, encode, quantize_input
 from ..solver.tpu.consolidate import replacement_min_price, simulate_subsets
@@ -57,7 +57,7 @@ class BatchedConsolidationEvaluator:
         uid_to_gid = {
             p.meta.uid: g for g, pods in enumerate(enc.group_pods) for p in pods
         }
-        pods_sorted = sorted(all_pods, key=ffd_key)
+        pods_sorted = ffd_sort(all_pods)
         run_group: List[int] = []
         run_count: List[int] = []
         run_cand: List[int] = []

@@ -93,6 +93,35 @@ def ffd_key(pod: Pod):
     return k
 
 
+def ffd_sort(pods: Sequence[Pod]) -> List[Pod]:
+    """Canonical FFD order (SPEC.md "Pod order"): descending (cpu, memory);
+    within an equal-size block, same-signature pods group contiguously by
+    first appearance (uid order within a signature). Size ties are arbitrary
+    for FFD correctness — grouping them maximizes run length so the tensor
+    path scans O(distinct specs) steps instead of O(pods) when differently-
+    constrained pods interleave by uid."""
+    from ..solver.encode import _pod_signature  # lazy: avoid import cycle
+
+    pods1 = sorted(pods, key=ffd_key)
+    out: List[Pod] = []
+    i = 0
+    n = len(pods1)
+    while i < n:
+        j = i
+        ki = ffd_key(pods1[i])[:2]
+        while j < n and ffd_key(pods1[j])[:2] == ki:
+            j += 1
+        block = pods1[i:j]
+        if j - i > 1:
+            order: Dict[tuple, int] = {}
+            for p in block:
+                order.setdefault(_pod_signature(p), len(order))
+            block = sorted(block, key=lambda p: order[_pod_signature(p)])  # stable
+        out.extend(block)
+        i = j
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Topology / affinity state (SPEC.md "Topology spread", "Inter-pod affinity")
 # ---------------------------------------------------------------------------
@@ -276,6 +305,12 @@ class VirtualNode:
         self.requests[PODS] = self.requests.get_(PODS)  # ensure key
         self.pod_uids: List[str] = []
         self.taints = list(pool.taints)
+        # claim-local affinity state: pods on one claim share EVERY topology
+        # domain (same node ⇒ same zone), even while the claim's zone is
+        # still multi-valued — so (anti-)affinity must see co-located pods
+        # directly, not only through recorded zone counts (SPEC.md).
+        self.pod_label_list: List[Dict[str, str]] = []
+        self.anti_sigs: set = set()  # {(sel_sig, key)} owned by pods here
 
     def _surviving(self, reqs: Requirements, requests: Resources) -> List[InstanceType]:
         out = []
@@ -386,7 +421,7 @@ class Scheduler:
     def solve(self) -> SolverResult:
         placements: Dict[str, Tuple[str, object]] = {}
         errors: Dict[str, str] = {}
-        pods = sorted([p for p in self.inp.pods if not p.scheduling_gated and not p.bound], key=ffd_key)
+        pods = ffd_sort([p for p in self.inp.pods if not p.scheduling_gated and not p.bound])
         for pod in pods:
             err = self._schedule_with_relaxation(pod, placements)
             if err:
@@ -505,6 +540,10 @@ class Scheduler:
             return False
         c.requests = requests
         c.pod_uids.append(pod.meta.uid)
+        c.pod_label_list.append(dict(pod.meta.labels))
+        for term in pod.affinity_terms:
+            if term.weight is None and term.anti and term.topology_key != wk.HOSTNAME_LABEL:
+                c.anti_sigs.add((_sel_sig(term.label_selector), term.topology_key))
         self.topo.record(pod, domains)
         return True
 
@@ -528,10 +567,49 @@ class Scheduler:
                 return False
         return self._affinity_admits(pod, {k: {v} for k, v in domains.items()}, fixed=True)[0]
 
+    def _anti_blocked_domains(self, pod: Pod, key: str) -> set:
+        """Domains of `key` excluded by anti-affinity for this pod: owned
+        required anti terms (domains holding matching pods) plus symmetric
+        blocks from placed owners whose selector matches this pod."""
+        blocked = set(self.topo.symmetric_anti_blocked(pod.meta.labels).get(key, set()))
+        for term in pod.affinity_terms:
+            if term.weight is not None or not term.anti or term.topology_key != key:
+                continue
+            blocked |= self.topo.anti_blocked(term.label_selector, key)
+        return blocked
+
+    def _affinity_present_restriction(
+        self, pod: Pod, key: str, claim: Optional[VirtualNode] = None
+    ) -> Optional[set]:
+        """Joint positive-affinity restriction on `key`: the intersection of
+        the present sets of the pod's required positive terms. Terms with no
+        matching pod anywhere (bootstrap) or satisfied claim-locally impose
+        no restriction. None = unrestricted."""
+        restriction: Optional[set] = None
+        for term in pod.affinity_terms:
+            if term.weight is not None or term.anti or term.topology_key != key:
+                continue
+            if claim is not None and any(
+                _matches(term.label_selector, pl) for pl in claim.pod_label_list
+            ):
+                continue  # co-located match satisfies the term
+            present = {
+                d
+                for d, cnt in self.topo.affinity_domains(term.label_selector, key).items()
+                if cnt > 0
+            }
+            if not present:
+                continue  # bootstrap (or doomed later) — no restriction here
+            restriction = present if restriction is None else (restriction & present)
+        return restriction
+
     def _topo_admits_claim(self, pod: Pod, pod_reqs: Requirements, c: VirtualNode) -> Tuple[bool, Dict[str, str]]:
         """Admission + narrowing for a virtual node. Returns committed domains."""
         committed: Dict[str, str] = {wk.HOSTNAME_LABEL: c.hostname}
-        # spread constraints
+        # spread constraints — the allowed set is JOINT: skew rule minus the
+        # pod's anti-affinity exclusions, so the committed domain is workable
+        # under every constraint at once (the reference tracks topology
+        # domains jointly across spread and affinity groups)
         for tsc in pod.topology_spread:
             if tsc.when_unsatisfiable != "DoNotSchedule":
                 continue
@@ -543,6 +621,10 @@ class Scheduler:
                 self._pod_own_domains(pod_reqs, key),
                 extra_domains=(c.hostname,) if key == wk.HOSTNAME_LABEL else (),
             )
+            allowed = allowed - self._anti_blocked_domains(pod, key)
+            aff_restriction = self._affinity_present_restriction(pod, key, c)
+            if aff_restriction is not None:
+                allowed = allowed & aff_restriction
             inter = [d for d in node_domains if d in allowed]
             if not inter:
                 return False, {}
@@ -584,6 +666,12 @@ class Scheduler:
         claim: Optional[VirtualNode] = None,
     ) -> Tuple[bool, Dict[str, str]]:
         committed: Dict[str, str] = {}
+        # claim-local symmetry: a pod matching an anti term OWNED by a pod
+        # already on this claim may not join it (same claim ⇒ same domain)
+        if claim is not None:
+            for sel_sig, _key in claim.anti_sigs:
+                if _matches(dict(sel_sig), pod.meta.labels):
+                    return False, {}
         # symmetric anti-affinity from placed pods
         for key, blocked in self.topo.symmetric_anti_blocked(pod.meta.labels).items():
             doms = node_domains.get(key)
@@ -604,15 +692,36 @@ class Scheduler:
             doms = set(node_domains.get(key, set()))
             if not doms:
                 return False, {}
+            claim_local = claim is not None and key != wk.HOSTNAME_LABEL and any(
+                _matches(term.label_selector, pl) for pl in claim.pod_label_list
+            )
             match = self.topo.affinity_domains(term.label_selector, key)
             if term.anti:
-                blocked = {d for d, cnt in match.items() if cnt > 0}
+                if claim_local:
+                    return False, {}  # matching pod co-located on this claim
+                # joint blocked set: this term, the pod's other anti terms,
+                # and symmetric blocks — the committed domain must satisfy all
+                # (and any positive present-set restriction on the same key)
+                blocked = self._anti_blocked_domains(pod, key)
                 remaining = doms - blocked
+                aff_r = self._affinity_present_restriction(pod, key, claim)
+                if aff_r is not None:
+                    remaining = remaining & aff_r
                 if not remaining:
                     return False, {}
-                if not fixed and len(remaining) < len(doms) and key != wk.HOSTNAME_LABEL and claim is not None:
-                    if not claim.narrow(key, remaining):
+                if not fixed and key != wk.HOSTNAME_LABEL and claim is not None and len(doms) > 1:
+                    # an owned anti term COMMITS the claim to one domain —
+                    # leaving it multi-valued would let two claims later
+                    # materialize in the same zone and violate the term
+                    # (SPEC.md: anti commits like spread; lex-first allowed)
+                    d_star = min(remaining)
+                    if not claim.narrow(key, {d_star}):
                         return False, {}
+                    committed[key] = d_star
+                    node_domains = dict(node_domains)
+                    node_domains[key] = {d_star}
+            elif claim_local:
+                continue  # co-located matching pod satisfies the term
             else:
                 present = {d for d, cnt in match.items() if cnt > 0}
                 if not present:
@@ -621,6 +730,11 @@ class Scheduler:
                         continue
                     return False, {}
                 inter = doms & present
+                # joint with the pod's OTHER positive terms on this key, so
+                # the committed domain satisfies all of them at once
+                aff_r = self._affinity_present_restriction(pod, key, claim)
+                if aff_r is not None:
+                    inter = inter & aff_r
                 if not inter:
                     return False, {}
                 d_star = min(inter, key=lambda d: (-match.get(d, 0), d))
@@ -628,6 +742,8 @@ class Scheduler:
                     if not claim.narrow(key, {d_star}):
                         return False, {}
                     committed[key] = d_star
+                    node_domains = dict(node_domains)
+                    node_domains[key] = {d_star}
         return True, committed
 
     # -- limits -------------------------------------------------------------

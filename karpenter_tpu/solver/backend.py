@@ -40,7 +40,73 @@ class Solver(abc.ABC):
 
 class ReferenceSolver(Solver):
     def solve(self, inp: SolverInput) -> SolverResult:
-        return Scheduler(inp).solve()
+        return canonicalize_placements(inp, Scheduler(inp).solve())
+
+
+def canonicalize_placements(inp: SolverInput, res: SolverResult) -> SolverResult:
+    """Canonical uid→target assignment within each run of identical pods.
+
+    Pods of one run are fungible (same signature ⇒ same scheduling
+    behavior); the sequential oracle may visit targets in interleaved order
+    (zone budgets rotate domains), while the tensor path assigns run pods to
+    targets in (existing-node input order, then claim creation order) —
+    SPEC.md "Determinism". This post-pass re-sorts the oracle's per-run
+    assignments into that canonical order; per-target COUNTS, claim
+    contents-as-sets, and error counts are untouched. A no-op for
+    monotone-fill runs (anything without zone budgets)."""
+    from dataclasses import replace as _replace
+
+    from .encode import _pod_signature
+
+    from ..provisioning.scheduler import ffd_sort
+
+    pods = ffd_sort([p for p in inp.pods if not p.scheduling_gated and not p.bound])
+    runs: List[list] = []
+    last_sig = object()
+    for p in pods:
+        s = _pod_signature(p)
+        if runs and s == last_sig:
+            runs[-1].append(p)
+        else:
+            runs.append([p])
+            last_sig = s
+
+    node_order = {n.id: i for i, n in enumerate(inp.nodes)}
+
+    def tkey(t):
+        if t[0] == "node":
+            return (0, node_order.get(t[1], 0))
+        return (1, t[1])
+
+    placements: Dict[str, Tuple[str, object]] = {}
+    errors: Dict[str, str] = {}
+    claim_pods: Dict[int, List[str]] = {i: [] for i in range(len(res.claims))}
+    for rp in runs:
+        counts: Dict[Tuple[str, object], int] = {}
+        err_msg = None
+        n_err = 0
+        for p in rp:
+            t = res.placements.get(p.meta.uid)
+            if t is None:
+                n_err += 1
+                err_msg = err_msg or res.errors.get(p.meta.uid, "unschedulable")
+            else:
+                counts[t] = counts.get(t, 0) + 1
+        i = 0
+        for t, c in sorted(counts.items(), key=lambda kv: tkey(kv[0])):
+            for _ in range(c):
+                uid = rp[i].meta.uid
+                placements[uid] = t
+                if t[0] == "claim":
+                    claim_pods[t[1]].append(uid)
+                i += 1
+        for j in range(i, len(rp)):
+            errors[rp[j].meta.uid] = err_msg or "unschedulable"
+
+    claims = [
+        _replace(c, pod_uids=claim_pods[i]) for i, c in enumerate(res.claims)
+    ]
+    return SolverResult(placements=placements, claims=claims, errors=errors)
 
 
 def pack_bits32(rows: np.ndarray) -> np.ndarray:
@@ -98,7 +164,13 @@ def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
         bucket(P, 4, 4),
     )
     Qp = bucket(enc.Q, 8, 8)
+    Vp = bucket(enc.V, 4, 4)
     W = (Gp + 31) // 32
+    # per-zone joint-bit columns: bit z*C+c for every c
+    zone_col = np.zeros(Z, dtype=np.uint32)
+    for z in range(Z):
+        for c in range(C):
+            zone_col[z] |= np.uint32(1) << np.uint32(z * C + c)
 
     def pad(a, shape, fill=0):
         out = np.full(shape, fill, dtype=a.dtype)
@@ -141,13 +213,22 @@ def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
         jnp.asarray(pad(enc.q_cap, (Qp,), fill=1)),
         jnp.asarray(pad(enc.node_q_member, (Ep, Qp))),
         jnp.asarray(pad(enc.node_q_owner, (Ep, Qp))),
+        jnp.asarray(pad(enc.v_member, (Gp, Vp))),
+        jnp.asarray(pad(enc.v_owner, (Gp, Vp))),
+        jnp.asarray(pad(enc.v_kind, (Vp,))),
+        jnp.asarray(pad(enc.v_cap, (Vp,), fill=1)),
+        jnp.asarray(pad(enc.v_primary, (Gp,), fill=np.int32(-1))),
+        jnp.asarray(pad(enc.v_aff, (Gp,), fill=np.int32(-1))),
+        jnp.asarray(pad(enc.v_count0, (Vp, Z))),
+        jnp.asarray(pad(enc.node_zone, (Ep,), fill=np.int32(-1))),
+        jnp.asarray(zone_col),
     )
     from .tpu.ffd import ARG_SPEC
 
     assert len(args) == len(ARG_SPEC), "kernel_args out of sync with ffd.ARG_SPEC"
     dims = dict(
         S=S, G=G, T=T, E=E, P=P, R=R, Z=Z, C=C,
-        Sp=Sp, Gp=Gp, Tp=Tp, Ep=Ep, Pp=Pp, Qp=Qp, W=W,
+        Sp=Sp, Gp=Gp, Tp=Tp, Ep=Ep, Pp=Pp, Qp=Qp, Vp=Vp, W=W,
     )
     return args, dims
 

@@ -33,7 +33,7 @@ import numpy as np
 
 from ..api import wellknown as wk
 from ..api.objects import Pod, tolerates_all
-from ..provisioning.scheduler import ExistingNode, NodePoolSpec, SolverInput, ffd_key
+from ..provisioning.scheduler import ExistingNode, NodePoolSpec, SolverInput, ffd_sort
 from ..scheduling.requirements import Requirements
 from ..utils.resources import CPU, EPHEMERAL_STORAGE, MEMORY, PODS, Resources
 
@@ -150,8 +150,26 @@ class EncodedInput:
     node_ids: List[str]
 
     # topology / affinity (config 3-4) — filled by encode, used by tpu kernels
+    # True only for constructs still off-device (capacity-type TSC/affinity,
+    # duplicate node hostnames); zone terms run on device via the V axis.
     has_topology: bool = False
     has_affinity: bool = False
+
+    # zone-granular constraints (V axis), run by the device event engine
+    # (ffd.py zone loop; SPEC.md "Topology spread" / "Inter-pod affinity"):
+    # v_kind 0 = zone TSC (cap = maxSkew), 1 = zone anti-affinity,
+    # 2 = zone positive affinity.
+    v_member: Optional[np.ndarray] = None  # [G, V] bool — pods match sig selector
+    v_owner: Optional[np.ndarray] = None  # [G, V] bool — pods carry the constraint
+    v_kind: Optional[np.ndarray] = None  # [V] int32
+    v_cap: Optional[np.ndarray] = None  # [V] int32 (maxSkew for TSC)
+    v_primary: Optional[np.ndarray] = None  # [G] int32 — group's owned zone-TSC sig (-1)
+    v_aff: Optional[np.ndarray] = None  # [G] int32 — group's owned positive-affinity sig (-1)
+    v_count0: Optional[np.ndarray] = None  # [V, Z] int32 initial matching-pod counts
+
+    @property
+    def V(self) -> int:
+        return 0 if self.v_kind is None else len(self.v_kind)
 
     # hostname-granular constraints (Q axis), handled closed-form on device:
     # per-(node, sig) matching-pod counts cap the pour. q_kind 0 = hostname
@@ -291,8 +309,8 @@ def encode(inp: SolverInput) -> EncodedInput:
     R = len(rkeys)
 
     # ---- groups -----------------------------------------------------------
-    pods_sorted = sorted(
-        [p for p in inp.pods if not p.scheduling_gated and not p.bound], key=ffd_key
+    pods_sorted = ffd_sort(
+        [p for p in inp.pods if not p.scheduling_gated and not p.bound]
     )
     sig_to_gid: Dict[tuple, int] = {}
     group_pods: List[List[Pod]] = []
@@ -330,10 +348,18 @@ def encode(inp: SolverInput) -> EncodedInput:
     has_topo = False
     has_aff = False
     hostname_sigs: Dict[tuple, int] = {}  # (kind, sel_sig, cap) -> q index
+    zone_sigs: Dict[tuple, int] = {}  # (kind, sel_sig, cap) -> v index
+    # per-group owned zone sigs, collected to fill v_owner / v_primary below
+    group_zone_tscs: List[List[tuple]] = []
+    group_zone_antis: List[List[tuple]] = []
+    group_zone_affs: List[List[tuple]] = []
     for g, pl in enumerate(group_pods):
         pod = pl[0]
         if len(pod.node_affinity) > 1 or pod.preferred_node_affinity:
             fallback[g] = True
+        ztscs: List[tuple] = []
+        zantis: List[tuple] = []
+        zaffs: List[tuple] = []
         for t in pod.topology_spread:
             if t.when_unsatisfiable != "DoNotSchedule":
                 continue
@@ -342,17 +368,59 @@ def encode(inp: SolverInput) -> EncodedInput:
                 # SPEC.md hostname floor-0 rule)
                 sig = (0, tuple(sorted(t.label_selector.items())), t.max_skew)
                 hostname_sigs.setdefault(sig, len(hostname_sigs))
+            elif t.topology_key == wk.ZONE_LABEL:
+                sig = (0, tuple(sorted(t.label_selector.items())), t.max_skew)
+                zone_sigs.setdefault(sig, len(zone_sigs))
+                ztscs.append(sig)
             else:
-                has_topo = True  # zone/capacity-type spread: fallback path
+                has_topo = True  # capacity-type spread: fallback path
         for t in pod.affinity_terms:
             if t.weight is not None:
                 continue
             if t.anti and t.topology_key == wk.HOSTNAME_LABEL:
                 sig = (1, tuple(sorted(t.label_selector.items())), 1)
                 hostname_sigs.setdefault(sig, len(hostname_sigs))
+            elif t.topology_key == wk.ZONE_LABEL:
+                kind = 1 if t.anti else 2
+                sig = (kind, tuple(sorted(t.label_selector.items())), 1 if t.anti else 0)
+                zone_sigs.setdefault(sig, len(zone_sigs))
+                (zantis if t.anti else zaffs).append(sig)
             else:
-                has_aff = True  # zone terms / positive affinity: fallback path
+                has_aff = True  # ct terms / positive hostname affinity: fallback
+        # the zone event engine supports ONE owned zone TSC and ONE positive
+        # zone affinity per pod, not combined (the oracle's sequential
+        # narrowing order for stacked terms isn't expressed on device yet)
+        if len(ztscs) > 1 or len(zaffs) > 1 or (ztscs and zaffs):
+            fallback[g] = True
+        group_zone_tscs.append(ztscs)
+        group_zone_antis.append(zantis)
+        group_zone_affs.append(zaffs)
         group_reqsets.append(pod.scheduling_requirements())
+
+    # ---- zone-sig (V axis) tables ------------------------------------------
+    V = len(zone_sigs)
+    v_member = np.zeros((G, V), dtype=bool)
+    v_owner = np.zeros((G, V), dtype=bool)
+    v_kind = np.zeros(V, dtype=np.int32)
+    v_cap = np.zeros(V, dtype=np.int32)
+    v_primary = np.full(G, -1, dtype=np.int32)
+    v_aff = np.full(G, -1, dtype=np.int32)
+    for (kind, sel_sig, cap), v in zone_sigs.items():
+        v_kind[v] = kind
+        v_cap[v] = cap
+        sel = dict(sel_sig)
+        for g, pl in enumerate(group_pods):
+            if all(pl[0].meta.labels.get(k) == val for k, val in sel.items()):
+                v_member[g, v] = True
+    for g in range(G):
+        for sig in group_zone_tscs[g]:
+            v_owner[g, zone_sigs[sig]] = True
+            v_primary[g] = zone_sigs[sig]
+        for sig in group_zone_antis[g]:
+            v_owner[g, zone_sigs[sig]] = True
+        for sig in group_zone_affs[g]:
+            v_owner[g, zone_sigs[sig]] = True
+            v_aff[g] = zone_sigs[sig]
 
     Q = len(hostname_sigs)
     q_member = np.zeros((G, Q), dtype=bool)
@@ -530,6 +598,8 @@ def encode(inp: SolverInput) -> EncodedInput:
         hostnames = [node_hostname(n) for n in inp.nodes]
         if len(set(hostnames)) < len(hostnames):
             has_topo = True
+    v_count0 = np.zeros((V, len(zones)), dtype=np.int32)
+    zsig_list = sorted(zone_sigs.items(), key=lambda kv: kv[1])
     for e, n in enumerate(inp.nodes):
         node_free[e] = _quantize(n.free, rkeys, ceil=False)
         node_zone[e] = zid.get(n.labels.get(wk.ZONE_LABEL, ""), -1)
@@ -539,6 +609,12 @@ def encode(inp: SolverInput) -> EncodedInput:
             node_q_member[e, q] = sum(
                 1 for pl in n.pod_labels if all(pl.get(k) == v for k, v in sel.items())
             )
+        if node_zone[e] >= 0:
+            for (kind, sel_sig, cap), v in zsig_list:
+                sel = dict(sel_sig)
+                v_count0[v, node_zone[e]] += sum(
+                    1 for pl in n.pod_labels if all(pl.get(k) == vv for k, vv in sel.items())
+                )
         if not n.schedulable:
             continue
         node_reqs = Requirements.from_labels(n.labels)
@@ -588,4 +664,11 @@ def encode(inp: SolverInput) -> EncodedInput:
         q_cap=q_cap,
         node_q_member=node_q_member,
         node_q_owner=node_q_owner,
+        v_member=v_member,
+        v_owner=v_owner,
+        v_kind=v_kind,
+        v_cap=v_cap,
+        v_primary=v_primary,
+        v_aff=v_aff,
+        v_count0=v_count0,
     )

@@ -121,6 +121,7 @@ class NativeSolver(Solver):
             or enc.has_topology
             or enc.has_affinity
             or enc.Q > 0  # hostname caps: device kernel only (C++ port pending)
+            or enc.V > 0  # zone constraints: device event engine only
             or enc.G == 0
         ):
             self.stats["fallback_solves"] += 1

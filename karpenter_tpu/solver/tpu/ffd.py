@@ -14,25 +14,33 @@ sequential inner loop. Opening new nodes is closed-form: each new node holds
 in closed form as well. The only sequential axis is the run axis (≈ number
 of distinct pod specs), walked with `lax.scan`.
 
-Bit-packing (v2): the (zone × capacity-type) offering feasibility of a claim
-is a PRODUCT SET (zones ∩ … ) × (cts ∩ …), and intersections of product sets
+Bit-packing: the (zone × capacity-type) offering feasibility of a claim is a
+PRODUCT SET (zones ∩ …) × (cts ∩ …), and intersections of product sets
 intersect componentwise — so each claim's joint feasibility is one uint32
 (`c_zc_bits`, bit z*C+c), each instance type's availability is one uint32,
 and the joint "does any offering survive" check is a single [M,T] bitwise
-AND instead of an [M,ZC]×[ZC,T] contraction. Group-membership state packs
-the same way into ceil(G/32) words. This collapsed the step's dominant
-memory traffic and the XLA graph size (the round-1 kernel compiled in ~15
-minutes and ran 2× over the latency target; see BENCH_r01).
+AND. Group-membership state packs the same way into ceil(G/32) words.
+
+Zone topology spread + inter-pod affinity (BASELINE configs 3-4) run through
+the **zone event engine**: a `lax.while_loop` entered (per run, via
+`lax.cond`) only for groups owning zone constraints. Each event places a
+closed-form batch of pods — per-zone consecutive budgets `m2 + maxSkew − cnt`
+for spread (SPEC.md skew rule), blocked/present zone sets for (anti-)affinity,
+claim zone commitment to `argmin(count, lex)` / `argmax(count, lex)` — and
+the *balanced phase* (equal counts across eligible zones) batches whole
+rotation rounds at once, so events scale with targets touched, not pods.
+Every event places ≥1 pod, bounding the loop by `remaining`.
 
 Per-step work is O((E+M)·T·R) fully-vectorized integer ops — VPU-friendly,
 HBM-bandwidth-bound, no data-dependent Python control flow, static shapes
-(SPEC: compile once per (E, M, T, R, P, S, Q, W) bucket). Padded scan steps
+(compile once per (E, M, T, R, P, S, Q, V, W, Z) bucket). Padded scan steps
 (run_count == 0) skip their body via `lax.cond`.
 
 Decisions are bit-identical to the reference path by construction: same FFD
-order (runs follow it), same first-fit node order (array index = creation
-order), same type-survival rule, same pool priority and limit charging
-(solver/SPEC.md).
+order, same first-fit target order (array index = creation order), same
+type-survival rule, same pool priority and limit charging, same domain
+commit rules; uid assignment within a run follows SPEC.md's canonical order
+(solver/SPEC.md "Determinism").
 """
 
 from __future__ import annotations
@@ -50,8 +58,7 @@ BIG = jnp.int32(2**30)
 # Positional argument table for ffd_solve. The second element is the batch
 # axis used by the consolidation evaluator's vmap (None = shared/broadcast,
 # 0 = per-candidate row). consolidate.py and backend.py derive indices from
-# THIS table — never hand-count positions (the round-1 hand-counted indices
-# silently skewed when the signature grew; VERDICT "what's weak" #6).
+# THIS table — never hand-count positions.
 ARG_SPEC = (
     ("run_group", None),
     ("run_count", 0),
@@ -77,6 +84,16 @@ ARG_SPEC = (
     ("q_cap", None),
     ("node_q_member", None),
     ("node_q_owner", None),
+    # zone constraint sigs (V axis; encode.py) — the zone event engine
+    ("v_member", None),
+    ("v_owner", None),
+    ("v_kind", None),
+    ("v_cap", None),
+    ("v_primary", None),
+    ("v_aff", None),
+    ("v_count0", None),
+    ("node_zone", None),
+    ("zone_col_mask", None),
 )
 
 ARG_INDEX = {name: i for i, (name, _ax) in enumerate(ARG_SPEC)}
@@ -97,6 +114,13 @@ class FFDState(NamedTuple):
     e_co: jnp.ndarray  # [E, Q] int32 — anti-owner pod presence per sig
     c_cm: jnp.ndarray  # [M, Q] int32
     c_co: jnp.ndarray  # [M, Q] int32
+    # zone-constraint counts (V axis):
+    v_count: jnp.ndarray  # [V, Z] int32 — matching pods per (sig, zone)
+    v_owner_z: jnp.ndarray  # [V, Z] bool — anti owners recorded per zone
+    # claim-local affinity state: same claim ⇒ same (eventual) zone, so
+    # (anti-)affinity must see co-located pods even on multi-zone claims
+    c_vm: jnp.ndarray  # [M, V] int32 — sig-matching pods per claim
+    c_vo: jnp.ndarray  # [M, V] bool — claim holds an owner of anti sig v
 
 
 class FFDOutput(NamedTuple):
@@ -109,7 +133,6 @@ class FFDOutput(NamedTuple):
 def _fit_count(alloc, cum, req):
     """[N] per-node count of additional `req` pods fitting: min over R of
     floor((alloc - cum) / req); req==0 axes don't constrain. Clamped >= 0."""
-    # alloc/cum: [N, R]; req: [R]
     safe_req = jnp.maximum(req, 1)
     k = jnp.where(req[None, :] > 0, (alloc - cum) // safe_req[None, :], BIG)
     return jnp.maximum(jnp.min(k, axis=1), 0).astype(jnp.int32)
@@ -211,6 +234,16 @@ def ffd_solve(
     q_cap,  # [Q] i32
     node_q_member,  # [E, Q] i32
     node_q_owner,  # [E, Q] i32
+    # zone constraint sigs (V axis; encode.py)
+    v_member,  # [G, V] bool
+    v_owner,  # [G, V] bool
+    v_kind,  # [V] i32
+    v_cap,  # [V] i32
+    v_primary,  # [G] i32 — owned zone-TSC sig per group (-1 none)
+    v_aff,  # [G] i32 — owned positive zone-affinity sig per group (-1 none)
+    v_count0,  # [V, Z] i32
+    node_zone,  # [E] i32 — zone index per node (-1 unknown)
+    zone_col_mask,  # [Z] u32 — joint-bit columns per zone
     *,
     max_claims: int,
 ) -> FFDOutput:
@@ -219,7 +252,12 @@ def ffd_solve(
     P = pool_type.shape[0]
     Q = q_kind.shape[0]
     W = group_pair_nok.shape[1]
+    V = v_kind.shape[0]
+    Z = zone_col_mask.shape[0]
     M = max_claims
+    zidx = jnp.arange(Z, dtype=jnp.int32)
+    eidx = jnp.arange(E, dtype=jnp.int32)
+    midx = jnp.arange(M, dtype=jnp.int32)
 
     state = FFDState(
         e_cum=jnp.zeros((E, R), jnp.int32),
@@ -234,7 +272,17 @@ def ffd_solve(
         e_co=node_q_owner.astype(jnp.int32),
         c_cm=jnp.zeros((M, Q), jnp.int32),
         c_co=jnp.zeros((M, Q), jnp.int32),
+        v_count=v_count0.astype(jnp.int32),
+        v_owner_z=jnp.zeros((V, Z), bool),
+        c_vm=jnp.zeros((M, V), jnp.int32),
+        c_vo=jnp.zeros((M, V), bool),
     )
+
+    e_zone_1h = node_zone[:, None] == zidx[None, :]  # [E, Z]
+
+    def zone_sets(bits):
+        """[...] u32 joint bits -> [..., Z] bool zone marginals."""
+        return (bits[..., None] & zone_col_mask) != 0
 
     def step_body(st: FFDState, g, count):
         req = group_req[g]  # [R]
@@ -244,56 +292,12 @@ def ffd_solve(
         g_nok = group_pair_nok[g]  # [W]
         member_g = q_member[g]  # [Q]
         owner_g = q_owner[g]  # [Q]
-        on_device = group_device[g]
-
-        remaining = jnp.where(on_device, count, 0).astype(jnp.int32)
-
-        # ---- 1. existing nodes --------------------------------------------
-        e_cap = _fit_count(node_free, st.e_cum, req)
-        e_cap = jnp.where(node_compat[g], e_cap, 0)
-        e_cap = jnp.minimum(
-            e_cap, _hostname_allowance(st.e_cm, st.e_co, q_kind, q_cap, member_g, owner_g)
-        )
-        take_e, remaining = _pour(e_cap, remaining)
-        e_cum = st.e_cum + take_e[:, None] * req[None, :]
-        e_cm = st.e_cm + take_e[:, None] * member_g[None, :].astype(jnp.int32)
-        e_co = st.e_co + (
-            (take_e[:, None] > 0) & owner_g[None, :] & (q_kind[None, :] == 1)
-        ).astype(jnp.int32)
-
-        # ---- 2. open claims -----------------------------------------------
-        # joint offering feasibility: one bitwise AND per (claim, type)
-        A_bits = offer_zc_bits & g_zc  # [T] u32
-        ok_off = (st.c_zc_bits[:, None] & A_bits[None, :]) != 0  # [M, T]
-
-        # pairwise group compatibility with everything on the node
-        pair_ok = ~jnp.any((st.c_gbits & g_nok[None, :]) != 0, axis=1)  # [M]
-        # pod must tolerate the claim's pool taints
-        is_open = st.c_pool >= 0
-        pool_ok = jnp.where(is_open, gpool[jnp.clip(st.c_pool, 0, P - 1)], False)
-
-        k_nt = _fit_count_nt(type_alloc, st.c_cum, req)  # [M, T]
-        fit_nt = st.c_mask & compat_t[None, :] & ok_off  # [M, T]
-        node_ok = is_open & pair_ok & pool_ok  # [M]
-        k_nt = jnp.where(fit_nt & node_ok[:, None], k_nt, 0)
-        c_cap = jnp.max(k_nt, axis=1)  # [M]
-        c_cap = jnp.minimum(
-            c_cap, _hostname_allowance(st.c_cm, st.c_co, q_kind, q_cap, member_g, owner_g)
-        )
-        take_c, remaining = _pour(c_cap, remaining)
-
-        added = take_c > 0
-        c_cum = st.c_cum + take_c[:, None] * req[None, :]
-        c_mask = jnp.where(added[:, None], fit_nt & (k_nt >= take_c[:, None]), st.c_mask)
-        c_zc_bits = jnp.where(added, st.c_zc_bits & g_zc, st.c_zc_bits)
+        member_v = v_member[g]  # [V]
+        owner_v = v_owner[g]  # [V]
         gword = _gbit_word(g, W)  # [W]
-        c_gbits = st.c_gbits | jnp.where(added[:, None], gword[None, :], jnp.uint32(0))
-        c_cm = st.c_cm + take_c[:, None] * member_g[None, :].astype(jnp.int32)
-        c_co = st.c_co + (
-            added[:, None] & owner_g[None, :] & (q_kind[None, :] == 1)
-        ).astype(jnp.int32)
+        on_device = group_device[g]
+        remaining0 = jnp.where(on_device, count, 0).astype(jnp.int32)
 
-        # ---- 3. new claims, pool by pool in priority order ----------------
         # fresh-node allowance under hostname constraints (counts start at 0)
         fresh_allow = _hostname_allowance(
             jnp.zeros((1, Q), jnp.int32),
@@ -304,135 +308,649 @@ def ffd_solve(
             owner_g,
         )[0]
 
-        def open_pool(p, carry):
-            (remaining, used, c_cum, c_mask, c_zc_bits, c_gbits, c_pool,
-             p_usage, take_new, c_cm, c_co) = carry
-
-            # per-type pod capacity for a fresh node of pool p
-            new_bits = pool_zc_bits[p] & g_zc  # u32
-            off_ok = (offer_zc_bits & new_bits) != 0  # [T]
-            fit_t = compat_t & pool_type[p] & off_ok  # [T]
-            daemon = pool_daemon[p]  # [R]
-            safe_req = jnp.maximum(req, 1)
-            k_t = jnp.where(
-                req[None, :] > 0, (type_alloc - daemon[None, :]) // safe_req[None, :], BIG
+        def count_contrib(take_e, take_c, c_zc_after):
+            """[Z] recorded-pod count deltas: node zones + single-zone claims
+            (multi-zone claims record no zone domain — SPEC.md)."""
+            contrib = jnp.sum(take_e[:, None] * e_zone_1h, axis=0)  # [Z]
+            cz = zone_sets(c_zc_after)  # [M, Z]
+            single = jnp.sum(cz, axis=1) == 1
+            contrib = contrib + jnp.sum(
+                take_c[:, None] * (cz & single[:, None]), axis=0
             )
-            k_t = jnp.maximum(jnp.min(k_t, axis=1), 0).astype(jnp.int32)
-            k_t = jnp.where(fit_t, k_t, 0)
-            kmax = jnp.max(k_t)
-            # hostname constraints cap pods-per-fresh-node below the
-            # resource capacity (e.g. hostname spread: maxSkew per node)
-            full_take = jnp.minimum(kmax, fresh_allow)
+            return contrib.astype(jnp.int32)
 
-            # limit accounting (SPEC: claim blocked if any limited resource
-            # usage >= limit at creation; charge = min type charge among the
-            # survivors AT CREATION, i.e. after the claim's FIRST pod — the
-            # oracle charges right after the opening pod lands)
-            one_set = fit_t & (k_t >= 1)
-            charge_one = jnp.min(
-                jnp.where(one_set[:, None], type_charge, INT32_MAX), axis=0
-            )  # [R]
-            charge_one = jnp.where(charge_one == INT32_MAX, 0, charge_one)
-            headroom = pool_limit[p] - p_usage[p]  # [R] (may be negative)
-            # claims before resource r trips: ceil(headroom / charge)
-            trips = jnp.where(
-                charge_one > 0,
-                jnp.maximum(-(-headroom // jnp.maximum(charge_one, 1)), 0),
-                BIG,
+        # =================================================================
+        # FAST branch: group owns no zone constraint — run-granular pours
+        # =================================================================
+        def fast(st: FFDState):
+            remaining = remaining0
+
+            # ---- 1. existing nodes ----------------------------------------
+            e_cap = _fit_count(node_free, st.e_cum, req)
+            e_cap = jnp.where(node_compat[g], e_cap, 0)
+            e_cap = jnp.minimum(
+                e_cap,
+                _hostname_allowance(st.e_cm, st.e_co, q_kind, q_cap, member_g, owner_g),
             )
-            already_over = jnp.any(p_usage[p] >= pool_limit[p])
-            allow = jnp.where(already_over, 0, jnp.min(trips)).astype(jnp.int32)
+            take_e, remaining = _pour(e_cap, remaining)
+            e_cum = st.e_cum + take_e[:, None] * req[None, :]
+            e_cm = st.e_cm + take_e[:, None] * member_g[None, :].astype(jnp.int32)
+            e_co = st.e_co + (
+                (take_e[:, None] > 0) & owner_g[None, :] & (q_kind[None, :] == 1)
+            ).astype(jnp.int32)
 
-            n_want = jnp.where(full_take > 0, -(-remaining // jnp.maximum(full_take, 1)), 0)
-            slots_left = M - used
-            n_new = jnp.minimum(jnp.minimum(n_want, allow), slots_left).astype(jnp.int32)
-            eligible = gpool[p] & (full_take > 0)
-            n_new = jnp.where(eligible, n_new, 0)
+            # ---- 2. open claims -------------------------------------------
+            A_bits = offer_zc_bits & g_zc  # [T] u32
+            ok_off = (st.c_zc_bits[:, None] & A_bits[None, :]) != 0  # [M, T]
+            pair_ok = ~jnp.any((st.c_gbits & g_nok[None, :]) != 0, axis=1)  # [M]
+            is_open = st.c_pool >= 0
+            pool_ok = jnp.where(is_open, gpool[jnp.clip(st.c_pool, 0, P - 1)], False)
 
-            def apply(ops):
-                (c_cum, c_mask, c_zc_bits, c_gbits, c_pool, p_usage, take_new,
-                 c_cm, c_co) = ops
-                idx = jnp.arange(M, dtype=jnp.int32)
-                is_new = (idx >= used) & (idx < used + n_new)
-                # node j (0-based among new) takes min(full_take, remaining - j*full_take)
-                j = idx - used
-                take_j = jnp.where(
-                    is_new, jnp.clip(remaining - j * full_take, 0, full_take), 0
+            k_nt = _fit_count_nt(type_alloc, st.c_cum, req)  # [M, T]
+            fit_nt = st.c_mask & compat_t[None, :] & ok_off  # [M, T]
+            node_ok = is_open & pair_ok & pool_ok  # [M]
+            k_nt = jnp.where(fit_nt & node_ok[:, None], k_nt, 0)
+            c_cap = jnp.max(k_nt, axis=1)  # [M]
+            c_cap = jnp.minimum(
+                c_cap,
+                _hostname_allowance(st.c_cm, st.c_co, q_kind, q_cap, member_g, owner_g),
+            )
+            take_c, remaining = _pour(c_cap, remaining)
+
+            added = take_c > 0
+            c_cum = st.c_cum + take_c[:, None] * req[None, :]
+            c_mask = jnp.where(
+                added[:, None], fit_nt & (k_nt >= take_c[:, None]), st.c_mask
+            )
+            c_zc_bits = jnp.where(added, st.c_zc_bits & g_zc, st.c_zc_bits)
+            c_gbits = st.c_gbits | jnp.where(
+                added[:, None], gword[None, :], jnp.uint32(0)
+            )
+            c_cm = st.c_cm + take_c[:, None] * member_g[None, :].astype(jnp.int32)
+            c_co = st.c_co + (
+                added[:, None] & owner_g[None, :] & (q_kind[None, :] == 1)
+            ).astype(jnp.int32)
+            c_vm = st.c_vm + take_c[:, None] * member_v[None, :].astype(jnp.int32)
+
+            # ---- 3. new claims, pool by pool in priority order ------------
+            def open_pool(p, carry):
+                (remaining, used, c_cum, c_mask, c_zc_bits, c_gbits, c_pool,
+                 p_usage, take_new, c_cm, c_co, c_vm) = carry
+
+                new_bits = pool_zc_bits[p] & g_zc  # u32
+                off_ok = (offer_zc_bits & new_bits) != 0  # [T]
+                fit_t = compat_t & pool_type[p] & off_ok  # [T]
+                daemon = pool_daemon[p]  # [R]
+                safe_req = jnp.maximum(req, 1)
+                k_t = jnp.where(
+                    req[None, :] > 0,
+                    (type_alloc - daemon[None, :]) // safe_req[None, :],
+                    BIG,
+                )
+                k_t = jnp.maximum(jnp.min(k_t, axis=1), 0).astype(jnp.int32)
+                k_t = jnp.where(fit_t, k_t, 0)
+                kmax = jnp.max(k_t)
+                full_take = jnp.minimum(kmax, fresh_allow)
+
+                one_set = fit_t & (k_t >= 1)
+                charge_one = jnp.min(
+                    jnp.where(one_set[:, None], type_charge, INT32_MAX), axis=0
+                )  # [R]
+                charge_one = jnp.where(charge_one == INT32_MAX, 0, charge_one)
+                headroom = pool_limit[p] - p_usage[p]  # [R]
+                trips = jnp.where(
+                    charge_one > 0,
+                    jnp.maximum(-(-headroom // jnp.maximum(charge_one, 1)), 0),
+                    BIG,
+                )
+                already_over = jnp.any(p_usage[p] >= pool_limit[p])
+                allow = jnp.where(already_over, 0, jnp.min(trips)).astype(jnp.int32)
+
+                n_want = jnp.where(
+                    full_take > 0, -(-remaining // jnp.maximum(full_take, 1)), 0
+                )
+                slots_left = M - used
+                n_new = jnp.minimum(jnp.minimum(n_want, allow), slots_left).astype(
+                    jnp.int32
+                )
+                eligible = gpool[p] & (full_take > 0)
+                n_new = jnp.where(eligible, n_new, 0)
+
+                def apply(ops):
+                    (c_cum, c_mask, c_zc_bits, c_gbits, c_pool, p_usage, take_new,
+                     c_cm, c_co, c_vm) = ops
+                    is_new = (midx >= used) & (midx < used + n_new)
+                    j = midx - used
+                    take_j = jnp.where(
+                        is_new, jnp.clip(remaining - j * full_take, 0, full_take), 0
+                    ).astype(jnp.int32)
+
+                    c_cum = jnp.where(
+                        is_new[:, None],
+                        daemon[None, :] + take_j[:, None] * req[None, :],
+                        c_cum,
+                    )
+                    new_mask = fit_t[None, :] & (k_t[None, :] >= take_j[:, None])
+                    c_mask = jnp.where(is_new[:, None], new_mask, c_mask)
+                    c_zc_bits = jnp.where(is_new, new_bits, c_zc_bits)
+                    c_gbits = jnp.where(is_new[:, None], gword[None, :], c_gbits)
+                    c_pool = jnp.where(is_new, p, c_pool)
+                    c_cm = jnp.where(
+                        is_new[:, None],
+                        take_j[:, None] * member_g[None, :].astype(jnp.int32),
+                        c_cm,
+                    )
+                    c_co = jnp.where(
+                        is_new[:, None],
+                        (
+                            (take_j[:, None] > 0)
+                            & owner_g[None, :]
+                            & (q_kind[None, :] == 1)
+                        ).astype(jnp.int32),
+                        c_co,
+                    )
+                    c_vm = jnp.where(
+                        is_new[:, None],
+                        take_j[:, None] * member_v[None, :].astype(jnp.int32),
+                        c_vm,
+                    )
+                    p_usage = p_usage.at[p].add((charge_one * n_new).astype(jnp.int32))
+                    take_new = take_new + take_j
+                    return (c_cum, c_mask, c_zc_bits, c_gbits, c_pool, p_usage,
+                            take_new, c_cm, c_co, c_vm, jnp.sum(take_j))
+
+                def skip(ops):
+                    return ops + (jnp.int32(0),)
+
+                (c_cum, c_mask, c_zc_bits, c_gbits, c_pool, p_usage, take_new, c_cm,
+                 c_co, c_vm, placed_new) = jax.lax.cond(
+                    n_new > 0,
+                    apply,
+                    skip,
+                    (c_cum, c_mask, c_zc_bits, c_gbits, c_pool, p_usage, take_new,
+                     c_cm, c_co, c_vm),
+                )
+                remaining = remaining - placed_new
+                used = used + n_new
+                return (remaining, used, c_cum, c_mask, c_zc_bits, c_gbits, c_pool,
+                        p_usage, take_new, c_cm, c_co, c_vm)
+
+            carry = (
+                remaining, st.used, c_cum, c_mask, c_zc_bits, c_gbits, st.c_pool,
+                st.p_usage, jnp.zeros((M,), jnp.int32), c_cm, c_co, c_vm,
+            )
+            carry = jax.lax.fori_loop(0, P, open_pool, carry)
+            (remaining, used, c_cum, c_mask, c_zc_bits, c_gbits, c_pool2, p_usage,
+             take_new, c_cm, c_co, c_vm) = carry
+
+            take_c_total = take_c + take_new
+            # zone-sig membership counts (this group may match other pods'
+            # selectors even without owning a constraint)
+            contrib = count_contrib(take_e, take_c_total, c_zc_bits)
+            v_count = st.v_count + member_v.astype(jnp.int32)[:, None] * contrib[None, :]
+
+            new_state = FFDState(
+                e_cum=e_cum, c_cum=c_cum, c_mask=c_mask, c_zc_bits=c_zc_bits,
+                c_gbits=c_gbits, c_pool=c_pool2, used=used, p_usage=p_usage,
+                e_cm=e_cm, e_co=e_co, c_cm=c_cm, c_co=c_co,
+                v_count=v_count, v_owner_z=st.v_owner_z,
+                c_vm=c_vm, c_vo=st.c_vo,
+            )
+            return new_state, (take_e, take_c_total, remaining)
+
+        # =================================================================
+        # ZONE branch: the event engine (SPEC.md topology/affinity rules)
+        # =================================================================
+        def zoned(st: FFDState):
+            gz_zones = zone_sets(g_zc[None])[0]  # [Z] group's own zone admission
+            psig_g = v_primary[g]
+            has_tsc = psig_g >= 0
+            psig = jnp.clip(psig_g, 0, V - 1)
+            cap_p = v_cap[psig]
+            asig_g = v_aff[g]
+            has_affs = asig_g >= 0
+            asig = jnp.clip(asig_g, 0, V - 1)
+            owned_anti = owner_v & (v_kind == 1)  # [V]
+            member_anti = member_v & (v_kind == 1)
+            self_anti = jnp.any(owned_anti & member_v)
+            is_member_a = member_v[asig]
+            has_owned = jnp.any(owner_v)
+
+            def cond(carry):
+                (remaining, progress, fuel) = carry[0], carry[1], carry[2]
+                return (remaining > 0) & progress & (fuel > 0)
+
+            def body(carry):
+                (remaining, _progress, fuel, take_e_acc, take_c_acc, e_cum, c_cum,
+                 c_mask, c_zc_bits, c_gbits, c_pool, used, p_usage, e_cm, e_co,
+                 c_cm, c_co, v_count, v_owner_z, c_vm_st, c_vo_st) = carry
+
+                # ---- allowed zones A and per-zone budgets B ----------------
+                elig = gz_zones
+                cnt_p = v_count[psig]  # [Z]
+                cm_ = jnp.where(elig, cnt_p, BIG)
+                m1 = jnp.min(cm_)
+                amin = jnp.argmin(cm_)
+                nmin = jnp.sum(cm_ == m1)
+                second = jnp.min(jnp.where(zidx == amin, BIG, cm_))
+                m2 = jnp.where((nmin == 1) & (zidx == amin), second, m1)  # [Z]
+                allowed_tsc = elig & (cnt_p + 1 - m1 <= cap_p)
+                budget_tsc = jnp.clip(m2 + cap_p - cnt_p, 0, BIG)
+                A = jnp.where(has_tsc, allowed_tsc, elig)
+                B = jnp.where(has_tsc, budget_tsc, BIG)
+
+                blocked_m = jnp.any(owned_anti[:, None] & (v_count > 0), axis=0)
+                blocked_o = jnp.any(member_anti[:, None] & v_owner_z, axis=0)
+                A = A & ~blocked_m & ~blocked_o
+                B = jnp.where(self_anti, jnp.minimum(B, 1), B)
+
+                cnt_a = v_count[asig]  # [Z]
+                present = cnt_a > 0
+                any_present = jnp.any(present)
+                A_base = A  # TSC + anti-zone exclusions, pre-affinity
+                A = jnp.where(
+                    has_affs,
+                    jnp.where(
+                        any_present, A & present, jnp.where(is_member_a, A, False)
+                    ),
+                    A,
+                )
+                bootstrap = has_affs & ~any_present
+                B = jnp.where(bootstrap, jnp.minimum(B, 1), B)
+
+                # ---- existing-node candidate ------------------------------
+                e_fit = _fit_count(node_free, e_cum, req)
+                e_host = _hostname_allowance(e_cm, e_co, q_kind, q_cap, member_g, owner_g)
+                nz_ok = jnp.where(
+                    node_zone >= 0, A[jnp.clip(node_zone, 0, Z - 1)], ~has_owned
+                )
+                elig_e_base = node_compat[g] & (e_fit > 0) & (e_host > 0)
+                elig_e = elig_e_base & nz_ok
+                found_e = jnp.any(elig_e)
+                e_star = jnp.argmax(elig_e)
+                z_e = node_zone[e_star]
+
+                # ---- open-claim candidates --------------------------------
+                # claim-local affinity: a co-located matching pod satisfies a
+                # positive term (and blocks anti terms) regardless of the
+                # claim's still-multi-valued zone — same claim, same domain
+                local_aff = has_affs & (c_vm_st[:, asig] > 0)  # [M]
+                anti_claim_ok = jnp.all(
+                    ~owned_anti[None, :] | (c_vm_st == 0), axis=1
+                ) & jnp.all(~member_anti[None, :] | ~c_vo_st, axis=1)  # [M]
+
+                cz = zone_sets(c_zc_bits)  # [M, Z]
+                zcount_m = jnp.sum(cz, axis=1)
+                A_m = jnp.where(local_aff[:, None], A_base[None, :], A[None, :])
+                inter = cz & A_m  # [M, Z]
+                has_inter = jnp.any(inter, axis=1)
+                # an owned anti term commits the claim to one zone too —
+                # multi-valued claims could later materialize in the same
+                # zone and violate the term (SPEC.md anti commit, lex-first)
+                has_anti = jnp.any(owned_anti)
+                commit_m = has_tsc | (has_affs & any_present & ~local_aff) | has_anti
+                score_tsc = jnp.where(inter, cnt_p[None, :] * 64 + zidx[None, :], BIG)
+                score_aff = jnp.where(inter, -cnt_a[None, :] * 64 + zidx[None, :], BIG)
+                score_lex = jnp.where(inter, zidx[None, :], BIG)
+                d_m = jnp.where(
+                    has_tsc,
+                    jnp.argmin(score_tsc, axis=1),
+                    jnp.where(
+                        has_affs & any_present & ~local_aff,
+                        jnp.argmin(score_aff, axis=1),
+                        jnp.argmin(score_lex, axis=1),
+                    ),
+                ).astype(jnp.int32)  # [M]
+                azmask = jnp.sum(
+                    jnp.where(inter, zone_col_mask[None, :], jnp.uint32(0)),
+                    axis=1,
+                    dtype=jnp.uint32,
+                )  # [M] — OR of disjoint bit columns
+                bits_eff = (
+                    jnp.where(commit_m, zone_col_mask[d_m], azmask)
+                    & c_zc_bits
+                    & g_zc
+                )  # [M]
+
+                ok_off = (bits_eff[:, None] & offer_zc_bits[None, :]) != 0  # [M, T]
+                pair_ok = ~jnp.any((c_gbits & g_nok[None, :]) != 0, axis=1)
+                is_open = c_pool >= 0
+                pool_ok = jnp.where(is_open, gpool[jnp.clip(c_pool, 0, P - 1)], False)
+                k_raw = _fit_count_nt(type_alloc, c_cum, req)  # [M, T]
+                fit_nt = c_mask & compat_t[None, :] & ok_off
+                node_ok = (
+                    is_open & pair_ok & pool_ok & has_inter & (bits_eff != 0)
+                    & anti_claim_ok
+                )
+                k_nt = jnp.where(fit_nt & node_ok[:, None], k_raw, 0)
+                k_m = jnp.max(k_nt, axis=1)  # [M]
+                c_host = _hostname_allowance(c_cm, c_co, q_kind, q_cap, member_g, owner_g)
+                elig_m = (k_m > 0) & (c_host > 0)
+                found_c = jnp.any(elig_m)
+                m_star = jnp.argmax(elig_m)
+                fin_z = zone_sets(bits_eff[m_star][None])[0]  # [Z]
+                nz_fin = jnp.sum(fin_z)
+                z_c = jnp.argmax(fin_z).astype(jnp.int32)
+
+                # ---- first-fit preemption bound ---------------------------
+                # Pouring into the unique min-count zone raises the floor,
+                # which can re-ADMIT a blocked zone; if that zone's first
+                # eligible target precedes the current one, the sequential
+                # scheduler switches targets there — budgets must stop at
+                # that point (SPEC.md first-fit order).
+                # per-zone first eligible target position (nodes 0..E-1,
+                # then claims E..E+M-1; new claims = +inf):
+                pos_node = jnp.min(
+                    jnp.where(
+                        elig_e_base[:, None] & e_zone_1h, eidx[:, None], BIG
+                    ),
+                    axis=0,
+                )  # [Z]
+                bits_z = c_zc_bits[:, None] & zone_col_mask[None, :] & g_zc  # [M, Z]
+                off_zt = (bits_z[:, :, None] & offer_zc_bits[None, None, :]) != 0
+                fit_base = c_mask & compat_t[None, :] & (k_raw >= 1)  # [M, T]
+                elig_m_z = jnp.any(off_zt & fit_base[:, None, :], axis=2)  # [M, Z]
+                elig_m_z = elig_m_z & (
+                    is_open & pair_ok & pool_ok & (c_host > 0) & anti_claim_ok
+                )[:, None]
+                pos_claim = jnp.min(
+                    jnp.where(elig_m_z, E + midx[:, None], BIG), axis=0
+                )  # [Z]
+                pos_z = jnp.minimum(pos_node, pos_claim)
+
+                def preempt_bound(zt, pos_t):
+                    """Max consecutive pods into zone zt before a blocked
+                    zone with an earlier target re-enters the allowed set."""
+                    uniq = (nmin == 1) & (zt == amin)
+                    cand = (
+                        elig
+                        & ~A
+                        & ~blocked_m
+                        & ~blocked_o
+                        & (pos_z < pos_t)
+                        & ((cnt_p + 1 - cap_p) <= second)
+                    )
+                    j = cnt_p + 1 - cap_p - cnt_p[jnp.clip(zt, 0, Z - 1)]
+                    val = jnp.min(jnp.where(cand, j, BIG))
+                    return jnp.where(has_tsc & uniq, jnp.maximum(val, 0), BIG)
+
+                Bz_e = jnp.where(
+                    z_e >= 0,
+                    jnp.minimum(
+                        B[jnp.clip(z_e, 0, Z - 1)], preempt_bound(z_e, e_star)
+                    ),
+                    BIG,
+                )
+                q_e = jnp.minimum(
+                    jnp.minimum(remaining, e_fit[e_star]),
+                    jnp.minimum(e_host[e_star], Bz_e),
+                )
+                Bz_c = jnp.where(
+                    nz_fin == 1,
+                    jnp.minimum(B[z_c], preempt_bound(z_c, E + m_star)),
+                    BIG,
+                )
+                q_c = jnp.minimum(
+                    jnp.minimum(remaining, k_m[m_star]),
+                    jnp.minimum(c_host[m_star], Bz_c),
+                )
+                q_c = jnp.where(self_anti, jnp.minimum(q_c, 1), q_c)
+
+                # ---- new-claim candidates (per pool) ----------------------
+                pz_bits = pool_zc_bits & g_zc  # [P]
+                pzz = zone_sets(pz_bits)  # [P, Z]
+                inter_p = pzz & A[None, :]
+                has_inter_p = jnp.any(inter_p, axis=1)
+                score_tsc_p = jnp.where(
+                    inter_p, cnt_p[None, :] * 64 + zidx[None, :], BIG
+                )
+                score_aff_p = jnp.where(
+                    inter_p, -cnt_a[None, :] * 64 + zidx[None, :], BIG
+                )
+                score_lex_p = jnp.where(inter_p, zidx[None, :], BIG)
+                commit_p = has_tsc | (has_affs & any_present) | has_anti
+                d_p = jnp.where(
+                    has_tsc,
+                    jnp.argmin(score_tsc_p, axis=1),
+                    jnp.where(
+                        has_affs & any_present,
+                        jnp.argmin(score_aff_p, axis=1),
+                        jnp.argmin(score_lex_p, axis=1),
+                    ),
+                ).astype(jnp.int32)
+                azmask_p = jnp.sum(
+                    jnp.where(inter_p, zone_col_mask[None, :], jnp.uint32(0)),
+                    axis=1,
+                    dtype=jnp.uint32,
+                )
+                nbits_p = (
+                    jnp.where(commit_p, zone_col_mask[d_p], azmask_p) & pz_bits
+                )  # [P]
+                off_ok_p = (nbits_p[:, None] & offer_zc_bits[None, :]) != 0  # [P, T]
+                fit_tp = compat_t[None, :] & pool_type & off_ok_p
+                k_tp = jnp.full((P, T), BIG, jnp.int32)
+                for r in range(R):
+                    kr = jnp.where(
+                        req[r] > 0,
+                        (type_alloc[None, :, r] - pool_daemon[:, r][:, None])
+                        // jnp.maximum(req[r], 1),
+                        BIG,
+                    )
+                    k_tp = jnp.minimum(k_tp, kr.astype(jnp.int32))
+                k_tp = jnp.maximum(k_tp, 0)
+                k_tp = jnp.where(fit_tp, k_tp, 0)
+                kmax_p = jnp.max(k_tp, axis=1)  # [P]
+                one_set_p = fit_tp & (k_tp >= 1)  # [P, T]
+                charge_one_p = jnp.min(
+                    jnp.where(one_set_p[:, :, None], type_charge[None, :, :], INT32_MAX),
+                    axis=1,
+                )  # [P, R]
+                charge_one_p = jnp.where(charge_one_p == INT32_MAX, 0, charge_one_p)
+                already_over_p = jnp.any(p_usage >= pool_limit, axis=1)  # [P]
+                elig_p = (
+                    gpool
+                    & has_inter_p
+                    & (kmax_p > 0)
+                    & ~already_over_p
+                    & (used < M)
+                    & (fresh_allow > 0)
+                )
+                found_p = jnp.any(elig_p)
+                p_star = jnp.argmax(elig_p)
+                fin_zp = zone_sets(nbits_p[p_star][None])[0]
+                nz_fin_p = jnp.sum(fin_zp)
+                z_p = jnp.argmax(fin_zp).astype(jnp.int32)
+                Bz_p = jnp.where(
+                    nz_fin_p == 1,
+                    jnp.minimum(B[z_p], preempt_bound(z_p, E + used)),
+                    BIG,
+                )
+                q_p = jnp.minimum(
+                    jnp.minimum(remaining, jnp.minimum(kmax_p[p_star], fresh_allow)),
+                    Bz_p,
+                )
+                q_p = jnp.where(self_anti, jnp.minimum(q_p, 1), q_p)
+
+                # ---- balanced-phase cycle batching ------------------------
+                # condition: pure single-TSC group, equal counts across
+                # eligible zones, no eligible multi-zone claim, and every
+                # eligible zone has a fixed target. Then one rotation round
+                # places maxSkew pods per zone; batch all full rounds.
+                counts_equal = jnp.max(jnp.where(elig, cnt_p, -BIG)) == m1
+                multi_claim = jnp.any(elig_m & (zcount_m > 1))
+                pure_tsc = (
+                    has_tsc
+                    & ~self_anti
+                    & ~has_affs
+                    & ~jnp.any(member_anti)
+                    & ~jnp.any(owned_anti)
+                )
+                cyc_ok = pure_tsc & counts_equal & ~multi_claim & (found_e | found_c)
+                # per-zone first targets (nodes before claims), unrolled on Z
+                tgt_cap_list = []
+                tgt_has_list = []
+                tgt_e_1h = jnp.zeros((E,), bool)
+                tgt_c_1h = jnp.zeros((M,), bool)
+                for z in range(Z):
+                    elig_ez = elig_e & (node_zone == z)
+                    found_ez = jnp.any(elig_ez)
+                    e_z = jnp.argmax(elig_ez)
+                    cap_ez = jnp.minimum(e_fit[e_z], e_host[e_z])
+                    sc_z = elig_m & cz[:, z] & (zcount_m == 1)
+                    found_cz = jnp.any(sc_z)
+                    m_z = jnp.argmax(sc_z)
+                    cap_cz = jnp.minimum(k_m[m_z], c_host[m_z])
+                    has_t = found_ez | found_cz
+                    cap_z = jnp.where(found_ez, cap_ez, cap_cz)
+                    relevant = elig[z]
+                    tgt_has_list.append(jnp.where(relevant, has_t, True))
+                    tgt_cap_list.append(jnp.where(relevant & has_t, cap_z, BIG))
+                    use_node = relevant & found_ez
+                    use_claim = relevant & ~found_ez & found_cz
+                    tgt_e_1h = tgt_e_1h | (use_node & (eidx == e_z))
+                    tgt_c_1h = tgt_c_1h | (use_claim & (midx == m_z))
+                tgt_has = jnp.stack(tgt_has_list)  # [Z]
+                tgt_cap = jnp.stack(tgt_cap_list)  # [Z]
+                cyc_ok = cyc_ok & jnp.all(tgt_has)
+                n_zones = jnp.sum(elig).astype(jnp.int32)
+                k_sk = jnp.maximum(cap_p, 1)
+                rounds = jnp.minimum(
+                    jnp.min(tgt_cap // k_sk),
+                    remaining // jnp.maximum(k_sk * n_zones, 1),
+                ).astype(jnp.int32)
+                cyc_ok = cyc_ok & (rounds >= 1) & (n_zones >= 1)
+                per_tgt = k_sk * rounds
+
+                # ---- selection & unified masked apply ---------------------
+                use_e = found_e & ~cyc_ok
+                use_c = ~found_e & found_c & ~cyc_ok
+                use_p = ~found_e & ~found_c & found_p & ~cyc_ok
+
+                take_e_add = (
+                    jnp.where(use_e & (eidx == e_star), q_e, 0)
+                    + jnp.where(cyc_ok & tgt_e_1h, per_tgt, 0)
+                ).astype(jnp.int32)
+                take_c_add = (
+                    jnp.where(use_c & (midx == m_star), q_c, 0)
+                    + jnp.where(cyc_ok & tgt_c_1h, per_tgt, 0)
                 ).astype(jnp.int32)
 
-                c_cum = jnp.where(
-                    is_new[:, None], daemon[None, :] + take_j[:, None] * req[None, :], c_cum
+                # existing-node state
+                e_cum = e_cum + take_e_add[:, None] * req[None, :]
+                e_cm = e_cm + take_e_add[:, None] * member_g[None, :].astype(jnp.int32)
+                e_co = e_co + (
+                    (take_e_add[:, None] > 0)
+                    & owner_g[None, :]
+                    & (q_kind[None, :] == 1)
+                ).astype(jnp.int32)
+
+                # open-claim state
+                added = take_c_add > 0
+                c_cum = c_cum + take_c_add[:, None] * req[None, :]
+                c_mask = jnp.where(
+                    added[:, None], fit_nt & (k_nt >= take_c_add[:, None]), c_mask
                 )
-                new_mask = fit_t[None, :] & (k_t[None, :] >= take_j[:, None])
-                c_mask = jnp.where(is_new[:, None], new_mask, c_mask)
-                c_zc_bits = jnp.where(is_new, new_bits, c_zc_bits)
+                c_zc_bits = jnp.where(added, bits_eff, c_zc_bits)
+                c_gbits = c_gbits | jnp.where(
+                    added[:, None], gword[None, :], jnp.uint32(0)
+                )
+                c_cm = c_cm + take_c_add[:, None] * member_g[None, :].astype(jnp.int32)
+                c_co = c_co + (
+                    added[:, None] & owner_g[None, :] & (q_kind[None, :] == 1)
+                ).astype(jnp.int32)
+                c_vm_st = c_vm_st + take_c_add[:, None] * member_v[None, :].astype(
+                    jnp.int32
+                )
+                c_vo_st = c_vo_st | (added[:, None] & owned_anti[None, :])
+
+                # new-claim open (single event only)
+                is_new = use_p & (midx == used)
+                tq = jnp.where(is_new, q_p, 0).astype(jnp.int32)
+                c_cum = jnp.where(
+                    is_new[:, None],
+                    pool_daemon[p_star][None, :] + tq[:, None] * req[None, :],
+                    c_cum,
+                )
+                c_mask = jnp.where(
+                    is_new[:, None],
+                    fit_tp[p_star][None, :] & (k_tp[p_star][None, :] >= tq[:, None]),
+                    c_mask,
+                )
+                c_zc_bits = jnp.where(is_new, nbits_p[p_star], c_zc_bits)
                 c_gbits = jnp.where(is_new[:, None], gword[None, :], c_gbits)
-                c_pool = jnp.where(is_new, p, c_pool)
+                c_pool = jnp.where(is_new, p_star.astype(jnp.int32), c_pool)
                 c_cm = jnp.where(
-                    is_new[:, None], take_j[:, None] * member_g[None, :].astype(jnp.int32), c_cm
+                    is_new[:, None],
+                    tq[:, None] * member_g[None, :].astype(jnp.int32),
+                    c_cm,
                 )
                 c_co = jnp.where(
                     is_new[:, None],
-                    ((take_j[:, None] > 0) & owner_g[None, :] & (q_kind[None, :] == 1)).astype(
-                        jnp.int32
-                    ),
+                    (
+                        (tq[:, None] > 0) & owner_g[None, :] & (q_kind[None, :] == 1)
+                    ).astype(jnp.int32),
                     c_co,
                 )
-                # charge pool usage: every claim charges its at-creation
-                # (1-pod survivor) minimum — n_new claims, charge_one each
-                p_usage = p_usage.at[p].add((charge_one * n_new).astype(jnp.int32))
-                take_new = take_new + take_j
-                return (c_cum, c_mask, c_zc_bits, c_gbits, c_pool, p_usage, take_new,
-                        c_cm, c_co, jnp.sum(take_j))
+                c_vm_st = jnp.where(
+                    is_new[:, None],
+                    tq[:, None] * member_v[None, :].astype(jnp.int32),
+                    c_vm_st,
+                )
+                c_vo_st = jnp.where(
+                    is_new[:, None], (tq[:, None] > 0) & owned_anti[None, :], c_vo_st
+                )
+                p_usage = p_usage.at[p_star].add(
+                    (charge_one_p[p_star] * use_p.astype(jnp.int32)).astype(jnp.int32)
+                )
+                used = used + use_p.astype(jnp.int32)
 
-            def skip(ops):
-                return ops + (jnp.int32(0),)
+                # zone-count recording (take_c_add excludes the new claim —
+                # add its recorded zone separately)
+                contrib = count_contrib(take_e_add, take_c_add, c_zc_bits)
+                contrib = contrib + jnp.where(
+                    use_p & (nz_fin_p == 1), jnp.where(zidx == z_p, q_p, 0), 0
+                ).astype(jnp.int32)
+                v_count = v_count + member_v.astype(jnp.int32)[:, None] * contrib[None, :]
+                # anti-owner registration keys on the target's recorded zone,
+                # member or not (the oracle registers owned terms' domains)
+                owner_rec = (
+                    (use_e & (z_e >= 0) & (zidx == jnp.clip(z_e, 0, Z - 1)))
+                    | (use_c & (nz_fin == 1) & (zidx == z_c))
+                    | (use_p & (nz_fin_p == 1) & (zidx == z_p))
+                )  # [Z]
+                v_owner_z = v_owner_z | (owned_anti[:, None] & owner_rec[None, :])
 
-            (c_cum, c_mask, c_zc_bits, c_gbits, c_pool, p_usage, take_new, c_cm,
-             c_co, placed_new) = jax.lax.cond(
-                n_new > 0,
-                apply,
-                skip,
-                (c_cum, c_mask, c_zc_bits, c_gbits, c_pool, p_usage, take_new, c_cm, c_co),
+                placed = jnp.sum(take_e_add) + jnp.sum(take_c_add) + jnp.sum(tq)
+                remaining = remaining - placed
+                progress = placed > 0
+                take_e_acc2 = take_e_acc + take_e_add
+                take_c_acc2 = take_c_acc + take_c_add + tq
+                return (remaining, progress, fuel - 1, take_e_acc2, take_c_acc2,
+                        e_cum, c_cum, c_mask, c_zc_bits, c_gbits, c_pool, used,
+                        p_usage, e_cm, e_co, c_cm, c_co, v_count, v_owner_z,
+                        c_vm_st, c_vo_st)
+
+            carry0 = (
+                remaining0, jnp.bool_(True), remaining0 + jnp.int32(8),
+                jnp.zeros((E,), jnp.int32), jnp.zeros((M,), jnp.int32),
+                st.e_cum, st.c_cum, st.c_mask, st.c_zc_bits, st.c_gbits, st.c_pool,
+                st.used, st.p_usage, st.e_cm, st.e_co, st.c_cm, st.c_co,
+                st.v_count, st.v_owner_z, st.c_vm, st.c_vo,
             )
+            out = jax.lax.while_loop(cond, body, carry0)
+            (remaining, _progress, _fuel, take_e_acc, take_c_acc, e_cum, c_cum,
+             c_mask, c_zc_bits, c_gbits, c_pool, used, p_usage, e_cm, e_co,
+             c_cm, c_co, v_count, v_owner_z, c_vm_f, c_vo_f) = out
+            new_state = FFDState(
+                e_cum=e_cum, c_cum=c_cum, c_mask=c_mask, c_zc_bits=c_zc_bits,
+                c_gbits=c_gbits, c_pool=c_pool, used=used, p_usage=p_usage,
+                e_cm=e_cm, e_co=e_co, c_cm=c_cm, c_co=c_co,
+                v_count=v_count, v_owner_z=v_owner_z, c_vm=c_vm_f, c_vo=c_vo_f,
+            )
+            return new_state, (take_e_acc, take_c_acc, remaining)
 
-            remaining = remaining - placed_new
-            used = used + n_new
-            return (remaining, used, c_cum, c_mask, c_zc_bits, c_gbits, c_pool,
-                    p_usage, take_new, c_cm, c_co)
-
-        carry = (
-            remaining,
-            st.used,
-            c_cum,
-            c_mask,
-            c_zc_bits,
-            c_gbits,
-            st.c_pool,
-            st.p_usage,
-            jnp.zeros((M,), jnp.int32),
-            c_cm,
-            c_co,
-        )
-        carry = jax.lax.fori_loop(0, P, open_pool, carry)
-        (remaining, used, c_cum, c_mask, c_zc_bits, c_gbits, c_pool2, p_usage,
-         take_new, c_cm, c_co) = carry
-
-        new_state = FFDState(
-            e_cum=e_cum,
-            c_cum=c_cum,
-            c_mask=c_mask,
-            c_zc_bits=c_zc_bits,
-            c_gbits=c_gbits,
-            c_pool=c_pool2,
-            used=used,
-            p_usage=p_usage,
-            e_cm=e_cm,
-            e_co=e_co,
-            c_cm=c_cm,
-            c_co=c_co,
-        )
-        return new_state, (take_e, take_c + take_new, remaining)
+        constrained = jnp.any(v_owner[g]) | jnp.any(member_v & (v_kind == 1))
+        return jax.lax.cond(constrained, zoned, fast, st)
 
     def step(st: FFDState, run):
         g, count = run

@@ -1,0 +1,380 @@
+"""Zone topology spread + inter-pod affinity ON DEVICE (BASELINE configs 3-4).
+
+The zone event engine (solver/tpu/ffd.py) must make bit-identical decisions
+to the oracle for zone-granular DoNotSchedule TSCs and required
+(anti-)affinity — including claim zone commitment (argmin/argmax count, lex),
+per-zone consecutive budgets, first-fit preemption as the min-count floor
+rises, and the balanced-phase cycle batching. Reference semantics:
+/root/reference/website/content/en/preview/concepts/scheduling.md:383-429.
+"""
+
+import random
+
+import pytest
+
+from karpenter_tpu.api import wellknown as wk
+from karpenter_tpu.api.objects import (
+    ObjectMeta,
+    Pod,
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.catalog.catalog import CatalogSpec, generate
+from karpenter_tpu.provisioning.scheduler import ExistingNode, NodePoolSpec, SolverInput
+from karpenter_tpu.scheduling.requirements import IN, Requirement, Requirements
+from karpenter_tpu.solver.backend import ReferenceSolver, TPUSolver
+from karpenter_tpu.solver.encode import quantize_input
+from karpenter_tpu.utils.resources import Resources
+
+CATALOG = generate(CatalogSpec())
+ZONES = ("zone-1a", "zone-1b", "zone-1c")
+
+
+def pool(name="default", weight=0, extra=None):
+    r = Requirements.of(Requirement.create(wk.NODEPOOL_LABEL, IN, [name]))
+    if extra:
+        r = r.union(extra)
+    return NodePoolSpec(
+        name=name, weight=weight, requirements=r, taints=[], instance_types=CATALOG
+    )
+
+
+def mkpod(name, cpu="1", mem="1Gi", labels=None, **kw):
+    return Pod(
+        meta=ObjectMeta(name=name, uid=name, labels=labels or {}),
+        requests=Resources.parse({"cpu": cpu, "memory": mem}),
+        **kw,
+    )
+
+
+def mknode(name, zone, matching=0, sel=None):
+    free = Resources.parse({"cpu": "8", "memory": "32Gi"})
+    free["pods"] = 50
+    n = ExistingNode(
+        id=name,
+        labels={
+            wk.ZONE_LABEL: zone,
+            wk.HOSTNAME_LABEL: name,
+            wk.CAPACITY_TYPE_LABEL: "on-demand",
+            wk.ARCH_LABEL: "amd64",
+            wk.OS_LABEL: "linux",
+        },
+        taints=[],
+        free=free,
+    )
+    n.pod_labels.extend([dict(sel or {"app": "w"})] * matching)
+    return n
+
+
+def assert_zone_parity(inp, expect_device=True):
+    ref = ReferenceSolver().solve(quantize_input(inp))
+    solver = TPUSolver()
+    tpu = solver.solve(inp)
+    assert set(ref.errors) == set(tpu.errors), (
+        f"errors: ref={sorted(ref.errors)} tpu={sorted(tpu.errors)}"
+    )
+    assert ref.placements == tpu.placements, _diff(ref.placements, tpu.placements)
+    assert len(ref.claims) == len(tpu.claims)
+    for i, (rc, tc) in enumerate(zip(ref.claims, tpu.claims)):
+        assert rc.nodepool == tc.nodepool, f"claim {i}"
+        assert sorted(rc.instance_type_names) == sorted(tc.instance_type_names), (
+            f"claim {i} types"
+        )
+        assert rc.pod_uids == tc.pod_uids, f"claim {i} pods"
+    if expect_device:
+        assert solver.stats["device_solves"] == 1, solver.stats
+    return ref, tpu
+
+
+def _diff(a, b):
+    keys = set(a) | set(b)
+    lines = [
+        f"{k}: ref={a.get(k)} tpu={b.get(k)}"
+        for k in sorted(keys)
+        if a.get(k) != b.get(k)
+    ]
+    return "placements diverge:\n" + "\n".join(lines[:20])
+
+
+TSC1 = TopologySpreadConstraint(
+    max_skew=1, topology_key=wk.ZONE_LABEL, label_selector={"app": "w"}
+)
+TSC2 = TopologySpreadConstraint(
+    max_skew=2, topology_key=wk.ZONE_LABEL, label_selector={"app": "w"}
+)
+
+
+class TestZoneSpreadOnDevice:
+    def test_fresh_claims_skew1(self):
+        pods = [
+            mkpod(f"p{i:02d}", cpu="2", mem="4Gi", labels={"app": "w"},
+                  topology_spread=[TSC1])
+            for i in range(9)
+        ]
+        ref, tpu = assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+        zones = set()
+        for c in tpu.claims:
+            zr = c.requirements.get(wk.ZONE_LABEL)
+            assert zr is not None and len(zr.values_list()) == 1  # committed
+            zones.add(zr.values_list()[0])
+        assert zones == set(ZONES)  # spread across all three AZs
+
+    def test_unbalanced_existing_counts(self):
+        """Transient phase: pre-existing matching pods skew the counts; the
+        pour must follow the oracle's first-fit preemption exactly."""
+        nodes = [mknode("na", "zone-1a", 3), mknode("nb", "zone-1b", 0),
+                 mknode("nc", "zone-1c", 1)]
+        pods = [
+            mkpod(f"p{i:02d}", cpu="500m", mem="1Gi", labels={"app": "w"},
+                  topology_spread=[TSC1])
+            for i in range(12)
+        ]
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=nodes, nodepools=[pool()], zones=ZONES)
+        )
+
+    def test_skew2_unbalanced(self):
+        nodes = [mknode("na", "zone-1a", 5), mknode("nb", "zone-1b", 2),
+                 mknode("nc", "zone-1c", 0)]
+        pods = [
+            mkpod(f"p{i:02d}", cpu="250m", mem="512Mi", labels={"app": "w"},
+                  topology_spread=[TSC2])
+            for i in range(30)
+        ]
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=nodes, nodepools=[pool()], zones=ZONES)
+        )
+
+    def test_zone_plus_hostname_tsc(self):
+        htsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.HOSTNAME_LABEL, label_selector={"app": "w"}
+        )
+        pods = [
+            mkpod(f"h{i:02d}", cpu="500m", mem="1Gi", labels={"app": "w"},
+                  topology_spread=[TSC1, htsc])
+            for i in range(6)
+        ]
+        ref, tpu = assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+        assert len(tpu.claims) == 6  # hostname skew 1: one pod per claim
+
+    def test_zone_selector_interaction(self):
+        zsel = {wk.ZONE_LABEL: "zone-1b"}
+        pods = [
+            mkpod(f"t{i:02d}", cpu="1", mem="2Gi", labels={"app": "w"},
+                  topology_spread=[TSC1])
+            for i in range(7)
+        ] + [mkpod(f"z{i:02d}", cpu="1", mem="2Gi", node_selector=zsel) for i in range(4)]
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+
+    def test_mixed_with_plain_pods(self):
+        pods = [
+            mkpod(f"t{i:02d}", cpu="2", mem="4Gi", labels={"app": "w"},
+                  topology_spread=[TSC1])
+            for i in range(6)
+        ] + [mkpod(f"u{i:02d}", cpu="1", mem="2Gi") for i in range(8)]
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=[mknode("na", "zone-1a", 0)],
+                        nodepools=[pool()], zones=ZONES)
+        )
+
+    def test_large_run_cycles(self):
+        """Balanced phase at scale: 300 identical spread pods must batch via
+        rotation rounds (and still match the oracle pod-for-pod)."""
+        pods = [
+            mkpod(f"p{i:03d}", cpu="500m", mem="1Gi", labels={"app": "w"},
+                  topology_spread=[TSC1])
+            for i in range(300)
+        ]
+        ref, tpu = assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+        assert not tpu.errors
+
+
+class TestZoneAffinityOnDevice:
+    def test_anti_affinity_exhausts_zones(self):
+        anti = PodAffinityTerm(
+            label_selector={"app": "db"}, topology_key=wk.ZONE_LABEL, anti=True
+        )
+        pods = [
+            mkpod(f"db{i}", cpu="1", mem="2Gi", labels={"app": "db"},
+                  affinity_terms=[anti])
+            for i in range(4)
+        ]
+        ref, tpu = assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+        # 3 zones -> the 4th anti pod cannot schedule
+        assert len(tpu.errors) == 1
+
+    def test_positive_affinity_bootstrap(self):
+        aff = PodAffinityTerm(
+            label_selector={"app": "web"}, topology_key=wk.ZONE_LABEL, anti=False
+        )
+        pods = [
+            mkpod(f"w{i}", cpu="1", mem="2Gi", labels={"app": "web"},
+                  affinity_terms=[aff])
+            for i in range(6)
+        ]
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+
+    def test_positive_affinity_follows_existing(self):
+        aff = PodAffinityTerm(
+            label_selector={"app": "web"}, topology_key=wk.ZONE_LABEL, anti=False
+        )
+        nodes = [mknode("nb", "zone-1b", 2, {"app": "web"})]
+        pods = [
+            mkpod(f"f{i}", cpu="1", mem="2Gi", labels={"x": "y"}, affinity_terms=[aff])
+            for i in range(4)
+        ]
+        ref, tpu = assert_zone_parity(
+            SolverInput(pods=pods, nodes=nodes, nodepools=[pool()], zones=ZONES)
+        )
+        # all followers co-locate with the existing web pods on nb
+        assert all(t == ("node", "nb") for t in tpu.placements.values())
+
+    def test_symmetric_anti_block(self):
+        anti = PodAffinityTerm(
+            label_selector={"app": "x"}, topology_key=wk.ZONE_LABEL, anti=True
+        )
+        pods = [
+            mkpod("owner", cpu="2", mem="4Gi", labels={"o": "1"}, affinity_terms=[anti])
+        ] + [mkpod(f"x{i}", cpu="1", mem="2Gi", labels={"app": "x"}) for i in range(3)]
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+
+    def test_tsc_with_symmetric_anti_joint_narrowing(self):
+        """A TSC pod that also matches a placed anti owner's selector must
+        commit to a zone satisfying BOTH (SPEC.md joint narrowing)."""
+        anti = PodAffinityTerm(
+            label_selector={"tier": "fe"}, topology_key=wk.ZONE_LABEL, anti=True
+        )
+        pods = [
+            mkpod("owner", cpu="2", mem="4Gi", labels={"o": "1"}, affinity_terms=[anti])
+        ] + [
+            mkpod(f"fe{i}", cpu="1", mem="2Gi", labels={"tier": "fe", "app": "w"},
+                  topology_spread=[TSC2])
+            for i in range(5)
+        ]
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+
+
+class TestJointNarrowingFallbackPath:
+    """TSC+affinity and stacked-affinity pods route to the oracle (encode
+    marks them fallback); the oracle must narrow claims over the JOINT
+    allowed set (SPEC.md) instead of committing per-constraint and failing."""
+
+    def _bignode(self, name, zone, pls):
+        n = mknode(name, zone, 0)
+        n.pod_labels.extend(pls)
+        return n
+
+    def test_tsc_plus_positive_affinity_commits_jointly(self):
+        nodes = [
+            self._bignode("na", "zone-1a", [{"app": "x"}]),
+            self._bignode("nb", "zone-1b", [{"app": "x"}, {"svc": "web"}]),
+            self._bignode("nc", "zone-1c", [{"app": "x"}]),
+        ]
+        aff = PodAffinityTerm(
+            label_selector={"svc": "web"}, topology_key=wk.ZONE_LABEL, anti=False
+        )
+        # too big for the nodes -> forces a fresh-claim commit
+        pod = mkpod("p", cpu="12", mem="24Gi", labels={"app": "x"},
+                    topology_spread=[TSC1], affinity_terms=[aff])
+        ref, tpu = assert_zone_parity(
+            SolverInput(pods=[pod], nodes=nodes, nodepools=[pool()], zones=ZONES),
+            expect_device=False,
+        )
+        assert not tpu.errors
+        zr = tpu.claims[0].requirements.get(wk.ZONE_LABEL)
+        assert zr.values_list() == ["zone-1b"]  # the only jointly-valid zone
+
+    def test_stacked_positive_affinity_commits_jointly(self):
+        nodes = [
+            self._bignode("na", "zone-1a", [{"svc": "web"}, {"svc": "web"}]),
+            self._bignode("nb", "zone-1b", [{"svc": "web"}, {"svc": "db"}]),
+        ]
+        a1 = PodAffinityTerm(label_selector={"svc": "web"},
+                             topology_key=wk.ZONE_LABEL, anti=False)
+        a2 = PodAffinityTerm(label_selector={"svc": "db"},
+                             topology_key=wk.ZONE_LABEL, anti=False)
+        pod = mkpod("q", cpu="12", mem="24Gi", affinity_terms=[a1, a2])
+        ref, tpu = assert_zone_parity(
+            SolverInput(pods=[pod], nodes=nodes, nodepools=[pool()], zones=ZONES),
+            expect_device=False,
+        )
+        assert not tpu.errors
+        zr = tpu.claims[0].requirements.get(wk.ZONE_LABEL)
+        assert zr.values_list() == ["zone-1b"]
+
+
+class TestZoneFuzzParity:
+    SELS = [{"app": "w"}, {"app": "db"}, {"tier": "fe"}]
+
+    def _scenario(self, seed):
+        rng = random.Random(seed)
+        pools = [pool("p1", 10)]
+        if rng.random() < 0.4:
+            pools.append(
+                pool("p0", 50,
+                     Requirements.of(Requirement.create(wk.CAPACITY_TYPE_LABEL, IN, ["spot"])))
+            )
+        nodes = []
+        for j in range(rng.randint(0, 5)):
+            n = mknode(f"n{j}", rng.choice(ZONES), 0)
+            n.free = Resources.parse({"cpu": rng.choice(["4", "8"]), "memory": "16Gi"})
+            n.free["pods"] = 30
+            for _ in range(rng.randint(0, 4)):
+                n.pod_labels.append(dict(rng.choice(self.SELS)))
+            nodes.append(n)
+        pods = []
+        for i in range(rng.randint(5, 35)):
+            labels = dict(rng.choice(self.SELS)) if rng.random() < 0.7 else {}
+            tsp, aft = [], []
+            r = rng.random()
+            if r < 0.3:
+                tsp.append(
+                    TopologySpreadConstraint(
+                        max_skew=rng.choice([1, 1, 2]), topology_key=wk.ZONE_LABEL,
+                        label_selector=dict(rng.choice(self.SELS)))
+                )
+            elif r < 0.45:
+                aft.append(PodAffinityTerm(label_selector=dict(rng.choice(self.SELS)),
+                                           topology_key=wk.ZONE_LABEL, anti=True))
+            elif r < 0.55:
+                aft.append(PodAffinityTerm(label_selector=dict(rng.choice(self.SELS)),
+                                           topology_key=wk.ZONE_LABEL, anti=False))
+            elif r < 0.62:
+                tsp.append(
+                    TopologySpreadConstraint(max_skew=1, topology_key=wk.HOSTNAME_LABEL,
+                                             label_selector=dict(rng.choice(self.SELS)))
+                )
+            sel = {}
+            if rng.random() < 0.2:
+                sel = {wk.ZONE_LABEL: rng.choice(ZONES)}
+            pods.append(
+                Pod(
+                    meta=ObjectMeta(name=f"p{i:03d}", uid=f"p{i:03d}", labels=labels),
+                    requests=Resources.parse(
+                        {"cpu": rng.choice(["250m", "500m", "1", "2"]),
+                         "memory": rng.choice(["512Mi", "1Gi", "2Gi"])}
+                    ),
+                    node_selector=sel, topology_spread=tsp, affinity_terms=aft,
+                )
+            )
+        return SolverInput(pods=pods, nodes=nodes, nodepools=pools, zones=ZONES)
+
+    @pytest.mark.parametrize("seed", range(16))
+    def test_fuzz(self, seed):
+        assert_zone_parity(self._scenario(seed), expect_device=False)
