@@ -101,6 +101,24 @@ class PodAffinityTerm:
     weight: Optional[int] = None
 
 
+# Pod fields that feed the solver's cached signature / FFD sort key; assigning
+# any of them drops the caches (see Pod.__setattr__).
+_POD_SIG_FIELDS = frozenset(
+    {
+        "meta",
+        "requests",
+        "node_selector",
+        "node_affinity",
+        "preferred_node_affinity",
+        "tolerations",
+        "topology_spread",
+        "affinity_terms",
+        "priority",
+    }
+)
+_POD_CACHE_KEYS = ("_solver_sig", "_ffd_key", "_sig_num", "_mib_aligned")
+
+
 @dataclass
 class Pod:
     meta: ObjectMeta
@@ -118,6 +136,22 @@ class Pod:
     priority: int = 0
     scheduling_gated: bool = False
     owner_kind: str = ""  # "DaemonSet" pods get special handling
+
+    def __setattr__(self, name, value):
+        object.__setattr__(self, name, value)
+        if name in _POD_SIG_FIELDS:
+            d = self.__dict__
+            for k in _POD_CACHE_KEYS:
+                d.pop(k, None)
+
+    def invalidate_solver_cache(self) -> None:
+        """Drop cached solver signature/sort keys. Field ASSIGNMENT does this
+        automatically (__setattr__); call this after mutating a nested
+        container in place (e.g. `pod.meta.labels[...] = ...`), which
+        __setattr__ cannot observe."""
+        d = self.__dict__
+        for k in _POD_CACHE_KEYS:
+            d.pop(k, None)
 
     def scheduling_requirements(self) -> Requirements:
         """nodeSelector + ALL required node-affinity terms folded into one

@@ -33,7 +33,13 @@ import numpy as np
 
 from ..api import wellknown as wk
 from ..api.objects import Pod, tolerates_all
-from ..provisioning.scheduler import ExistingNode, NodePoolSpec, SolverInput, ffd_sort
+from ..provisioning.scheduler import (
+    ExistingNode,
+    NodePoolSpec,
+    SolverInput,
+    ffd_sort,
+    ffd_sort_with_sigs,
+)
 from ..scheduling.requirements import Requirements
 from ..utils.resources import CPU, EPHEMERAL_STORAGE, MEMORY, PODS, Resources
 
@@ -76,6 +82,60 @@ def _pod_signature(pod: Pod) -> tuple:
     sig = _pod_signature_uncached(pod)
     pod.__dict__["_solver_sig"] = sig
     return sig
+
+
+# Global signature intern table: maps signature tuples to small ints so the
+# per-solve group key is an int compare/hash instead of re-hashing a large
+# nested tuple per pod per solve. Bounded: on overflow the table resets and
+# the epoch bumps, invalidating every pod's cached id (and the compat cache
+# entries keyed by (epoch, id)).
+_SIG_IDS: Dict[tuple, int] = {}
+_SIG_EPOCH: int = 0
+_SIG_CAP = 100_000
+
+
+def sig_num(pod: Pod) -> int:
+    """Interned scheduling-signature id (stable within the current epoch)."""
+    global _SIG_IDS, _SIG_EPOCH
+    ent = pod.__dict__.get("_sig_num")
+    if ent is not None and ent[0] == _SIG_EPOCH:
+        return ent[1]
+    if len(_SIG_IDS) >= _SIG_CAP:
+        _SIG_IDS = {}
+        _SIG_EPOCH += 1
+        # compat-cache keys embed the epoch; entries from prior epochs are
+        # unreachable forever — drop them rather than leak a generation
+        _GROUP_COMPAT_CACHE.clear()
+    sig = _pod_signature(pod)
+    n = _SIG_IDS.setdefault(sig, len(_SIG_IDS))
+    pod.__dict__["_sig_num"] = (_SIG_EPOCH, n)
+    return n
+
+
+def sig_nums(pods: Sequence[Pod]) -> Tuple[np.ndarray, bool]:
+    """Interned ids for a batch, guaranteed mutually consistent (one epoch).
+
+    If the intern table resets mid-batch (epoch bump), ids from before the
+    bump could collide with fresh ids of different signatures — so retry once
+    against the fresh table; a batch with more distinct signatures than the
+    table cap falls back to batch-local interning (second value False: the
+    ids are then NOT stable across calls and must not key persistent caches).
+    """
+    n = len(pods)
+    for _ in range(2):
+        e0 = _SIG_EPOCH
+        arr = np.fromiter((sig_num(p) for p in pods), np.int64, n)
+        if _SIG_EPOCH == e0:
+            return arr, True
+    local: Dict[tuple, int] = {}
+    return (
+        np.fromiter(
+            (local.setdefault(_pod_signature(p), len(local)) for p in pods),
+            np.int64,
+            n,
+        ),
+        False,
+    )
 
 
 def _pod_signature_uncached(pod: Pod) -> tuple:
@@ -149,6 +209,10 @@ class EncodedInput:
     node_ct: np.ndarray  # [E] int32
     node_ids: List[str]
 
+    # pod uids in FFD-sorted order (= concatenation of runs); decode's
+    # vectorized result assembly indexes this instead of walking pod objects
+    sorted_uids: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=object))
+
     # topology / affinity (config 3-4) — filled by encode, used by tpu kernels
     # True only for constructs still off-device (capacity-type TSC/affinity,
     # duplicate node hostnames); zone terms run on device via the V axis.
@@ -219,6 +283,9 @@ def quantize_resources(res: Resources, ceil: bool) -> Resources:
 
 _QUANTIZED_TYPE_CACHE: dict = {}
 
+# id(type) -> (type, rkeys tuple, alloc row, capacity row) — see encode()
+_TYPE_ROW_CACHE: dict = {}
+
 # pod-signature -> (catalog id-tuple, pinned types, [T] bool compat row)
 _GROUP_COMPAT_CACHE: dict = {}
 
@@ -236,6 +303,8 @@ def _quantize_type(it):
         capacity=quantize_resources(it.capacity, ceil=False),
         overhead=quantize_resources(it.overhead, ceil=True),
     )
+    if len(_QUANTIZED_TYPE_CACHE) > 8192:
+        _QUANTIZED_TYPE_CACHE.clear()  # bound against catalog-churn growth
     _QUANTIZED_TYPE_CACHE[id(it)] = (it, q)
     return q
 
@@ -259,7 +328,14 @@ def quantize_input(inp: SolverInput) -> SolverInput:
     from dataclasses import replace as _replace
 
     def qpod(p):
-        if _already_mib_aligned(p.requests):
+        # alignment verdict cached on the pod (invalidated by field assignment,
+        # objects.py Pod.__setattr__): typical requests are MiB-aligned, so a
+        # 50k-pod surge pays one dict hit per pod instead of a Resources walk
+        a = p.__dict__.get("_mib_aligned")
+        if a is None:
+            a = _already_mib_aligned(p.requests)
+            p.__dict__["_mib_aligned"] = a
+        if a:
             return p
         return _replace(p, requests=quantize_resources(p.requests, ceil=True))
 
@@ -298,43 +374,51 @@ def encode(inp: SolverInput) -> EncodedInput:
                 type_names.append(it.name)
     T = len(type_names)
 
-    # ---- resource axis ----------------------------------------------------
+    # ---- groups (vectorized: the only O(pods) work is cached-key gathering)
+    pods_sorted, sigs, sorted_uids, sigs_interned = ffd_sort_with_sigs(
+        [p for p in inp.pods if not p.scheduling_gated and p.node_name is None]
+    )
+    n_pods = len(pods_sorted)
+    if n_pods:
+        # group ids in first-appearance order over the sorted sequence
+        _, first_idx, inv = np.unique(sigs, return_index=True, return_inverse=True)
+        rank = np.empty(len(first_idx), np.int64)
+        rank[np.argsort(first_idx, kind="stable")] = np.arange(len(first_idx))
+        gids = rank[inv]
+        G = len(first_idx)
+        # runs: consecutive same-group stretches of the sorted pod list
+        change = np.flatnonzero(np.diff(gids) != 0) + 1
+        starts = np.concatenate(([0], change))
+        run_group = gids[starts].astype(np.int32)
+        run_count = np.diff(np.concatenate((starts, [n_pods]))).astype(np.int32)
+        # per-group pod lists (sorted order preserved within each group)
+        # per-group pod lists assembled run-by-run (S slices of the sorted
+        # list, C-speed extend) — NOT via an object ndarray: numpy's
+        # list→object-array fill probes every element and costs ~70ms at 50k
+        group_pods = [[] for _ in range(G)]
+        pos = 0
+        for s in range(len(run_group)):
+            c = int(run_count[s])
+            group_pods[int(run_group[s])].extend(pods_sorted[pos : pos + c])
+            pos += c
+        group_snums = [int(s) for s in sigs[np.sort(first_idx)]]
+    else:
+        G = 0
+        group_pods = []
+        run_group = np.zeros(0, np.int32)
+        run_count = np.zeros(0, np.int32)
+        group_snums = []
+
+    # ---- resource axis (from group representatives — same-group pods have
+    # identical requests, so the scan is O(groups), not O(pods)) -------------
     rkeys = [CPU, MEMORY, PODS]
     seen = set(rkeys)
-    for pod in list(inp.pods) + list(inp.daemonset_pods):
+    for pod in [pl[0] for pl in group_pods] + list(inp.daemonset_pods):
         for k, v in pod.requests.items():
             if v and k not in seen:
                 seen.add(k)
                 rkeys.append(k)
     R = len(rkeys)
-
-    # ---- groups -----------------------------------------------------------
-    pods_sorted = ffd_sort(
-        [p for p in inp.pods if not p.scheduling_gated and not p.bound]
-    )
-    sig_to_gid: Dict[tuple, int] = {}
-    group_pods: List[List[Pod]] = []
-    pod_gids: List[int] = []
-    for pod in pods_sorted:
-        sig = _pod_signature(pod)
-        gid = sig_to_gid.get(sig)
-        if gid is None:
-            gid = len(group_pods)
-            sig_to_gid[sig] = gid
-            group_pods.append([])
-        group_pods[gid].append(pod)
-        pod_gids.append(gid)
-    G = len(group_pods)
-
-    # runs: consecutive same-group stretches of the sorted pod list
-    run_group: List[int] = []
-    run_count: List[int] = []
-    for gid in pod_gids:
-        if run_group and run_group[-1] == gid:
-            run_count[-1] += 1
-        else:
-            run_group.append(gid)
-            run_count.append(1)
 
     group_req = np.zeros((G, R), dtype=np.int32)
     for g, pl in enumerate(group_pods):
@@ -461,14 +545,27 @@ def encode(inp: SolverInput) -> EncodedInput:
     offer_price = np.full((T, len(zones), len(cts)), np.inf, dtype=np.float32)
     zid = {z: i for i, z in enumerate(zones)}
     cid = {c: i for i, c in enumerate(cts)}
+    rkeys_tuple = tuple(rkeys)
+    if len(_TYPE_ROW_CACHE) > 8192:
+        # catalog churn (e.g. ICE-seq rebuilds) creates fresh type objects;
+        # bound the id-keyed cache so stale generations don't accumulate
+        _TYPE_ROW_CACHE.clear()
     for t, name in enumerate(type_names):
         it = types_by_name[name]
         # alloc = floor(capacity) - ceil(overhead): matches quantize_input's
-        # per-field rounding exactly (allocatable() of quantized fields)
-        cap_q = np.asarray(_quantize(it.capacity, rkeys, ceil=False), dtype=np.int64)
-        ovh_q = np.asarray(_quantize(it.overhead, rkeys, ceil=True), dtype=np.int64)
-        type_alloc[t] = np.maximum(cap_q - ovh_q, 0).astype(np.int32)
-        type_capacity[t] = cap_q.astype(np.int32)
+        # per-field rounding exactly (allocatable() of quantized fields).
+        # Rows cache per (type object, resource axis) — the catalog is static
+        # across solves, so steady state is a dict hit per type.
+        ent = _TYPE_ROW_CACHE.get(id(it))
+        if ent is not None and ent[0] is it and ent[1] == rkeys_tuple:
+            type_alloc[t], type_capacity[t] = ent[2], ent[3]
+        else:
+            cap_q = np.asarray(_quantize(it.capacity, rkeys, ceil=False), dtype=np.int64)
+            ovh_q = np.asarray(_quantize(it.overhead, rkeys, ceil=True), dtype=np.int64)
+            alloc_row = np.maximum(cap_q - ovh_q, 0).astype(np.int32)
+            cap_row = cap_q.astype(np.int32)
+            type_alloc[t], type_capacity[t] = alloc_row, cap_row
+            _TYPE_ROW_CACHE[id(it)] = (it, rkeys_tuple, alloc_row, cap_row)
         for o in it.offerings:
             if o.zone in zid and o.capacity_type in cid:
                 zi, ci = zid[o.zone], cid[o.capacity_type]
@@ -477,12 +574,12 @@ def encode(inp: SolverInput) -> EncodedInput:
                     offer_price[t, zi, ci] = min(offer_price[t, zi, ci], o.price)
 
     # ---- group×type / group×zone / group×ct --------------------------------
-    # group×type compatibility rows cache by pod signature: a recurring group
-    # (same deployment, next solve) costs a dict hit instead of T
-    # requirement-algebra calls. The catalog is identified by object ids,
+    # group×type compatibility rows cache by interned pod signature id: a
+    # recurring group (same deployment, next solve) costs a dict hit instead
+    # of T requirement-algebra calls. The catalog is identified by object ids,
     # with the referenced types pinned in the cache entry so ids can't be
-    # recycled under us.
-    group_sigs = {gid: sig for sig, gid in sig_to_gid.items()}
+    # recycled under us; the epoch in the key invalidates entries when the
+    # signature intern table resets.
     types_tuple = tuple(types_by_name[n] for n in type_names)
     types_ids = tuple(map(id, types_tuple))
     group_compat_t = np.zeros((G, T), dtype=bool)
@@ -497,8 +594,8 @@ def encode(inp: SolverInput) -> EncodedInput:
         cr = reqs.get(wk.CAPACITY_TYPE_LABEL)
         for i, c in enumerate(cts):
             group_ct[g, i] = cr is None or cr.has(c)
-        sig = group_sigs[g]
-        ent = _GROUP_COMPAT_CACHE.get(sig)
+        key = (_SIG_EPOCH, group_snums[g]) if sigs_interned else None
+        ent = _GROUP_COMPAT_CACHE.get(key) if key is not None else None
         if ent is not None and ent[0] == types_ids:
             group_compat_t[g] = ent[2]
         else:
@@ -508,7 +605,8 @@ def encode(inp: SolverInput) -> EncodedInput:
                 count=T,
             )
             group_compat_t[g] = row
-            _GROUP_COMPAT_CACHE[sig] = (types_ids, types_tuple, row)
+            if key is not None:
+                _GROUP_COMPAT_CACHE[key] = (types_ids, types_tuple, row)
 
     # ---- pool tensors -------------------------------------------------------
     P = len(pools)
@@ -600,6 +698,8 @@ def encode(inp: SolverInput) -> EncodedInput:
             has_topo = True
     v_count0 = np.zeros((V, len(zones)), dtype=np.int32)
     zsig_list = sorted(zone_sigs.items(), key=lambda kv: kv[1])
+    all_req_keys = sorted({k for reqs in group_reqsets for k in reqs})
+    profile_cols: Dict[tuple, np.ndarray] = {}
     for e, n in enumerate(inp.nodes):
         node_free[e] = _quantize(n.free, rkeys, ceil=False)
         node_zone[e] = zid.get(n.labels.get(wk.ZONE_LABEL, ""), -1)
@@ -617,12 +717,29 @@ def encode(inp: SolverInput) -> EncodedInput:
                 )
         if not n.schedulable:
             continue
-        node_reqs = Requirements.from_labels(n.labels)
-        for g, pl in enumerate(group_pods):
-            pod = pl[0]
-            if not tolerates_all(pod.tolerations, n.taints):
-                continue
-            node_compat[g, e] = group_reqsets[g].strictly_compatible(node_reqs)
+        # Node-profile dedupe: strictly_compatible only reads the labels at
+        # the groups' requirement keys, and toleration checks only read
+        # taints — so nodes sharing (taints, referenced-label values) share
+        # the whole [G] compat column. A homogeneous fleet computes G×profiles
+        # algebra calls instead of G×E.
+        prof = (
+            tuple((t.key, t.value, t.effect) for t in n.taints),
+            tuple(n.labels.get(k) for k in all_req_keys),
+        )
+        col = profile_cols.get(prof)
+        if col is None:
+            node_reqs = Requirements.from_labels(n.labels)
+            col = np.fromiter(
+                (
+                    tolerates_all(group_pods[g][0].tolerations, n.taints)
+                    and group_reqsets[g].strictly_compatible(node_reqs)
+                    for g in range(G)
+                ),
+                bool,
+                G,
+            )
+            profile_cols[prof] = col
+        node_compat[:, e] = col
 
     return EncodedInput(
         resource_keys=rkeys,
@@ -640,6 +757,7 @@ def encode(inp: SolverInput) -> EncodedInput:
         group_fallback=fallback,
         run_group=np.asarray(run_group, dtype=np.int32),
         run_count=np.asarray(run_count, dtype=np.int32),
+        sorted_uids=sorted_uids,
         type_alloc=type_alloc,
         type_capacity=type_capacity,
         charge_axes=np.asarray([k in (CPU, MEMORY) for k in rkeys], dtype=bool),
