@@ -230,6 +230,10 @@ class EncodedInput:
     v_primary: Optional[np.ndarray] = None  # [G] int32 — group's owned zone-TSC sig (-1)
     v_aff: Optional[np.ndarray] = None  # [G] int32 — group's owned positive-affinity sig (-1)
     v_count0: Optional[np.ndarray] = None  # [V, Z] int32 initial matching-pod counts
+    # per-node share of v_count0 (node e contributes node_v_member[e] at its
+    # zone) — lets the batched consolidation evaluator subtract a removed
+    # candidate node's bound pods from the zone counts per subset
+    node_v_member: Optional[np.ndarray] = None  # [E, V] int32
 
     @property
     def V(self) -> int:
@@ -697,6 +701,7 @@ def encode(inp: SolverInput) -> EncodedInput:
         if len(set(hostnames)) < len(hostnames):
             has_topo = True
     v_count0 = np.zeros((V, len(zones)), dtype=np.int32)
+    node_v_member = np.zeros((E, V), dtype=np.int32)
     zsig_list = sorted(zone_sigs.items(), key=lambda kv: kv[1])
     all_req_keys = sorted({k for reqs in group_reqsets for k in reqs})
     profile_cols: Dict[tuple, np.ndarray] = {}
@@ -712,9 +717,11 @@ def encode(inp: SolverInput) -> EncodedInput:
         if node_zone[e] >= 0:
             for (kind, sel_sig, cap), v in zsig_list:
                 sel = dict(sel_sig)
-                v_count0[v, node_zone[e]] += sum(
+                cnt = sum(
                     1 for pl in n.pod_labels if all(pl.get(k) == vv for k, vv in sel.items())
                 )
+                node_v_member[e, v] = cnt
+                v_count0[v, node_zone[e]] += cnt
         if not n.schedulable:
             continue
         # Node-profile dedupe: strictly_compatible only reads the labels at
@@ -789,4 +796,5 @@ def encode(inp: SolverInput) -> EncodedInput:
         v_primary=v_primary,
         v_aff=v_aff,
         v_count0=v_count0,
+        node_v_member=node_v_member,
     )

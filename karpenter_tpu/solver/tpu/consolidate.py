@@ -39,11 +39,15 @@ from .ffd import ARG_INDEX, IN_AXES, ffd_solve
 # kernel-signature change can never silently skew the batch layout again:
 #   run_count    batched (per-subset membership zeroing)
 #   node_compat  batched (per-subset node removal)
-#   everything else broadcasts (hostname-cap sigs shared; removed nodes are
-#   already compat-masked so their counts are inert)
+#   v_count0     batched (removed candidates' zone-count contributions
+#                subtracted — their pods are re-posed as pending runs, and
+#                hostname (Q) counts on removed nodes are inert because the
+#                nodes are compat-masked, but zone (V) counts are GLOBAL)
+#   everything else broadcasts
 _IN_AXES = IN_AXES
 _RUN_COUNT = ARG_INDEX["run_count"]
 _NODE_COMPAT = ARG_INDEX["node_compat"]
+_V_COUNT0 = ARG_INDEX["v_count0"]
 
 
 @functools.partial(jax.jit, static_argnames=("max_claims",))
@@ -60,6 +64,7 @@ def simulate_subsets(
     subsets: Sequence[Sequence[int]],  # candidate-id subsets to evaluate
     candidate_node_idx: dict,  # candidate id -> existing-node index (E axis)
     max_claims: int = 16,
+    candidate_v_delta: Optional[dict] = None,  # cid -> [V, Z] zone-count share
 ):
     """Evaluate each subset; returns FFDOutput with leading batch axis B.
 
@@ -69,12 +74,14 @@ def simulate_subsets(
     """
     run_count = np.asarray(kernel_args[_RUN_COUNT])
     node_compat = np.asarray(kernel_args[_NODE_COMPAT])
+    v_count0 = np.asarray(kernel_args[_V_COUNT0])
     B = len(subsets)
     S = run_count.shape[0]
     G, E = node_compat.shape
 
     b_run_count = np.zeros((B, S), dtype=run_count.dtype)
     b_node_compat = np.broadcast_to(node_compat, (B, G, E)).copy()
+    b_v_count0 = np.broadcast_to(v_count0, (B,) + v_count0.shape).copy()
     for b, subset in enumerate(subsets):
         member = np.isin(run_candidate, np.asarray(list(subset), dtype=np.int64))
         b_run_count[b] = np.where(member, run_count, 0)
@@ -82,10 +89,16 @@ def simulate_subsets(
             e = candidate_node_idx.get(cid)
             if e is not None and e < E:
                 b_node_compat[b, :, e] = False
+            if candidate_v_delta is not None:
+                d = candidate_v_delta.get(cid)
+                if d is not None and d.size:
+                    V, Z = d.shape
+                    b_v_count0[b, :V, :Z] -= d
 
     args = list(kernel_args)
     args[_RUN_COUNT] = jnp.asarray(b_run_count)
     args[_NODE_COMPAT] = jnp.asarray(b_node_compat)
+    args[_V_COUNT0] = jnp.asarray(b_v_count0)
     return _batched_ffd(tuple(args), max_claims=max_claims)
 
 
