@@ -118,6 +118,17 @@ _POD_SIG_FIELDS = frozenset(
 )
 _POD_CACHE_KEYS = ("_solver_sig", "_ffd_key", "_sig_num", "_mib_aligned")
 
+# Global pod-mutation epoch: bumped when a pod that has been through the
+# encoder (it carries cache keys) is mutated in place. Cross-solve encode
+# caches key on (epoch, identity-fingerprint of the pod set): any in-place
+# mutation of an encoded pod invalidates them. Fresh pods have no cache keys
+# yet, so construction does not bump the epoch.
+_POD_MUTATION_EPOCH = 0
+
+
+def pod_mutation_epoch() -> int:
+    return _POD_MUTATION_EPOCH
+
 
 @dataclass
 class Pod:
@@ -141,8 +152,13 @@ class Pod:
         object.__setattr__(self, name, value)
         if name in _POD_SIG_FIELDS:
             d = self.__dict__
+            dropped = False
             for k in _POD_CACHE_KEYS:
-                d.pop(k, None)
+                if d.pop(k, None) is not None:
+                    dropped = True
+            if dropped:
+                global _POD_MUTATION_EPOCH
+                _POD_MUTATION_EPOCH += 1
 
     def invalidate_solver_cache(self) -> None:
         """Drop cached solver signature/sort keys. Field ASSIGNMENT does this
@@ -150,8 +166,13 @@ class Pod:
         container in place (e.g. `pod.meta.labels[...] = ...`), which
         __setattr__ cannot observe."""
         d = self.__dict__
+        dropped = False
         for k in _POD_CACHE_KEYS:
-            d.pop(k, None)
+            if d.pop(k, None) is not None:
+                dropped = True
+        if dropped:
+            global _POD_MUTATION_EPOCH
+            _POD_MUTATION_EPOCH += 1
 
     def scheduling_requirements(self) -> Requirements:
         """nodeSelector + ALL required node-affinity terms folded into one

@@ -2,13 +2,20 @@
 
 Wraps solver/tpu/consolidate.py for the disruption controller: encodes the
 simulation universe ONCE (all candidates' pods pending, all nodes present),
-then evaluates candidate subsets as one vmapped batch. Used as a fast filter
+then evaluates candidate subsets as vmapped batches. Used as a fast filter
 — the winning subset is re-materialized through the sequential simulate path,
 so command construction (and therefore behavior) is bit-identical to the
 reference-style sequential evaluation; only wall-clock changes.
 
+prepare() builds and uploads the shared universe once; evaluate_prepared()
+dispatches one batch of subsets against it — the controller's tiered prefix
+search (config 5: 10k-node multi-node consolidation) issues several small
+batches against a single prepared universe instead of re-encoding per phase.
+
 Falls back (returns None) when the universe contains constructs the device
-kernel can't express (topology/affinity/fallback groups — encode.py).
+kernel can't express (fallback groups / off-device topology-affinity forms —
+encode.py). Zone-granular constraints (V axis) ARE expressible: each subset
+row subtracts its removed candidates' zone-count contributions.
 """
 
 from __future__ import annotations
@@ -18,10 +25,15 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..provisioning.scheduler import SolverInput, ffd_sort
-from ..solver.backend import TPUSolver, kernel_args
+from ..provisioning.scheduler import SolverInput
+from ..solver.backend import TPUSolver, kernel_args, unpack_zc_bits
 from ..solver.encode import UnpackableInput, encode, quantize_input
-from ..solver.tpu.consolidate import replacement_min_price, simulate_subsets
+from ..solver.tpu.consolidate import (
+    _V_COUNT0,
+    fetch_verdicts,
+    replacement_min_price,
+    simulate_subsets,
+)
 
 
 @dataclasses.dataclass
@@ -32,53 +44,97 @@ class SubsetVerdict:
     replacement_type_count: int  # surviving instance types (spot >=15 rule)
 
 
+def tiered_prefix_search(evaluate_ks, n_max: int, acceptable, width: int = 32):
+    """Largest-acceptable-prefix search over prefix lengths [2, n_max].
+
+    evaluate_ks(ks) -> verdicts for prefixes of those lengths;
+    acceptable(k, verdict) -> bool. Phase 1 probes ≤width evenly spaced
+    lengths over the whole range; each later phase refines between the
+    largest accepted probe and the next probe above it, until the gap is
+    fully enumerated — O(log_width(N)) batched dispatches instead of O(N)
+    sequential re-solves (config 5). Shared by the disruption controller
+    and bench.py so the measured loop IS the production loop.
+
+    Returns (k_best — 1 when nothing accepted, probed {k: verdict},
+    dispatches)."""
+    probed: Dict[int, object] = {}
+    k_lo, k_hi = 1, n_max + 1
+    dispatches = 0
+    while k_hi - k_lo > 1:
+        span = [k for k in range(k_lo + 1, k_hi) if k not in probed]
+        if not span:
+            break
+        if len(span) > width:
+            step = (len(span) - 1) / (width - 1)
+            ks = sorted({span[int(round(i * step))] for i in range(width)})
+        else:
+            ks = span
+        verdicts = evaluate_ks(ks)
+        dispatches += 1
+        for k, v in zip(ks, verdicts):
+            probed[k] = v
+        acc = [k for k in ks if acceptable(k, probed[k])]
+        if acc:
+            k_lo = max(acc)
+            higher = [k for k in probed if k > k_lo]
+            k_hi = min(higher) if higher else k_hi
+        else:
+            k_hi = min(ks)
+    return k_lo, probed, dispatches
+
+
+@dataclasses.dataclass
+class PreparedUniverse:
+    enc: object  # EncodedInput
+    args: tuple  # device-resident shared kernel args (ffd.ARG_SPEC order)
+    pod_cand: np.ndarray  # [N] int64 — candidate id per pod, FFD order
+    pod_run: np.ndarray  # [N] int64 — natural run index per pod, FFD order
+    node_idx: Dict[int, int]  # candidate id -> E index
+    v_delta: Optional[Dict[int, np.ndarray]]  # cid -> [V, Z] zone-count share
+    v_count0_host: Optional[np.ndarray] = None  # host copy (per-dispatch base)
+
+
 class BatchedConsolidationEvaluator:
     def __init__(self, solver: TPUSolver, max_claims: int = 16):
         self.solver = solver
         self.max_claims = max_claims
 
-    def evaluate(
+    def prepare(
         self,
         base_input: SolverInput,
         candidate_pods: Dict[int, list],  # candidate id -> pods (unbound copies)
         candidate_node: Dict[int, str],  # candidate id -> existing-node id
-        subsets: Sequence[Sequence[int]],
-    ) -> Optional[List[SubsetVerdict]]:
+    ) -> Optional[PreparedUniverse]:
+        import jax
+
         all_pods = [p for pods in candidate_pods.values() for p in pods]
         inp = dataclasses.replace(base_input, pods=all_pods)
         enc = encode(quantize_input(inp))
         if enc.group_fallback.any() or enc.has_topology or enc.has_affinity or enc.G == 0:
             return None
 
-        # (group, candidate)-granular runs following the exact FFD order
+        # Runs stay at NATURAL group granularity (enc.run_group/run_count):
+        # same-group pods are fungible, so each subset is expressed as
+        # per-run member COUNTS — the device scan length stays O(distinct
+        # pod specs) instead of O(candidates) (config 5: 2000 candidates
+        # collapse to ~#groups scan steps).
         uid_to_cid = {
             p.meta.uid: cid for cid, pods in candidate_pods.items() for p in pods
         }
-        uid_to_gid = {
-            p.meta.uid: g for g, pods in enumerate(enc.group_pods) for p in pods
-        }
-        pods_sorted = ffd_sort(all_pods)
-        run_group: List[int] = []
-        run_count: List[int] = []
-        run_cand: List[int] = []
-        for p in pods_sorted:
-            g, c = uid_to_gid[p.meta.uid], uid_to_cid[p.meta.uid]
-            if run_group and run_group[-1] == g and run_cand[-1] == c:
-                run_count[-1] += 1
-            else:
-                run_group.append(g)
-                run_count.append(1)
-                run_cand.append(c)
-        enc.run_group = np.asarray(run_group, dtype=np.int32)
-        enc.run_count = np.asarray(run_count, dtype=np.int32)
+        pod_cand = np.fromiter(
+            (uid_to_cid[u] for u in enc.sorted_uids), np.int64, len(enc.sorted_uids)
+        )
+        pod_run = np.repeat(
+            np.arange(len(enc.run_count), dtype=np.int64), enc.run_count
+        )
 
         try:
             args, dims = kernel_args(enc, self.solver._bucket)
         except UnpackableInput:
             return None  # Z*C > 32 — sequential path takes over
-        Sp = len(np.asarray(args[0]))
-        run_candidate = np.full(Sp, -1, dtype=np.int32)
-        run_candidate[: len(run_cand)] = run_cand
+        v_count0_host = np.asarray(args[_V_COUNT0])
+        # upload the shared arrays once; batched axes are re-uploaded per call
+        args = tuple(jax.device_put(a) for a in args)
 
         id_to_e = {nid: e for e, nid in enumerate(enc.node_ids)}
         node_idx = {cid: id_to_e[nid] for cid, nid in candidate_node.items()
@@ -98,16 +154,22 @@ class BatchedConsolidationEvaluator:
                 d[:, z] = enc.node_v_member[e]
                 if d.any():
                     v_delta[cid] = d
-        out = simulate_subsets(args, run_candidate, subsets, node_idx, self.max_claims,
-                               candidate_v_delta=v_delta)
+        return PreparedUniverse(
+            enc=enc, args=args, pod_cand=pod_cand, pod_run=pod_run,
+            node_idx=node_idx, v_delta=v_delta, v_count0_host=v_count0_host,
+        )
 
+    def evaluate_prepared(
+        self, prep: PreparedUniverse, subsets: Sequence[Sequence[int]]
+    ) -> List[SubsetVerdict]:
+        enc = prep.enc
+        out = simulate_subsets(
+            prep.args, prep.pod_cand, prep.pod_run, subsets, prep.node_idx,
+            self.max_claims, candidate_v_delta=prep.v_delta, verdict_only=True,
+            zone_engine=enc.V > 0, v_count0_host=prep.v_count0_host,
+        )
         T, Z, C = enc.T, len(enc.zones), len(enc.capacity_types)
-        used = np.asarray(out.state.used)
-        leftover = np.asarray(out.leftover).sum(axis=1)
-        c_mask = np.asarray(out.state.c_mask)[:, :, :T]
-        from ..solver.backend import unpack_zc_bits
-
-        zc_bits = np.asarray(out.state.c_zc_bits)  # [B, M]
+        leftover, used, zc_bits, c_mask = fetch_verdicts(out, T, len(subsets))
         B_, M_ = zc_bits.shape
         c_zone_flat, c_ct_flat = unpack_zc_bits(zc_bits.reshape(-1), Z, C)
         c_zone = c_zone_flat.reshape(B_, M_, Z)
@@ -133,3 +195,15 @@ class BatchedConsolidationEvaluator:
                 )
             )
         return verdicts
+
+    def evaluate(
+        self,
+        base_input: SolverInput,
+        candidate_pods: Dict[int, list],
+        candidate_node: Dict[int, str],
+        subsets: Sequence[Sequence[int]],
+    ) -> Optional[List[SubsetVerdict]]:
+        prep = self.prepare(base_input, candidate_pods, candidate_node)
+        if prep is None:
+            return None
+        return self.evaluate_prepared(prep, subsets)

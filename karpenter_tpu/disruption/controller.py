@@ -74,6 +74,8 @@ class DisruptionController:
         clock=time.monotonic,
         replacement_timeout_s: float = 10 * 60,
         multi_node_max_candidates: int = 100,
+        multi_node_max_candidates_batched: int = 10_000,
+        batch_phase_width: int = 32,
     ):
         self.store = store
         self.cluster = cluster
@@ -83,8 +85,15 @@ class DisruptionController:
         self.eviction = EvictionQueue(store)
         self.replacement_timeout_s = replacement_timeout_s
         self.multi_node_max_candidates = multi_node_max_candidates
+        # the batched (device) path spans the fleet: subset rows are cheap,
+        # so the heuristic candidate pool is 100× the sequential cap
+        # (config 5: 10k-node multi-node consolidation)
+        self.multi_node_max_candidates_batched = multi_node_max_candidates_batched
+        self.batch_phase_width = batch_phase_width
         self._command: Optional[Command] = None
         self._provisioner_helper: Optional[Provisioner] = None
+        self._prep_cache = None  # per-reconcile prepared batched universe
+        self.stats: Dict[str, int] = {}
         # TPU backend: evaluate candidate subsets as one vmapped batch
         # (solver/tpu/consolidate.py); sequential path remains ground truth
         from ..solver.backend import TPUSolver
@@ -100,6 +109,7 @@ class DisruptionController:
     def reconcile(self) -> bool:
         if self._command is not None:
             return self._progress_command()
+        self._prep_cache = None  # cluster state may have changed since last loop
         candidates = self._candidates()
         if not candidates:
             return False
@@ -249,26 +259,12 @@ class DisruptionController:
             for c in candidates
             if self._consolidation_enabled(c) and self._consolidate_after_ok(c)
         ]
-        verdicts = self._batched_verdicts(method, consolidatable, budgets)
         if method == "multi-consolidation":
+            if self._batched is not None:
+                cmd = self._multi_batched(consolidatable, budgets)
+                if cmd is not NotImplemented:
+                    return cmd
             pool = consolidatable[: self.multi_node_max_candidates]
-            if verdicts is not None:
-                # all prefixes were evaluated in one vmapped batch; take the
-                # largest feasible one (same answer the binary search finds)
-                for k in range(len(pool), 1, -1):
-                    v = verdicts.get(k)
-                    if v is None or not self._within_budget(pool[:k], method, budgets):
-                        continue
-                    old_price = sum(c.price for c in pool[:k])
-                    if v.has_replacement and (
-                        v.replacement_price is None or v.replacement_price >= old_price
-                    ):
-                        continue
-                    ok, claim_res = self._simulate(pool[:k], allow_replacement=True, require_cheaper=True)
-                    if ok:
-                        names = [self._create_replacement(claim_res)] if claim_res else []
-                        return Command(method, pool[:k], replacement_names=names)
-                return None
             # sequential: binary search the largest cost-ordered prefix that
             # consolidates (>=2 deletes, <=1 cheaper replacement)
             lo, hi = 2, len(pool)
@@ -292,35 +288,27 @@ class DisruptionController:
             return None
 
         # single-node consolidation
-        for i, c in enumerate(consolidatable):
+        if self._batched is not None:
+            cmd = self._single_batched(consolidatable, budgets)
+            if cmd is not NotImplemented:
+                return cmd
+        for c in consolidatable:
             if not self._within_budget([c], method, budgets):
                 continue
-            if verdicts is not None:
-                v = verdicts.get(i)
-                if v is None or not v.ok:
-                    continue
-                if v.has_replacement:
-                    if v.replacement_price is None or v.replacement_price >= c.price:
-                        continue
-                    if (
-                        c.claim.capacity_type == wk.CAPACITY_TYPE_SPOT
-                        and v.replacement_type_count < 15
-                    ):
-                        continue
             ok, claim_res = self._simulate([c], allow_replacement=True, require_cheaper=True)
             if ok and self._spot_flexibility_ok_res(c, claim_res):
                 names = [self._create_replacement(claim_res)] if claim_res else []
                 return Command(method, [c], replacement_names=names)
         return None
 
-    def _batched_verdicts(self, method: str, consolidatable: List[Candidate], budgets):
-        """One vmapped evaluation of every subset this method will consider.
-        Returns {key: SubsetVerdict} or None (no TPU backend / inexpressible
-        constraints). Keys: candidate index (single) or prefix length (multi)."""
-        if self._batched is None or not consolidatable:
-            return None
-        if method not in ("multi-consolidation", "single-consolidation"):
-            return None
+    # ------------------------------------------------ batched consolidation
+
+    def _prepared_universe(self, consolidatable: List[Candidate]):
+        """Encode + upload the simulation universe once per reconcile; both
+        consolidation methods evaluate subset batches against it."""
+        key = tuple(c.claim.name for c in consolidatable)
+        if self._prep_cache is not None and self._prep_cache[0] == key:
+            return self._prep_cache[1]
         import dataclasses as _dc
 
         if self._provisioner_helper is None:
@@ -334,22 +322,116 @@ class DisruptionController:
             for i, c in enumerate(consolidatable)
         }
         candidate_node = {i: c.node.meta.name for i, c in enumerate(consolidatable)}
-        if method == "single-consolidation":
-            subsets = [[i] for i in range(len(consolidatable))]
-            keys = list(range(len(consolidatable)))
-        else:
-            pool_n = min(len(consolidatable), self.multi_node_max_candidates)
-            if pool_n < 2:
-                return None
-            subsets = [list(range(k)) for k in range(2, pool_n + 1)]
-            keys = list(range(2, pool_n + 1))
         try:
-            verdicts = self._batched.evaluate(base, candidate_pods, candidate_node, subsets)
+            prep = self._batched.prepare(base, candidate_pods, candidate_node)
         except Exception:
+            prep = None
+        self._prep_cache = (key, prep)
+        return prep
+
+    def _max_budget_prefix(self, pool: List[Candidate], method: str, budgets) -> int:
+        """Largest k with pool[:k] within budget (monotone in k)."""
+        lo, hi = 0, len(pool)
+        while lo < hi:
+            mid = (lo + hi + 1) // 2
+            if self._within_budget(pool[:mid], method, budgets):
+                lo = mid
+            else:
+                hi = mid - 1
+        return lo
+
+    def _multi_batched(self, consolidatable: List[Candidate], budgets):
+        """Tiered largest-feasible-prefix search on the device evaluator.
+
+        Phase 1 probes ≤batch_phase_width evenly spaced prefix lengths over
+        the whole (budget-clamped) pool; each later phase refines between the
+        largest accepted probe and the next probe above it, until the gap is
+        fully enumerated — O(log_width(N)) vmapped dispatches instead of
+        O(N) sequential re-solves (config 5; disruption.md:104-106's
+        heuristic subset, spanning the fleet instead of a fixed cap).
+        Returns Command | None, or NotImplemented to use the sequential path.
+        """
+        method = "multi-consolidation"
+        pool = consolidatable[: self.multi_node_max_candidates_batched]
+        kmax = min(self._max_budget_prefix(pool, method, budgets), len(pool))
+        if kmax < 2:
+            return None  # budget admits no >=2-node command this loop
+        prep = self._prepared_universe(consolidatable)
+        if prep is None:
+            return NotImplemented
+        cum_price = [0.0]
+        for c in pool:
+            cum_price.append(cum_price[-1] + c.price)
+
+        def acceptable(k: int, v) -> bool:
+            if not v.ok:
+                return False
+            if v.has_replacement and (
+                v.replacement_price is None or v.replacement_price >= cum_price[k]
+            ):
+                return False
+            return True
+
+        from .batched import tiered_prefix_search
+
+        def eval_ks(ks):
+            return self._batched.evaluate_prepared(
+                prep, [list(range(k)) for k in ks]
+            )
+
+        try:
+            _k_best, probed, _d = tiered_prefix_search(
+                eval_ks, kmax, acceptable, width=max(self.batch_phase_width, 2)
+            )
+        except Exception:
+            return NotImplemented  # device failure mid-search: sequential path
+        self.stats["batched_prefixes_evaluated"] = (
+            self.stats.get("batched_prefixes_evaluated", 0) + len(probed)
+        )
+        # validate accepted prefixes, largest first (the winning command is
+        # re-materialized sequentially, so behavior stays bit-identical)
+        for k in sorted((k for k, v in probed.items() if acceptable(k, v)), reverse=True):
+            ok, claim_res = self._simulate(pool[:k], allow_replacement=True, require_cheaper=True)
+            if ok:
+                names = [self._create_replacement(claim_res)] if claim_res else []
+                return Command(method, pool[:k], replacement_names=names)
+        return None
+
+    def _single_batched(self, consolidatable: List[Candidate], budgets):
+        """Chunked single-candidate verdicts in cost order; first acceptable
+        chunk short-circuits (the sequential scan's first-success order)."""
+        method = "single-consolidation"
+        if not consolidatable:
             return None
-        if verdicts is None:
-            return None
-        return dict(zip(keys, verdicts))
+        prep = self._prepared_universe(consolidatable)
+        if prep is None:
+            return NotImplemented
+        chunk = max(self.batch_phase_width, 2) * 2
+        for start in range(0, len(consolidatable), chunk):
+            idxs = list(range(start, min(start + chunk, len(consolidatable))))
+            try:
+                verdicts = self._batched.evaluate_prepared(prep, [[i] for i in idxs])
+            except Exception:
+                return NotImplemented  # device failure: sequential path
+            for i, v in zip(idxs, verdicts):
+                c = consolidatable[i]
+                if not self._within_budget([c], method, budgets):
+                    continue
+                if not v.ok:
+                    continue
+                if v.has_replacement:
+                    if v.replacement_price is None or v.replacement_price >= c.price:
+                        continue
+                    if (
+                        c.claim.capacity_type == wk.CAPACITY_TYPE_SPOT
+                        and v.replacement_type_count < 15
+                    ):
+                        continue
+                ok, claim_res = self._simulate([c], allow_replacement=True, require_cheaper=True)
+                if ok and self._spot_flexibility_ok_res(c, claim_res):
+                    names = [self._create_replacement(claim_res)] if claim_res else []
+                    return Command(method, [c], replacement_names=names)
+        return None
 
     def _consolidation_enabled(self, c: Candidate) -> bool:
         for p in self.store.list(st.NODEPOOLS):

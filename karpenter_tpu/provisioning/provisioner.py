@@ -118,10 +118,19 @@ class Provisioner:
         self._first_seen = None
         t0 = time.perf_counter()
         inp = self.build_input(pending)
-        result = self.solver.solve(inp)
+        solve_async = getattr(self.solver, "solve_async", None)
+        if solve_async is not None:
+            # async seam: kernel + link transfer run while the claim-creation
+            # lookups below are prepared on host (backend.AsyncSolve)
+            handle = solve_async(inp)
+            nodepools: Dict[str, NodePool] = {
+                p.name: p for p in self.store.list(st.NODEPOOLS)
+            }
+            result = handle.result()
+        else:
+            result = self.solver.solve(inp)
+            nodepools = {p.name: p for p in self.store.list(st.NODEPOOLS)}
         PROVISIONER_SCHEDULING_DURATION.observe(time.perf_counter() - t0)
-
-        nodepools: Dict[str, NodePool] = {p.name: p for p in self.store.list(st.NODEPOOLS)}
         did = False
         for claim_res in result.claims:
             np_obj = nodepools.get(claim_res.nodepool)

@@ -138,6 +138,15 @@ def unpack_zc_bits(bits: np.ndarray, Z: int, C: int) -> Tuple[np.ndarray, np.nda
     return joint.any(axis=2), joint.any(axis=1)
 
 
+# Padded+uploaded CORE kernel args cached across solves: the pod/pool/type
+# stage of an encode is shared by every solve of an unchanged pending set
+# (encode._EncodeCore), so its ~25 padded arrays upload once and stay
+# device-resident; only node-state and pool-usage arrays rebuild per solve.
+# Entries pin the identity arrays they key on so ids can't be recycled.
+_CORE_ARGS_CACHE: dict = {}
+_CORE_ARGS_CACHE_MAX = 4
+
+
 def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
     """The padded positional arrays for tpu.ffd.ffd_solve (order = ffd.ARG_SPEC),
     plus dims.
@@ -166,66 +175,112 @@ def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
     Qp = bucket(enc.Q, 8, 8)
     Vp = bucket(enc.V, 4, 4)
     W = (Gp + 31) // 32
-    # per-zone joint-bit columns: bit z*C+c for every c
-    zone_col = np.zeros(Z, dtype=np.uint32)
-    for z in range(Z):
-        for c in range(C):
-            zone_col[z] |= np.uint32(1) << np.uint32(z * C + c)
 
     def pad(a, shape, fill=0):
         out = np.full(shape, fill, dtype=a.dtype)
         out[tuple(slice(0, s) for s in a.shape)] = a
         return out
 
-    type_charge = np.where(enc.charge_axes[None, :], enc.type_capacity, 0).astype(np.int32)
-    group_zc = pack_bits32(
-        (enc.group_zone[:, :, None] & enc.group_ct[:, None, :]).reshape(G, Z * C)
-    )
-    pool_zc = pack_bits32(
-        (enc.pool_zone[:, :, None] & enc.pool_ct[:, None, :]).reshape(P, Z * C)
-    )
-    offer_zc = pack_bits32(enc.offer_avail.reshape(T, Z * C))
-    # pairwise-INcompatibility words; padded groups are compatible with all
-    pair_nok = pack_words(~pad(enc.group_pair, (Gp, Gp), fill=True), Gp)
+    ckey = (id(enc.run_group), R, Z, C, Sp, Gp, Tp, Pp, Qp, Vp)
+    hit = _CORE_ARGS_CACHE.get(ckey)
+    if hit is not None and hit[0] is enc.run_group:
+        core_args = hit[1]
+    else:
+        # per-zone joint-bit columns: bit z*C+c for every c
+        zone_col = np.zeros(Z, dtype=np.uint32)
+        for z in range(Z):
+            for c in range(C):
+                zone_col[z] |= np.uint32(1) << np.uint32(z * C + c)
+        type_charge = np.where(
+            enc.charge_axes[None, :], enc.type_capacity, 0
+        ).astype(np.int32)
+        group_zc = pack_bits32(
+            (enc.group_zone[:, :, None] & enc.group_ct[:, None, :]).reshape(G, Z * C)
+        )
+        pool_zc = pack_bits32(
+            (enc.pool_zone[:, :, None] & enc.pool_ct[:, None, :]).reshape(P, Z * C)
+        )
+        offer_zc = pack_bits32(enc.offer_avail.reshape(T, Z * C))
+        # pairwise-INcompatibility words; padded groups are compatible with all
+        pair_nok = pack_words(~pad(enc.group_pair, (Gp, Gp), fill=True), Gp)
+        core_args = {
+            "run_group": jnp.asarray(pad(enc.run_group, (Sp,))),
+            "run_count": jnp.asarray(pad(enc.run_count, (Sp,))),
+            "group_req": jnp.asarray(pad(enc.group_req, (Gp, R))),
+            "group_compat_t": jnp.asarray(pad(enc.group_compat_t, (Gp, Tp))),
+            "group_zc_bits": jnp.asarray(pad(group_zc, (Gp,))),
+            "group_pool": jnp.asarray(pad(enc.group_pool, (Gp, Pp))),
+            "group_pair_nok": jnp.asarray(pair_nok),
+            "group_device": jnp.asarray(pad(~enc.group_fallback, (Gp,))),
+            "type_alloc": jnp.asarray(pad(enc.type_alloc, (Tp, R))),
+            "type_charge": jnp.asarray(pad(type_charge, (Tp, R))),
+            "offer_zc_bits": jnp.asarray(pad(offer_zc, (Tp,))),
+            "pool_type": jnp.asarray(pad(enc.pool_type, (Pp, Tp))),
+            "pool_zc_bits": jnp.asarray(pad(pool_zc, (Pp,))),
+            "pool_daemon": jnp.asarray(pad(enc.pool_daemon, (Pp, R))),
+            "q_member": jnp.asarray(pad(enc.q_member, (Gp, Qp))),
+            "q_owner": jnp.asarray(pad(enc.q_owner, (Gp, Qp))),
+            "q_kind": jnp.asarray(pad(enc.q_kind, (Qp,))),
+            "q_cap": jnp.asarray(pad(enc.q_cap, (Qp,), fill=1)),
+            "v_member": jnp.asarray(pad(enc.v_member, (Gp, Vp))),
+            "v_owner": jnp.asarray(pad(enc.v_owner, (Gp, Vp))),
+            "v_kind": jnp.asarray(pad(enc.v_kind, (Vp,))),
+            "v_cap": jnp.asarray(pad(enc.v_cap, (Vp,), fill=1)),
+            "v_primary": jnp.asarray(pad(enc.v_primary, (Gp,), fill=np.int32(-1))),
+            "v_aff": jnp.asarray(pad(enc.v_aff, (Gp,), fill=np.int32(-1))),
+            "zone_col_mask": jnp.asarray(zone_col),
+        }
+        if len(_CORE_ARGS_CACHE) >= _CORE_ARGS_CACHE_MAX:
+            _CORE_ARGS_CACHE.pop(next(iter(_CORE_ARGS_CACHE)))
+        _CORE_ARGS_CACHE[ckey] = (enc.run_group, core_args)
 
+    ca = core_args
     args = (
-        jnp.asarray(pad(enc.run_group, (Sp,))),
-        jnp.asarray(pad(enc.run_count, (Sp,))),
-        jnp.asarray(pad(enc.group_req, (Gp, R))),
-        jnp.asarray(pad(enc.group_compat_t, (Gp, Tp))),
-        jnp.asarray(pad(group_zc, (Gp,))),
-        jnp.asarray(pad(enc.group_pool, (Gp, Pp))),
-        jnp.asarray(pair_nok),
-        jnp.asarray(pad(~enc.group_fallback, (Gp,))),
-        jnp.asarray(pad(enc.type_alloc, (Tp, R))),
-        jnp.asarray(pad(type_charge, (Tp, R))),
-        jnp.asarray(pad(offer_zc, (Tp,))),
-        jnp.asarray(pad(enc.pool_type, (Pp, Tp))),
-        jnp.asarray(pad(pool_zc, (Pp,))),
-        jnp.asarray(pad(enc.pool_daemon, (Pp, R))),
+        ca["run_group"],
+        ca["run_count"],
+        ca["group_req"],
+        ca["group_compat_t"],
+        ca["group_zc_bits"],
+        ca["group_pool"],
+        ca["group_pair_nok"],
+        ca["group_device"],
+        ca["type_alloc"],
+        ca["type_charge"],
+        ca["offer_zc_bits"],
+        ca["pool_type"],
+        ca["pool_zc_bits"],
+        ca["pool_daemon"],
         jnp.asarray(pad(enc.pool_limit, (Pp, R), fill=INT32_MAX_NP)),
         jnp.asarray(pad(enc.pool_usage, (Pp, R))),
         jnp.asarray(pad(enc.node_free, (Ep, R))),
         jnp.asarray(pad(enc.node_compat, (Gp, Ep))),
-        jnp.asarray(pad(enc.q_member, (Gp, Qp))),
-        jnp.asarray(pad(enc.q_owner, (Gp, Qp))),
-        jnp.asarray(pad(enc.q_kind, (Qp,))),
-        jnp.asarray(pad(enc.q_cap, (Qp,), fill=1)),
+        ca["q_member"],
+        ca["q_owner"],
+        ca["q_kind"],
+        ca["q_cap"],
         jnp.asarray(pad(enc.node_q_member, (Ep, Qp))),
         jnp.asarray(pad(enc.node_q_owner, (Ep, Qp))),
-        jnp.asarray(pad(enc.v_member, (Gp, Vp))),
-        jnp.asarray(pad(enc.v_owner, (Gp, Vp))),
-        jnp.asarray(pad(enc.v_kind, (Vp,))),
-        jnp.asarray(pad(enc.v_cap, (Vp,), fill=1)),
-        jnp.asarray(pad(enc.v_primary, (Gp,), fill=np.int32(-1))),
-        jnp.asarray(pad(enc.v_aff, (Gp,), fill=np.int32(-1))),
+        ca["v_member"],
+        ca["v_owner"],
+        ca["v_kind"],
+        ca["v_cap"],
+        ca["v_primary"],
+        ca["v_aff"],
         jnp.asarray(pad(enc.v_count0, (Vp, Z))),
         jnp.asarray(pad(enc.node_zone, (Ep,), fill=np.int32(-1))),
-        jnp.asarray(zone_col),
+        ca["zone_col_mask"],
     )
     from .tpu.ffd import ARG_SPEC
 
     assert len(args) == len(ARG_SPEC), "kernel_args out of sync with ffd.ARG_SPEC"
+    assert list(ARG_SPEC) == [
+        "run_group", "run_count", "group_req", "group_compat_t", "group_zc_bits",
+        "group_pool", "group_pair_nok", "group_device", "type_alloc", "type_charge",
+        "offer_zc_bits", "pool_type", "pool_zc_bits", "pool_daemon", "pool_limit",
+        "pool_usage0", "node_free", "node_compat", "q_member", "q_owner", "q_kind",
+        "q_cap", "node_q_member", "node_q_owner", "v_member", "v_owner", "v_kind",
+        "v_cap", "v_primary", "v_aff", "v_count0", "node_zone", "zone_col_mask",
+    ], "kernel_args order out of sync with ffd.ARG_SPEC"
     dims = dict(
         S=S, G=G, T=T, E=E, P=P, R=R, Z=Z, C=C,
         Sp=Sp, Gp=Gp, Tp=Tp, Ep=Ep, Pp=Pp, Qp=Qp, Vp=Vp, W=W,
@@ -252,7 +307,61 @@ def _pack_outputs(out):
     buffer (bool mask rows bit-packed to words, uint32 bitcast) so the
     device→host hop is a single transfer: on a tunneled link each fetched
     array pays per-message overhead on top of the shared roundtrip, and the
-    9-array fetch measured ~2× the bare RTT."""
+    9-array fetch measured ~2× the bare RTT.
+
+    take_e/take_c dominate the buffer; they pack as uint16 pairs (per-run
+    takes are bounded by per-node pod capacity in practice). A leading
+    overflow flag records any value > 65535 — the host re-fetches wide via
+    _pack_outputs_wide in that (pathological) case, so correctness never
+    depends on the bound."""
+    import jax
+    import jax.numpy as jnp
+
+    def go(out):
+        st = out.state
+        b32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
+
+        def pack16(x):
+            flat = x.ravel()
+            n = flat.shape[0]
+            flat = jnp.pad(flat, (0, (-n) % 2))
+            u16 = flat.astype(jnp.uint16).reshape(-1, 2)
+            return jax.lax.bitcast_convert_type(u16, jnp.int32)
+
+        M, Tp = st.c_mask.shape
+        W = (Tp + 31) // 32
+        cm = jnp.pad(st.c_mask, ((0, 0), (0, W * 32 - Tp))).reshape(M, W, 32)
+        weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+        cm_words = (cm.astype(jnp.uint32) * weights[None, None, :]).sum(
+            axis=2, dtype=jnp.uint32
+        )
+        overflow = (
+            (jnp.max(out.take_e, initial=0) > 65535)
+            | (jnp.max(out.take_c, initial=0) > 65535)
+        ).astype(jnp.int32)
+        parts = [
+            overflow.reshape(1),
+            pack16(out.take_e),
+            pack16(out.take_c),
+            out.leftover.ravel(),
+            b32(cm_words).ravel(),
+            b32(st.c_zc_bits).ravel(),
+            b32(st.c_gbits).ravel(),
+            st.c_pool.ravel(),
+            st.c_cum.ravel(),
+            st.used.reshape(1),
+        ]
+        return jnp.concatenate(parts)
+
+    fn = _PACK_CACHE.get("pack16")
+    if fn is None:
+        fn = jax.jit(go)
+        _PACK_CACHE["pack16"] = fn
+    return fn(out)
+
+
+def _pack_outputs_wide(out):
+    """Full-width (int32) packing — the overflow fallback of _pack_outputs."""
     import jax
     import jax.numpy as jnp
 
@@ -279,11 +388,10 @@ def _pack_outputs(out):
         ]
         return jnp.concatenate(parts)
 
-    key = "pack"
-    fn = _PACK_CACHE.get(key)
+    fn = _PACK_CACHE.get("pack_wide")
     if fn is None:
         fn = jax.jit(go)
-        _PACK_CACHE[key] = fn
+        _PACK_CACHE["pack_wide"] = fn
     return fn(out)
 
 
@@ -300,6 +408,25 @@ def _unpack_flat(flat: np.ndarray, shapes: dict) -> dict:
             a = a.view(np.uint32)
         res[name] = a.reshape(shape) if shape else a[0]
     return res
+
+
+class AsyncSolve:
+    """Handle for an in-flight solve: the kernel is dispatched and the packed
+    output is streaming to the host; result() blocks, decodes, and returns
+    the SolverResult. Lets a control loop overlap host encode/decode of one
+    solve with device compute + link transfer of another (the tunnel RTT is
+    the e2e seam's floor — pipelining hides it across solves)."""
+
+    def __init__(self, fn):
+        self._fn = fn
+        self._result: Optional[SolverResult] = None
+        self._done = False
+
+    def result(self) -> SolverResult:
+        if not self._done:
+            self._result = self._fn()
+            self._done = True
+        return self._result
 
 
 class TPUSolver(Solver):
@@ -322,6 +449,10 @@ class TPUSolver(Solver):
         self.stats: Dict[str, int] = {"device_solves": 0, "fallback_solves": 0}
 
     def solve(self, inp: SolverInput) -> SolverResult:
+        return self.solve_async(inp).result()
+
+    def solve_async(self, inp: SolverInput) -> AsyncSolve:
+        """Encode + dispatch now; fetch + decode when result() is called."""
         qinp = quantize_input(inp)
         enc = encode(qinp)
         if (
@@ -338,13 +469,21 @@ class TPUSolver(Solver):
             # affinity, and duplicate node hostnames. Whole-solve fallback
             # keeps semantics unforked.
             self.stats["fallback_solves"] += 1
-            return self.fallback.solve(qinp)
-        out = self._device_solve(enc)
-        if out is None:
+            return AsyncSolve(lambda: self.fallback.solve(qinp))
+        handle = self._device_solve_async(enc)
+        if handle is None:
             self.stats["fallback_solves"] += 1
-            return self.fallback.solve(qinp)
-        self.stats["device_solves"] += 1
-        return out
+            return AsyncSolve(lambda: self.fallback.solve(qinp))
+
+        def finish():
+            out = handle()
+            if out is None:
+                self.stats["fallback_solves"] += 1
+                return self.fallback.solve(qinp)
+            self.stats["device_solves"] += 1
+            return out
+
+        return AsyncSolve(finish)
 
     # -- device path --------------------------------------------------------
 
@@ -355,9 +494,71 @@ class TPUSolver(Solver):
         avoids recompilation storms)."""
         return max(floor, ((n + mult - 1) // mult) * mult)
 
-    def _device_solve(self, enc: EncodedInput) -> Optional[SolverResult]:
+    def _dispatch(self, enc: EncodedInput, args, M: int):
+        """Dispatch kernel + output packing; start the device→host copy.
+        Returns (flat_device_array, unpack_fn)."""
         from .tpu.ffd import ffd_solve
 
+        out = ffd_solve(*args, max_claims=M, zone_engine=enc.V > 0)
+        # ONE device→host transfer: all outputs packed into a single
+        # int32 buffer on device (bit-packed masks, uint16 takes), so the
+        # tunnel pays one roundtrip per solve — not one per output array
+        # (VERDICT r2 'what's weak' #1: 9 sync fetches dominated the seam).
+        Sp, Ep = out.take_e.shape
+        Mb, Tp = out.state.c_mask.shape
+        Wm = (Tp + 31) // 32
+        Wg = out.state.c_gbits.shape[1]
+        Rr = out.state.c_cum.shape[1]
+
+        wide_shapes = {
+            "take_e": ((Sp, Ep), "i32"),
+            "take_c": ((Sp, Mb), "i32"),
+            "leftover": ((Sp,), "i32"),
+            "c_mask_words": ((Mb, Wm), "u32"),
+            "c_zc_bits": ((Mb,), "u32"),
+            "c_gbits": ((Mb, Wg), "u32"),
+            "c_pool": ((Mb,), "i32"),
+            "c_cum": ((Mb, Rr), "i32"),
+            "used": ((), "i32"),
+        }
+
+        def unpack(flat: np.ndarray) -> dict:
+            if flat[0]:  # take overflowed uint16 — re-fetch full width (rare)
+                return _unpack_flat(np.asarray(_pack_outputs_wide(out)), wide_shapes)
+            off = 1
+            f = {}
+            for name, (sh, n) in (
+                ("take_e", ((Sp, Ep), Sp * Ep)),
+                ("take_c", ((Sp, Mb), Sp * Mb)),
+            ):
+                w = (n + 1) // 2
+                f[name] = (
+                    flat[off : off + w]
+                    .view(np.uint16)[:n]
+                    .astype(np.int32)
+                    .reshape(sh)
+                )
+                off += w
+            rest = {
+                "leftover": ((Sp,), "i32"),
+                "c_mask_words": ((Mb, Wm), "u32"),
+                "c_zc_bits": ((Mb,), "u32"),
+                "c_gbits": ((Mb, Wg), "u32"),
+                "c_pool": ((Mb,), "i32"),
+                "c_cum": ((Mb, Rr), "i32"),
+                "used": ((), "i32"),
+            }
+            f.update(_unpack_flat(flat[off:], rest))
+            return f
+
+        flat_dev = _pack_outputs(out)
+        try:
+            flat_dev.copy_to_host_async()
+        except AttributeError:
+            pass  # backend without async host copies: asarray will block
+        return flat_dev, unpack
+
+    def _device_solve_async(self, enc: EncodedInput):
         try:
             args, dims = kernel_args(enc, self._bucket)
         except UnpackableInput:
@@ -370,44 +571,30 @@ class TPUSolver(Solver):
         # saturation — each M is a cached compile bucket, and a too-big M
         # inflates every [M,T] intermediate (VERDICT r1: M=8192 for a
         # 462-claim solve was ~17× wasted bandwidth).
-        M = initial_claim_bucket(total_pods, self.max_claims)
-        while True:
-            out = ffd_solve(*args, max_claims=M)
-            # ONE device→host transfer: all outputs packed into a single
-            # int32 buffer on device (bit-packed masks), so the tunnel pays
-            # one roundtrip per solve — not one per output array (VERDICT r2
-            # 'what's weak' #1: 9 sync fetches dominated the e2e seam).
-            Sp, Ep = out.take_e.shape
-            Mb, Tp = out.state.c_mask.shape
-            Wm = (Tp + 31) // 32
-            Wg = out.state.c_gbits.shape[1]
-            Rr = out.state.c_cum.shape[1]
-            shapes = {
-                "take_e": ((Sp, Ep), "i32"),
-                "take_c": ((Sp, Mb), "i32"),
-                "leftover": ((Sp,), "i32"),
-                "c_mask_words": ((Mb, Wm), "u32"),
-                "c_zc_bits": ((Mb,), "u32"),
-                "c_gbits": ((Mb, Wg), "u32"),
-                "c_pool": ((Mb,), "i32"),
-                "c_cum": ((Mb, Rr), "i32"),
-                "used": ((), "i32"),
-            }
-            flat = np.asarray(_pack_outputs(out))
-            f = _unpack_flat(flat, shapes)
-            used = int(f["used"])
-            if used < M:
-                break
-            if M >= self.max_claims:
-                return None  # true overflow — replay on fallback
-            M = min(M * 2, self.max_claims)
+        M0 = initial_claim_bucket(total_pods, self.max_claims)
+        flat_dev, unpack = self._dispatch(enc, args, M0)
 
-        c_mask = _unpack_words(f["c_mask_words"], T)
-        c_zone, c_ct = unpack_zc_bits(f["c_zc_bits"], Z, C)
-        c_gmask = _unpack_gmask(f["c_gbits"], G)
-        return decode(enc, f["take_e"][:S, :E], f["take_c"][:S],
-                      f["leftover"][:S], c_mask,
-                      c_zone, c_ct, f["c_pool"], c_gmask, f["c_cum"], used)
+        def finish() -> Optional[SolverResult]:
+            M = M0
+            flat, up = np.asarray(flat_dev), unpack
+            while True:
+                f = up(flat)
+                used = int(f["used"])
+                if used < M:
+                    break
+                if M >= self.max_claims:
+                    return None  # true overflow — replay on fallback
+                M = min(M * 2, self.max_claims)
+                fd, up = self._dispatch(enc, args, M)
+                flat = np.asarray(fd)
+            c_mask = _unpack_words(f["c_mask_words"], T)
+            c_zone, c_ct = unpack_zc_bits(f["c_zc_bits"], Z, C)
+            c_gmask = _unpack_gmask(f["c_gbits"], G)
+            return decode(enc, f["take_e"][:S, :E], f["take_c"][:S],
+                          f["leftover"][:S], c_mask,
+                          c_zone, c_ct, f["c_pool"], c_gmask, f["c_cum"], used)
+
+        return finish
 
 
 def _unpack_words(words: np.ndarray, width: int) -> np.ndarray:

@@ -321,15 +321,35 @@ def _already_mib_aligned(res: Resources) -> bool:
     return True
 
 
-def quantize_input(inp: SolverInput) -> SolverInput:
-    """A structurally-shared copy of `inp` with all resources MiB-quantized —
-    what the hybrid production path and the parity tests feed the reference
-    solver so both backends see identical numbers. Only fields that actually
-    need quantizing become fresh objects; everything else is shared IDENTITY
-    (nothing downstream mutates pods/types), which keeps per-pod caches
-    (signature, FFD key) warm across solves — typical requests like "1Gi"
-    are already MiB-aligned, so a 50k-pod surge copies nothing."""
+_QUANT_PODS_CACHE: Dict[tuple, list] = {}
+_QUANT_PODS_CACHE_MAX = 4
+
+
+def _quantized_pods(pods: list) -> list:
+    """MiB-quantized pod list, cached by (mutation epoch, identity
+    fingerprint): a control loop re-quantizing an unchanged 50k-pod surge
+    pays a fingerprint pass instead of a per-pod alignment walk."""
     from dataclasses import replace as _replace
+
+    from ..api.objects import pod_mutation_epoch
+
+    n = len(pods)
+    ids = None
+    if n > 64:
+        ids = np.fromiter(map(id, pods), np.uint64, n)
+        key = (
+            pod_mutation_epoch(),
+            n,
+            int(ids.sum(dtype=np.uint64)),
+            int(np.bitwise_xor.reduce(ids)),
+        )
+        hit = _QUANT_PODS_CACHE.get(key)
+        # exact id-array compare: the aggregate fingerprint can collide
+        # between distinct live pod sets; pinned entries make ids stable
+        if hit is not None and np.array_equal(ids, hit[0]):
+            return hit[2]
+    else:
+        key = None
 
     def qpod(p):
         # alignment verdict cached on the pod (invalidated by field assignment,
@@ -343,25 +363,181 @@ def quantize_input(inp: SolverInput) -> SolverInput:
             return p
         return _replace(p, requests=quantize_resources(p.requests, ceil=True))
 
+    out = [qpod(p) for p in pods]
+    if key is not None:
+        if len(_QUANT_PODS_CACHE) >= _QUANT_PODS_CACHE_MAX:
+            _QUANT_PODS_CACHE.pop(next(iter(_QUANT_PODS_CACHE)))
+        # pin the INPUT pods too: unaligned pods are replaced in `out`, and
+        # without a reference the originals could be freed and their ids
+        # recycled into a colliding fingerprint (fresh pods never bump the
+        # mutation epoch)
+        _QUANT_PODS_CACHE[key] = (ids, tuple(pods), out)
+    return out
+
+
+def quantize_input(inp: SolverInput) -> SolverInput:
+    """A structurally-shared copy of `inp` with all resources MiB-quantized —
+    what the hybrid production path and the parity tests feed the reference
+    solver so both backends see identical numbers. Only fields that actually
+    need quantizing become fresh objects; everything else is shared IDENTITY
+    (nothing downstream mutates pods/types), which keeps per-pod caches
+    (signature, FFD key) warm across solves — typical requests like "1Gi"
+    are already MiB-aligned, so a 50k-pod surge copies nothing."""
+    from dataclasses import replace as _replace
+
     def qnode(n):
         if _already_mib_aligned(n.free):
             return n
         return _replace(n, free=quantize_resources(n.free, ceil=False))
 
     return SolverInput(
-        pods=[qpod(p) for p in inp.pods],
+        pods=_quantized_pods(inp.pods),
         nodes=[qnode(n) for n in inp.nodes],
         nodepools=[
             _replace(pool, instance_types=[_quantize_type(it) for it in pool.instance_types])
             for pool in inp.nodepools
         ],
-        daemonset_pods=[qpod(p) for p in inp.daemonset_pods],
+        daemonset_pods=_quantized_pods(inp.daemonset_pods),
         zones=inp.zones,
         capacity_types=inp.capacity_types,
     )
 
 
+@dataclass
+class _EncodeCore:
+    """The pod/pool/type-dependent stage of encode(), cached across solves.
+
+    Keyed by (pod-mutation epoch, identity fingerprint of the filtered pod
+    set, pool/type content-and-identity keys, axes): a control loop that
+    re-solves an unchanged pending surge pays O(1) host work instead of the
+    O(pods) sort/signature/grouping passes (the e2e Solve() seam's dominant
+    host cost at 50k pods). Existing-node tensors and pool usage/limits are
+    rebuilt every call — they change between solves."""
+
+    zones: List[str]
+    cts: List[str]
+    type_names: List[str]
+    pool_names: List[str]
+    rkeys: List[str]
+    charge_axes: np.ndarray
+    group_pods: List[List[Pod]]
+    group_req: np.ndarray
+    group_compat_t: np.ndarray
+    group_zone: np.ndarray
+    group_ct: np.ndarray
+    group_pool: np.ndarray
+    group_pair: np.ndarray
+    fallback: np.ndarray
+    run_group: np.ndarray
+    run_count: np.ndarray
+    sorted_uids: np.ndarray
+    group_reqsets: List[Requirements]
+    has_topo: bool
+    has_aff: bool
+    hostname_sigs: Dict[tuple, int]
+    zone_sigs: Dict[tuple, int]
+    q_member: np.ndarray
+    q_owner: np.ndarray
+    q_kind: np.ndarray
+    q_cap: np.ndarray
+    v_member: np.ndarray
+    v_owner: np.ndarray
+    v_kind: np.ndarray
+    v_cap: np.ndarray
+    v_primary: np.ndarray
+    v_aff: np.ndarray
+    type_alloc: np.ndarray
+    type_capacity: np.ndarray
+    offer_avail: np.ndarray
+    offer_price: np.ndarray
+    pool_type: np.ndarray
+    pool_zone: np.ndarray
+    pool_ct: np.ndarray
+    pool_daemon: np.ndarray
+    all_req_keys: List[str]
+    zid: Dict[str, int]
+    cid: Dict[str, int]
+
+
+_CORE_CACHE: Dict[tuple, _EncodeCore] = {}
+_CORE_CACHE_MAX = 4
+
+
+def _reqs_key(reqs: Requirements) -> tuple:
+    return tuple(
+        sorted(
+            (k, r.complement, tuple(sorted(r.values)), r.greater_than,
+             r.less_than, r.require_present)
+            for k, r in reqs.items()
+        )
+    )
+
+
+def _core_key(pods_f: List[Pod], inp: SolverInput) -> Tuple[tuple, np.ndarray]:
+    """Cache key + the exact ordered pod-id array. The key's pod part is an
+    aggregate fingerprint (fast dict hash); a hit must ALSO compare the id
+    array exactly — aggregates can collide between distinct live sets. Pinning
+    (group_pods in the cached core, instance types in the entry) guarantees a
+    matching id refers to the same live object, never a recycled address."""
+    from ..api.objects import pod_mutation_epoch
+
+    n = len(pods_f)
+    if n:
+        ids = np.fromiter(map(id, pods_f), np.uint64, n)
+        pod_fp = (n, int(ids.sum(dtype=np.uint64)), int(np.bitwise_xor.reduce(ids)))
+    else:
+        ids = np.zeros(0, np.uint64)
+        pod_fp = (0, 0, 0)
+    pools_key = tuple(
+        (
+            p.name,
+            p.weight,
+            _reqs_key(p.requirements),
+            tuple((t.key, t.value, t.effect) for t in p.taints),
+            tuple(map(id, p.instance_types)),
+        )
+        for p in inp.nodepools
+    )
+    ds_key = tuple(
+        (
+            tuple(sorted(dp.requests.items())),
+            tuple((t.key, t.operator, t.value, t.effect) for t in dp.tolerations),
+            _reqs_key(dp.scheduling_requirements()),
+        )
+        for dp in inp.daemonset_pods
+    )
+    return (
+        (
+            pod_mutation_epoch(),
+            pod_fp,
+            pools_key,
+            ds_key,
+            tuple(inp.zones),
+            tuple(inp.capacity_types),
+        ),
+        ids,
+    )
+
+
 def encode(inp: SolverInput) -> EncodedInput:
+    pods_f = [p for p in inp.pods if not p.scheduling_gated and p.node_name is None]
+    key, ids = _core_key(pods_f, inp)
+    ent = _CORE_CACHE.get(key)
+    if ent is not None and np.array_equal(ids, ent[0]):
+        core = ent[1]
+    else:
+        core = _build_core(inp, pods_f)
+        if len(_CORE_CACHE) >= _CORE_CACHE_MAX:
+            _CORE_CACHE.pop(next(iter(_CORE_CACHE)))
+        # entry pins the instance-type objects whose ids appear in the key
+        # (pods are pinned via core.group_pods), so ids can't be recycled
+        # while the entry lives
+        type_pins = tuple(it for p in inp.nodepools for it in p.instance_types)
+        _CORE_CACHE[key] = (ids, core, type_pins)
+    return _encode_with_nodes(core, inp)
+
+
+def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
     # ---- axes -------------------------------------------------------------
     zones = list(inp.zones)
     cts = list(inp.capacity_types)
@@ -379,9 +555,7 @@ def encode(inp: SolverInput) -> EncodedInput:
     T = len(type_names)
 
     # ---- groups (vectorized: the only O(pods) work is cached-key gathering)
-    pods_sorted, sigs, sorted_uids, sigs_interned = ffd_sort_with_sigs(
-        [p for p in inp.pods if not p.scheduling_gated and p.node_name is None]
-    )
+    pods_sorted, sigs, sorted_uids, sigs_interned = ffd_sort_with_sigs(pods_f)
     n_pods = len(pods_sorted)
     if n_pods:
         # group ids in first-appearance order over the sorted sequence
@@ -612,14 +786,12 @@ def encode(inp: SolverInput) -> EncodedInput:
             if key is not None:
                 _GROUP_COMPAT_CACHE[key] = (types_ids, types_tuple, row)
 
-    # ---- pool tensors -------------------------------------------------------
+    # ---- pool tensors (usage/limits are per-solve: _encode_with_nodes) -----
     P = len(pools)
     pool_type = np.zeros((P, T), dtype=bool)
     pool_zone = np.zeros((P, len(zones)), dtype=bool)
     pool_ct = np.zeros((P, len(cts)), dtype=bool)
     pool_daemon = np.zeros((P, R), dtype=np.int32)
-    pool_limit = np.full((P, R), INT32_MAX, dtype=np.int32)
-    pool_usage = np.zeros((P, R), dtype=np.int32)
     group_pool = np.zeros((G, P), dtype=bool)
     for p, pool in enumerate(pools):
         in_pool = {it.name for it in pool.instance_types}
@@ -650,10 +822,6 @@ def encode(inp: SolverInput) -> EncodedInput:
             dcount += 1
         dres[PODS] = dres.get_(PODS) + dcount
         pool_daemon[p] = _quantize(dres, rkeys, ceil=True)
-        for i, k in enumerate(rkeys):
-            if k in pool.limits:
-                pool_limit[p, i] = min(int(pool.limits[k]), int(INT32_MAX))
-        pool_usage[p] = _quantize(pool.usage, rkeys, ceil=True)
         for g, pl in enumerate(group_pods):
             pod = pl[0]
             if not tolerates_all(pod.tolerations, pool.taints):
@@ -681,6 +849,77 @@ def encode(inp: SolverInput) -> EncodedInput:
                 if k in reqs:
                     fallback[g] = True
 
+    return _EncodeCore(
+        zones=zones,
+        cts=cts,
+        type_names=type_names,
+        pool_names=pool_names,
+        rkeys=rkeys,
+        charge_axes=np.asarray([k in (CPU, MEMORY) for k in rkeys], dtype=bool),
+        group_pods=group_pods,
+        group_req=group_req,
+        group_compat_t=group_compat_t,
+        group_zone=group_zone,
+        group_ct=group_ct,
+        group_pool=group_pool,
+        group_pair=group_pair,
+        fallback=fallback,
+        run_group=np.asarray(run_group, dtype=np.int32),
+        run_count=np.asarray(run_count, dtype=np.int32),
+        sorted_uids=sorted_uids,
+        group_reqsets=group_reqsets,
+        has_topo=has_topo,
+        has_aff=has_aff,
+        hostname_sigs=hostname_sigs,
+        zone_sigs=zone_sigs,
+        q_member=q_member,
+        q_owner=q_owner,
+        q_kind=q_kind,
+        q_cap=q_cap,
+        v_member=v_member,
+        v_owner=v_owner,
+        v_kind=v_kind,
+        v_cap=v_cap,
+        v_primary=v_primary,
+        v_aff=v_aff,
+        type_alloc=type_alloc,
+        type_capacity=type_capacity,
+        offer_avail=offer_avail,
+        offer_price=offer_price,
+        pool_type=pool_type,
+        pool_zone=pool_zone,
+        pool_ct=pool_ct,
+        pool_daemon=pool_daemon,
+        all_req_keys=sorted({k for reqs in group_reqsets for k in reqs}),
+        zid=zid,
+        cid=cid,
+    )
+
+
+def _encode_with_nodes(core: _EncodeCore, inp: SolverInput) -> EncodedInput:
+    """Per-solve stage: existing-node tensors + pool usage/limits (both
+    change between solves) assembled around the cached core."""
+    zones, cts, rkeys = core.zones, core.cts, core.rkeys
+    group_pods, group_reqsets = core.group_pods, core.group_reqsets
+    hostname_sigs, zone_sigs = core.hostname_sigs, core.zone_sigs
+    zid, cid = core.zid, core.cid
+    G = len(group_pods)
+    R = len(rkeys)
+    Q = len(hostname_sigs)
+    V = len(zone_sigs)
+    has_topo = core.has_topo
+
+    # pool usage/limits from the fresh pool objects, in core's pool order
+    pools = sorted(inp.nodepools, key=lambda p: (-p.weight, p.name))
+    P = len(pools)
+    pool_limit = np.full((P, R), INT32_MAX, dtype=np.int32)
+    pool_usage = np.zeros((P, R), dtype=np.int32)
+    for p, pool in enumerate(pools):
+        for i, k in enumerate(rkeys):
+            if k in pool.limits:
+                pool_limit[p, i] = min(int(pool.limits[k]), int(INT32_MAX))
+        pool_usage[p] = _quantize(pool.usage, rkeys, ceil=True)
+
     # ---- existing nodes -----------------------------------------------------
     E = len(inp.nodes)
     node_free = np.zeros((E, R), dtype=np.int32)
@@ -703,7 +942,7 @@ def encode(inp: SolverInput) -> EncodedInput:
     v_count0 = np.zeros((V, len(zones)), dtype=np.int32)
     node_v_member = np.zeros((E, V), dtype=np.int32)
     zsig_list = sorted(zone_sigs.items(), key=lambda kv: kv[1])
-    all_req_keys = sorted({k for reqs in group_reqsets for k in reqs})
+    all_req_keys = core.all_req_keys
     profile_cols: Dict[tuple, np.ndarray] = {}
     for e, n in enumerate(inp.nodes):
         node_free[e] = _quantize(n.free, rkeys, ceil=False)
@@ -752,28 +991,28 @@ def encode(inp: SolverInput) -> EncodedInput:
         resource_keys=rkeys,
         zones=zones,
         capacity_types=cts,
-        type_names=type_names,
-        pool_names=pool_names,
+        type_names=core.type_names,
+        pool_names=core.pool_names,
         group_pods=group_pods,
-        group_req=group_req,
-        group_compat_t=group_compat_t,
-        group_zone=group_zone,
-        group_ct=group_ct,
-        group_pool=group_pool,
-        group_pair=group_pair,
-        group_fallback=fallback,
-        run_group=np.asarray(run_group, dtype=np.int32),
-        run_count=np.asarray(run_count, dtype=np.int32),
-        sorted_uids=sorted_uids,
-        type_alloc=type_alloc,
-        type_capacity=type_capacity,
-        charge_axes=np.asarray([k in (CPU, MEMORY) for k in rkeys], dtype=bool),
-        offer_avail=offer_avail,
-        offer_price=offer_price,
-        pool_type=pool_type,
-        pool_zone=pool_zone,
-        pool_ct=pool_ct,
-        pool_daemon=pool_daemon,
+        group_req=core.group_req,
+        group_compat_t=core.group_compat_t,
+        group_zone=core.group_zone,
+        group_ct=core.group_ct,
+        group_pool=core.group_pool,
+        group_pair=core.group_pair,
+        group_fallback=core.fallback,
+        run_group=core.run_group,
+        run_count=core.run_count,
+        sorted_uids=core.sorted_uids,
+        type_alloc=core.type_alloc,
+        type_capacity=core.type_capacity,
+        charge_axes=core.charge_axes,
+        offer_avail=core.offer_avail,
+        offer_price=core.offer_price,
+        pool_type=core.pool_type,
+        pool_zone=core.pool_zone,
+        pool_ct=core.pool_ct,
+        pool_daemon=core.pool_daemon,
         pool_limit=pool_limit,
         pool_usage=pool_usage,
         node_free=node_free,
@@ -782,19 +1021,19 @@ def encode(inp: SolverInput) -> EncodedInput:
         node_ct=node_ct,
         node_ids=node_ids,
         has_topology=has_topo,
-        has_affinity=has_aff,
-        q_member=q_member,
-        q_owner=q_owner,
-        q_kind=q_kind,
-        q_cap=q_cap,
+        has_affinity=core.has_aff,
+        q_member=core.q_member,
+        q_owner=core.q_owner,
+        q_kind=core.q_kind,
+        q_cap=core.q_cap,
         node_q_member=node_q_member,
         node_q_owner=node_q_owner,
-        v_member=v_member,
-        v_owner=v_owner,
-        v_kind=v_kind,
-        v_cap=v_cap,
-        v_primary=v_primary,
-        v_aff=v_aff,
+        v_member=core.v_member,
+        v_owner=core.v_owner,
+        v_kind=core.v_kind,
+        v_cap=core.v_cap,
+        v_primary=core.v_primary,
+        v_aff=core.v_aff,
         v_count0=v_count0,
         node_v_member=node_v_member,
     )

@@ -33,73 +33,186 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .ffd import ARG_INDEX, IN_AXES, ffd_solve
+from .ffd import ARG_INDEX, ffd_solve
 
-# vmap axes derived from ffd.ARG_SPEC — the single signature table — so a
-# kernel-signature change can never silently skew the batch layout again:
-#   run_count    batched (per-subset membership zeroing)
-#   node_compat  batched (per-subset node removal)
-#   v_count0     batched (removed candidates' zone-count contributions
-#                subtracted — their pods are re-posed as pending runs, and
-#                hostname (Q) counts on removed nodes are inert because the
-#                nodes are compat-masked, but zone (V) counts are GLOBAL)
-#   everything else broadcasts
-_IN_AXES = IN_AXES
+# Batched axes (documented in ffd.ARG_SPEC; indices derived from that single
+# signature table so a kernel-signature change can never silently skew the
+# batch layout):
+#   run_count    per-subset member pod COUNTS per natural run
+#   node_compat  per-subset node removal — derived ON DEVICE from a tiny
+#                [B, n_cand] membership matrix + a shared [E] node→candidate
+#                map, so the [B, G, E] tensor never crosses the host link
+#   v_count0     removed candidates' zone-count contributions subtracted —
+#                their pods are re-posed as pending runs, and hostname (Q)
+#                counts on removed nodes are inert because the nodes are
+#                compat-masked, but zone (V) counts are GLOBAL
+# everything else broadcasts.
 _RUN_COUNT = ARG_INDEX["run_count"]
 _NODE_COMPAT = ARG_INDEX["node_compat"]
 _V_COUNT0 = ARG_INDEX["v_count0"]
 
 
-@functools.partial(jax.jit, static_argnames=("max_claims",))
-def _batched_ffd(args_shared_and_batched, *, max_claims: int):
-    fn = jax.vmap(
-        functools.partial(ffd_solve.__wrapped__, max_claims=max_claims), in_axes=_IN_AXES
-    )
-    return fn(*args_shared_and_batched)
+@functools.partial(
+    jax.jit, static_argnames=("max_claims", "emit_takes", "zone_engine")
+)
+def _batched_ffd(
+    shared_args,
+    b_run_count,  # [B, Sp]
+    b_v_count0,  # [B, Vp, Z]
+    cand_member,  # [B, NC] bool — candidate ids in each subset
+    node_cand,  # [E] int32 — candidate id owning node e (-1 none)
+    *,
+    max_claims: int,
+    emit_takes: bool = True,
+    zone_engine: bool = True,
+):
+    node_compat = shared_args[_NODE_COMPAT]
+    nc = cand_member.shape[1]
+
+    def one(rc, vc0, cm):
+        removed = (node_cand >= 0) & cm[jnp.clip(node_cand, 0, max(nc - 1, 0))]
+        args = list(shared_args)
+        args[_RUN_COUNT] = rc
+        args[_NODE_COMPAT] = node_compat & ~removed[None, :]
+        args[_V_COUNT0] = vc0
+        return ffd_solve.__wrapped__(
+            *args,
+            max_claims=max_claims,
+            emit_takes=emit_takes,
+            zone_engine=zone_engine,
+        )
+
+    return jax.vmap(one)(b_run_count, b_v_count0, cand_member)
 
 
 def simulate_subsets(
     kernel_args: tuple,
-    run_candidate: np.ndarray,  # [S] int32 — candidate id owning each run (-1 = none)
+    pod_cand: np.ndarray,  # [N] int64 — candidate id per pod, FFD order
+    pod_run: np.ndarray,  # [N] int64 — run index per pod, FFD order
     subsets: Sequence[Sequence[int]],  # candidate-id subsets to evaluate
     candidate_node_idx: dict,  # candidate id -> existing-node index (E axis)
     max_claims: int = 16,
     candidate_v_delta: Optional[dict] = None,  # cid -> [V, Z] zone-count share
+    verdict_only: bool = False,
+    zone_engine: bool = True,
+    v_count0_host: Optional[np.ndarray] = None,  # host copy of args[v_count0]
 ):
     """Evaluate each subset; returns FFDOutput with leading batch axis B.
 
     kernel_args: the shared (padded) ffd_solve arrays (order = ffd.ARG_SPEC)
-    for the FULL simulation universe (all candidates' pods as runs, all
-    nodes present).
+    for the FULL simulation universe (all candidates' pods pending, all
+    nodes present), with runs at NATURAL group granularity: same-group pods
+    are fungible, so a subset's pods are expressed as per-run COUNTS
+    (segment-count of member pods), not per-candidate run splits — the
+    kernel's sequential scan stays O(distinct pod specs), not O(candidates),
+    and removing pods from a sorted list preserves FFD order exactly.
+    verdict_only skips the per-run take outputs (the disruption filter only
+    reads leftovers + final claim state).
     """
-    run_count = np.asarray(kernel_args[_RUN_COUNT])
-    node_compat = np.asarray(kernel_args[_NODE_COMPAT])
-    v_count0 = np.asarray(kernel_args[_V_COUNT0])
+    # shapes/dtypes read off the device arrays directly (no transfer); the
+    # v_count0 VALUES are needed host-side to build the per-subset deltas —
+    # callers pass a host copy saved at prepare time to avoid a per-dispatch
+    # device fetch over the link
+    rc = kernel_args[_RUN_COUNT]
+    run_count_dtype = np.dtype(rc.dtype)
+    v_count0 = (
+        v_count0_host
+        if v_count0_host is not None
+        else np.asarray(kernel_args[_V_COUNT0])
+    )
     B = len(subsets)
-    S = run_count.shape[0]
-    G, E = node_compat.shape
+    S = rc.shape[0]
+    G, E = kernel_args[_NODE_COMPAT].shape
+    # candidate-id universe: pods AND nodes (an empty candidate has no pods
+    # but its node must still be removed from subset capacity)
+    NC = 1
+    if pod_cand.size:
+        NC = max(NC, int(pod_cand.max()) + 1)
+    if candidate_node_idx:
+        NC = max(NC, max(candidate_node_idx) + 1)
+    # bucket the traced dims so dispatches compile once per bucket, not once
+    # per (candidate count, phase width); padded rows simulate an empty
+    # subset and are sliced off before verdict decoding
+    NC = ((NC + 63) // 64) * 64
+    Bp = max(8, ((B + 7) // 8) * 8)
 
-    b_run_count = np.zeros((B, S), dtype=run_count.dtype)
-    b_node_compat = np.broadcast_to(node_compat, (B, G, E)).copy()
-    b_v_count0 = np.broadcast_to(v_count0, (B,) + v_count0.shape).copy()
+    b_run_count = np.zeros((Bp, S), dtype=run_count_dtype)
+    b_v_count0 = np.broadcast_to(v_count0, (Bp,) + v_count0.shape).copy()
+    cand_member = np.zeros((Bp, NC), dtype=bool)
     for b, subset in enumerate(subsets):
-        member = np.isin(run_candidate, np.asarray(list(subset), dtype=np.int64))
-        b_run_count[b] = np.where(member, run_count, 0)
+        sub = np.asarray(list(subset), dtype=np.int64)
+        cand_member[b, sub[sub < NC]] = True
+        member = np.isin(pod_cand, sub)
+        b_run_count[b] = np.bincount(
+            pod_run[member], minlength=S
+        ).astype(run_count_dtype)
         for cid in subset:
-            e = candidate_node_idx.get(cid)
-            if e is not None and e < E:
-                b_node_compat[b, :, e] = False
             if candidate_v_delta is not None:
                 d = candidate_v_delta.get(cid)
                 if d is not None and d.size:
                     V, Z = d.shape
                     b_v_count0[b, :V, :Z] -= d
 
-    args = list(kernel_args)
-    args[_RUN_COUNT] = jnp.asarray(b_run_count)
-    args[_NODE_COMPAT] = jnp.asarray(b_node_compat)
-    args[_V_COUNT0] = jnp.asarray(b_v_count0)
-    return _batched_ffd(tuple(args), max_claims=max_claims)
+    node_cand = np.full(E, -1, dtype=np.int32)
+    for cid, e in candidate_node_idx.items():
+        if 0 <= e < E and cid < NC:
+            node_cand[e] = cid
+    return _batched_ffd(
+        tuple(kernel_args),
+        jnp.asarray(b_run_count),
+        jnp.asarray(b_v_count0),
+        jnp.asarray(cand_member),
+        jnp.asarray(node_cand),
+        max_claims=max_claims,
+        emit_takes=not verdict_only,
+        zone_engine=zone_engine,
+    )
+
+
+@jax.jit
+def _pack_verdicts(out):
+    """Flatten every host-consumed verdict field into ONE int32 buffer so a
+    tunneled link pays a single device→host roundtrip per dispatch (same
+    rationale as backend._pack_outputs). c_mask bit-packs to uint32 words —
+    32× less link traffic than int32-per-bool."""
+    st = out.state
+    b32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
+    B, M, Tp = st.c_mask.shape
+    W = (Tp + 31) // 32
+    cm = jnp.pad(st.c_mask, ((0, 0), (0, 0), (0, W * 32 - Tp))).reshape(B, M, W, 32)
+    weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+    cm_words = (cm.astype(jnp.uint32) * weights).sum(axis=3, dtype=jnp.uint32)
+    return jnp.concatenate(
+        [
+            out.leftover.sum(axis=1).reshape(B, 1),
+            st.used.reshape(B, 1),
+            b32(st.c_zc_bits),  # [B, M]
+            b32(cm_words).reshape(B, M * W),
+        ],
+        axis=1,
+    ).ravel()
+
+
+def fetch_verdicts(out, T: int, n_rows: int):
+    """One-transfer fetch of the per-subset verdict fields, sliced to the
+    first n_rows real (non-padding) subsets.
+
+    Returns (leftover_total [B], used [B], c_zc_bits [B, M] u32,
+    c_mask [B, M, T] bool)."""
+    st = out.state
+    B, M = st.c_zc_bits.shape
+    Tp = st.c_mask.shape[2]
+    W = (Tp + 31) // 32
+    flat = np.asarray(_pack_verdicts(out)).reshape(B, -1)[:n_rows]
+    leftover = flat[:, 0]
+    used = flat[:, 1]
+    zc = flat[:, 2 : 2 + M].view(np.uint32)
+    words = flat[:, 2 + M :].view(np.uint32).reshape(n_rows, M, W)
+    bits = (
+        words[:, :, :, None] >> np.arange(32, dtype=np.uint32)[None, None, None, :]
+    ) & 1
+    cm = bits.reshape(n_rows, M, W * 32)[:, :, :T].astype(bool)
+    return leftover, used, zc, cm
 
 
 def replacement_min_price(

@@ -55,53 +55,49 @@ import numpy as np
 INT32_MAX = jnp.int32(2**31 - 1)
 BIG = jnp.int32(2**30)
 
-# Positional argument table for ffd_solve. The second element is the batch
-# axis used by the consolidation evaluator's vmap (None = shared/broadcast,
-# 0 = per-candidate row). consolidate.py and backend.py derive indices from
-# THIS table — never hand-count positions.
+# Positional argument table for ffd_solve. consolidate.py and backend.py
+# derive indices from THIS table — never hand-count positions. The batched
+# consolidation evaluator (consolidate._batched_ffd) substitutes run_count,
+# v_count0, and a device-derived node_compat per subset row; everything else
+# broadcasts.
 ARG_SPEC = (
-    ("run_group", None),
-    ("run_count", 0),
-    ("group_req", None),
-    ("group_compat_t", None),
-    ("group_zc_bits", None),
-    ("group_pool", None),
-    ("group_pair_nok", None),
-    ("group_device", None),
-    ("type_alloc", None),
-    ("type_charge", None),
-    ("offer_zc_bits", None),
-    ("pool_type", None),
-    ("pool_zc_bits", None),
-    ("pool_daemon", None),
-    ("pool_limit", None),
-    ("pool_usage0", None),
-    ("node_free", None),
-    ("node_compat", 0),
-    ("q_member", None),
-    ("q_owner", None),
-    ("q_kind", None),
-    ("q_cap", None),
-    ("node_q_member", None),
-    ("node_q_owner", None),
+    "run_group",
+    "run_count",
+    "group_req",
+    "group_compat_t",
+    "group_zc_bits",
+    "group_pool",
+    "group_pair_nok",
+    "group_device",
+    "type_alloc",
+    "type_charge",
+    "offer_zc_bits",
+    "pool_type",
+    "pool_zc_bits",
+    "pool_daemon",
+    "pool_limit",
+    "pool_usage0",
+    "node_free",
+    "node_compat",
+    "q_member",
+    "q_owner",
+    "q_kind",
+    "q_cap",
+    "node_q_member",
+    "node_q_owner",
     # zone constraint sigs (V axis; encode.py) — the zone event engine
-    ("v_member", None),
-    ("v_owner", None),
-    ("v_kind", None),
-    ("v_cap", None),
-    ("v_primary", None),
-    ("v_aff", None),
-    # batched in the consolidation vmap: each subset row subtracts its removed
-    # candidate nodes' bound-pod contributions from the zone counts (a removed
-    # node's pods are re-posed as pending; counting them twice was VERDICT r3
-    # "what's weak" #1)
-    ("v_count0", 0),
-    ("node_zone", None),
-    ("zone_col_mask", None),
+    "v_member",
+    "v_owner",
+    "v_kind",
+    "v_cap",
+    "v_primary",
+    "v_aff",
+    "v_count0",
+    "node_zone",
+    "zone_col_mask",
 )
 
-ARG_INDEX = {name: i for i, (name, _ax) in enumerate(ARG_SPEC)}
-IN_AXES = tuple(ax for _name, ax in ARG_SPEC)
+ARG_INDEX = {name: i for i, name in enumerate(ARG_SPEC)}
 
 
 class FFDState(NamedTuple):
@@ -206,7 +202,9 @@ def _gbit_word(g, W):
     )
 
 
-@functools.partial(jax.jit, static_argnames=("max_claims",))
+@functools.partial(
+    jax.jit, static_argnames=("max_claims", "emit_takes", "zone_engine")
+)
 def ffd_solve(
     # runs
     run_group,  # [S] i32
@@ -250,6 +248,8 @@ def ffd_solve(
     zone_col_mask,  # [Z] u32 — joint-bit columns per zone
     *,
     max_claims: int,
+    emit_takes: bool = True,
+    zone_engine: bool = True,
 ) -> FFDOutput:
     E, R = node_free.shape
     G, T = group_compat_t.shape
@@ -953,6 +953,13 @@ def ffd_solve(
             )
             return new_state, (take_e_acc, take_c_acc, remaining)
 
+        # zone_engine=False (caller knows V == 0) drops the zoned branch at
+        # TRACE time. This matters beyond compile size: under vmap, lax.cond
+        # lowers to executing BOTH branches + select, so a batched
+        # consolidation row would pay the event engine's while_loop per scan
+        # step even with zero zone constraints in the input.
+        if not zone_engine:
+            return fast(st)
         constrained = jnp.any(v_owner[g]) | jnp.any(member_v & (v_kind == 1))
         return jax.lax.cond(constrained, zoned, fast, st)
 
@@ -960,7 +967,7 @@ def ffd_solve(
         g, count = run
         # padded runs (count == 0) skip the whole body — bucketed S padding
         # costs ~nothing at runtime
-        return jax.lax.cond(
+        new_st, (te, tc, lo) = jax.lax.cond(
             count > 0,
             lambda s: step_body(s, g, count),
             lambda s: (
@@ -973,6 +980,19 @@ def ffd_solve(
             ),
             st,
         )
+        # verdict mode (batched consolidation): only leftovers + final state
+        # matter; stacking [S, E]/[S, M] takes per batch row would dominate
+        # HBM at 10k nodes × thousands of runs × the subset axis
+        if emit_takes:
+            return new_st, (te, tc, lo)
+        return new_st, lo
 
-    state, (take_e, take_c, leftover) = jax.lax.scan(step, state, (run_group, run_count))
+    state, ys = jax.lax.scan(step, state, (run_group, run_count))
+    if emit_takes:
+        take_e, take_c, leftover = ys
+    else:
+        S = run_group.shape[0]
+        take_e = jnp.zeros((0, E), jnp.int32)
+        take_c = jnp.zeros((0, M), jnp.int32)
+        leftover = ys.reshape(S)
     return FFDOutput(take_e=take_e, take_c=take_c, leftover=leftover, state=state)

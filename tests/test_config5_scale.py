@@ -1,0 +1,99 @@
+"""Config 5 e2e: multi-node consolidation at fleet scale, one command.
+
+BASELINE.json configs[5] — the disruption engine must consolidate a large
+underutilized fleet through the batched device evaluator, deleting 100+
+nodes in a SINGLE multi-consolidation command (reference semantics: one
+command per loop, heuristic cost-ordered prefix — disruption.md:97-106,
+designs/consolidation.md:5-36). bench.py measures the same seam at 10k
+nodes on real hardware.
+"""
+
+import pytest
+
+from karpenter_tpu.api import wellknown as wk
+from karpenter_tpu.api.objects import (
+    Budget,
+    Disruption,
+    NodeClaimTemplate,
+    NodePool,
+    ObjectMeta,
+    Pod,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.controllers import store as st
+from karpenter_tpu.disruption.controller import DisruptionController
+from karpenter_tpu.operator.operator import new_kwok_operator
+from karpenter_tpu.solver.backend import TPUSolver
+from karpenter_tpu.utils.resources import Resources
+
+from tests.test_e2e_kwok import FakeClock
+
+N = 104  # >100 nodes in one command; fits a single replacement node's pod cap
+
+
+@pytest.fixture
+def op():
+    clock = FakeClock()
+    o = new_kwok_operator(clock=clock, solver=TPUSolver())
+    o.clock = clock
+    return o
+
+
+def test_multi_node_consolidation_hundred_nodes_one_command(op):
+    op.store.create(
+        st.NODEPOOLS,
+        NodePool(
+            meta=ObjectMeta(name="default"),
+            template=NodeClaimTemplate(),
+            disruption=Disruption(
+                consolidation_policy="WhenEmptyOrUnderutilized",
+                consolidate_after_s=0.0,
+                budgets=[Budget(nodes="100%")],
+            ),
+        ),
+    )
+    tsc = TopologySpreadConstraint(
+        max_skew=1, topology_key=wk.HOSTNAME_LABEL, label_selector={"app": "wide"}
+    )
+    for i in range(N):
+        op.store.create(
+            st.PODS,
+            Pod(
+                meta=ObjectMeta(name=f"w{i:03d}", uid=f"w{i:03d}", labels={"app": "wide"}),
+                requests=Resources.parse({"cpu": "150m", "memory": "192Mi"}),
+                topology_spread=[tsc],
+            ),
+        )
+    op.manager.settle(max_ticks=600)
+    assert len(op.store.list(st.NODES)) == N, "hostname spread must fan out 1 pod/node"
+
+    # record every executed command to prove ONE multi-node command does it
+    dc = next(c for c in op.manager.controllers if isinstance(c, DisruptionController))
+    executed = []
+    orig = dc._execute
+
+    def spy(cmd):
+        executed.append((cmd.method, len(cmd.candidates)))
+        return orig(cmd)
+
+    dc._execute = spy
+
+    for i in range(N):
+        p = op.store.get(st.PODS, f"w{i:03d}")
+        p.topology_spread = []
+        op.store.update(st.PODS, p)
+    op.clock.advance(30)
+    op.manager.settle(max_ticks=600)
+
+    pods = op.store.list(st.PODS)
+    nodes = op.store.list(st.NODES)
+    assert all(p.node_name for p in pods), "every pod rebinds"
+    assert len(nodes) <= 3, f"fleet should collapse, still {len(nodes)} nodes"
+    multi = [e for e in executed if e[0] == "multi-consolidation"]
+    assert multi, f"no multi-consolidation command executed: {executed}"
+    assert max(n for _m, n in multi) >= 100, (
+        f"expected >=100 candidates in one command: {executed}"
+    )
+    assert dc.stats.get("batched_prefixes_evaluated", 0) > 0, (
+        "prefix search must run on the batched device evaluator"
+    )
