@@ -114,6 +114,7 @@ _POD_SIG_FIELDS = frozenset(
         "topology_spread",
         "affinity_terms",
         "priority",
+        "volume_zones",
     }
 )
 _POD_CACHE_KEYS = ("_solver_sig", "_ffd_key", "_sig_num", "_mib_aligned")
@@ -147,6 +148,12 @@ class Pod:
     priority: int = 0
     scheduling_gated: bool = False
     owner_kind: str = ""  # "DaemonSet" pods get special handling
+    # PV zonal topology (website/.../concepts/scheduling.md:430+):
+    # volume_claims names the pod's PVCs; volume_zones is the resolved zone
+    # restriction from BOUND zonal PVs (maintained by
+    # controllers/volume.VolumeTopologyController; None = unrestricted)
+    volume_claims: List[str] = field(default_factory=list)
+    volume_zones: Optional[Tuple[str, ...]] = None
 
     def __setattr__(self, name, value):
         object.__setattr__(self, name, value)
@@ -184,6 +191,10 @@ class Pod:
         reqs = Requirements.from_labels(self.node_selector)
         for term in self.node_affinity:
             reqs = reqs.union(term)
+        if self.volume_zones is not None:
+            # an EMPTY tuple (conflicting bound volumes) is an unsatisfiable
+            # In-[] requirement, not "unrestricted"
+            reqs.add(Requirement.create(wk.ZONE_LABEL, IN, list(self.volume_zones)))
         return reqs
 
     @property
@@ -216,6 +227,28 @@ class Node:
 
     def labels(self) -> Dict[str, str]:
         return self.meta.labels
+
+
+@dataclass
+class PersistentVolume:
+    """Zonal persistent volume: `zones` mirrors the PV's nodeAffinity zone
+    terms (scheduling.md:430+ — a pod using a zonal PV must schedule in the
+    PV's zone). Empty zones = non-zonal (no restriction)."""
+
+    meta: ObjectMeta
+    zones: List[str] = field(default_factory=list)
+    storage_class: str = ""
+
+
+@dataclass
+class PersistentVolumeClaim:
+    """Claim; `volume_name` set = bound. Unbound claims follow
+    WaitForFirstConsumer semantics: no restriction during scheduling, then
+    the volume controller binds a PV in the zone the pod landed in."""
+
+    meta: ObjectMeta
+    volume_name: Optional[str] = None
+    storage_class: str = ""
 
 
 @dataclass

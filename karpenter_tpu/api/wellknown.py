@@ -32,6 +32,7 @@ CAPACITY_TYPE_RESERVED = "reserved"
 
 # Annotations
 DO_NOT_DISRUPT_ANNOTATION = "karpenter.sh/do-not-disrupt"
+POD_DELETION_COST_ANNOTATION = "controller.kubernetes.io/pod-deletion-cost"
 NODEPOOL_HASH_ANNOTATION = "karpenter.sh/nodepool-hash"
 NODEPOOL_HASH_VERSION_ANNOTATION = "karpenter.sh/nodepool-hash-version"
 NODECLASS_HASH_ANNOTATION = "karpenter.tpu/nodeclass-hash"

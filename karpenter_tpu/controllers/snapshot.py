@@ -1,0 +1,107 @@
+"""Durability: periodic store + cloud snapshot with boot-time restore.
+
+The reference's control plane is stateless (all durable state lives in the
+kube API); its ONE explicit checkpoint is kwok's instance backup to
+ConfigMaps every 5s with restore at boot (kwok/ec2/ec2.go:112-232). In this
+framework the in-process store IS the API server, so durability covers both
+halves: every store kind (the "API objects") plus the kwok cloud's instance
+map (the "cloud side"), written atomically to one snapshot file on a 5s
+cadence and restored before controllers run.
+
+A process restart therefore rebuilds the exact cluster: instances without
+NodeClaims are reaped by the GC controller after its grace period (no leaked
+capacity), and NodeClaims without instances re-launch — the same
+reconcile-from-state convergence the reference gets from re-listing the API.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+import time
+from typing import Optional, Tuple
+
+from . import store as st
+
+SNAPSHOT_KINDS = (
+    st.PODS,
+    st.NODES,
+    st.NODEPOOLS,
+    st.NODECLAIMS,
+    st.NODECLASSES,
+    st.PDBS,
+    st.DAEMONSETS,
+    st.PERSISTENTVOLUMES,
+    st.PERSISTENTVOLUMECLAIMS,
+)
+
+
+def save_snapshot(store: st.Store, cloud, path: str) -> None:
+    """Atomic snapshot (tmp + rename): store kinds + cloud instances.
+
+    Serialization happens WHILE both locks are held — the collected lists
+    reference the live objects, and other threads mutate fields in place
+    (deletion timestamps, PVC bindings), so pickling after release could
+    tear the snapshot or crash mid-iteration. The dump goes to memory under
+    the locks; only the file write happens outside."""
+    with store._lock, cloud._lock:
+        objects = {kind: list(store._objects.get(kind, {}).values()) for kind in SNAPSHOT_KINDS}
+        rv = next(store._rv)  # monotonic observation of the rv high-water mark
+        instances = dict(cloud._instances)
+        seq = next(cloud._seq)  # observe; re-prime on restore
+        payload = pickle.dumps(
+            {"objects": objects, "instances": instances, "rv": rv, "seq": seq}
+        )
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".snap-")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def restore_snapshot(store: st.Store, cloud, path: str) -> bool:
+    """Hydrate an EMPTY store + cloud from a snapshot file; True on restore."""
+    if not os.path.exists(path):
+        return False
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    with store._lock:
+        for kind, objs in payload["objects"].items():
+            for obj in objs:
+                store._objects[kind][store._key(obj)] = obj
+        store.bump_to(payload.get("rv", 0))
+    with cloud._lock:
+        cloud._instances.update(payload["instances"])
+        import itertools
+
+        cloud._seq = itertools.count(payload.get("seq", 1))
+    return True
+
+
+class SnapshotController:
+    """Writes the snapshot every `interval_s` of controller-loop time — the
+    5s ConfigMap-backup cadence of the reference's kwok provider."""
+
+    name = "snapshot"
+
+    def __init__(self, store: st.Store, cloud, path: str, interval_s: float = 5.0,
+                 clock=time.monotonic):
+        self.store = store
+        self.cloud = cloud
+        self.path = path
+        self.interval_s = interval_s
+        self.clock = clock
+        self._last: Optional[float] = None
+
+    def reconcile(self) -> bool:
+        now = self.clock()
+        if self._last is not None and now - self._last < self.interval_s:
+            return False
+        save_snapshot(self.store, self.cloud, self.path)
+        self._last = now
+        return False  # snapshots are not cluster progress

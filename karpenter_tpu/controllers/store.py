@@ -37,11 +37,22 @@ class Store:
         self._objects: Dict[str, Dict[str, Any]] = defaultdict(dict)  # kind -> key -> obj
         self._watchers: List[Tuple[Optional[str], WatchFn]] = []
         self._rv = itertools.count(1)
+        # watcher events queue under _lock (rv order) and deliver outside it
+        from collections import deque
+
+        self._pending = deque()
+        self._dispatch_lock = threading.Lock()
 
     @staticmethod
     def _key(obj: Any) -> str:
         m = obj.meta
         return f"{m.namespace}/{m.name}"
+
+    def bump_to(self, rv: int) -> None:
+        """Advance the resource-version counter past a restored snapshot's
+        high-water mark so post-restore updates stay monotonic."""
+        with self._lock:
+            self._rv = itertools.count(rv + 1)
 
     # -- crud ---------------------------------------------------------------
 
@@ -52,8 +63,9 @@ class Store:
                 raise Conflict(f"{kind} {key} already exists")
             obj.meta.resource_version = next(self._rv)
             self._objects[kind][key] = obj
-            self._notify("ADDED", kind, obj)
-            return obj
+            self._enqueue("ADDED", kind, obj)
+        self._drain()
+        return obj
 
     def update(self, kind: str, obj: Any) -> Any:
         with self._lock:
@@ -66,10 +78,11 @@ class Store:
             # finalizer-gated purge: a deleting object with no finalizers goes away
             if obj.meta.deleting and not obj.meta.finalizers:
                 del self._objects[kind][key]
-                self._notify("DELETED", kind, obj)
+                self._enqueue("DELETED", kind, obj)
             else:
-                self._notify("MODIFIED", kind, obj)
-            return obj
+                self._enqueue("MODIFIED", kind, obj)
+        self._drain()
+        return obj
 
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
         """Kube-style: mark deleting; purge only when finalizers are gone."""
@@ -79,14 +92,16 @@ class Store:
             if cur is None:
                 raise NotFound(f"{kind} {key}")
             if cur.meta.finalizers:
-                if not cur.meta.deleting:
-                    cur.meta.deletion_timestamp = time.monotonic()
-                    cur.meta.resource_version = next(self._rv)
-                    self._notify("MODIFIED", kind, cur)
-                return
-            del self._objects[kind][key]
-            cur.meta.deletion_timestamp = cur.meta.deletion_timestamp or time.monotonic()
-            self._notify("DELETED", kind, cur)
+                if cur.meta.deleting:
+                    return
+                cur.meta.deletion_timestamp = time.monotonic()
+                cur.meta.resource_version = next(self._rv)
+                self._enqueue("MODIFIED", kind, cur)
+            else:
+                del self._objects[kind][key]
+                cur.meta.deletion_timestamp = cur.meta.deletion_timestamp or time.monotonic()
+                self._enqueue("DELETED", kind, cur)
+        self._drain()
 
     def get(self, kind: str, name: str, namespace: str = "default") -> Any:
         with self._lock:
@@ -115,10 +130,28 @@ class Store:
                 for obj in self._objects[k].values():
                     fn("ADDED", k, obj)
 
-    def _notify(self, event: str, kind: str, obj: Any) -> None:
-        for k, fn in list(self._watchers):
-            if k is None or k == kind:
-                fn(event, kind, obj)
+    def _enqueue(self, event: str, kind: str, obj: Any) -> None:
+        """Called UNDER the store lock so queue order matches rv order."""
+        self._pending.append((event, kind, obj))
+
+    def _drain(self) -> None:
+        """Deliver queued events OUTSIDE the store lock, in rv order, from a
+        single drainer at a time: a slow watcher never stalls other threads'
+        mutations (they enqueue and return; the active drainer delivers
+        their events in order when the watcher yields)."""
+        if not self._dispatch_lock.acquire(blocking=False):
+            return  # another thread is draining; it delivers our event too
+        try:
+            while True:
+                try:
+                    event, kind, obj = self._pending.popleft()
+                except IndexError:
+                    return
+                for k, fn in list(self._watchers):
+                    if k is None or k == kind:
+                        fn(event, kind, obj)
+        finally:
+            self._dispatch_lock.release()
 
 
 # Canonical kind names
@@ -129,3 +162,5 @@ NODECLAIMS = "nodeclaims"
 NODECLASSES = "nodeclasses"
 PDBS = "poddisruptionbudgets"
 DAEMONSETS = "daemonsets"
+PERSISTENTVOLUMES = "persistentvolumes"
+PERSISTENTVOLUMECLAIMS = "persistentvolumeclaims"

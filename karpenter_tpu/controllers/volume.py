@@ -1,0 +1,106 @@
+"""PV zonal topology controller (website/.../concepts/scheduling.md:430+).
+
+Two reconciliations:
+
+1. **Resolve**: a pending pod referencing PVCs bound to zonal PVs gets its
+   `volume_zones` restriction set (intersection across its bound volumes) —
+   the scheduler and the solver encoders read it through
+   `Pod.scheduling_requirements()`. Pods are REPLACED on update (store
+   convention: scheduling fields never mutate in place), which also keeps
+   the solver's identity-keyed caches sound.
+
+2. **Late binding** (WaitForFirstConsumer): when a pod with an UNBOUND claim
+   lands on a node, a zonal PV is provisioned in the node's zone and the
+   claim binds to it — so a later reschedule of the pod stays zone-pinned,
+   exactly the trap the reference documents for zonal storage.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from ..api import wellknown as wk
+from ..api.objects import ObjectMeta, PersistentVolume
+from . import store as st
+
+
+class VolumeTopologyController:
+    name = "volume-topology"
+
+    def __init__(self, store: st.Store):
+        self.store = store
+        self._pv_seq = 0
+
+    def reconcile(self) -> bool:
+        did = False
+        # claims are namespaced like pods (a pod's volume_claims name PVCs in
+        # ITS namespace); PVs are cluster-scoped
+        pvcs = {
+            (c.meta.namespace, c.meta.name): c
+            for c in self.store.list(st.PERSISTENTVOLUMECLAIMS)
+        }
+        pvs = {v.meta.name: v for v in self.store.list(st.PERSISTENTVOLUMES)}
+        for pod in self.store.list(st.PODS):
+            if not pod.volume_claims:
+                continue
+            if pod.node_name is not None and self._late_bind(pod, pvcs):
+                did = True
+                pvcs = {
+                    (c.meta.namespace, c.meta.name): c
+                    for c in self.store.list(st.PERSISTENTVOLUMECLAIMS)
+                }
+                pvs = {v.meta.name: v for v in self.store.list(st.PERSISTENTVOLUMES)}
+            # resolve for bound pods too: a reschedule must stay zone-pinned
+            if self._resolve(pod, pvcs, pvs):
+                did = True
+        return did
+
+    def _zones_for(self, pod, pvcs, pvs) -> Optional[Tuple[str, ...]]:
+        """Intersection of the pod's bound zonal PVs' zones; None when no
+        bound zonal volume restricts it."""
+        restriction: Optional[set] = None
+        for claim_name in pod.volume_claims:
+            pvc = pvcs.get((pod.meta.namespace, claim_name))
+            if pvc is None or pvc.volume_name is None:
+                continue  # unbound: WaitForFirstConsumer, no restriction yet
+            pv = pvs.get(pvc.volume_name)
+            if pv is None or not pv.zones:
+                continue  # non-zonal volume
+            zs = set(pv.zones)
+            restriction = zs if restriction is None else (restriction & zs)
+        if restriction is None:
+            return None
+        return tuple(sorted(restriction))
+
+    def _resolve(self, pod, pvcs, pvs) -> bool:
+        zones = self._zones_for(pod, pvcs, pvs)
+        if zones == pod.volume_zones:
+            return False
+        updated = dataclasses.replace(pod, volume_zones=zones)
+        self.store.update(st.PODS, updated)
+        return True
+
+    def _late_bind(self, pod, pvcs) -> bool:
+        node = self.store.try_get(st.NODES, pod.node_name)
+        if node is None:
+            return False
+        zone = node.meta.labels.get(wk.ZONE_LABEL)
+        if zone is None:
+            return False
+        did = False
+        for claim_name in pod.volume_claims:
+            pvc = pvcs.get((pod.meta.namespace, claim_name))
+            if pvc is None or pvc.volume_name is not None:
+                continue
+            self._pv_seq += 1
+            pv = PersistentVolume(
+                meta=ObjectMeta(name=f"pv-{claim_name}-{self._pv_seq:04d}"),
+                zones=[zone],
+                storage_class=pvc.storage_class,
+            )
+            self.store.create(st.PERSISTENTVOLUMES, pv)
+            pvc.volume_name = pv.meta.name
+            self.store.update(st.PERSISTENTVOLUMECLAIMS, pvc)
+            did = True
+        return did

@@ -45,6 +45,20 @@ from ..state.cluster import Cluster
 from ..termination.controller import EvictionQueue
 
 
+def _pod_cost(p: Pod) -> float:
+    """Per-pod move cost: base 1, shifted by priority and the
+    pod-deletion-cost annotation (higher deletion cost / priority = more
+    expensive to move; negative deletion cost makes a pod cheaper)."""
+    cost = 1.0 + p.priority / 1000.0
+    raw = p.meta.annotations.get(wk.POD_DELETION_COST_ANNOTATION)
+    if raw is not None:
+        try:
+            cost += float(raw) / 1000.0
+        except ValueError:
+            pass  # malformed annotation: ignored, like the kube controllers
+    return cost
+
+
 @dataclass
 class Candidate:
     claim: NodeClaim
@@ -72,6 +86,8 @@ class DisruptionController:
         cloud_provider: CloudProvider,
         solver: Solver,
         clock=time.monotonic,
+        wall_clock=time.time,
+        preference_policy: str = "Respect",
         replacement_timeout_s: float = 10 * 60,
         multi_node_max_candidates: int = 100,
         multi_node_max_candidates_batched: int = 10_000,
@@ -82,6 +98,8 @@ class DisruptionController:
         self.cloud_provider = cloud_provider
         self.solver = solver
         self.clock = clock
+        self.wall_clock = wall_clock  # cron budget windows need civil time
+        self.preference_policy = preference_policy
         self.eviction = EvictionQueue(store)
         self.replacement_timeout_s = replacement_timeout_s
         self.multi_node_max_candidates = multi_node_max_candidates
@@ -150,11 +168,20 @@ class DisruptionController:
                 continue  # PDB-blocked (disruption.md:335-409)
             resched = [p for p in pods if p.owner_kind != "DaemonSet"]
             age = self.clock() - claim.meta.creation_timestamp
-            # disruption cost: fewer/cheaper-to-move pods first; ties by age
-            # (older first) then name for determinism
-            cost = float(
-                sum(1 + p.priority / 1000.0 for p in resched)
-            )
+            # Disruption cost (disruption.md: candidates ranked by pod count,
+            # pod-deletion-cost, pod priority, and node lifetime remaining):
+            # cheaper-to-move nodes first. Pod cost folds the
+            # controller.kubernetes.io/pod-deletion-cost annotation and
+            # priority; the sum scales by the claim's remaining share of its
+            # expireAfter lifetime — a node close to expiry is nearly free to
+            # disrupt (it is about to be replaced anyway).
+            cost = float(sum(_pod_cost(p) for p in resched))
+            if claim.expire_after_s and cost > 0:
+                # scale positive sums only: a negative sum (deletion-cost
+                # annotations) scaled toward 0 would INVERT the ranking and
+                # make a near-expiry node look more expensive
+                remaining = 1.0 - (age / claim.expire_after_s)
+                cost *= min(max(remaining, 0.0), 1.0)
             out.append(
                 Candidate(claim=claim, node=node, pods=resched, price=claim.price, cost=cost)
             )
@@ -187,6 +214,8 @@ class DisruptionController:
                 for b in np_obj.disruption.budgets:
                     if b.reasons is not None and reason not in b.reasons:
                         continue
+                    if not self._budget_active(b):
+                        continue
                     if b.nodes.endswith("%"):
                         cap = math.ceil(total * int(b.nodes[:-1]) / 100.0)
                     else:
@@ -196,6 +225,20 @@ class DisruptionController:
                     allowed = math.ceil(total * 0.10)
                 out[(pool_name, reason)] = max(0, allowed - disrupting)
         return out
+
+    def _budget_active(self, b) -> bool:
+        """Cron-scheduled budgets constrain only inside [match, match+duration]
+        (disruption.md:274-330); schedule-less budgets are always active."""
+        if b.schedule is None:
+            return True
+        if b.duration_s is None:
+            return False  # schedule requires a duration (CRD validation)
+        from .cron import in_window
+
+        try:
+            return in_window(b.schedule, b.duration_s, self.wall_clock())
+        except ValueError:
+            return False  # malformed schedule: never constrains
 
     @staticmethod
     def _reason(method: str) -> str:
@@ -315,6 +358,7 @@ class DisruptionController:
             self._provisioner_helper = Provisioner(
                 self.store, self.cluster, self.cloud_provider, self.solver,
                 batch_idle_s=0, batch_max_s=0, clock=self.clock,
+                preference_policy=self.preference_policy,
             )
         base = self._provisioner_helper.build_input([])
         candidate_pods = {
@@ -471,6 +515,7 @@ class DisruptionController:
             self._provisioner_helper = Provisioner(
                 self.store, self.cluster, self.cloud_provider, self.solver,
                 batch_idle_s=0, batch_max_s=0, clock=self.clock,
+                preference_policy=self.preference_policy,
             )
         import dataclasses
 
@@ -502,7 +547,15 @@ class DisruptionController:
         return True, None
 
     def _min_price(self, claim_res) -> Optional[float]:
-        types = {it.name: it for it in self.cloud_provider.get_instance_types("")}
+        # name->type dict cached by catalog-list identity (the provider
+        # returns the same list object until the ICE SeqNum moves), so the
+        # disruption hot path doesn't rebuild a 600-entry dict per simulation
+        lst = self.cloud_provider.get_instance_types("")
+        cached = getattr(self, "_types_by_name", None)
+        if cached is None or cached[0] is not lst:
+            cached = (lst, {it.name: it for it in lst})
+            self._types_by_name = cached
+        types = cached[1]
         best = None
         for tn in claim_res.instance_type_names:
             it = types.get(tn)

@@ -1,0 +1,89 @@
+"""Minimal 5-field cron matcher for disruption budget schedules
+(website/.../concepts/disruption.md:274-330: budgets carry an optional
+`schedule` cron + `duration`; the budget constrains only inside the window
+[match, match+duration], evaluated in UTC).
+
+Supports: "*", numbers, ranges "a-b", steps "*/n" and "a-b/n", and comma
+lists — the subset the reference's budget examples use.
+"""
+
+from __future__ import annotations
+
+from datetime import datetime, timedelta, timezone
+from typing import List, Optional
+
+
+def _parse_field(spec: str, lo: int, hi: int) -> Optional[frozenset]:
+    vals: set = set()
+    for part in spec.split(","):
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/", 1)
+            if not step_s.isdigit() or int(step_s) < 1:
+                return None
+            step = int(step_s)
+        if part == "*":
+            start, end = lo, hi
+        elif "-" in part:
+            a, _, b = part.partition("-")
+            if not (a.isdigit() and b.isdigit()):
+                return None
+            start, end = int(a), int(b)
+        elif part.isdigit():
+            start = end = int(part)
+        else:
+            return None
+        if start < lo or end > hi or start > end:
+            return None
+        vals.update(range(start, end + 1, step))
+    return frozenset(vals)
+
+
+class Cron:
+    """Parsed 5-field cron expression (minute hour dom month dow)."""
+
+    def __init__(self, expr: str):
+        fields = expr.split()
+        if len(fields) != 5:
+            raise ValueError(f"cron needs 5 fields: {expr!r}")
+        bounds = [(0, 59), (0, 23), (1, 31), (1, 12), (0, 7)]
+        parsed: List[frozenset] = []
+        for f, (lo, hi) in zip(fields, bounds):
+            p = _parse_field(f, lo, hi)
+            if p is None:
+                raise ValueError(f"bad cron field {f!r} in {expr!r}")
+            parsed.append(p)
+        self.minute, self.hour, self.dom, self.month, self.dow = parsed
+        if 7 in self.dow:  # standard cron: 7 is Sunday too
+            self.dow = self.dow | frozenset([0])
+        # kube cron quirk: dom and dow are OR'd when both are restricted
+        self._dom_star = self.dom == frozenset(range(1, 32))
+        self._dow_star = frozenset(range(0, 7)) <= self.dow
+
+    def matches(self, dt: datetime) -> bool:
+        if dt.minute not in self.minute or dt.hour not in self.hour:
+            return False
+        if dt.month not in self.month:
+            return False
+        dom_ok = dt.day in self.dom
+        dow_ok = dt.isoweekday() % 7 in self.dow  # cron: 0 = Sunday
+        if self._dom_star or self._dow_star:
+            return dom_ok and dow_ok
+        return dom_ok or dow_ok
+
+
+def in_window(expr: str, duration_s: float, now_epoch: float) -> bool:
+    """True iff some cron match t0 satisfies t0 <= now < t0 + duration.
+    Scans minute marks backwards over the duration (UTC, like the
+    reference's budget schedules)."""
+    cron = Cron(expr)
+    now = datetime.fromtimestamp(now_epoch, tz=timezone.utc)
+    mark = now.replace(second=0, microsecond=0)
+    steps = int(duration_s // 60) + 1
+    for _ in range(min(steps, 60 * 24 * 32)):  # bound: one month of minutes
+        if cron.matches(mark):
+            start = mark.timestamp()
+            if start <= now_epoch < start + duration_s:
+                return True
+        mark -= timedelta(minutes=1)
+    return False

@@ -75,11 +75,13 @@ class KwokCloud:
         instance_types: Sequence[InstanceType],
         rate_limits: bool = False,
         auto_register_delay_s: float = 0.0,
+        clock=time.monotonic,
     ):
         self.store = store
         self.types = {it.name: it for it in instance_types}
         self.limits = ApiLimits(enabled=rate_limits)
         self.auto_register_delay_s = auto_register_delay_s
+        self.clock = clock  # instance launch_time shares the control-plane clock
         self._instances: Dict[str, Instance] = {}
         self._lock = threading.RLock()
         self._seq = itertools.count(1)
@@ -131,6 +133,7 @@ class KwokCloud:
                     price=ov.price,
                     reservation_id=ov.reservation_id,
                     tags=dict(tags or {}),
+                    launch_time=self.clock(),
                 )
                 self._instances[inst.id] = inst
                 self._create_node(inst)

@@ -65,6 +65,9 @@ def main(argv=None) -> int:
         batch_idle_s=o.batch_idle_duration_s,
         batch_max_s=o.batch_max_duration_s,
         rate_limits=o.kwok_rate_limits,
+        preference_policy=o.preference_policy,
+        snapshot_path=o.snapshot_path or None,
+        snapshot_interval_s=o.snapshot_interval_s,
     )
     serve_endpoints(o.metrics_port, o.health_probe_port)
     log.info("karpenter-tpu starting: solver=%s metrics=:%d", o.solver_backend, o.metrics_port)

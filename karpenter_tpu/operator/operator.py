@@ -59,10 +59,19 @@ def new_kwok_operator(
     rate_limits: bool = False,
     clock=time.monotonic,
     disruption: bool = True,
+    preference_policy: str = "Respect",
+    snapshot_path: Optional[str] = None,
+    snapshot_interval_s: float = 5.0,
 ) -> Operator:
     store = st.Store()
     types = list(instance_types) if instance_types is not None else generate(CatalogSpec())
-    cloud = KwokCloud(store, types, rate_limits=rate_limits)
+    cloud = KwokCloud(store, types, rate_limits=rate_limits, clock=clock)
+    if snapshot_path is not None:
+        # restore BEFORE any controller runs: the reference's kwok provider
+        # hydrates instances from ConfigMaps at boot (kwok/ec2/ec2.go:112-232)
+        from ..controllers.snapshot import restore_snapshot
+
+        restore_snapshot(store, cloud, snapshot_path)
     reservations = CapacityReservationProvider(clock=clock)
     cloud_provider = KwokCloudProvider(cloud, types, reservations=reservations)
     cluster = Cluster(store, clock=clock)
@@ -75,10 +84,14 @@ def new_kwok_operator(
         batch_idle_s=batch_idle_s,
         batch_max_s=batch_max_s,
         clock=clock,
+        preference_policy=preference_policy,
     )
+    from ..controllers.volume import VolumeTopologyController
+
     queue = InterruptionQueue()
     manager = Manager()
     manager.register(
+        VolumeTopologyController(store),
         provisioner,
         LaunchController(store, cloud_provider),
         RegistrationController(store, clock=clock),
@@ -97,7 +110,19 @@ def new_kwok_operator(
     if disruption:
         from ..disruption.controller import DisruptionController
 
-        manager.register(DisruptionController(store, cluster, cloud_provider, solver, clock=clock))
+        manager.register(
+            DisruptionController(
+                store, cluster, cloud_provider, solver, clock=clock,
+                preference_policy=preference_policy,
+            )
+        )
+    if snapshot_path is not None:
+        from ..controllers.snapshot import SnapshotController
+
+        manager.register(
+            SnapshotController(store, cloud, snapshot_path,
+                               interval_s=snapshot_interval_s, clock=clock)
+        )
     return Operator(
         store=store,
         cloud=cloud,

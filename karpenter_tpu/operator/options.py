@@ -44,6 +44,10 @@ class Options:
     # kwok provider
     kwok_rate_limits: bool = False
     vm_memory_overhead_percent: float = 0.075  # options.go:36-56
+    # durability: periodic store+cloud snapshot with boot-time restore
+    # (kwok ConfigMap-backup analog, kwok/ec2/ec2.go:112-232); empty = off
+    snapshot_path: str = ""
+    snapshot_interval_s: float = 5.0
     # self-contained smoke run (inject a demo nodepool + pods)
     demo: bool = False
 

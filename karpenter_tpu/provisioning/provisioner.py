@@ -36,6 +36,7 @@ class Provisioner:
         batch_idle_s: float = 1.0,
         batch_max_s: float = 10.0,
         clock=time.monotonic,
+        preference_policy: str = "Respect",
     ):
         self.store = store
         self.cluster = cluster
@@ -44,6 +45,7 @@ class Provisioner:
         self.batch_idle_s = batch_idle_s
         self.batch_max_s = batch_max_s
         self.clock = clock
+        self.preference_policy = preference_policy  # settings.md:38
         self._first_seen: Optional[float] = None
         self._last_count = 0
         self._claim_seq = 0
@@ -106,6 +108,7 @@ class Provisioner:
             daemonset_pods=daemonsets,
             zones=tuple(sorted(zones)),
             capacity_types=tuple(sorted(cts)) or ("on-demand", "spot"),
+            preference_policy=self.preference_policy,
         )
 
     # -- reconcile ----------------------------------------------------------

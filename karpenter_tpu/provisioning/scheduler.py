@@ -57,6 +57,10 @@ class SolverInput:
     daemonset_pods: List[Pod] = field(default_factory=list)
     zones: Tuple[str, ...] = ()  # zone universe (for topology domains)
     capacity_types: Tuple[str, ...] = (wk.CAPACITY_TYPE_ON_DEMAND, wk.CAPACITY_TYPE_SPOT)
+    # --preference-policy (settings.md:38): Respect treats preferences as
+    # required and relaxes them by ascending weight on failure; Ignore drops
+    # every preference up front.
+    preference_policy: str = "Respect"
 
 
 @dataclass
@@ -362,6 +366,8 @@ class VirtualNode:
         survivors = self._surviving(combined, requests)
         if not survivors:
             return None
+        if not min_values_ok(combined, survivors):
+            return None  # narrowed below the NodePool's flexibility floor
         return combined, survivors, requests
 
     def commit(self, pod: Pod, state: Tuple[Requirements, List[InstanceType], Resources]) -> None:
@@ -379,6 +385,8 @@ class VirtualNode:
         trial[key] = nxt
         survivors = self._surviving(trial, self.requests)
         if not survivors:
+            return False
+        if not min_values_ok(trial, survivors):
             return False
         self.requirements, self.options = trial, survivors
         return True
@@ -398,6 +406,28 @@ def _has_offering(it: InstanceType, reqs: Requirements) -> bool:
         if o.available and reqs.compatible(o.requirements()):
             return True
     return False
+
+
+def min_values_ok(reqs: Requirements, survivors: Sequence[InstanceType]) -> bool:
+    """NodePool minValues flexibility floors (nodepools.md:268-330): every
+    requirement carrying a floor must retain >= minValues distinct values
+    among the surviving instance types. Checked at every narrowing step in
+    the oracle; the tensor backends check the FINAL surviving sets instead —
+    equivalent, because options only ever shrink (a final state meeting the
+    floor implies every intermediate superset did too)."""
+    for k, r in reqs.items():
+        if not r.min_values:
+            continue
+        vals: set = set()
+        for it in survivors:
+            ir = it.requirements.get(k)
+            if ir is not None and not ir.complement:
+                vals.update(v for v in ir.values if r.has(v))
+            if len(vals) >= r.min_values:
+                break
+        if len(vals) < r.min_values:
+            return False
+    return True
 
 
 # ---------------------------------------------------------------------------
@@ -464,43 +494,95 @@ class Scheduler:
         return SolverResult(placements=placements, claims=claims, errors=errors)
 
     def _schedule_with_relaxation(self, pod: Pod, placements) -> Optional[str]:
-        prefs = sorted(
-            range(len(pod.preferred_node_affinity)),
-            key=lambda i: (pod.preferred_node_affinity[i][0], i),
-        )
+        """Preferences treated as required, then relaxed ONE at a time by
+        ascending weight until the pod places (scheduling.md:212-219).
+        Preference kinds: preferred node affinity (its weight),
+        ScheduleAnyway topology spread (weight 0 — relaxed first), and
+        weighted (preferred) pod-affinity terms (their weight). Ties break by
+        kind then input order (framework-chosen; the docs leave it open).
+        --preference-policy=Ignore drops them all up front (settings.md:38)."""
+        items: List[Tuple[int, int, str, int]] = []  # (weight, kind, tag, idx)
+        if self.inp.preference_policy != "Ignore":
+            for i, (w, _r) in enumerate(pod.preferred_node_affinity):
+                items.append((w, 0, "na", i))
+            for i, t in enumerate(pod.topology_spread):
+                if t.when_unsatisfiable == "ScheduleAnyway":
+                    items.append((0, 1, "tsc", i))
+            for i, t in enumerate(pod.affinity_terms):
+                if t.weight is not None:
+                    items.append((t.weight, 2, "aff", i))
+            items.sort(key=lambda it: (it[0], it[1], it[3]))
         dropped = 0
         while True:
-            active = [pod.preferred_node_affinity[i] for i in prefs[dropped:]]
-            err = self._try_schedule(pod, active, placements)
+            active = items[dropped:]
+            active_prefs = [
+                pod.preferred_node_affinity[i] for (_w, _k, tag, i) in active
+                if tag == "na"
+            ]
+            eff = self._effective_pod(pod, active)
+            err = self._try_schedule(pod, eff, active_prefs, placements)
             if err is None:
                 return None
-            if dropped >= len(prefs):
+            if dropped >= len(items):
                 return err
             dropped += 1  # relax lowest-weight preference and retry
+
+    def _effective_pod(self, pod: Pod, active) -> Pod:
+        """Pod view where the still-active soft constraints appear REQUIRED:
+        active ScheduleAnyway spreads become DoNotSchedule, active weighted
+        affinity terms lose their weight; dropped ones vanish. Admission
+        checks read this view; bookkeeping (placement record, owned-anti
+        registration) stays on the original pod so satisfied preferences
+        never constrain later pods."""
+        import dataclasses as _dc
+
+        if all(t.when_unsatisfiable == "DoNotSchedule" for t in pod.topology_spread) and all(
+            t.weight is None for t in pod.affinity_terms
+        ):
+            return pod
+        act_tsc = {i for (_w, _k, tag, i) in active if tag == "tsc"}
+        act_aff = {i for (_w, _k, tag, i) in active if tag == "aff"}
+        tscs = []
+        for i, t in enumerate(pod.topology_spread):
+            if t.when_unsatisfiable == "DoNotSchedule":
+                tscs.append(t)
+            elif i in act_tsc:
+                tscs.append(_dc.replace(t, when_unsatisfiable="DoNotSchedule"))
+        affs = []
+        for i, t in enumerate(pod.affinity_terms):
+            if t.weight is None:
+                affs.append(t)
+            elif i in act_aff:
+                affs.append(_dc.replace(t, weight=None))
+        return _dc.replace(pod, topology_spread=tscs, affinity_terms=affs)
 
     def _pod_requirement_alternatives(self, pod: Pod, active_prefs) -> List[Requirements]:
         """nodeSelector ∧ (one OR'd required node-affinity term) ∧ active
         preferences — kube semantics: a node matches if ANY term matches, so
         each term yields an alternative tried per target in input order."""
         base = Requirements.from_labels(pod.node_selector)
+        if pod.volume_zones is not None:
+            # bound zonal PVs pin the pod to their zones (scheduling.md:430+);
+            # an empty tuple (conflicting volumes) is unsatisfiable
+            base.add(Requirement.create(wk.ZONE_LABEL, IN, list(pod.volume_zones)))
         for _w, pref in active_prefs:
             base = base.union(pref)
         if not pod.node_affinity:
             return [base]
         return [base.union(term) for term in pod.node_affinity]
 
-    def _try_schedule(self, pod: Pod, active_prefs, placements) -> Optional[str]:
+    def _try_schedule(self, pod: Pod, eff: Pod, active_prefs, placements) -> Optional[str]:
         alternatives = self._pod_requirement_alternatives(pod, active_prefs)
 
         # 1. existing nodes, in order
         for n in self.inp.nodes:
-            if any(self._try_existing(pod, reqs, n) for reqs in alternatives):
+            if any(self._try_existing(pod, eff, reqs, n) for reqs in alternatives):
                 placements[pod.meta.uid] = ("node", n.id)
                 return None
 
         # 2. open claims, in order
         for c in self.claims:
-            if any(self._try_claim(pod, reqs, c) for reqs in alternatives):
+            if any(self._try_claim(pod, eff, reqs, c) for reqs in alternatives):
                 placements[pod.meta.uid] = ("claim", c.index)
                 return None
 
@@ -511,7 +593,7 @@ class Scheduler:
                 last_err = f"nodepool {pool.name} limits exceeded"
                 continue
             c = VirtualNode(len(self.claims), pool, self._daemon_overhead(pool))
-            if any(self._try_claim(pod, reqs, c, new=True) for reqs in alternatives):
+            if any(self._try_claim(pod, eff, reqs, c, new=True) for reqs in alternatives):
                 self.claims.append(c)
                 self.topo.add_hostname(c.hostname)
                 placements[pod.meta.uid] = ("claim", c.index)
@@ -522,7 +604,7 @@ class Scheduler:
 
     # -- existing-node path -------------------------------------------------
 
-    def _try_existing(self, pod: Pod, pod_reqs: Requirements, n: ExistingNode) -> bool:
+    def _try_existing(self, pod: Pod, eff: Pod, pod_reqs: Requirements, n: ExistingNode) -> bool:
         if not n.schedulable:
             return False
         if not tolerates_all(pod.tolerations, n.taints):
@@ -537,7 +619,7 @@ class Scheduler:
             return False
         domains = {k: n.labels[k] for k in wk.TOPOLOGY_KEYS if k in n.labels}
         domains.setdefault(wk.HOSTNAME_LABEL, n.id)
-        if not self._topo_admits_fixed(pod, pod_reqs, domains):
+        if not self._topo_admits_fixed(eff, pod_reqs, domains):
             return False
         # commit (the placement log in TopologyState.record covers topology
         # bookkeeping; n.pod_labels stays as-input to avoid double counting)
@@ -549,7 +631,7 @@ class Scheduler:
 
     # -- claim path ---------------------------------------------------------
 
-    def _try_claim(self, pod: Pod, pod_reqs: Requirements, c: VirtualNode, new: bool = False) -> bool:
+    def _try_claim(self, pod: Pod, eff: Pod, pod_reqs: Requirements, c: VirtualNode, new: bool = False) -> bool:
         state = c.try_add(pod, pod_reqs)
         if state is None:
             return False
@@ -557,7 +639,7 @@ class Scheduler:
         # Topology/affinity: compute per-key narrowing before committing.
         saved_reqs, saved_opts = c.requirements, c.options
         c.requirements, c.options = combined, survivors
-        ok, domains = self._topo_admits_claim(pod, pod_reqs, c)
+        ok, domains = self._topo_admits_claim(eff, pod_reqs, c)
         if not ok:
             c.requirements, c.options = saved_reqs, saved_opts
             return False

@@ -101,7 +101,11 @@ def canonicalize_placements(inp: SolverInput, res: SolverResult) -> SolverResult
                     claim_pods[t[1]].append(uid)
                 i += 1
         for j in range(i, len(rp)):
-            errors[rp[j].meta.uid] = err_msg or "unschedulable"
+            # keep each pod's own diagnostic when the source recorded one;
+            # the run-level message only backfills pods whose uid moved
+            # within the run during canonicalization
+            uid = rp[j].meta.uid
+            errors[uid] = res.errors.get(uid) or err_msg or "unschedulable"
 
     claims = [
         _replace(c, pod_uids=claim_pods[i]) for i, c in enumerate(res.claims)
@@ -286,6 +290,45 @@ def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
         Sp=Sp, Gp=Gp, Tp=Tp, Ep=Ep, Pp=Pp, Qp=Qp, Vp=Vp, W=W,
     )
     return args, dims
+
+
+def min_values_post_check(qinp: SolverInput, result: SolverResult) -> bool:
+    """minValues floors for the tensor backends (nodepools.md:268-330): the
+    kernels narrow type sets without counting distinct requirement values, so
+    each claim's FINAL surviving set is checked here — equivalent to the
+    oracle's per-add checks because options only shrink (scheduler.
+    min_values_ok). A violation routes the whole solve to the fallback
+    chain, whose oracle enforces floors during packing."""
+    floors = {}
+    types_by_pool = {}
+    for p in qinp.nodepools:
+        fl = [(k, r) for k, r in p.requirements.items() if r.min_values]
+        if fl:
+            floors[p.name] = fl
+            types_by_pool[p.name] = {it.name: it for it in p.instance_types}
+    if not floors:
+        return True
+    for claim in result.claims:
+        fl = floors.get(claim.nodepool)
+        if not fl:
+            continue
+        types = types_by_pool[claim.nodepool]
+        survivors = [types[n] for n in claim.instance_type_names if n in types]
+        for k, r in fl:
+            eff = r
+            cr = claim.requirements.get(k)
+            if cr is not None:
+                eff = r.intersect(cr)
+            vals: set = set()
+            for it in survivors:
+                ir = it.requirements.get(k)
+                if ir is not None and not ir.complement:
+                    vals.update(v for v in ir.values if eff.has(v))
+                if len(vals) >= r.min_values:
+                    break
+            if len(vals) < r.min_values:
+                return False
+    return True
 
 
 def initial_claim_bucket(total_pods: int, max_claims: int) -> int:
@@ -477,7 +520,7 @@ class TPUSolver(Solver):
 
         def finish():
             out = handle()
-            if out is None:
+            if out is None or not min_values_post_check(qinp, out):
                 self.stats["fallback_solves"] += 1
                 return self.fallback.solve(qinp)
             self.stats["device_solves"] += 1

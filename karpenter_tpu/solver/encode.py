@@ -161,6 +161,7 @@ def _pod_signature_uncached(pod: Pod) -> tuple:
         ),
         tuple(sorted(pod.meta.labels.items())),
         pod.priority,
+        pod.volume_zones,
     )
 
 
@@ -400,6 +401,7 @@ def quantize_input(inp: SolverInput) -> SolverInput:
         daemonset_pods=_quantized_pods(inp.daemonset_pods),
         zones=inp.zones,
         capacity_types=inp.capacity_types,
+        preference_policy=inp.preference_policy,
     )
 
 
@@ -514,6 +516,7 @@ def _core_key(pods_f: List[Pod], inp: SolverInput) -> Tuple[tuple, np.ndarray]:
             ds_key,
             tuple(inp.zones),
             tuple(inp.capacity_types),
+            inp.preference_policy,
         ),
         ids,
     )
@@ -615,9 +618,19 @@ def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
     group_zone_tscs: List[List[tuple]] = []
     group_zone_antis: List[List[tuple]] = []
     group_zone_affs: List[List[tuple]] = []
+    respect_prefs = inp.preference_policy != "Ignore"
     for g, pl in enumerate(group_pods):
         pod = pl[0]
-        if len(pod.node_affinity) > 1 or pod.preferred_node_affinity:
+        if len(pod.node_affinity) > 1:
+            fallback[g] = True
+        if respect_prefs and (
+            pod.preferred_node_affinity
+            or any(t.when_unsatisfiable != "DoNotSchedule" for t in pod.topology_spread)
+            or any(t.weight is not None for t in pod.affinity_terms)
+        ):
+            # preferences relax as-required in the oracle (scheduling.md:
+            # 212-219); under --preference-policy=Ignore they vanish and the
+            # device path keeps the solve
             fallback[g] = True
         ztscs: List[tuple] = []
         zantis: List[tuple] = []

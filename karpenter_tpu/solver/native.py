@@ -133,5 +133,13 @@ class NativeSolver(Solver):
         if out is None:
             self.stats["fallback_solves"] += 1
             return self.fallback.solve(qinp)
+        result = decode(enc, *out)
+        from .backend import min_values_post_check
+
+        if not min_values_post_check(qinp, result):
+            # claim narrowed below a NodePool flexibility floor: replay on
+            # the oracle, which enforces minValues during packing
+            self.stats["fallback_solves"] += 1
+            return self.fallback.solve(qinp)
         self.stats["native_solves"] += 1
-        return decode(enc, *out)
+        return result

@@ -1,0 +1,201 @@
+"""Budget cron windows + full disruption-cost ranking
+(website/.../concepts/disruption.md:274-330).
+
+Clock-driven: a fake wall clock steps through budget windows; ranking tests
+assert candidate order under pod-deletion-cost annotations and node lifetime
+remaining.
+"""
+
+from datetime import datetime, timezone
+
+import pytest
+
+from karpenter_tpu.api import wellknown as wk
+from karpenter_tpu.api.objects import (
+    Budget,
+    Disruption,
+    NodeClaimTemplate,
+    NodePool,
+    ObjectMeta,
+    Pod,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.controllers import store as st
+from karpenter_tpu.disruption.controller import DisruptionController
+from karpenter_tpu.disruption.cron import Cron, in_window
+from karpenter_tpu.operator.operator import new_kwok_operator
+from karpenter_tpu.utils.resources import Resources
+
+from tests.test_e2e_kwok import FakeClock
+
+
+def ts(y, mo, d, h, mi) -> float:
+    return datetime(y, mo, d, h, mi, tzinfo=timezone.utc).timestamp()
+
+
+class TestCron:
+    def test_basic_match(self):
+        c = Cron("0 9 * * *")
+        assert c.matches(datetime(2026, 7, 29, 9, 0, tzinfo=timezone.utc))
+        assert not c.matches(datetime(2026, 7, 29, 9, 1, tzinfo=timezone.utc))
+        assert not c.matches(datetime(2026, 7, 29, 10, 0, tzinfo=timezone.utc))
+
+    def test_ranges_steps_lists(self):
+        c = Cron("*/15 8-17 * * 1-5")
+        dt = datetime(2026, 7, 29, 8, 45, tzinfo=timezone.utc)  # a Wednesday
+        assert c.matches(dt)
+        assert not c.matches(dt.replace(minute=50))
+        sat = datetime(2026, 8, 1, 8, 45, tzinfo=timezone.utc)
+        assert not c.matches(sat)
+
+    def test_sunday_is_zero(self):
+        c = Cron("0 0 * * 0")
+        sun = datetime(2026, 8, 2, 0, 0, tzinfo=timezone.utc)
+        assert c.matches(sun)
+        assert not c.matches(sun.replace(day=3))  # Monday
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            Cron("0 9 * *")
+        with pytest.raises(ValueError):
+            Cron("61 9 * * *")
+
+    def test_in_window(self):
+        # 09:00 UTC daily, one hour long
+        assert in_window("0 9 * * *", 3600, ts(2026, 7, 29, 9, 30))
+        assert in_window("0 9 * * *", 3600, ts(2026, 7, 29, 9, 0))
+        assert not in_window("0 9 * * *", 3600, ts(2026, 7, 29, 10, 0))
+        assert not in_window("0 9 * * *", 3600, ts(2026, 7, 29, 8, 59))
+
+
+def mkpool_budgets(budgets):
+    return NodePool(
+        meta=ObjectMeta(name="default"),
+        template=NodeClaimTemplate(),
+        disruption=Disruption(
+            consolidation_policy="WhenEmptyOrUnderutilized",
+            consolidate_after_s=0.0,
+            budgets=budgets,
+        ),
+    )
+
+
+def mkpod(name, cpu="200m", mem="256Mi", labels=None, annotations=None, **kw):
+    return Pod(
+        meta=ObjectMeta(name=name, uid=name, labels=labels or {},
+                        annotations=annotations or {}),
+        requests=Resources.parse({"cpu": cpu, "memory": mem}),
+        **kw,
+    )
+
+
+class FakeWallClock:
+    def __init__(self, epoch):
+        self.t = epoch
+
+    def __call__(self):
+        return self.t
+
+
+def two_node_setup(op, budgets=None, annotations=(None, None)):
+    op.store.create(st.NODEPOOLS, mkpool_budgets(budgets or [Budget()]))
+    tsc = TopologySpreadConstraint(
+        max_skew=1, topology_key=wk.HOSTNAME_LABEL, label_selector={"app": "w"}
+    )
+    for i in range(2):
+        op.store.create(
+            st.PODS,
+            mkpod(f"w{i}", labels={"app": "w"}, topology_spread=[tsc],
+                  annotations=annotations[i] or {}),
+        )
+    op.manager.settle()
+    assert len(op.store.list(st.NODES)) == 2
+    for i in range(2):
+        p = op.store.get(st.PODS, f"w{i}")
+        p.topology_spread = []
+        op.store.update(st.PODS, p)
+    op.clock.advance(30)
+
+
+class TestBudgetWindows:
+    def _op(self):
+        clock = FakeClock()
+        o = new_kwok_operator(clock=clock)
+        o.clock = clock
+        return o
+
+    def _dc(self, op) -> DisruptionController:
+        return next(
+            c for c in op.manager.controllers if isinstance(c, DisruptionController)
+        )
+
+    def test_zero_budget_inside_window_blocks(self):
+        op = self._op()
+        freeze = [Budget(nodes="0", schedule="0 9 * * *", duration_s=3600.0)]
+        two_node_setup(op, budgets=freeze)
+        dc = self._dc(op)
+        dc.wall_clock = FakeWallClock(ts(2026, 7, 29, 9, 30))
+        op.manager.settle()
+        assert len(op.store.list(st.NODES)) == 2, "frozen window must block"
+
+        # window over: the budget no longer constrains; default 10%->ceil
+        # still allows one node per loop and consolidation proceeds
+        dc.wall_clock = FakeWallClock(ts(2026, 7, 29, 11, 0))
+        op.manager.settle()
+        assert len(op.store.list(st.NODES)) < 2
+
+    def test_schedule_without_duration_never_constrains(self):
+        op = self._op()
+        broken = [Budget(nodes="0", schedule="0 9 * * *", duration_s=None)]
+        two_node_setup(op, budgets=broken)
+        dc = self._dc(op)
+        dc.wall_clock = FakeWallClock(ts(2026, 7, 29, 9, 30))
+        op.manager.settle()
+        assert len(op.store.list(st.NODES)) < 2
+
+
+class TestRanking:
+    def _op(self):
+        clock = FakeClock()
+        o = new_kwok_operator(clock=clock)
+        o.clock = clock
+        return o
+
+    def _dc(self, op) -> DisruptionController:
+        return next(
+            c for c in op.manager.controllers if isinstance(c, DisruptionController)
+        )
+
+    def test_deletion_cost_orders_candidates(self):
+        op = self._op()
+        two_node_setup(
+            op,
+            annotations=({wk.POD_DELETION_COST_ANNOTATION: "5000"}, None),
+        )
+        cands = self._dc(op)._candidates()
+        assert len(cands) == 2
+        # w1's node (no deletion cost) must rank first (cheapest to disrupt)
+        assert [p.meta.name for p in cands[0].pods] == ["w1"]
+        assert cands[0].cost < cands[1].cost
+
+    def test_negative_deletion_cost_prefers_node(self):
+        op = self._op()
+        two_node_setup(
+            op,
+            annotations=({wk.POD_DELETION_COST_ANNOTATION: "-900"}, None),
+        )
+        cands = self._dc(op)._candidates()
+        assert [p.meta.name for p in cands[0].pods] == ["w0"]
+
+    def test_lifetime_remaining_scales_cost(self):
+        op = self._op()
+        two_node_setup(op)
+        dc = self._dc(op)
+        # age one claim close to its expiry: it becomes nearly free to disrupt
+        claims = sorted(op.store.list(st.NODECLAIMS), key=lambda c: c.meta.name)
+        claims[1].expire_after_s = 100.0
+        claims[1].meta.creation_timestamp = op.clock() - 90.0
+        op.store.update(st.NODECLAIMS, claims[1])
+        cands = dc._candidates()
+        assert cands[0].claim.name == claims[1].name
+        assert cands[0].cost < cands[1].cost

@@ -1,0 +1,157 @@
+"""NodePool minValues flexibility floors enforced DURING Solve.
+
+Reference semantics (website/.../concepts/nodepools.md:268-330; scale e2e
+variants test/suites/scale/provisioning_test.go:179,215): a requirement with
+minValues demands that many distinct values among a claim's surviving
+instance types; a pod whose constraints would narrow a claim below the floor
+cannot use that NodePool. Enforced by the oracle at every narrowing step
+(scheduler.min_values_ok) and by the tensor backends via the equivalent
+final-state post-check (backend.min_values_post_check).
+"""
+
+import pytest
+
+from karpenter_tpu.api import wellknown as wk
+from karpenter_tpu.api.objects import ObjectMeta, Pod
+from karpenter_tpu.catalog.catalog import CatalogSpec, generate
+from karpenter_tpu.provisioning.scheduler import NodePoolSpec, SolverInput
+from karpenter_tpu.scheduling.requirements import (
+    EXISTS,
+    IN,
+    Requirement,
+    Requirements,
+)
+from karpenter_tpu.solver.backend import ReferenceSolver, TPUSolver, quantize_input
+from karpenter_tpu.solver.native import NativeSolver
+
+from tests.test_solver_parity import assert_parity, mkpod
+
+CATALOG = generate(CatalogSpec())
+ZONES = ("zone-1a", "zone-1b", "zone-1c")
+FAMILY_KEY = "karpenter.tpu/instance-family"
+N_FAMILIES = len({it.requirements.get(FAMILY_KEY).values_list()[0] for it in CATALOG})
+
+
+def mv_pool(min_values: int, key: str = FAMILY_KEY):
+    return NodePoolSpec(
+        name="flex",
+        weight=0,
+        requirements=Requirements.of(
+            Requirement.create(wk.NODEPOOL_LABEL, IN, ["flex"]),
+            Requirement.create(key, EXISTS, (), min_values=min_values),
+        ),
+        taints=[],
+        instance_types=CATALOG,
+    )
+
+
+class TestOracleMinValues:
+    def test_floor_satisfied_schedules(self):
+        inp = SolverInput(
+            pods=[mkpod(f"p{i}") for i in range(5)],
+            nodes=[],
+            nodepools=[mv_pool(min_values=2)],
+            zones=ZONES,
+        )
+        res = ReferenceSolver().solve(quantize_input(inp))
+        assert not res.errors
+        for c in res.claims:
+            fams = {
+                t.requirements.get(FAMILY_KEY).values_list()[0]
+                for t in CATALOG
+                if t.name in set(c.instance_type_names)
+            }
+            assert len(fams) >= 2
+
+    def test_narrowing_below_floor_fails(self):
+        # pin the pod to ONE family: the floor (2 families) can never be met
+        pod = mkpod("pinned", node_selector={FAMILY_KEY: "m5"})
+        inp = SolverInput(
+            pods=[pod], nodes=[], nodepools=[mv_pool(min_values=2)], zones=ZONES
+        )
+        res = ReferenceSolver().solve(quantize_input(inp))
+        assert "pinned" in res.errors
+
+    def test_impossible_floor_fails_everything(self):
+        inp = SolverInput(
+            pods=[mkpod("p0")],
+            nodes=[],
+            nodepools=[mv_pool(min_values=N_FAMILIES + 10)],
+            zones=ZONES,
+        )
+        res = ReferenceSolver().solve(quantize_input(inp))
+        assert res.errors
+
+    def test_second_pool_picks_up_rejected_pod(self):
+        # higher-weight pool has an unreachable floor; the pod lands on the
+        # plain lower-weight pool instead
+        plain = NodePoolSpec(
+            name="plain",
+            weight=0,
+            requirements=Requirements.of(
+                Requirement.create(wk.NODEPOOL_LABEL, IN, ["plain"])
+            ),
+            taints=[],
+            instance_types=CATALOG,
+        )
+        strict = mv_pool(min_values=N_FAMILIES + 10)
+        strict.weight = 50
+        inp = SolverInput(
+            pods=[mkpod("p0")], nodes=[], nodepools=[strict, plain], zones=ZONES
+        )
+        res = ReferenceSolver().solve(quantize_input(inp))
+        assert not res.errors
+        assert res.claims[0].nodepool == "plain"
+
+
+class TestBackendsMinValues:
+    def test_parity_floor_satisfied(self):
+        inp = SolverInput(
+            pods=[mkpod(f"p{i}", cpu="500m", mem="512Mi") for i in range(12)],
+            nodes=[],
+            nodepools=[mv_pool(min_values=3)],
+            zones=ZONES,
+        )
+        ref, tpu = assert_parity(inp)
+        assert not ref.errors
+
+    def test_device_falls_back_on_violation(self):
+        # the pinned pod violates the floor: the device post-check must route
+        # the solve to the oracle, whose verdict (error) is authoritative
+        pod = mkpod("pinned", node_selector={FAMILY_KEY: "m5"})
+        inp = SolverInput(
+            pods=[pod], nodes=[], nodepools=[mv_pool(min_values=2)], zones=ZONES
+        )
+        solver = TPUSolver()
+        res = solver.solve(inp)
+        assert "pinned" in res.errors
+        ref = ReferenceSolver().solve(quantize_input(inp))
+        assert set(res.errors) == set(ref.errors)
+
+    def test_native_falls_back_on_violation(self):
+        pod = mkpod("pinned", node_selector={FAMILY_KEY: "m5"})
+        inp = SolverInput(
+            pods=[pod], nodes=[], nodepools=[mv_pool(min_values=2)], zones=ZONES
+        )
+        solver = NativeSolver()
+        res = solver.solve(inp)
+        assert "pinned" in res.errors
+
+    def test_parity_mixed_floor_and_plain_pools(self):
+        plain = NodePoolSpec(
+            name="plain",
+            weight=0,
+            requirements=Requirements.of(
+                Requirement.create(wk.NODEPOOL_LABEL, IN, ["plain"])
+            ),
+            taints=[],
+            instance_types=CATALOG,
+        )
+        strict = mv_pool(min_values=2)
+        strict.weight = 50
+        pods = [mkpod(f"p{i}") for i in range(6)]
+        pods.append(mkpod("pinned", node_selector={FAMILY_KEY: "c5"}))
+        inp = SolverInput(
+            pods=pods, nodes=[], nodepools=[strict, plain], zones=ZONES
+        )
+        assert_parity(inp)

@@ -1,0 +1,243 @@
+"""Preference semantics: ScheduleAnyway TSCs, weighted pod-affinity, and
+--preference-policy (scheduling.md:212-219; settings.md:38).
+
+Preferences are treated as required and relaxed one at a time by ascending
+weight; policy Ignore drops them up front (and keeps the solve on device).
+"""
+
+import pytest
+
+from karpenter_tpu.api import wellknown as wk
+from karpenter_tpu.api.objects import (
+    ObjectMeta,
+    Pod,
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.catalog.catalog import CatalogSpec, generate
+from karpenter_tpu.provisioning.scheduler import (
+    ExistingNode,
+    NodePoolSpec,
+    SolverInput,
+)
+from karpenter_tpu.scheduling.requirements import IN, Requirement, Requirements
+from karpenter_tpu.solver.backend import ReferenceSolver, TPUSolver, quantize_input
+from karpenter_tpu.utils.resources import Resources
+
+CATALOG = generate(CatalogSpec())
+ZONES = ("zone-1a", "zone-1b", "zone-1c")
+
+
+def pool(name="default", reqs=None):
+    r = Requirements.of(Requirement.create(wk.NODEPOOL_LABEL, IN, [name]))
+    if reqs:
+        r = r.union(reqs)
+    return NodePoolSpec(name=name, weight=0, requirements=r, taints=[], instance_types=CATALOG)
+
+
+def mkpod(name, cpu="500m", mem="512Mi", labels=None, **kw):
+    return Pod(
+        meta=ObjectMeta(name=name, uid=name, labels=labels or {}),
+        requests=Resources.parse({"cpu": cpu, "memory": mem}),
+        **kw,
+    )
+
+
+def mknode(nid, zone, free_cpu="8"):
+    free = Resources.parse({"cpu": free_cpu, "memory": "32Gi"})
+    free["pods"] = 110
+    return ExistingNode(
+        id=nid,
+        labels={
+            wk.ZONE_LABEL: zone,
+            wk.CAPACITY_TYPE_LABEL: "on-demand",
+            wk.HOSTNAME_LABEL: nid,
+            wk.ARCH_LABEL: "amd64",
+            wk.OS_LABEL: "linux",
+        },
+        taints=[],
+        free=free,
+    )
+
+
+def solve(inp):
+    return ReferenceSolver().solve(quantize_input(inp))
+
+
+class TestScheduleAnywaySpread:
+    def _pods(self, n):
+        tsc = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=wk.ZONE_LABEL,
+            when_unsatisfiable="ScheduleAnyway",
+            label_selector={"app": "soft"},
+        )
+        return [
+            mkpod(f"s{i}", labels={"app": "soft"}, topology_spread=[tsc])
+            for i in range(n)
+        ]
+
+    def test_honored_when_satisfiable(self):
+        # three pods, three zones of capacity: the soft spread behaves like a
+        # hard one and lands one per zone
+        inp = SolverInput(
+            pods=self._pods(3), nodes=[], nodepools=[pool()], zones=ZONES
+        )
+        res = solve(inp)
+        assert not res.errors
+        zones = set()
+        for c in res.claims:
+            zr = c.requirements.get(wk.ZONE_LABEL)
+            assert zr is not None
+            zones.update(zr.values_list())
+        assert len(zones) == 3
+
+    def test_relaxed_when_impossible(self):
+        # the pool only offers one zone: a HARD maxSkew=1 spread would leave
+        # pods unschedulable past the first; the soft one relaxes instead
+        one_zone = pool(
+            reqs=Requirements.of(Requirement.create(wk.ZONE_LABEL, IN, ["zone-1a"]))
+        )
+        inp = SolverInput(
+            pods=self._pods(3), nodes=[], nodepools=[one_zone], zones=ZONES
+        )
+        res = solve(inp)
+        assert not res.errors, res.errors
+
+        # hard variant really is impossible — proves relaxation did the work
+        hard = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.ZONE_LABEL, label_selector={"app": "soft"}
+        )
+        pods = [
+            mkpod(f"h{i}", labels={"app": "soft"}, topology_spread=[hard])
+            for i in range(3)
+        ]
+        res_hard = solve(
+            SolverInput(pods=pods, nodes=[], nodepools=[one_zone], zones=ZONES)
+        )
+        assert res_hard.errors
+
+
+class TestWeightedPodAffinity:
+    def test_weighted_anti_honored_when_capacity_allows(self):
+        term = PodAffinityTerm(
+            label_selector={"svc": "db"},
+            topology_key=wk.ZONE_LABEL,
+            anti=True,
+            weight=100,
+        )
+        pods = [
+            mkpod(f"db{i}", labels={"svc": "db"}, affinity_terms=[term])
+            for i in range(3)
+        ]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        res = solve(inp)
+        assert not res.errors
+        zones = [
+            tuple(sorted(c.requirements.get(wk.ZONE_LABEL).values_list()))
+            for c in res.claims
+        ]
+        assert len(set(zones)) == len(zones) == 3, zones
+
+    def test_weighted_anti_relaxed_when_impossible(self):
+        # only one zone available: required anti would strand 2 pods; the
+        # weighted term relaxes and all three schedule
+        term = PodAffinityTerm(
+            label_selector={"svc": "db"},
+            topology_key=wk.ZONE_LABEL,
+            anti=True,
+            weight=100,
+        )
+        pods = [
+            mkpod(f"db{i}", labels={"svc": "db"}, affinity_terms=[term])
+            for i in range(3)
+        ]
+        one_zone = pool(
+            reqs=Requirements.of(Requirement.create(wk.ZONE_LABEL, IN, ["zone-1b"]))
+        )
+        res = solve(SolverInput(pods=pods, nodes=[], nodepools=[one_zone], zones=ZONES))
+        assert not res.errors, res.errors
+
+    def test_relax_order_by_ascending_weight(self):
+        # two soft anti terms, weights 10 (svc) and 90 (tier); only two zones
+        # of capacity for three mutually-exclusive pods: the LOW-weight term
+        # must be sacrificed first, keeping the heavy one satisfied
+        nodes = [mknode("na", "zone-1a"), mknode("nb", "zone-1b")]
+        def pods():
+            out = []
+            for i in range(3):
+                out.append(
+                    mkpod(
+                        f"p{i}",
+                        labels={"svc": "s", "tier": "t" if i < 2 else "u"},
+                        affinity_terms=[
+                            PodAffinityTerm({"svc": "s"}, wk.ZONE_LABEL, anti=True, weight=10),
+                            PodAffinityTerm({"tier": "t"}, wk.ZONE_LABEL, anti=True, weight=90),
+                        ],
+                    )
+                )
+            return out
+        one_pool = pool(
+            reqs=Requirements.of(
+                Requirement.create(wk.ZONE_LABEL, IN, ["zone-1a", "zone-1b"])
+            )
+        )
+        res = solve(SolverInput(pods=pods(), nodes=nodes, nodepools=[one_pool], zones=ZONES))
+        assert not res.errors, res.errors
+        # the two tier=t pods must sit in different zones (heavy term held)
+        zone_of = {}
+        for uid, tgt in res.placements.items():
+            if tgt[0] == "node":
+                zone_of[uid] = "zone-1a" if tgt[1] == "na" else "zone-1b"
+        claims_zone = {
+            i: tuple(c.requirements.get(wk.ZONE_LABEL).values_list())
+            for i, c in enumerate(res.claims)
+        }
+        for uid, tgt in res.placements.items():
+            if tgt[0] == "claim":
+                zone_of[uid] = claims_zone[tgt[1]][0]
+        assert zone_of["p0"] != zone_of["p1"], zone_of
+
+
+class TestPreferencePolicy:
+    def test_ignore_drops_preferred_node_affinity(self):
+        prefs = [(50, Requirements.of(Requirement.create("nonexistent-label", IN, ["x"])))]
+        pods = [mkpod("p0", preferred_node_affinity=prefs)]
+        inp = SolverInput(
+            pods=pods, nodes=[], nodepools=[pool()], zones=ZONES,
+            preference_policy="Ignore",
+        )
+        res = solve(inp)
+        assert not res.errors
+
+    def test_ignore_keeps_device_path(self):
+        prefs = [(50, Requirements.of(Requirement.create(wk.ARCH_LABEL, IN, ["arm64"])))]
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.ZONE_LABEL,
+            when_unsatisfiable="ScheduleAnyway", label_selector={"app": "x"},
+        )
+        pods = [
+            mkpod(f"p{i}", labels={"app": "x"},
+                  preferred_node_affinity=list(prefs), topology_spread=[tsc])
+            for i in range(4)
+        ]
+        inp = SolverInput(
+            pods=pods, nodes=[], nodepools=[pool()], zones=ZONES,
+            preference_policy="Ignore",
+        )
+        solver = TPUSolver()
+        res = solver.solve(inp)
+        assert not res.errors
+        assert solver.stats["device_solves"] == 1, solver.stats
+
+    def test_respect_routes_preferences_to_oracle(self):
+        prefs = [(50, Requirements.of(Requirement.create(wk.ARCH_LABEL, IN, ["arm64"])))]
+        pods = [mkpod("p0", preferred_node_affinity=prefs)]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        solver = TPUSolver()
+        res = solver.solve(inp)
+        assert not res.errors
+        assert solver.stats["fallback_solves"] == 1
+        # the preference was honored: the claim narrowed to arm64 types
+        arch = res.claims[0].requirements.get(wk.ARCH_LABEL)
+        assert arch is not None and arch.values_list() == ["arm64"]

