@@ -37,21 +37,34 @@ SNAPSHOT_KINDS = (
 )
 
 
-def save_snapshot(store: st.Store, cloud, path: str) -> None:
+def save_snapshot(store: st.Store, cloud, path: str, now: Optional[float] = None) -> None:
     """Atomic snapshot (tmp + rename): store kinds + cloud instances.
 
     Serialization happens WHILE both locks are held — the collected lists
     reference the live objects, and other threads mutate fields in place
     (deletion timestamps, PVC bindings), so pickling after release could
     tear the snapshot or crash mid-iteration. The dump goes to memory under
-    the locks; only the file write happens outside."""
-    with store._lock, cloud._lock:
+    the locks; only the file write happens outside. Lock order is cloud
+    before store, matching KwokCloud.create_fleet (which holds its lock
+    while fabricating Node objects through the store). `now` (the control-
+    plane clock) is recorded so restore can rebase monotonic timestamps.
+
+    Cost note: the dump serializes the whole store under the lock — at 5s
+    cadence this is the kwok ConfigMap-backup trade-off, and the controller
+    skips entirely when the rv high-water mark hasn't moved."""
+    with cloud._lock, store._lock:
         objects = {kind: list(store._objects.get(kind, {}).values()) for kind in SNAPSHOT_KINDS}
         rv = next(store._rv)  # monotonic observation of the rv high-water mark
         instances = dict(cloud._instances)
         seq = next(cloud._seq)  # observe; re-prime on restore
         payload = pickle.dumps(
-            {"objects": objects, "instances": instances, "rv": rv, "seq": seq}
+            {
+                "objects": objects,
+                "instances": instances,
+                "rv": rv,
+                "seq": seq,
+                "now": now if now is not None else time.monotonic(),
+            }
         )
     d = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(dir=d, prefix=".snap-")
@@ -64,18 +77,39 @@ def save_snapshot(store: st.Store, cloud, path: str) -> None:
             os.unlink(tmp)
 
 
-def restore_snapshot(store: st.Store, cloud, path: str) -> bool:
-    """Hydrate an EMPTY store + cloud from a snapshot file; True on restore."""
+def restore_snapshot(store: st.Store, cloud, path: str, now: Optional[float] = None) -> bool:
+    """Hydrate an EMPTY store + cloud from a snapshot file; True on restore.
+
+    Persisted timestamps are CLOCK_MONOTONIC values from the dead process —
+    meaningless on a rebooted machine. Every known timestamp field is rebased
+    by (now - snapshot_now) so AGES are preserved: GC grace, expiry, and
+    disruption lifetime math keep working after restore."""
     if not os.path.exists(path):
         return False
     with open(path, "rb") as f:
         payload = pickle.load(f)
+    delta = (now if now is not None else time.monotonic()) - payload.get("now", 0.0)
+
+    def rebase(obj) -> None:
+        m = getattr(obj, "meta", None)
+        if m is not None:
+            m.creation_timestamp += delta
+            if m.deletion_timestamp:
+                m.deletion_timestamp += delta
+        for f in ("last_transition", "launched_at", "registered_at"):
+            v = getattr(obj, f, None)
+            if isinstance(v, (int, float)) and v:
+                setattr(obj, f, v + delta)
+
     with store._lock:
         for kind, objs in payload["objects"].items():
             for obj in objs:
+                rebase(obj)
                 store._objects[kind][store._key(obj)] = obj
         store.bump_to(payload.get("rv", 0))
     with cloud._lock:
+        for inst in payload["instances"].values():
+            inst.launch_time += delta
         cloud._instances.update(payload["instances"])
         import itertools
 
@@ -97,11 +131,20 @@ class SnapshotController:
         self.interval_s = interval_s
         self.clock = clock
         self._last: Optional[float] = None
+        self._last_rv: int = -1
 
     def reconcile(self) -> bool:
         now = self.clock()
         if self._last is not None and now - self._last < self.interval_s:
             return False
-        save_snapshot(self.store, self.cloud, self.path)
+        # skip when nothing changed: the rv high-water mark is cheap to read
+        # and an idle cluster should not pay the serialization stall
+        with self.store._lock:
+            rv = next(self.store._rv)
+        if rv <= self._last_rv + 1:
+            self._last = now
+            return False
+        save_snapshot(self.store, self.cloud, self.path, now=now)
         self._last = now
+        self._last_rv = rv
         return False  # snapshots are not cluster progress

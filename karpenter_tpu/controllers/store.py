@@ -138,20 +138,25 @@ class Store:
         """Deliver queued events OUTSIDE the store lock, in rv order, from a
         single drainer at a time: a slow watcher never stalls other threads'
         mutations (they enqueue and return; the active drainer delivers
-        their events in order when the watcher yields)."""
-        if not self._dispatch_lock.acquire(blocking=False):
-            return  # another thread is draining; it delivers our event too
-        try:
-            while True:
-                try:
-                    event, kind, obj = self._pending.popleft()
-                except IndexError:
-                    return
-                for k, fn in list(self._watchers):
-                    if k is None or k == kind:
-                        fn(event, kind, obj)
-        finally:
-            self._dispatch_lock.release()
+        their events in order when the watcher yields).
+
+        The outer loop closes the lost-wakeup window: a thread that enqueued
+        while the drainer was between its empty-check and its lock release
+        re-checks after the release instead of assuming delivery."""
+        while self._pending:
+            if not self._dispatch_lock.acquire(blocking=False):
+                return  # an active drainer will re-check after releasing
+            try:
+                while True:
+                    try:
+                        event, kind, obj = self._pending.popleft()
+                    except IndexError:
+                        break
+                    for k, fn in list(self._watchers):
+                        if k is None or k == kind:
+                            fn(event, kind, obj)
+            finally:
+                self._dispatch_lock.release()
 
 
 # Canonical kind names

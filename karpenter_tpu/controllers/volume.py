@@ -93,9 +93,15 @@ class VolumeTopologyController:
             pvc = pvcs.get((pod.meta.namespace, claim_name))
             if pvc is None or pvc.volume_name is not None:
                 continue
-            self._pv_seq += 1
+            # seq restarts at 0 after a snapshot restore while restored PVs
+            # keep their names — skip past collisions instead of Conflict-ing
+            while True:
+                self._pv_seq += 1
+                name = f"pv-{claim_name}-{self._pv_seq:04d}"
+                if self.store.try_get(st.PERSISTENTVOLUMES, name) is None:
+                    break
             pv = PersistentVolume(
-                meta=ObjectMeta(name=f"pv-{claim_name}-{self._pv_seq:04d}"),
+                meta=ObjectMeta(name=name),
                 zones=[zone],
                 storage_class=pvc.storage_class,
             )

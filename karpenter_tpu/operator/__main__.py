@@ -68,6 +68,7 @@ def main(argv=None) -> int:
         preference_policy=o.preference_policy,
         snapshot_path=o.snapshot_path or None,
         snapshot_interval_s=o.snapshot_interval_s,
+        warm_start=o.warm_start and o.solver_backend == "tpu",
     )
     serve_endpoints(o.metrics_port, o.health_probe_port)
     log.info("karpenter-tpu starting: solver=%s metrics=:%d", o.solver_backend, o.metrics_port)

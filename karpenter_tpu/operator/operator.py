@@ -62,6 +62,7 @@ def new_kwok_operator(
     preference_policy: str = "Respect",
     snapshot_path: Optional[str] = None,
     snapshot_interval_s: float = 5.0,
+    warm_start: bool = False,
 ) -> Operator:
     store = st.Store()
     types = list(instance_types) if instance_types is not None else generate(CatalogSpec())
@@ -71,7 +72,7 @@ def new_kwok_operator(
         # hydrates instances from ConfigMaps at boot (kwok/ec2/ec2.go:112-232)
         from ..controllers.snapshot import restore_snapshot
 
-        restore_snapshot(store, cloud, snapshot_path)
+        restore_snapshot(store, cloud, snapshot_path, now=clock())
     reservations = CapacityReservationProvider(clock=clock)
     cloud_provider = KwokCloudProvider(cloud, types, reservations=reservations)
     cluster = Cluster(store, clock=clock)
@@ -123,6 +124,16 @@ def new_kwok_operator(
             SnapshotController(store, cloud, snapshot_path,
                                interval_s=snapshot_interval_s, clock=clock)
         )
+    if warm_start and hasattr(solver, "warmup"):
+        # pre-compile standard shape buckets off the boot path: first
+        # production solve hits a warm jit cache instead of a compile stall
+        import threading
+
+        zones = sorted({o.zone for it in types for o in it.offerings})
+        threading.Thread(
+            target=lambda: solver.warmup(types, zones), daemon=True,
+            name="solver-warmup",
+        ).start()
     return Operator(
         store=store,
         cloud=cloud,

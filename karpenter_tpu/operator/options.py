@@ -44,6 +44,8 @@ class Options:
     # kwok provider
     kwok_rate_limits: bool = False
     vm_memory_overhead_percent: float = 0.075  # options.go:36-56
+    # pre-compile solver shape buckets at boot (background thread)
+    warm_start: bool = True
     # durability: periodic store+cloud snapshot with boot-time restore
     # (kwok ConfigMap-backup analog, kwok/ec2/ec2.go:112-232); empty = off
     snapshot_path: str = ""

@@ -408,6 +408,23 @@ def _has_offering(it: InstanceType, reqs: Requirements) -> bool:
     return False
 
 
+def distinct_values_at_least(
+    key: str, eff: "Requirement", floor: int, survivors: Sequence[InstanceType]
+) -> bool:
+    """True iff the surviving instance types expose >= `floor` distinct
+    values for `key` admitted by the effective requirement `eff` — the ONE
+    counting rule behind minValues, shared by the oracle's per-step check
+    and the tensor backends' final-state post-check."""
+    vals: set = set()
+    for it in survivors:
+        ir = it.requirements.get(key)
+        if ir is not None and not ir.complement:
+            vals.update(v for v in ir.values if eff.has(v))
+        if len(vals) >= floor:
+            return True
+    return len(vals) >= floor
+
+
 def min_values_ok(reqs: Requirements, survivors: Sequence[InstanceType]) -> bool:
     """NodePool minValues flexibility floors (nodepools.md:268-330): every
     requirement carrying a floor must retain >= minValues distinct values
@@ -418,14 +435,7 @@ def min_values_ok(reqs: Requirements, survivors: Sequence[InstanceType]) -> bool
     for k, r in reqs.items():
         if not r.min_values:
             continue
-        vals: set = set()
-        for it in survivors:
-            ir = it.requirements.get(k)
-            if ir is not None and not ir.complement:
-                vals.update(v for v in ir.values if r.has(v))
-            if len(vals) >= r.min_values:
-                break
-        if len(vals) < r.min_values:
+        if not distinct_values_at_least(k, r, r.min_values, survivors):
             return False
     return True
 

@@ -308,6 +308,8 @@ def min_values_post_check(qinp: SolverInput, result: SolverResult) -> bool:
             types_by_pool[p.name] = {it.name: it for it in p.instance_types}
     if not floors:
         return True
+    from ..provisioning.scheduler import distinct_values_at_least
+
     for claim in result.claims:
         fl = floors.get(claim.nodepool)
         if not fl:
@@ -319,14 +321,7 @@ def min_values_post_check(qinp: SolverInput, result: SolverResult) -> bool:
             cr = claim.requirements.get(k)
             if cr is not None:
                 eff = r.intersect(cr)
-            vals: set = set()
-            for it in survivors:
-                ir = it.requirements.get(k)
-                if ir is not None and not ir.complement:
-                    vals.update(v for v in ir.values if eff.has(v))
-                if len(vals) >= r.min_values:
-                    break
-            if len(vals) < r.min_values:
+            if not distinct_values_at_least(k, eff, r.min_values, survivors):
                 return False
     return True
 
@@ -527,6 +522,64 @@ class TPUSolver(Solver):
             return out
 
         return AsyncSolve(finish)
+
+    def warmup(self, instance_types, zones, capacity_types=("on-demand", "spot"),
+               pod_presets=(12, 600), with_zone_spread=True) -> int:
+        """Pre-compile the standard shape buckets so the first production
+        solve is not a 6-15s compile stall (VERDICT r3 next #3). Each preset
+        solves a synthetic single-pool surge shaped to the production
+        bucketing (Sp/Gp floors, M doubling ladder); with_zone_spread also
+        compiles the zone-engine variant. Compilations land in the in-process
+        jit cache and the persistent compilation cache. Returns the number of
+        warm solves executed; call from a background thread at operator start
+        (operator.py) so boot isn't blocked."""
+        from ..api import wellknown as wk
+        from ..api.objects import ObjectMeta, Pod, TopologySpreadConstraint
+        from ..provisioning.scheduler import NodePoolSpec, SolverInput
+        from ..scheduling.requirements import IN, Requirement, Requirements
+
+        pool = NodePoolSpec(
+            name="warmup",
+            weight=0,
+            requirements=Requirements.of(
+                Requirement.create(wk.NODEPOOL_LABEL, IN, ["warmup"])
+            ),
+            taints=[],
+            instance_types=list(instance_types),
+        )
+        sizes = [("100m", "128Mi"), ("250m", "512Mi"), ("500m", "1Gi"),
+                 ("1", "2Gi"), ("2", "4Gi"), ("4", "8Gi")]
+        from ..utils.resources import Resources
+
+        n_warm = 0
+        for n in pod_presets:
+            pods = [
+                Pod(
+                    meta=ObjectMeta(name=f"wu{i:05d}", uid=f"wu{i:05d}"),
+                    requests=Resources.parse(dict(zip(("cpu", "memory"), sizes[i % len(sizes)]))),
+                )
+                for i in range(n)
+            ]
+            self.solve(SolverInput(pods=pods, nodes=[], nodepools=[pool],
+                                   zones=tuple(zones), capacity_types=tuple(capacity_types)))
+            n_warm += 1
+        if with_zone_spread and zones:
+            tsc = TopologySpreadConstraint(
+                max_skew=1, topology_key=wk.ZONE_LABEL, label_selector={"app": "wu"}
+            )
+            pods = [
+                Pod(
+                    meta=ObjectMeta(name=f"wz{i:05d}", uid=f"wz{i:05d}",
+                                    labels={"app": "wu"}),
+                    requests=Resources.parse({"cpu": "500m", "memory": "1Gi"}),
+                    topology_spread=[tsc],
+                )
+                for i in range(12)
+            ]
+            self.solve(SolverInput(pods=pods, nodes=[], nodepools=[pool],
+                                   zones=tuple(zones), capacity_types=tuple(capacity_types)))
+            n_warm += 1
+        return n_warm
 
     # -- device path --------------------------------------------------------
 

@@ -49,12 +49,14 @@ def load() -> ctypes.CDLL:
             u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
             lib.ffd_solve_native.restype = ctypes.c_int
             lib.ffd_solve_native.argtypes = (
-                [ctypes.c_int32] * 9
+                [ctypes.c_int32] * 11
                 + [i32p, i32p]  # runs
                 + [i32p, u8p, u8p, u8p, u8p, u8p, u8p]  # groups
                 + [i32p, i32p, u8p]  # types
                 + [u8p, u8p, u8p, i32p, i32p, i32p]  # pools
-                + [i32p, u8p]  # nodes
+                + [i32p, u8p, i32p]  # nodes (free, compat, zone)
+                + [u8p, u8p, i32p, i32p, i32p, i32p]  # hostname sigs (Q)
+                + [u8p, u8p, i32p, i32p, i32p, i32p, i32p]  # zone sigs (V)
                 + [i32p, i32p, i32p, u8p, u8p, u8p, u8p, i32p, i32p, i32p]  # outputs
             )
             _lib = lib
@@ -68,6 +70,7 @@ def solve_encoded(enc: EncodedInput, max_claims: int = 1024):
     S, G, T, E, P = len(enc.run_group), enc.G, enc.T, enc.E, enc.P
     R = enc.group_req.shape[1]
     Z, C = len(enc.zones), len(enc.capacity_types)
+    Q, V = enc.Q, enc.V
     M = max_claims
     u8 = lambda a: np.ascontiguousarray(a, dtype=np.uint8)
     i32 = lambda a: np.ascontiguousarray(a, dtype=np.int32)
@@ -86,7 +89,7 @@ def solve_encoded(enc: EncodedInput, max_claims: int = 1024):
     used = np.zeros(1, np.int32)
 
     rc = lib.ffd_solve_native(
-        S, G, T, E, P, R, Z, C, M,
+        S, G, T, E, P, R, Z, C, M, Q, V,
         i32(enc.run_group), i32(enc.run_count),
         i32(enc.group_req), u8(enc.group_compat_t), u8(enc.group_zone), u8(enc.group_ct),
         u8(enc.group_pool), u8(enc.group_pair), u8(~enc.group_fallback),
@@ -95,7 +98,11 @@ def solve_encoded(enc: EncodedInput, max_claims: int = 1024):
         i32(enc.pool_daemon),
         i32(np.where(enc.pool_limit < 0, INT32_MAX, enc.pool_limit)),
         i32(enc.pool_usage),
-        i32(enc.node_free), u8(enc.node_compat),
+        i32(enc.node_free), u8(enc.node_compat), i32(enc.node_zone),
+        u8(enc.q_member), u8(enc.q_owner), i32(enc.q_kind), i32(enc.q_cap),
+        i32(enc.node_q_member), i32(enc.node_q_owner),
+        u8(enc.v_member), u8(enc.v_owner), i32(enc.v_kind), i32(enc.v_cap),
+        i32(enc.v_primary), i32(enc.v_aff), i32(enc.v_count0),
         take_e, take_c, leftover, c_mask, c_zone, c_ct, c_gmask, c_pool, c_cum, used,
     )
     if rc != 0:
@@ -120,10 +127,11 @@ class NativeSolver(Solver):
             enc.group_fallback.any()
             or enc.has_topology
             or enc.has_affinity
-            or enc.Q > 0  # hostname caps: device kernel only (C++ port pending)
-            or enc.V > 0  # zone constraints: device event engine only
             or enc.G == 0
         ):
+            # hostname (Q) and zone (V) constraints run in the native core
+            # (per-pod placement path); what still routes to the oracle is
+            # the same set the device kernel can't express
             self.stats["fallback_solves"] += 1
             return self.fallback.solve(qinp)
         try:

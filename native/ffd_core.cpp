@@ -14,10 +14,15 @@
 // design: one solve is inherently sequential; parallelism lives above
 // (batched candidate simulation) and below (vectorized device kernel).
 //
-// Algorithm: identical to solver/tpu/ffd.py — runs of identical pods pour
-// first-fit over existing nodes, then open claims, then closed-form new-node
-// opening per pool in priority order with limit accounting. Arrays are
-// row-major int32/uint8 exactly as encode.py lays them out (unpadded).
+// Algorithm: identical semantics to solver/tpu/ffd.py. Runs of identical
+// pods pour first-fit over existing nodes, then open claims, then
+// closed-form new-node opening per pool in priority order with limit
+// accounting. Hostname constraints (Q axis: per-target matching-pod caps)
+// bound every pour. Zone constraints (V axis: spread skew, (anti-)affinity)
+// switch the run to PER-POD placement — the sequential core doesn't need the
+// device's closed-form event batching, it just walks pods applying the
+// joint allowed-zone set and the commit rules of solver/SPEC.md ("Topology
+// spread", "Inter-pod affinity", joint narrowing).
 
 #include <cstdint>
 #include <cstring>
@@ -27,10 +32,6 @@
 namespace {
 
 constexpr int32_t BIG = 1 << 30;
-
-struct Dims {
-  int32_t S, G, T, E, P, R, Z, C, M;
-};
 
 inline int32_t fit_count_row(const int32_t* alloc, const int32_t* cum,
                              const int32_t* req, int32_t R) {
@@ -45,6 +46,31 @@ inline int32_t fit_count_row(const int32_t* alloc, const int32_t* cum,
   return std::max(k, 0);
 }
 
+// Per-target additional-pod allowance under the hostname sigs (ffd.py
+// _hostname_allowance; SPEC.md hostname floor-0 rule).
+inline int32_t hostname_allow(const int32_t* cm, const int32_t* co,
+                              const int32_t* q_kind, const int32_t* q_cap,
+                              const uint8_t* member_g, const uint8_t* owner_g,
+                              int32_t Q) {
+  int32_t allow = BIG;
+  for (int32_t q = 0; q < Q; ++q) {
+    const bool member = member_g[q], owner = owner_g[q];
+    const bool kind0 = q_kind[q] == 0;
+    const bool relevant = owner || (!kind0 && member);
+    if (!relevant) continue;
+    int32_t a;
+    if (kind0) {
+      a = member ? (q_cap[q] - cm[q]) : (cm[q] + 1 <= q_cap[q] ? BIG : 0);
+    } else if (owner) {
+      a = (cm[q] == 0) ? (member ? 1 : BIG) : 0;
+    } else {  // anti, member only
+      a = (co[q] == 0) ? BIG : 0;
+    }
+    allow = std::min(allow, a);
+  }
+  return std::max(allow, 0);
+}
+
 }  // namespace
 
 extern "C" {
@@ -56,7 +82,7 @@ extern "C" {
 int ffd_solve_native(
     // dims
     int32_t S, int32_t G, int32_t T, int32_t E, int32_t P, int32_t R,
-    int32_t Z, int32_t C, int32_t M,
+    int32_t Z, int32_t C, int32_t M, int32_t Q, int32_t V,
     // runs
     const int32_t* run_group, const int32_t* run_count,
     // groups
@@ -81,6 +107,22 @@ int ffd_solve_native(
     // existing nodes
     const int32_t* node_free,       // [E,R]
     const uint8_t* node_compat,     // [G,E]
+    const int32_t* node_zone,       // [E] (-1 unknown)
+    // hostname constraint sigs (Q axis)
+    const uint8_t* q_member,        // [G,Q]
+    const uint8_t* q_owner,         // [G,Q]
+    const int32_t* q_kind,          // [Q]
+    const int32_t* q_cap,           // [Q]
+    const int32_t* node_q_member,   // [E,Q]
+    const int32_t* node_q_owner,    // [E,Q]
+    // zone constraint sigs (V axis)
+    const uint8_t* v_member,        // [G,V]
+    const uint8_t* v_owner,         // [G,V]
+    const int32_t* v_kind,          // [V]
+    const int32_t* v_cap,           // [V]
+    const int32_t* v_primary,       // [G] owned zone-TSC sig (-1)
+    const int32_t* v_aff,           // [G] owned positive-affinity sig (-1)
+    const int32_t* v_count0,        // [V,Z]
     // outputs
     int32_t* take_e, int32_t* take_c, int32_t* leftover,
     uint8_t* c_mask, uint8_t* c_zone, uint8_t* c_ct, uint8_t* c_gmask,
@@ -99,8 +141,27 @@ int ffd_solve_native(
   int32_t used = 0;
   bool overflow = false;
 
-  std::vector<int32_t> k_t(T);          // per-type capacity scratch
+  // hostname (Q) counts per target
+  std::vector<int32_t> e_cm(node_q_member, node_q_member + static_cast<size_t>(E) * Q);
+  std::vector<int32_t> e_co(node_q_owner, node_q_owner + static_cast<size_t>(E) * Q);
+  std::vector<int32_t> c_cm(static_cast<size_t>(M) * Q, 0);
+  std::vector<int32_t> c_co(static_cast<size_t>(M) * Q, 0);
+  // zone (V) state
+  std::vector<int32_t> v_count(v_count0, v_count0 + static_cast<size_t>(V) * Z);
+  std::vector<uint8_t> v_owner_z(static_cast<size_t>(V) * Z, 0);
+  std::vector<int32_t> c_vm(static_cast<size_t>(M) * V, 0);
+  std::vector<uint8_t> c_vo(static_cast<size_t>(M) * V, 0);
+
+  std::vector<int32_t> k_t(T);  // per-type capacity scratch
   std::vector<uint8_t> fit_t(T);
+  std::vector<uint8_t> A(Z), A_base(Z), inter(Z);
+  std::vector<int32_t> charge_one(R);
+
+  auto claim_zone_count = [&](int32_t m) {
+    int32_t n = 0;
+    for (int32_t z = 0; z < Z; ++z) n += c_zone[static_cast<size_t>(m) * Z + z] ? 1 : 0;
+    return n;
+  };
 
   for (int32_t s = 0; s < S; ++s) {
     const int32_t g = run_group[s];
@@ -108,157 +169,506 @@ int ffd_solve_native(
     const int32_t* req = group_req + static_cast<size_t>(g) * R;
     const uint8_t* gz = group_zone + static_cast<size_t>(g) * Z;
     const uint8_t* gc = group_ct + static_cast<size_t>(g) * C;
+    const uint8_t* member_q = q_member + static_cast<size_t>(g) * Q;
+    const uint8_t* owner_q = q_owner + static_cast<size_t>(g) * Q;
+    const uint8_t* member_v_g = v_member + static_cast<size_t>(g) * V;
+    const uint8_t* owner_v_g = v_owner + static_cast<size_t>(g) * V;
 
-    // ---- 1. existing nodes ----------------------------------------------
-    for (int32_t e = 0; e < E && remaining > 0; ++e) {
-      if (!node_compat[static_cast<size_t>(g) * E + e]) continue;
-      int32_t cap = fit_count_row(node_free + static_cast<size_t>(e) * R,
-                                  e_cum.data() + static_cast<size_t>(e) * R, req, R);
-      int32_t take = std::min(cap, remaining);
-      if (take > 0) {
-        take_e[static_cast<size_t>(s) * E + e] = take;
-        for (int32_t r = 0; r < R; ++r)
-          e_cum[static_cast<size_t>(e) * R + r] += take * req[r];
-        remaining -= take;
-      }
+    bool zone_constrained = false;
+    bool has_owned = false;
+    for (int32_t v = 0; v < V; ++v) {
+      if (owner_v_g[v]) { zone_constrained = true; has_owned = true; }
+      if (member_v_g[v] && v_kind[v] == 1) zone_constrained = true;
     }
 
-    // ---- 2. open claims --------------------------------------------------
-    for (int32_t m = 0; m < used && remaining > 0; ++m) {
-      const int32_t p = c_pool[m];
-      if (p < 0 || !group_pool[static_cast<size_t>(g) * P + p]) continue;
-      // pairwise compat with everything already on the node
-      bool pair_ok = true;
-      for (int32_t g2 = 0; g2 < G && pair_ok; ++g2)
-        if (c_gmask[static_cast<size_t>(m) * G + g2] &&
-            !group_pair[static_cast<size_t>(g) * G + g2])
-          pair_ok = false;
-      if (!pair_ok) continue;
-      // per-type fit under node+group zone/ct masks with joint (z,c) check
-      int32_t cap = 0;
-      for (int32_t t = 0; t < T; ++t) {
-        fit_t[t] = 0;
-        if (!c_mask[static_cast<size_t>(m) * T + t]) continue;
-        if (!group_compat_t[static_cast<size_t>(g) * T + t]) continue;
-        bool off_ok = false;
-        for (int32_t z = 0; z < Z && !off_ok; ++z) {
-          if (!(c_zone[static_cast<size_t>(m) * Z + z] && gz[z])) continue;
-          for (int32_t c = 0; c < C; ++c) {
-            if (c_ct[static_cast<size_t>(m) * C + c] && gc[c] &&
-                offer_avail[(static_cast<size_t>(t) * Z + z) * C + c]) {
-              off_ok = true;
-              break;
+    const int32_t fresh_allow = hostname_allow(
+        std::vector<int32_t>(Q, 0).data(), std::vector<int32_t>(Q, 0).data(),
+        q_kind, q_cap, member_q, owner_q, Q);
+
+    // run-level zone-count contribution bookkeeping (fast path): which
+    // claims received pods this run, and per-zone node takes
+    std::vector<int32_t> node_take_z(Z, 0);
+    std::vector<int32_t> claim_take(M, 0);
+
+    auto record_v_counts_fast = [&]() {
+      if (V == 0) return;
+      std::vector<int32_t> contrib(Z, 0);
+      for (int32_t z = 0; z < Z; ++z) contrib[z] = node_take_z[z];
+      for (int32_t m = 0; m < used; ++m) {
+        if (claim_take[m] <= 0) continue;
+        if (claim_zone_count(m) != 1) continue;  // multi-zone: no domain
+        for (int32_t z = 0; z < Z; ++z)
+          if (c_zone[static_cast<size_t>(m) * Z + z]) contrib[z] += claim_take[m];
+      }
+      for (int32_t v = 0; v < V; ++v) {
+        if (!member_v_g[v]) continue;
+        for (int32_t z = 0; z < Z; ++z)
+          v_count[static_cast<size_t>(v) * Z + z] += contrib[z];
+      }
+    };
+
+    if (!zone_constrained) {
+      // ================= FAST path: run-granular pours ====================
+      // ---- 1. existing nodes --------------------------------------------
+      for (int32_t e = 0; e < E && remaining > 0; ++e) {
+        if (!node_compat[static_cast<size_t>(g) * E + e]) continue;
+        int32_t cap = fit_count_row(node_free + static_cast<size_t>(e) * R,
+                                    e_cum.data() + static_cast<size_t>(e) * R, req, R);
+        cap = std::min(cap, hostname_allow(
+            e_cm.data() + static_cast<size_t>(e) * Q,
+            e_co.data() + static_cast<size_t>(e) * Q,
+            q_kind, q_cap, member_q, owner_q, Q));
+        int32_t take = std::min(cap, remaining);
+        if (take > 0) {
+          take_e[static_cast<size_t>(s) * E + e] = take;
+          for (int32_t r = 0; r < R; ++r)
+            e_cum[static_cast<size_t>(e) * R + r] += take * req[r];
+          for (int32_t q = 0; q < Q; ++q) {
+            if (member_q[q]) e_cm[static_cast<size_t>(e) * Q + q] += take;
+            if (owner_q[q] && q_kind[q] == 1) e_co[static_cast<size_t>(e) * Q + q] += 1;
+          }
+          if (node_zone[e] >= 0) node_take_z[node_zone[e]] += take;
+          remaining -= take;
+        }
+      }
+
+      // ---- 2. open claims -------------------------------------------------
+      for (int32_t m = 0; m < used && remaining > 0; ++m) {
+        const int32_t p = c_pool[m];
+        if (p < 0 || !group_pool[static_cast<size_t>(g) * P + p]) continue;
+        bool pair_ok = true;
+        for (int32_t g2 = 0; g2 < G && pair_ok; ++g2)
+          if (c_gmask[static_cast<size_t>(m) * G + g2] &&
+              !group_pair[static_cast<size_t>(g) * G + g2])
+            pair_ok = false;
+        if (!pair_ok) continue;
+        int32_t cap = 0;
+        for (int32_t t = 0; t < T; ++t) {
+          fit_t[t] = 0;
+          if (!c_mask[static_cast<size_t>(m) * T + t]) continue;
+          if (!group_compat_t[static_cast<size_t>(g) * T + t]) continue;
+          bool off_ok = false;
+          for (int32_t z = 0; z < Z && !off_ok; ++z) {
+            if (!(c_zone[static_cast<size_t>(m) * Z + z] && gz[z])) continue;
+            for (int32_t c = 0; c < C; ++c)
+              if (c_ct[static_cast<size_t>(m) * C + c] && gc[c] &&
+                  offer_avail[(static_cast<size_t>(t) * Z + z) * C + c]) {
+                off_ok = true;
+                break;
+              }
+          }
+          if (!off_ok) continue;
+          int32_t kt = fit_count_row(type_alloc + static_cast<size_t>(t) * R,
+                                     c_cum + static_cast<size_t>(m) * R, req, R);
+          k_t[t] = kt;
+          fit_t[t] = 1;
+          cap = std::max(cap, kt);
+        }
+        cap = std::min(cap, hostname_allow(
+            c_cm.data() + static_cast<size_t>(m) * Q,
+            c_co.data() + static_cast<size_t>(m) * Q,
+            q_kind, q_cap, member_q, owner_q, Q));
+        int32_t take = std::min(cap, remaining);
+        if (take > 0) {
+          take_c[static_cast<size_t>(s) * M + m] += take;
+          claim_take[m] += take;
+          for (int32_t r = 0; r < R; ++r)
+            c_cum[static_cast<size_t>(m) * R + r] += take * req[r];
+          for (int32_t t = 0; t < T; ++t)
+            c_mask[static_cast<size_t>(m) * T + t] =
+                (fit_t[t] && k_t[t] >= take) ? 1 : 0;
+          for (int32_t z = 0; z < Z; ++z)
+            c_zone[static_cast<size_t>(m) * Z + z] &= gz[z];
+          for (int32_t c = 0; c < C; ++c)
+            c_ct[static_cast<size_t>(m) * C + c] &= gc[c];
+          c_gmask[static_cast<size_t>(m) * G + g] = 1;
+          for (int32_t q = 0; q < Q; ++q) {
+            if (member_q[q]) c_cm[static_cast<size_t>(m) * Q + q] += take;
+            if (owner_q[q] && q_kind[q] == 1) c_co[static_cast<size_t>(m) * Q + q] += 1;
+          }
+          for (int32_t v = 0; v < V; ++v)
+            if (member_v_g[v]) c_vm[static_cast<size_t>(m) * V + v] += take;
+          remaining -= take;
+        }
+      }
+
+      // ---- 3. new claims, pool by pool ------------------------------------
+      for (int32_t p = 0; p < P && remaining > 0; ++p) {
+        if (!group_pool[static_cast<size_t>(g) * P + p]) continue;
+        bool over = false;
+        for (int32_t r = 0; r < R; ++r)
+          if (p_usage[static_cast<size_t>(p) * R + r] >= pool_limit[static_cast<size_t>(p) * R + r])
+            over = true;
+        if (over) continue;
+        const int32_t* daemon = pool_daemon + static_cast<size_t>(p) * R;
+        int32_t kmax = 0;
+        for (int32_t t = 0; t < T; ++t) {
+          fit_t[t] = 0;
+          if (!group_compat_t[static_cast<size_t>(g) * T + t]) continue;
+          if (!pool_type[static_cast<size_t>(p) * T + t]) continue;
+          bool off_ok = false;
+          for (int32_t z = 0; z < Z && !off_ok; ++z) {
+            if (!(pool_zone[static_cast<size_t>(p) * Z + z] && gz[z])) continue;
+            for (int32_t c = 0; c < C; ++c)
+              if (pool_ct[static_cast<size_t>(p) * C + c] && gc[c] &&
+                  offer_avail[(static_cast<size_t>(t) * Z + z) * C + c]) {
+                off_ok = true;
+                break;
+              }
+          }
+          if (!off_ok) continue;
+          int32_t k = BIG;
+          for (int32_t r = 0; r < R; ++r)
+            if (req[r] > 0) {
+              int32_t rem = type_alloc[static_cast<size_t>(t) * R + r] - daemon[r];
+              k = std::min(k, rem >= 0 ? rem / req[r] : -1);
             }
+          k = std::max(k, 0);
+          k_t[t] = k;
+          fit_t[t] = 1;
+          kmax = std::max(kmax, k);
+        }
+        const int32_t full_take = std::min(kmax, fresh_allow);
+        if (full_take <= 0) continue;
+
+        for (int32_t r = 0; r < R; ++r) {
+          int32_t mn = BIG;
+          for (int32_t t = 0; t < T; ++t)
+            if (fit_t[t] && k_t[t] >= 1)
+              mn = std::min(mn, type_charge[static_cast<size_t>(t) * R + r]);
+          charge_one[r] = (mn == BIG) ? 0 : mn;
+        }
+
+        while (remaining > 0) {
+          bool blocked = false;
+          for (int32_t r = 0; r < R; ++r)
+            if (p_usage[static_cast<size_t>(p) * R + r] >=
+                pool_limit[static_cast<size_t>(p) * R + r])
+              blocked = true;
+          if (blocked) break;
+          if (used >= M) { overflow = true; break; }
+          const int32_t m = used++;
+          const int32_t take = std::min(full_take, remaining);
+          take_c[static_cast<size_t>(s) * M + m] = take;
+          claim_take[m] = take;
+          c_pool[m] = p;
+          for (int32_t r = 0; r < R; ++r)
+            c_cum[static_cast<size_t>(m) * R + r] = daemon[r] + take * req[r];
+          for (int32_t t = 0; t < T; ++t)
+            c_mask[static_cast<size_t>(m) * T + t] = (fit_t[t] && k_t[t] >= take) ? 1 : 0;
+          for (int32_t z = 0; z < Z; ++z)
+            c_zone[static_cast<size_t>(m) * Z + z] =
+                pool_zone[static_cast<size_t>(p) * Z + z] && gz[z];
+          for (int32_t c = 0; c < C; ++c)
+            c_ct[static_cast<size_t>(m) * C + c] =
+                pool_ct[static_cast<size_t>(p) * C + c] && gc[c];
+          c_gmask[static_cast<size_t>(m) * G + g] = 1;
+          for (int32_t q = 0; q < Q; ++q) {
+            if (member_q[q]) c_cm[static_cast<size_t>(m) * Q + q] = take;
+            if (owner_q[q] && q_kind[q] == 1 && take > 0)
+              c_co[static_cast<size_t>(m) * Q + q] = 1;
+          }
+          for (int32_t v = 0; v < V; ++v)
+            if (member_v_g[v]) c_vm[static_cast<size_t>(m) * V + v] = take;
+          for (int32_t r = 0; r < R; ++r)
+            p_usage[static_cast<size_t>(p) * R + r] += charge_one[r];
+          remaining -= take;
+        }
+        if (overflow) break;
+      }
+      record_v_counts_fast();
+      leftover[s] = remaining;
+      if (overflow) break;
+      continue;
+    }
+
+    // ================= ZONE path: per-pod placement =======================
+    // (solver/tpu/ffd.py zoned branch semantics, walked one pod at a time)
+    const int32_t psig = v_primary[g];
+    const bool has_tsc = psig >= 0;
+    const int32_t cap_p = has_tsc ? v_cap[psig] : 0;
+    const int32_t asig = v_aff[g];
+    const bool has_affs = asig >= 0;
+    bool is_member_a = has_affs && member_v_g[asig];
+    bool has_anti = false;
+    for (int32_t v = 0; v < V; ++v)
+      if (owner_v_g[v] && v_kind[v] == 1) has_anti = true;
+
+    while (remaining > 0) {
+      // ---- allowed zone set A ------------------------------------------
+      int32_t m1 = BIG;
+      const int32_t* cnt_p = has_tsc ? v_count.data() + static_cast<size_t>(psig) * Z : nullptr;
+      if (has_tsc)
+        for (int32_t z = 0; z < Z; ++z)
+          if (gz[z]) m1 = std::min(m1, cnt_p[z]);
+      bool any_present = false;
+      const int32_t* cnt_a = has_affs ? v_count.data() + static_cast<size_t>(asig) * Z : nullptr;
+      if (has_affs)
+        for (int32_t z = 0; z < Z; ++z)
+          if (cnt_a[z] > 0) any_present = true;
+      for (int32_t z = 0; z < Z; ++z) {
+        bool a = gz[z];
+        if (a && has_tsc) a = (cnt_p[z] + 1 - m1 <= cap_p);
+        if (a)
+          for (int32_t v = 0; v < V && a; ++v) {
+            if (v_kind[v] != 1) continue;
+            if (owner_v_g[v] && v_count[static_cast<size_t>(v) * Z + z] > 0) a = false;
+            if (member_v_g[v] && v_owner_z[static_cast<size_t>(v) * Z + z]) a = false;
+          }
+        A_base[z] = a ? 1 : 0;
+        if (has_affs) {
+          if (any_present) a = a && (cnt_a[z] > 0);
+          else if (!is_member_a) a = false;  // bootstrap only for members
+        }
+        A[z] = a ? 1 : 0;
+      }
+
+      bool placed = false;
+
+      // ---- 1. existing nodes, in order ---------------------------------
+      for (int32_t e = 0; e < E && !placed; ++e) {
+        if (!node_compat[static_cast<size_t>(g) * E + e]) continue;
+        const int32_t zn = node_zone[e];
+        const bool nz_ok = (zn >= 0) ? (A[zn] != 0) : !has_owned;
+        if (!nz_ok) continue;
+        if (fit_count_row(node_free + static_cast<size_t>(e) * R,
+                          e_cum.data() + static_cast<size_t>(e) * R, req, R) < 1)
+          continue;
+        if (hostname_allow(e_cm.data() + static_cast<size_t>(e) * Q,
+                           e_co.data() + static_cast<size_t>(e) * Q,
+                           q_kind, q_cap, member_q, owner_q, Q) < 1)
+          continue;
+        // place one pod on node e
+        take_e[static_cast<size_t>(s) * E + e] += 1;
+        for (int32_t r = 0; r < R; ++r)
+          e_cum[static_cast<size_t>(e) * R + r] += req[r];
+        for (int32_t q = 0; q < Q; ++q) {
+          if (member_q[q]) e_cm[static_cast<size_t>(e) * Q + q] += 1;
+          if (owner_q[q] && q_kind[q] == 1) e_co[static_cast<size_t>(e) * Q + q] += 1;
+        }
+        if (zn >= 0) {
+          for (int32_t v = 0; v < V; ++v) {
+            if (member_v_g[v]) v_count[static_cast<size_t>(v) * Z + zn] += 1;
+            if (owner_v_g[v] && v_kind[v] == 1)
+              v_owner_z[static_cast<size_t>(v) * Z + zn] = 1;
           }
         }
-        if (!off_ok) continue;
-        int32_t kt = fit_count_row(type_alloc + static_cast<size_t>(t) * R,
-                                   c_cum + static_cast<size_t>(m) * R, req, R);
-        k_t[t] = kt;
-        fit_t[t] = 1;
-        cap = std::max(cap, kt);
+        placed = true;
       }
-      int32_t take = std::min(cap, remaining);
-      if (take > 0) {
-        take_c[static_cast<size_t>(s) * M + m] = take;
+
+      // ---- 2. open claims, in order -------------------------------------
+      for (int32_t m = 0; m < used && !placed; ++m) {
+        const int32_t p = c_pool[m];
+        if (p < 0 || !group_pool[static_cast<size_t>(g) * P + p]) continue;
+        bool pair_ok = true;
+        for (int32_t g2 = 0; g2 < G && pair_ok; ++g2)
+          if (c_gmask[static_cast<size_t>(m) * G + g2] &&
+              !group_pair[static_cast<size_t>(g) * G + g2])
+            pair_ok = false;
+        if (!pair_ok) continue;
+        // claim-local anti checks
+        bool anti_ok = true;
+        for (int32_t v = 0; v < V && anti_ok; ++v) {
+          if (v_kind[v] != 1) continue;
+          if (owner_v_g[v] && c_vm[static_cast<size_t>(m) * V + v] > 0) anti_ok = false;
+          if (member_v_g[v] && c_vo[static_cast<size_t>(m) * V + v]) anti_ok = false;
+        }
+        if (!anti_ok) continue;
+        if (hostname_allow(c_cm.data() + static_cast<size_t>(m) * Q,
+                           c_co.data() + static_cast<size_t>(m) * Q,
+                           q_kind, q_cap, member_q, owner_q, Q) < 1)
+          continue;
+        // effective allowed set for this claim: a co-located matching pod
+        // satisfies the positive term (local_aff -> pre-affinity set)
+        const bool local_aff =
+            has_affs && c_vm[static_cast<size_t>(m) * V + asig] > 0;
+        const uint8_t* Am = local_aff ? A_base.data() : A.data();
+        int32_t n_inter = 0;
+        for (int32_t z = 0; z < Z; ++z) {
+          inter[z] = (c_zone[static_cast<size_t>(m) * Z + z] && Am[z] && gz[z]) ? 1 : 0;
+          n_inter += inter[z];
+        }
+        if (n_inter == 0) continue;
+        // commit rule (SPEC.md joint narrowing)
+        const bool commit =
+            has_tsc || (has_affs && any_present && !local_aff) || has_anti;
+        int32_t d_star = -1;
+        if (commit) {
+          int32_t best = BIG + 1;
+          for (int32_t z = 0; z < Z; ++z) {
+            if (!inter[z]) continue;
+            int32_t score;
+            if (has_tsc) score = cnt_p[z] * 64 + z;
+            else if (has_affs && any_present && !local_aff) score = -cnt_a[z] * 64 + z;
+            else score = z;
+            if (score < best) { best = score; d_star = z; }
+          }
+        }
+        // surviving types under the effective zone bits
+        int32_t kmax = 0;
+        for (int32_t t = 0; t < T; ++t) {
+          fit_t[t] = 0;
+          if (!c_mask[static_cast<size_t>(m) * T + t]) continue;
+          if (!group_compat_t[static_cast<size_t>(g) * T + t]) continue;
+          bool off_ok = false;
+          for (int32_t z = 0; z < Z && !off_ok; ++z) {
+            const bool zin = commit ? (z == d_star) : (inter[z] != 0);
+            if (!zin) continue;
+            for (int32_t c = 0; c < C; ++c)
+              if (c_ct[static_cast<size_t>(m) * C + c] && gc[c] &&
+                  offer_avail[(static_cast<size_t>(t) * Z + z) * C + c]) {
+                off_ok = true;
+                break;
+              }
+          }
+          if (!off_ok) continue;
+          int32_t kt = fit_count_row(type_alloc + static_cast<size_t>(t) * R,
+                                     c_cum + static_cast<size_t>(m) * R, req, R);
+          if (kt < 1) continue;
+          k_t[t] = kt;
+          fit_t[t] = 1;
+          kmax = std::max(kmax, kt);
+        }
+        if (kmax < 1) continue;
+        // place one pod on claim m
+        take_c[static_cast<size_t>(s) * M + m] += 1;
         for (int32_t r = 0; r < R; ++r)
-          c_cum[static_cast<size_t>(m) * R + r] += take * req[r];
+          c_cum[static_cast<size_t>(m) * R + r] += req[r];
         for (int32_t t = 0; t < T; ++t)
-          c_mask[static_cast<size_t>(m) * T + t] =
-              (fit_t[t] && k_t[t] >= take) ? 1 : 0;
+          c_mask[static_cast<size_t>(m) * T + t] = (fit_t[t] && k_t[t] >= 1) ? 1 : 0;
         for (int32_t z = 0; z < Z; ++z)
-          c_zone[static_cast<size_t>(m) * Z + z] &= gz[z];
+          c_zone[static_cast<size_t>(m) * Z + z] =
+              (commit ? (z == d_star) : (inter[z] != 0)) ? 1 : 0;
         for (int32_t c = 0; c < C; ++c)
           c_ct[static_cast<size_t>(m) * C + c] &= gc[c];
         c_gmask[static_cast<size_t>(m) * G + g] = 1;
-        remaining -= take;
-      }
-    }
-
-    // ---- 3. new claims, pool by pool ------------------------------------
-    for (int32_t p = 0; p < P && remaining > 0; ++p) {
-      if (!group_pool[static_cast<size_t>(g) * P + p]) continue;
-      // limit gate: blocked if any resource already at/over limit
-      bool over = false;
-      for (int32_t r = 0; r < R; ++r)
-        if (p_usage[static_cast<size_t>(p) * R + r] >= pool_limit[static_cast<size_t>(p) * R + r])
-          over = true;
-      if (over) continue;
-      const int32_t* daemon = pool_daemon + static_cast<size_t>(p) * R;
-      int32_t kmax = 0;
-      for (int32_t t = 0; t < T; ++t) {
-        fit_t[t] = 0;
-        if (!group_compat_t[static_cast<size_t>(g) * T + t]) continue;
-        if (!pool_type[static_cast<size_t>(p) * T + t]) continue;
-        bool off_ok = false;
-        for (int32_t z = 0; z < Z && !off_ok; ++z) {
-          if (!(pool_zone[static_cast<size_t>(p) * Z + z] && gz[z])) continue;
-          for (int32_t c = 0; c < C; ++c)
-            if (pool_ct[static_cast<size_t>(p) * C + c] && gc[c] &&
-                offer_avail[(static_cast<size_t>(t) * Z + z) * C + c]) {
-              off_ok = true;
-              break;
-            }
+        for (int32_t q = 0; q < Q; ++q) {
+          if (member_q[q]) c_cm[static_cast<size_t>(m) * Q + q] += 1;
+          if (owner_q[q] && q_kind[q] == 1) c_co[static_cast<size_t>(m) * Q + q] += 1;
         }
-        if (!off_ok) continue;
-        int32_t k = BIG;
-        for (int32_t r = 0; r < R; ++r)
-          if (req[r] > 0) {
-            int32_t rem = type_alloc[static_cast<size_t>(t) * R + r] - daemon[r];
-            k = std::min(k, rem >= 0 ? rem / req[r] : -1);
+        for (int32_t v = 0; v < V; ++v) {
+          if (member_v_g[v]) c_vm[static_cast<size_t>(m) * V + v] += 1;
+          if (owner_v_g[v] && v_kind[v] == 1) c_vo[static_cast<size_t>(m) * V + v] = 1;
+        }
+        // zone-count recording: single-zone claims only (SPEC.md)
+        if (claim_zone_count(m) == 1) {
+          int32_t zc = -1;
+          for (int32_t z = 0; z < Z; ++z)
+            if (c_zone[static_cast<size_t>(m) * Z + z]) zc = z;
+          for (int32_t v = 0; v < V; ++v) {
+            if (member_v_g[v]) v_count[static_cast<size_t>(v) * Z + zc] += 1;
+            if (owner_v_g[v] && v_kind[v] == 1)
+              v_owner_z[static_cast<size_t>(v) * Z + zc] = 1;
           }
-        k = std::max(k, 0);
-        k_t[t] = k;
-        fit_t[t] = 1;
-        kmax = std::max(kmax, k);
-      }
-      if (kmax <= 0) continue;
-
-      // per-claim charge for limit accounting: min charge among the
-      // at-creation surviving set (after the claim's FIRST pod) — the oracle
-      // charges right after the opening pod lands
-      std::vector<int32_t> charge_one(R, 0);
-      for (int32_t r = 0; r < R; ++r) {
-        int32_t mn = BIG;
-        for (int32_t t = 0; t < T; ++t)
-          if (fit_t[t] && k_t[t] >= 1)
-            mn = std::min(mn, type_charge[static_cast<size_t>(t) * R + r]);
-        charge_one[r] = (mn == BIG) ? 0 : mn;
-      }
-
-      while (remaining > 0) {
-        // limit check before EACH claim creation
-        bool blocked = false;
-        for (int32_t r = 0; r < R; ++r)
-          if (p_usage[static_cast<size_t>(p) * R + r] >=
-              pool_limit[static_cast<size_t>(p) * R + r])
-            blocked = true;
-        if (blocked) break;
-        if (used >= M) {
-          overflow = true;
-          break;
         }
+        placed = true;
+      }
+
+      // ---- 3. new claim, pool by pool ------------------------------------
+      for (int32_t p = 0; p < P && !placed; ++p) {
+        if (!group_pool[static_cast<size_t>(g) * P + p]) continue;
+        bool over = false;
+        for (int32_t r = 0; r < R; ++r)
+          if (p_usage[static_cast<size_t>(p) * R + r] >= pool_limit[static_cast<size_t>(p) * R + r])
+            over = true;
+        if (over) continue;
+        if (fresh_allow < 1) continue;
+        if (used >= M) { overflow = true; break; }
+        const int32_t* daemon = pool_daemon + static_cast<size_t>(p) * R;
+        // pool's admissible zones intersect A; commit like open claims
+        int32_t n_inter = 0;
+        for (int32_t z = 0; z < Z; ++z) {
+          inter[z] = (pool_zone[static_cast<size_t>(p) * Z + z] && gz[z] && A[z]) ? 1 : 0;
+          n_inter += inter[z];
+        }
+        if (n_inter == 0) continue;
+        const bool commit = has_tsc || (has_affs && any_present) || has_anti;
+        int32_t d_star = -1;
+        if (commit) {
+          int32_t best = BIG + 1;
+          for (int32_t z = 0; z < Z; ++z) {
+            if (!inter[z]) continue;
+            int32_t score;
+            if (has_tsc) score = cnt_p[z] * 64 + z;
+            else if (has_affs && any_present) score = -cnt_a[z] * 64 + z;
+            else score = z;
+            if (score < best) { best = score; d_star = z; }
+          }
+        }
+        int32_t kmax = 0;
+        for (int32_t t = 0; t < T; ++t) {
+          fit_t[t] = 0;
+          if (!group_compat_t[static_cast<size_t>(g) * T + t]) continue;
+          if (!pool_type[static_cast<size_t>(p) * T + t]) continue;
+          bool off_ok = false;
+          for (int32_t z = 0; z < Z && !off_ok; ++z) {
+            const bool zin = commit ? (z == d_star) : (inter[z] != 0);
+            if (!zin) continue;
+            for (int32_t c = 0; c < C; ++c)
+              if (pool_ct[static_cast<size_t>(p) * C + c] && gc[c] &&
+                  offer_avail[(static_cast<size_t>(t) * Z + z) * C + c]) {
+                off_ok = true;
+                break;
+              }
+          }
+          if (!off_ok) continue;
+          int32_t k = BIG;
+          for (int32_t r = 0; r < R; ++r)
+            if (req[r] > 0) {
+              int32_t rem = type_alloc[static_cast<size_t>(t) * R + r] - daemon[r];
+              k = std::min(k, rem >= 0 ? rem / req[r] : -1);
+            }
+          if (k < 1) continue;
+          k_t[t] = k;
+          fit_t[t] = 1;
+          kmax = std::max(kmax, k);
+        }
+        if (kmax < 1) continue;
         const int32_t m = used++;
-        const int32_t take = std::min(kmax, remaining);
-        take_c[static_cast<size_t>(s) * M + m] = take;
+        take_c[static_cast<size_t>(s) * M + m] += 1;
         c_pool[m] = p;
         for (int32_t r = 0; r < R; ++r)
-          c_cum[static_cast<size_t>(m) * R + r] = daemon[r] + take * req[r];
+          c_cum[static_cast<size_t>(m) * R + r] = daemon[r] + req[r];
         for (int32_t t = 0; t < T; ++t)
-          c_mask[static_cast<size_t>(m) * T + t] = (fit_t[t] && k_t[t] >= take) ? 1 : 0;
+          c_mask[static_cast<size_t>(m) * T + t] = fit_t[t];
         for (int32_t z = 0; z < Z; ++z)
           c_zone[static_cast<size_t>(m) * Z + z] =
-              pool_zone[static_cast<size_t>(p) * Z + z] && gz[z];
+              (commit ? (z == d_star) : (inter[z] != 0)) ? 1 : 0;
         for (int32_t c = 0; c < C; ++c)
           c_ct[static_cast<size_t>(m) * C + c] =
               pool_ct[static_cast<size_t>(p) * C + c] && gc[c];
         c_gmask[static_cast<size_t>(m) * G + g] = 1;
-        // charge: every claim charges its at-creation (1-pod survivor) min
-        for (int32_t r = 0; r < R; ++r)
-          p_usage[static_cast<size_t>(p) * R + r] += charge_one[r];
-        remaining -= take;
+        for (int32_t q = 0; q < Q; ++q) {
+          if (member_q[q]) c_cm[static_cast<size_t>(m) * Q + q] = 1;
+          if (owner_q[q] && q_kind[q] == 1) c_co[static_cast<size_t>(m) * Q + q] = 1;
+        }
+        for (int32_t v = 0; v < V; ++v) {
+          if (member_v_g[v]) c_vm[static_cast<size_t>(m) * V + v] = 1;
+          if (owner_v_g[v] && v_kind[v] == 1) c_vo[static_cast<size_t>(m) * V + v] = 1;
+        }
+        for (int32_t r = 0; r < R; ++r) {
+          int32_t mn = BIG;
+          for (int32_t t = 0; t < T; ++t)
+            if (fit_t[t] && k_t[t] >= 1)
+              mn = std::min(mn, type_charge[static_cast<size_t>(t) * R + r]);
+          p_usage[static_cast<size_t>(p) * R + r] += (mn == BIG) ? 0 : mn;
+        }
+        if (claim_zone_count(m) == 1) {
+          int32_t zc = -1;
+          for (int32_t z = 0; z < Z; ++z)
+            if (c_zone[static_cast<size_t>(m) * Z + z]) zc = z;
+          for (int32_t v = 0; v < V; ++v) {
+            if (member_v_g[v]) v_count[static_cast<size_t>(v) * Z + zc] += 1;
+            if (owner_v_g[v] && v_kind[v] == 1)
+              v_owner_z[static_cast<size_t>(v) * Z + zc] = 1;
+          }
+        }
+        placed = true;
       }
+
       if (overflow) break;
+      if (!placed) break;  // this pod (and its identical peers) can't place
+      remaining -= 1;
     }
     leftover[s] = remaining;
     if (overflow) break;

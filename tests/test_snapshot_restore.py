@@ -99,3 +99,45 @@ def test_snapshot_cadence(tmp_path):
     op.manager.tick()
     # content may be identical; cadence is what we assert (file rewritten)
     assert os.path.getmtime(path) >= mtime0
+
+
+def test_restore_rebases_monotonic_clocks(tmp_path):
+    """A reboot resets CLOCK_MONOTONIC: the restored process starts near 0
+    while the snapshot carries large timestamps. Restore must rebase them so
+    ages are preserved — GC grace and expiry keep firing."""
+    clock_hi = FakeClock()
+    clock_hi.t = 500_000.0
+    op = new_kwok_operator(
+        clock=clock_hi, snapshot_path=str(tmp_path / "snap.bin")
+    )
+    op.clock = clock_hi
+    op.store.create(st.NODEPOOLS, mkpool())
+    op.store.create(st.PODS, mkpod("p0", cpu="500m"))
+    op.manager.settle()
+    # orphan the instance (claim+node lost in the crash)
+    claim = op.store.list(st.NODECLAIMS)[0]
+    node = op.store.list(st.NODES)[0]
+    claim.meta.finalizers = []
+    node.meta.finalizers = []
+    op.store.update(st.NODECLAIMS, claim)
+    op.store.update(st.NODES, node)
+    op.store.delete(st.NODECLAIMS, claim.name)
+    op.store.delete(st.NODES, node.meta.name)
+    pod = op.store.get(st.PODS, "p0")
+    pod.meta.finalizers = []
+    op.store.delete(st.PODS, "p0")
+    save_snapshot(op.store, op.cloud, str(tmp_path / "snap.bin"), now=clock_hi())
+
+    # "reboot": fresh process with a small monotonic clock
+    clock_lo = FakeClock()
+    clock_lo.t = 100.0
+    op2 = new_kwok_operator(
+        clock=clock_lo, snapshot_path=str(tmp_path / "snap.bin")
+    )
+    op2.clock = clock_lo
+    insts = op2.cloud.describe_instances()
+    assert len(insts) == 1
+    assert insts[0].launch_time <= clock_lo(), "launch_time rebased into the new epoch"
+    clock_lo.advance(60)  # past GC grace in the NEW epoch
+    op2.manager.settle()
+    assert not op2.cloud.describe_instances(), "orphan reaped after rebase"

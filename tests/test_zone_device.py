@@ -406,3 +406,93 @@ class TestZoneFuzzParity:
         assert DEVICE_SOLVES_SEEN["n"] > 0, (
             "no fuzz scenario exercised the device kernel"
         )
+
+
+class TestNativeZoneParity:
+    """Third leg for constrained workloads: the C++ core's per-pod zone/
+    hostname path (native/ffd_core.cpp) must match the oracle bit-for-bit
+    (VERDICT r3 next #8: constrained CPU-only deployments keep compiled-class
+    speed instead of degrading to the interpreter)."""
+
+    def _assert_native(self, inp):
+        from karpenter_tpu.solver.native import NativeSolver
+
+        ref = ReferenceSolver().solve(quantize_input(inp))
+        solver = NativeSolver()
+        nat = solver.solve(inp)
+        assert solver.stats["native_solves"] == 1, solver.stats
+        assert set(ref.errors) == set(nat.errors), (
+            f"errors: ref={sorted(ref.errors)} nat={sorted(nat.errors)}"
+        )
+        assert ref.placements == nat.placements, _diff(ref.placements, nat.placements)
+        assert len(ref.claims) == len(nat.claims)
+        for i, (rc, tc) in enumerate(zip(ref.claims, nat.claims)):
+            assert rc.nodepool == tc.nodepool, f"claim {i}"
+            assert sorted(rc.instance_type_names) == sorted(tc.instance_type_names), f"claim {i}"
+            assert rc.pod_uids == tc.pod_uids, f"claim {i}"
+
+    def test_zone_spread_fresh_claims(self):
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.ZONE_LABEL, label_selector={"app": "x"}
+        )
+        pods = [
+            mkpod(f"p{i:02d}", labels={"app": "x"}, topology_spread=[tsc])
+            for i in range(9)
+        ]
+        self._assert_native(SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES))
+
+    def test_anti_affinity_exhausts_zones(self):
+        term = PodAffinityTerm(label_selector={"svc": "lock"},
+                               topology_key=wk.ZONE_LABEL, anti=True)
+        pods = [
+            mkpod(f"a{i}", labels={"svc": "lock"}, affinity_terms=[term])
+            for i in range(5)
+        ]
+        self._assert_native(SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES))
+
+    def test_positive_affinity_follows_existing(self):
+        term = PodAffinityTerm(label_selector={"svc": "web"},
+                               topology_key=wk.ZONE_LABEL, anti=False)
+        n = mknode("n0", "zone-1b", 0)
+        n.free = Resources.parse({"cpu": "1", "memory": "2Gi"})
+        n.free["pods"] = 5
+        n.pod_labels = [{"svc": "web"}]
+        pods = [
+            mkpod(f"w{i}", labels={"svc": "web"}, affinity_terms=[term])
+            for i in range(4)
+        ]
+        self._assert_native(SolverInput(pods=pods, nodes=[n], nodepools=[pool()], zones=ZONES))
+
+    def test_hostname_spread(self):
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.HOSTNAME_LABEL, label_selector={"app": "h"}
+        )
+        pods = [
+            mkpod(f"h{i}", labels={"app": "h"}, topology_spread=[tsc])
+            for i in range(4)
+        ]
+        self._assert_native(SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES))
+
+    def test_hostname_anti_affinity(self):
+        term = PodAffinityTerm(label_selector={"svc": "solo"},
+                               topology_key=wk.HOSTNAME_LABEL, anti=True)
+        pods = [
+            mkpod(f"s{i}", labels={"svc": "solo"}, affinity_terms=[term])
+            for i in range(3)
+        ]
+        pods += [mkpod(f"f{i}", cpu="250m", mem="256Mi") for i in range(4)]
+        self._assert_native(SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES))
+
+    @pytest.mark.parametrize("seed", range(0, 16, 2))
+    def test_fuzz_native(self, seed):
+        inp = TestZoneFuzzParity()._scenario(seed)
+        from karpenter_tpu.solver.native import NativeSolver
+
+        ref = ReferenceSolver().solve(quantize_input(inp))
+        solver = NativeSolver()
+        nat = solver.solve(inp)
+        # constrained fuzz scenarios may still contain oracle-only constructs
+        # (fallback groups); when the native core DID run, results must match
+        if solver.stats["native_solves"]:
+            assert set(ref.errors) == set(nat.errors)
+            assert ref.placements == nat.placements, _diff(ref.placements, nat.placements)
