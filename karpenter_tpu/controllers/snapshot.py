@@ -54,7 +54,7 @@ def save_snapshot(store: st.Store, cloud, path: str, now: Optional[float] = None
     skips entirely when the rv high-water mark hasn't moved."""
     with cloud._lock, store._lock:
         objects = {kind: list(store._objects.get(kind, {}).values()) for kind in SNAPSHOT_KINDS}
-        rv = next(store._rv)  # monotonic observation of the rv high-water mark
+        rv = store.current_rv()  # non-consuming high-water mark
         instances = dict(cloud._instances)
         seq = next(cloud._seq)  # observe; re-prime on restore
         payload = pickle.dumps(
@@ -88,7 +88,11 @@ def restore_snapshot(store: st.Store, cloud, path: str, now: Optional[float] = N
         return False
     with open(path, "rb") as f:
         payload = pickle.load(f)
-    delta = (now if now is not None else time.monotonic()) - payload.get("now", 0.0)
+    snap_now = payload.get("now")
+    # payloads without a clock reference (older format) must NOT be rebased:
+    # defaulting the epoch to 0 would shift every timestamp by the restoring
+    # host's entire uptime and freeze GC/expiry/lifetime math
+    delta = ((now if now is not None else time.monotonic()) - snap_now) if snap_now is not None else 0.0
 
     def rebase(obj) -> None:
         m = getattr(obj, "meta", None)
@@ -137,11 +141,10 @@ class SnapshotController:
         now = self.clock()
         if self._last is not None and now - self._last < self.interval_s:
             return False
-        # skip when nothing changed: the rv high-water mark is cheap to read
-        # and an idle cluster should not pay the serialization stall
-        with self.store._lock:
-            rv = next(self.store._rv)
-        if rv <= self._last_rv + 1:
+        # skip when nothing changed: the rv high-water mark is a
+        # non-consuming peek, so an idle cluster pays nothing
+        rv = self.store.current_rv()
+        if rv == self._last_rv:
             self._last = now
             return False
         save_snapshot(self.store, self.cloud, self.path, now=now)

@@ -36,7 +36,7 @@ class Store:
         self._lock = threading.RLock()
         self._objects: Dict[str, Dict[str, Any]] = defaultdict(dict)  # kind -> key -> obj
         self._watchers: List[Tuple[Optional[str], WatchFn]] = []
-        self._rv = itertools.count(1)
+        self._rv_counter = 0  # last issued resource version
         # watcher events queue under _lock (rv order) and deliver outside it
         from collections import deque
 
@@ -52,7 +52,17 @@ class Store:
         """Advance the resource-version counter past a restored snapshot's
         high-water mark so post-restore updates stay monotonic."""
         with self._lock:
-            self._rv = itertools.count(rv + 1)
+            self._rv_counter = max(self._rv_counter, rv)
+
+    def _next_rv(self) -> int:
+        self._rv_counter += 1
+        return self._rv_counter
+
+    def current_rv(self) -> int:
+        """Last issued resource version — a non-consuming peek (snapshot
+        change detection)."""
+        with self._lock:
+            return self._rv_counter
 
     # -- crud ---------------------------------------------------------------
 
@@ -61,7 +71,7 @@ class Store:
             key = self._key(obj)
             if key in self._objects[kind]:
                 raise Conflict(f"{kind} {key} already exists")
-            obj.meta.resource_version = next(self._rv)
+            obj.meta.resource_version = self._next_rv()
             self._objects[kind][key] = obj
             self._enqueue("ADDED", kind, obj)
         self._drain()
@@ -73,7 +83,7 @@ class Store:
             cur = self._objects[kind].get(key)
             if cur is None:
                 raise NotFound(f"{kind} {key}")
-            obj.meta.resource_version = next(self._rv)
+            obj.meta.resource_version = self._next_rv()
             self._objects[kind][key] = obj
             # finalizer-gated purge: a deleting object with no finalizers goes away
             if obj.meta.deleting and not obj.meta.finalizers:
@@ -95,7 +105,7 @@ class Store:
                 if cur.meta.deleting:
                     return
                 cur.meta.deletion_timestamp = time.monotonic()
-                cur.meta.resource_version = next(self._rv)
+                cur.meta.resource_version = self._next_rv()
                 self._enqueue("MODIFIED", kind, cur)
             else:
                 del self._objects[kind][key]

@@ -825,10 +825,135 @@ def ffd_solve(
                 cyc_ok = cyc_ok & (rounds >= 1) & (n_zones >= 1)
                 per_tgt = k_sk * rounds
 
+                # ---- (A) multi-claim opening quantities --------------------
+                # Without a TSC the commit zone cannot rotate away between
+                # claims: positive affinity reinforces its argmax, anti/lex
+                # choices ignore counts, and the allowed set A is invariant
+                # to our own pours (self-matching anti is excluded). So the
+                # whole budgeted pour opens ALL its claims in ONE event
+                # instead of one event per claim (config 4's cost).
+                full_p = jnp.minimum(kmax_p[p_star], fresh_allow)
+                multi_ok = ~has_tsc & ~self_anti
+                q_tot_p = jnp.where(multi_ok, jnp.minimum(remaining, Bz_p), q_p)
+                headroom_p = pool_limit[p_star] - p_usage[p_star]  # [R]
+                ch_p = charge_one_p[p_star]
+                trips_p = jnp.min(jnp.where(
+                    ch_p > 0,
+                    jnp.maximum(-(-headroom_p // jnp.maximum(ch_p, 1)), 0),
+                    BIG,
+                )).astype(jnp.int32)
+                n_want_p = jnp.where(
+                    full_p > 0, -(-q_tot_p // jnp.maximum(full_p, 1)), 0
+                ).astype(jnp.int32)
+                n_open_p = jnp.where(
+                    multi_ok,
+                    jnp.minimum(jnp.minimum(n_want_p, trips_p), M - used),
+                    1,
+                ).astype(jnp.int32)
+
+                # ---- (B) closed-form generation batching -------------------
+                # Balanced pure-TSC pours into FRESH claims (config 3's
+                # cost): with equal counts, no eligible node/claim targets,
+                # one covering pool, and uniform per-zone type capacity, the
+                # sequential engine opens claims in generation-major /
+                # lex-zone-minor order (claims open when cap-chunk rotation
+                # crosses each kmax boundary; kmax >= cap keeps that order)
+                # and fills each to kmax — so the ENTIRE run lays out in
+                # closed form: zone rank r receives the cap-chunk share T_z,
+                # and zone z's g-th claim takes min(kmax, T_z - g*kmax).
+                pz_star = pz_bits[p_star]
+                off_zt_star = (
+                    (zone_col_mask[:, None] & pz_star) & offer_zc_bits[None, :]
+                ) != 0  # [Z, T]
+                fit_zt = compat_t[None, :] & pool_type[p_star][None, :] & off_zt_star
+                k_cap_t = jnp.full((T,), BIG, jnp.int32)
+                for r in range(R):
+                    kr = jnp.where(
+                        req[r] > 0,
+                        (type_alloc[:, r] - pool_daemon[p_star, r])
+                        // jnp.maximum(req[r], 1),
+                        BIG,
+                    )
+                    k_cap_t = jnp.minimum(k_cap_t, kr.astype(jnp.int32))
+                k_cap_t = jnp.maximum(k_cap_t, 0)
+                k_zt = jnp.where(fit_zt, k_cap_t[None, :], 0)  # [Z, T]
+                kmax_z = jnp.max(k_zt, axis=1)  # [Z]
+                z_first = jnp.argmax(elig)
+                kmax0 = kmax_z[z_first]
+                kmax_eq = jnp.all(~elig | (kmax_z == kmax0))
+                one_zt = fit_zt & (k_zt >= 1)
+                charge_zr = jnp.min(
+                    jnp.where(one_zt[:, :, None], type_charge[None, :, :], INT32_MAX),
+                    axis=1,
+                )  # [Z, R]
+                charge_zr = jnp.where(charge_zr == INT32_MAX, 0, charge_zr)
+                charge0 = charge_zr[z_first]
+                charge_eq = jnp.all(~elig[:, None] | (charge_zr == charge0[None, :]))
+                covers = jnp.all(~elig | pzz[p_star])
+                cap_sk = jnp.maximum(cap_p, 1)
+                nz_e = jnp.sum(elig).astype(jnp.int32)
+                C_tot = remaining // cap_sk
+                lo_rem = remaining % cap_sk
+                rank_z = (jnp.cumsum(elig) - 1).astype(jnp.int32)  # valid where elig
+                fc_z = jnp.where(
+                    elig,
+                    jnp.maximum(
+                        (C_tot - rank_z + nz_e - 1) // jnp.maximum(nz_e, 1), 0
+                    ),
+                    0,
+                ).astype(jnp.int32)
+                T_zv = (cap_sk * fc_z + jnp.where(
+                    elig & (rank_z == (C_tot % jnp.maximum(nz_e, 1))), lo_rem, 0
+                )).astype(jnp.int32)
+                km0 = jnp.maximum(kmax0, 1)
+                n_z = -(-T_zv // km0)  # claims per zone [Z]
+                n_mega = jnp.sum(n_z).astype(jnp.int32)
+                trips0 = jnp.min(jnp.where(
+                    charge0 > 0,
+                    jnp.maximum(
+                        -(-(pool_limit[p_star] - p_usage[p_star])
+                          // jnp.maximum(charge0, 1)),
+                        0,
+                    ),
+                    BIG,
+                )).astype(jnp.int32)
+                mega_ok = (
+                    pure_tsc & counts_equal & ~found_e & ~found_c & found_p
+                    # cap == 1 ONLY: with maxSkew >= 2 the per-pod first-fit
+                    # re-admits earlier claims mid-rotation (skew headroom),
+                    # so pours are not clean cap-chunks; maxSkew=1 rotation
+                    # is strict and the closed form is exact
+                    & (cap_p == 1)
+                    & (kmax0 > 0) & (kmax0 >= cap_sk) & kmax_eq & charge_eq & covers
+                    & (fresh_allow >= kmax0)
+                    & (n_mega <= M - used) & (trips0 >= n_mega)
+                    & (nz_e >= 1) & (remaining > 0)
+                )
+                # slot -> (generation, zone) map: cnt(G) = sum_z min(n_z, G);
+                # slot j's generation is the largest G with cnt(G) <= j, its
+                # zone the (j - cnt(g))-th lex zone still needing claims
+                Garr = jnp.arange(1, M + 1, dtype=jnp.int32)  # [M]
+                cnt_arr = jnp.sum(
+                    jnp.minimum(n_z[None, :], Garr[:, None])
+                    * elig[None, :].astype(jnp.int32),
+                    axis=1,
+                )  # [M]
+                j_off = midx - used  # [M]
+                g_j = jnp.sum(cnt_arr[None, :] <= j_off[:, None], axis=1).astype(jnp.int32)
+                cnt_g = jnp.where(g_j > 0, cnt_arr[jnp.clip(g_j - 1, 0, M - 1)], 0)
+                p_j = j_off - cnt_g
+                ok_zm = elig[None, :] & (n_z[None, :] > g_j[:, None])  # [M, Z]
+                rnk = jnp.cumsum(ok_zm.astype(jnp.int32), axis=1) - 1
+                zsel = jnp.argmax(ok_zm & (rnk == p_j[:, None]), axis=1).astype(jnp.int32)
+                in_mega = mega_ok & (j_off >= 0) & (j_off < n_mega)
+                take_mega = jnp.where(
+                    in_mega, jnp.clip(T_zv[zsel] - g_j * km0, 0, km0), 0
+                ).astype(jnp.int32)
+
                 # ---- selection & unified masked apply ---------------------
                 use_e = found_e & ~cyc_ok
                 use_c = ~found_e & found_c & ~cyc_ok
-                use_p = ~found_e & ~found_c & found_p & ~cyc_ok
+                use_p = ~found_e & ~found_c & found_p & ~cyc_ok & ~mega_ok
 
                 take_e_add = (
                     jnp.where(use_e & (eidx == e_star), q_e, 0)
@@ -867,9 +992,17 @@ def ffd_solve(
                 )
                 c_vo_st = c_vo_st | (added[:, None] & owned_anti[None, :])
 
-                # new-claim open (single event only)
-                is_new = use_p & (midx == used)
-                tq = jnp.where(is_new, q_p, 0).astype(jnp.int32)
+                # new-claim open: n_open_p slots in the committed zone (A)
+                is_new = use_p & (j_off >= 0) & (j_off < n_open_p)
+                tq = jnp.where(
+                    is_new,
+                    jnp.where(
+                        multi_ok,
+                        jnp.clip(q_tot_p - j_off * jnp.maximum(full_p, 1), 0, full_p),
+                        q_p,
+                    ),
+                    0,
+                ).astype(jnp.int32)
                 c_cum = jnp.where(
                     is_new[:, None],
                     pool_daemon[p_star][None, :] + tq[:, None] * req[None, :],
@@ -904,16 +1037,56 @@ def ffd_solve(
                     is_new[:, None], (tq[:, None] > 0) & owned_anti[None, :], c_vo_st
                 )
                 p_usage = p_usage.at[p_star].add(
-                    (charge_one_p[p_star] * use_p.astype(jnp.int32)).astype(jnp.int32)
+                    (charge_one_p[p_star]
+                     * jnp.where(use_p, n_open_p, 0)).astype(jnp.int32)
                 )
-                used = used + use_p.astype(jnp.int32)
+                used = used + jnp.where(use_p, n_open_p, 0)
 
-                # zone-count recording (take_c_add excludes the new claim —
-                # add its recorded zone separately)
+                # mega-generation open (B): rotating zone per slot
+                fit_sel = fit_zt[zsel]  # [M, T]
+                k_sel = k_zt[zsel]  # [M, T]
+                c_cum = jnp.where(
+                    in_mega[:, None],
+                    pool_daemon[p_star][None, :] + take_mega[:, None] * req[None, :],
+                    c_cum,
+                )
+                c_mask = jnp.where(
+                    in_mega[:, None], fit_sel & (k_sel >= take_mega[:, None]), c_mask
+                )
+                c_zc_bits = jnp.where(in_mega, zone_col_mask[zsel] & pz_star, c_zc_bits)
+                c_gbits = jnp.where(in_mega[:, None], gword[None, :], c_gbits)
+                c_pool = jnp.where(in_mega, p_star.astype(jnp.int32), c_pool)
+                c_cm = jnp.where(
+                    in_mega[:, None],
+                    take_mega[:, None] * member_g[None, :].astype(jnp.int32),
+                    c_cm,
+                )
+                c_co = jnp.where(
+                    in_mega[:, None],
+                    (
+                        (take_mega[:, None] > 0)
+                        & owner_g[None, :]
+                        & (q_kind[None, :] == 1)
+                    ).astype(jnp.int32),
+                    c_co,
+                )
+                c_vm_st = jnp.where(
+                    in_mega[:, None],
+                    take_mega[:, None] * member_v[None, :].astype(jnp.int32),
+                    c_vm_st,
+                )
+                p_usage = p_usage.at[p_star].add(
+                    (charge0 * jnp.where(mega_ok, n_mega, 0)).astype(jnp.int32)
+                )
+                used = used + jnp.where(mega_ok, n_mega, 0)
+
+                # zone-count recording (take_c_add excludes new claims —
+                # their recorded zones add separately)
                 contrib = count_contrib(take_e_add, take_c_add, c_zc_bits)
                 contrib = contrib + jnp.where(
-                    use_p & (nz_fin_p == 1), jnp.where(zidx == z_p, q_p, 0), 0
+                    use_p & (nz_fin_p == 1), jnp.where(zidx == z_p, jnp.sum(tq), 0), 0
                 ).astype(jnp.int32)
+                contrib = contrib + jnp.where(mega_ok, T_zv, 0).astype(jnp.int32)
                 v_count = v_count + member_v.astype(jnp.int32)[:, None] * contrib[None, :]
                 # anti-owner registration keys on the target's recorded zone,
                 # member or not (the oracle registers owned terms' domains)
@@ -924,11 +1097,14 @@ def ffd_solve(
                 )  # [Z]
                 v_owner_z = v_owner_z | (owned_anti[:, None] & owner_rec[None, :])
 
-                placed = jnp.sum(take_e_add) + jnp.sum(take_c_add) + jnp.sum(tq)
+                placed = (
+                    jnp.sum(take_e_add) + jnp.sum(take_c_add) + jnp.sum(tq)
+                    + jnp.sum(take_mega)
+                )
                 remaining = remaining - placed
                 progress = placed > 0
                 take_e_acc2 = take_e_acc + take_e_add
-                take_c_acc2 = take_c_acc + take_c_add + tq
+                take_c_acc2 = take_c_acc + take_c_add + tq + take_mega
                 return (remaining, progress, fuel - 1, take_e_acc2, take_c_acc2,
                         e_cum, c_cum, c_mask, c_zc_bits, c_gbits, c_pool, used,
                         p_usage, e_cm, e_co, c_cm, c_co, v_count, v_owner_z,

@@ -496,3 +496,89 @@ class TestNativeZoneParity:
         if solver.stats["native_solves"]:
             assert set(ref.errors) == set(nat.errors)
             assert ref.placements == nat.placements, _diff(ref.placements, nat.placements)
+
+
+class TestEventBatchingParity:
+    """Directed coverage for the zoned branch's closed-form batching: the
+    mega-generation path (balanced pure-TSC into fresh claims, config 3's
+    shape) and multi-claim opening (constant-zone commits, config 4's
+    shape) must stay bit-identical to the oracle."""
+
+    def test_mega_generations_multi_app(self):
+        # several apps, each a large balanced run into fresh claims
+        pods = []
+        for a in range(3):
+            tsc = TopologySpreadConstraint(
+                max_skew=1, topology_key=wk.ZONE_LABEL,
+                label_selector={"app": f"m{a}"})
+            for i in range(120):
+                pods.append(
+                    mkpod(f"a{a}p{i:03d}", cpu="500m", mem="1Gi",
+                          labels={"app": f"m{a}"}, topology_spread=[tsc]))
+        ref, tpu = assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+        assert not tpu.errors
+
+    def test_mega_with_skew2_and_remainder(self):
+        # maxSkew=2 and a pod count that leaves ragged chunk remainders
+        tsc = TopologySpreadConstraint(
+            max_skew=2, topology_key=wk.ZONE_LABEL, label_selector={"app": "r"})
+        pods = [
+            mkpod(f"r{i:03d}", cpu="1", mem="2Gi", labels={"app": "r"},
+                  topology_spread=[tsc])
+            for i in range(157)
+        ]
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+
+    def test_mega_after_draining_existing_targets(self):
+        # existing nodes absorb the head of the run; fresh-claim generations
+        # take over mid-run once the targets drain
+        nodes = [mknode("na", "zone-1a", 0), mknode("nb", "zone-1b", 0)]
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.ZONE_LABEL, label_selector={"app": "d"})
+        pods = [
+            mkpod(f"d{i:03d}", cpu="500m", mem="1Gi", labels={"app": "d"},
+                  topology_spread=[tsc])
+            for i in range(90)
+        ]
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=nodes, nodepools=[pool()], zones=ZONES)
+        )
+
+    def test_multi_open_positive_affinity_wave(self):
+        # config-4 shape: a large wave follows its own label into one zone —
+        # all claims must open in few events and still match the oracle
+        term = PodAffinityTerm(label_selector={"svc": "web"},
+                               topology_key=wk.ZONE_LABEL, anti=False)
+        pods = [
+            mkpod(f"w{i:03d}", cpu="1", mem="2Gi", labels={"svc": "web"},
+                  affinity_terms=[term])
+            for i in range(150)
+        ]
+        ref, tpu = assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+        # each claim satisfies the term CLAIM-LOCALLY (co-located matching
+        # pods), so claims legitimately stay zone-flexible; what matters is
+        # parity and that the wave didn't shatter into per-pod claims
+        assert not tpu.errors
+        assert len(tpu.claims) <= 4, len(tpu.claims)
+
+    def test_multi_open_anti_member_wave(self):
+        # members of an anti sig (not owners): lex-zone commit, constant
+        # across claims — multi-open path with blocked-zone exclusions
+        anti = PodAffinityTerm(label_selector={"svc": "noisy"},
+                               topology_key=wk.ZONE_LABEL, anti=True)
+        owner = mkpod("owner", cpu="500m", mem="1Gi", labels={"tag": "o"},
+                      affinity_terms=[anti])
+        members = [
+            mkpod(f"n{i:03d}", cpu="1", mem="2Gi", labels={"svc": "noisy"})
+            for i in range(80)
+        ]
+        assert_zone_parity(
+            SolverInput(pods=[owner] + members, nodes=[], nodepools=[pool()],
+                        zones=ZONES)
+        )
