@@ -1,0 +1,166 @@
+"""Admission validation — the CEL-rule analog of the reference's CRD schemas.
+
+The reference injects x-kubernetes-validations into its CRDs
+(hack/validation/{requirements,labels,kubelet}.sh →
+pkg/apis/crds/karpenter.sh_nodepools.yaml) so the API server rejects invalid
+NodePools before any controller sees them. This framework's store IS the API
+server, so the same rules run as an admission hook (Store.set_validator):
+
+  - restricted requirement keys / template label domains (karpenter.sh,
+    kubernetes.io, k8s.io, and this provider's karpenter.tpu domain — with
+    the same well-known allowlists the reference carves out);
+  - operator shape rules: In needs values; Gt/Lt need a single positive
+    integer; minValues needs at least that many values for In (and a sane
+    bound);
+  - budgets: nodes is a count or 0-100%; schedule must be set with duration
+    (karpenter.sh_nodepools.yaml:140);
+  - nodeClassRef name may not be empty.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from ..scheduling.requirements import DOES_NOT_EXIST, EXISTS, GT, IN, LT, NOT_IN
+from . import wellknown as wk
+
+
+class ValidationError(Exception):
+    def __init__(self, errors: List[str]):
+        super().__init__("; ".join(errors))
+        self.errors = list(errors)
+
+
+# keys the reference allows inside its own restricted domains
+# (karpenter.sh_nodepools.yaml:199-209 allowlists, incl. the legacy beta set)
+_WELLKNOWN_ALLOWED = {
+    wk.ZONE_LABEL,
+    "topology.kubernetes.io/region",
+    wk.ARCH_LABEL,
+    wk.OS_LABEL,
+    wk.INSTANCE_TYPE_LABEL,
+    wk.CAPACITY_TYPE_LABEL,
+    "beta.kubernetes.io/instance-type",
+    "beta.kubernetes.io/os",
+    "beta.kubernetes.io/arch",
+    "failure-domain.beta.kubernetes.io/zone",
+    "failure-domain.beta.kubernetes.io/region",
+    "node.kubernetes.io/windows-build",
+}
+_TPU_DOMAIN_ALLOWED_SUFFIXES = (
+    "instance-family",
+    "instance-size",
+    "instance-generation",
+    "instance-cpu",
+    "instance-memory-mib",
+)
+_RESTRICTED_DOMAINS = ("karpenter.sh", "kubernetes.io", "k8s.io", "karpenter.tpu")
+_BUDGET_NODES_RE = re.compile(r"^((100|[0-9]{1,2})%|[0-9]+)$")
+
+
+def _domain_of(key: str) -> str:
+    return key.split("/", 1)[0] if "/" in key else ""
+
+
+def _key_restricted(key: str) -> bool:
+    if key in _WELLKNOWN_ALLOWED:
+        return False
+    dom = _domain_of(key)
+    if dom == "karpenter.tpu":
+        return not any(key == f"karpenter.tpu/{s}" for s in _TPU_DOMAIN_ALLOWED_SUFFIXES)
+    # the reference carves out whole operator-usable domains
+    # (karpenter.sh_nodepools.yaml:202-208): node.kubernetes.io,
+    # node-restriction.kubernetes.io, and kops.k8s.io
+    for carved in ("node.kubernetes.io", "node-restriction.kubernetes.io", "kops.k8s.io"):
+        if dom == carved or dom.endswith("." + carved):
+            return False
+    for restricted in _RESTRICTED_DOMAINS:
+        if dom == restricted or dom.endswith("." + restricted):
+            return True
+    return False
+
+
+def _validate_requirement(key: str, r, errors: List[str], where: str) -> None:
+    if key == wk.NODEPOOL_LABEL:
+        # dedicated rule (karpenter.sh_nodepools.yaml:279): a template may
+        # not require the pool-identity label — hijacking it would produce
+        # claims contradicting the pool that owns them
+        errors.append(f'{where}: label "karpenter.sh/nodepool" is restricted')
+        return
+    if key == wk.HOSTNAME_LABEL:
+        errors.append(f'{where}: label "kubernetes.io/hostname" is restricted')
+        return
+    if _key_restricted(key):
+        errors.append(f'{where}: label domain of "{key}" is restricted')
+    op_in = not r.complement and r.require_present
+    if op_in and not r.values and r.greater_than is None and r.less_than is None:
+        errors.append(
+            f"{where}: requirements with operator 'In' must have a value defined"
+        )
+    for bound in (r.greater_than, r.less_than):
+        if bound is not None and bound < 0:
+            errors.append(
+                f"{where}: requirements operator 'Gt' or 'Lt' must have a "
+                f"single positive integer value"
+            )
+    if r.min_values:
+        if r.min_values > 50:
+            errors.append(f"{where}: minValues must be <= 50")
+        if not r.complement and r.values and len(r.values) < r.min_values:
+            errors.append(
+                f"{where}: requirements with 'minValues' must have at least "
+                f"that many values specified in the 'values' field"
+            )
+
+
+def validate_nodepool(np_obj) -> List[str]:
+    errors: List[str] = []
+    tmpl = np_obj.template
+    for key, r in tmpl.requirements.items():
+        _validate_requirement(key, r, errors, "spec.template.spec.requirements")
+    for key in tmpl.labels:
+        if key == wk.HOSTNAME_LABEL:
+            errors.append('labels: label "kubernetes.io/hostname" is restricted')
+        elif key == wk.NODEPOOL_LABEL:
+            errors.append('labels: label "karpenter.sh/nodepool" is restricted')
+        elif _key_restricted(key):
+            errors.append(f'labels: label domain of "{key}" is restricted')
+    for b in np_obj.disruption.budgets:
+        if not _BUDGET_NODES_RE.match(b.nodes):
+            errors.append(
+                f"budgets: nodes must be a count or a 0-100 percentage, got {b.nodes!r}"
+            )
+        if (b.schedule is None) != (b.duration_s is None):
+            errors.append("budgets: 'schedule' must be set with 'duration'")
+        if b.schedule is not None:
+            from ..disruption.cron import Cron
+
+            try:
+                Cron(b.schedule)
+            except ValueError as e:
+                errors.append(f"budgets: {e}")
+    return errors
+
+
+def validate_nodeclaim(claim) -> List[str]:
+    errors: List[str] = []
+    for key, r in claim.requirements.items():
+        # NodeClaim requirements legitimately carry karpenter.sh/nodepool and
+        # instance-type narrowing set by the provisioner
+        if key in (wk.NODEPOOL_LABEL, wk.INSTANCE_TYPE_LABEL):
+            continue
+        _validate_requirement(key, r, errors, "spec.requirements")
+    return errors
+
+
+def admission_validator(kind: str, obj) -> None:
+    """Store admission hook: raises ValidationError on rule violations."""
+    if kind == "nodepools":
+        errors = validate_nodepool(obj)
+    elif kind == "nodeclaims":
+        errors = validate_nodeclaim(obj)
+    else:
+        return
+    if errors:
+        raise ValidationError(errors)

@@ -1,0 +1,48 @@
+"""CloudProvider metrics decorator (cloudprovider/metrics decorator,
+cmd/controller/main.go:42; metrics.md:298-322): every CloudProvider call is
+wrapped with a duration histogram and an error counter, without the
+provider implementation knowing."""
+
+from __future__ import annotations
+
+import time
+
+from ..metrics.registry import CLOUDPROVIDER_DURATION, CLOUDPROVIDER_ERRORS
+
+_WRAPPED = (
+    "create",
+    "delete",
+    "get",
+    "list",
+    "get_instance_types",
+    "is_drifted",
+    "repair_policies",
+)
+
+
+class MeteredCloudProvider:
+    """Delegating proxy: metrics.Decorate analog."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name not in _WRAPPED or not callable(attr):
+            return attr
+
+        def wrapped(*args, **kwargs):
+            t0 = time.perf_counter()
+            try:
+                return attr(*args, **kwargs)
+            except Exception as e:
+                CLOUDPROVIDER_ERRORS.inc(method=name, error=type(e).__name__)
+                raise
+            finally:
+                CLOUDPROVIDER_DURATION.observe(time.perf_counter() - t0, method=name)
+
+        return wrapped
+
+
+def decorate(cloud_provider) -> MeteredCloudProvider:
+    return MeteredCloudProvider(cloud_provider)

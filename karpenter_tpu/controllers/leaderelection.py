@@ -1,0 +1,118 @@
+"""Leader election: lease-based single-active-controller HA.
+
+The reference runs one active controller instance with standbys behind
+kube's lease-based leader election (`DISABLE_LEADER_ELECTION`,
+/root/reference/Makefile:56; settings.md:21). This framework's analog is a
+Lease object contended through the shared store with the same semantics:
+
+  - the holder renews every `renew_s`; a candidate acquires only when the
+    lease is expired (holder crashed / wedged past `lease_s`);
+  - acquisition goes through the store's optimistic concurrency
+    (resource_version conflict = someone else won the race);
+  - the Manager gates reconciliation on `elector.is_leader()` — standbys
+    tick their elector but run no controllers until they take over.
+
+A two-process deployment shares the lease through the snapshot/store layer;
+in-process HA (the testable configuration here) contends two managers on
+one store — the handoff test kills the leader and watches the standby take
+over and continue the control loop.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..api.objects import ObjectMeta
+from . import store as st
+
+LEASES = "leases"
+LEADER_LEASE_NAME = "karpenter-tpu-leader"
+
+
+@dataclass
+class Lease:
+    meta: ObjectMeta
+    holder: str = ""
+    renew_time: float = 0.0
+    lease_duration_s: float = 15.0
+
+
+class LeaderElector:
+    """Contends for the leader lease; call tick() regularly (the manager
+    does). Defaults mirror kube leader election (15s lease / 10s renew /
+    2s retry)."""
+
+    def __init__(
+        self,
+        store: st.Store,
+        identity: str,
+        lease_s: float = 15.0,
+        renew_s: float = 10.0,
+        clock=time.monotonic,
+    ):
+        self.store = store
+        self.identity = identity
+        self.lease_s = lease_s
+        self.renew_s = renew_s
+        self.clock = clock
+        self._leading = False
+
+    def is_leader(self) -> bool:
+        return self._leading
+
+    def _cas(self, lease: Lease, holder: str, renew_time: float) -> bool:
+        """Compare-and-swap a FRESH lease object against the observed
+        resource_version — two concurrent electors cannot both win; the
+        loser sees Conflict (store.update_if, real optimistic concurrency)."""
+        fresh = Lease(
+            meta=ObjectMeta(name=LEADER_LEASE_NAME),
+            holder=holder,
+            renew_time=renew_time,
+            lease_duration_s=self.lease_s,
+        )
+        try:
+            self.store.update_if(LEASES, fresh, lease.meta.resource_version)
+            return True
+        except (st.Conflict, st.NotFound):
+            return False
+
+    def tick(self) -> bool:
+        """Acquire/renew/observe; returns True when leadership CHANGED."""
+        now = self.clock()
+        lease: Optional[Lease] = self.store.try_get(LEASES, LEADER_LEASE_NAME)
+        was = self._leading
+        if lease is None:
+            try:
+                self.store.create(
+                    LEASES,
+                    Lease(
+                        meta=ObjectMeta(name=LEADER_LEASE_NAME),
+                        holder=self.identity,
+                        renew_time=now,
+                        lease_duration_s=self.lease_s,
+                    ),
+                )
+                self._leading = True
+            except st.Conflict:
+                self._leading = False  # lost the creation race
+        elif lease.holder == self.identity and self._leading:
+            if now - lease.renew_time >= self.renew_s / 2:
+                # a failed renewal CAS means someone took the lease from us
+                self._leading = self._cas(lease, self.identity, now)
+            else:
+                self._leading = True
+        elif now - lease.renew_time > lease.lease_duration_s:
+            # expired: take over; CAS loser stays standby
+            self._leading = self._cas(lease, self.identity, now)
+        else:
+            self._leading = False
+        return self._leading != was
+
+    def resign(self) -> None:
+        """Release the lease voluntarily (clean shutdown hands off fast)."""
+        lease: Optional[Lease] = self.store.try_get(LEASES, LEADER_LEASE_NAME)
+        if lease is not None and lease.holder == self.identity:
+            self._cas(lease, self.identity, -self.lease_s)  # instantly expired
+        self._leading = False

@@ -25,15 +25,23 @@ class Controller(Protocol):
 
 
 class Manager:
-    def __init__(self):
+    def __init__(self, elector=None):
         self.controllers: List[Controller] = []
         self._stop = threading.Event()
+        # lease-based leader election (controllers/leaderelection.py):
+        # standbys tick the elector but run nothing until they take over —
+        # the reference's singleton-controller HA model (settings.md:21)
+        self.elector = elector
 
     def register(self, *controllers: Controller) -> None:
         self.controllers.extend(controllers)
 
     def tick(self) -> bool:
         did = False
+        if self.elector is not None:
+            self.elector.tick()
+            if not self.elector.is_leader():
+                return False
         for c in self.controllers:
             try:
                 did = bool(c.reconcile()) or did

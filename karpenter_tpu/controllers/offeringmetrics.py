@@ -1,0 +1,33 @@
+"""Per-offering gauges (controllers/metrics/metrics.go:30-58): availability
+and price-estimate series per (instance type, zone, capacity type), refilled
+periodically so dashboards see the live ICE/pricing state."""
+
+from __future__ import annotations
+
+import time
+
+from ..metrics.registry import OFFERING_AVAILABLE, OFFERING_PRICE
+
+
+class OfferingMetricsController:
+    name = "metrics.offerings"
+
+    def __init__(self, cloud_provider, interval_s: float = 60.0, clock=time.monotonic):
+        self.cloud_provider = cloud_provider
+        self.interval_s = interval_s
+        self.clock = clock
+        self._last = None
+
+    def reconcile(self) -> bool:
+        now = self.clock()
+        if self._last is not None and now - self._last < self.interval_s:
+            return False
+        self._last = now
+        for it in self.cloud_provider.get_instance_types(""):
+            for o in it.offerings:
+                labels = dict(
+                    instance_type=it.name, zone=o.zone, capacity_type=o.capacity_type
+                )
+                OFFERING_AVAILABLE.set(1.0 if o.available else 0.0, **labels)
+                OFFERING_PRICE.set(o.price, **labels)
+        return False  # metrics are not cluster progress

@@ -42,6 +42,18 @@ class Store:
 
         self._pending = deque()
         self._dispatch_lock = threading.Lock()
+        # admission validators per kind (the CRD-schema/CEL analog — the
+        # store IS this framework's API server): fn(kind, obj) raises on
+        # invalid objects before they are persisted
+        self._validators: Dict[str, Callable[[str, Any], None]] = {}
+
+    def set_validator(self, kind: str, fn: Callable[[str, Any], None]) -> None:
+        self._validators[kind] = fn
+
+    def _admit(self, kind: str, obj: Any) -> None:
+        fn = self._validators.get(kind)
+        if fn is not None:
+            fn(kind, obj)
 
     @staticmethod
     def _key(obj: Any) -> str:
@@ -67,6 +79,7 @@ class Store:
     # -- crud ---------------------------------------------------------------
 
     def create(self, kind: str, obj: Any) -> Any:
+        self._admit(kind, obj)
         with self._lock:
             key = self._key(obj)
             if key in self._objects[kind]:
@@ -78,6 +91,26 @@ class Store:
         return obj
 
     def update(self, kind: str, obj: Any) -> Any:
+        # Admission on update: deleting objects are exempt (finalizer removal
+        # must always proceed), and objects whose STORED state already fails
+        # validation are grandfathered (e.g. restored from a pre-rule
+        # snapshot) so they never become un-updatable. Caveat: in-process
+        # callers often mutate the live stored object before calling
+        # update(), so a rejected update cannot un-publish the mutation —
+        # admission is airtight for create(), advisory for update().
+        if not obj.meta.deleting:
+            try:
+                self._admit(kind, obj)
+            except Exception:
+                cur0 = self.try_get(kind, obj.meta.name, obj.meta.namespace)
+                grandfathered = False
+                if cur0 is not None:
+                    try:
+                        self._admit(kind, cur0)
+                    except Exception:
+                        grandfathered = True
+                if not grandfathered:
+                    raise
         with self._lock:
             key = self._key(obj)
             cur = self._objects[kind].get(key)
@@ -91,6 +124,26 @@ class Store:
                 self._enqueue("DELETED", kind, obj)
             else:
                 self._enqueue("MODIFIED", kind, obj)
+        self._drain()
+        return obj
+
+    def update_if(self, kind: str, obj: Any, expected_rv: int) -> Any:
+        """Compare-and-swap update: succeeds only if the stored object's
+        resource_version still equals expected_rv (real optimistic
+        concurrency for contended objects like the leader lease — callers
+        must write a FRESH object, not mutate the stored one)."""
+        with self._lock:
+            key = self._key(obj)
+            cur = self._objects[kind].get(key)
+            if cur is None:
+                raise NotFound(f"{kind} {key}")
+            if cur.meta.resource_version != expected_rv:
+                raise Conflict(
+                    f"{kind} {key}: rv {cur.meta.resource_version} != {expected_rv}"
+                )
+            obj.meta.resource_version = self._next_rv()
+            self._objects[kind][key] = obj
+            self._enqueue("MODIFIED", kind, obj)
         self._drain()
         return obj
 

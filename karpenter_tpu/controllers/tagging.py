@@ -1,0 +1,46 @@
+"""Post-registration instance tagging (tagging/controller.go:54-131).
+
+Launch-time tags only carry what the launch path knows; once the NodeClaim
+registers, the instance gains the Name + claim identity tags the reference
+applies (Name, karpenter.sh/nodeclaim) so cloud-side inventories line up
+with cluster objects. Applied once per claim (annotation-marked, like the
+reference's tagged-annotation)."""
+
+from __future__ import annotations
+
+from ..api import wellknown as wk
+from . import store as st
+
+TAGGED_ANNOTATION = "karpenter.tpu/tagged"
+
+
+class TaggingController:
+    name = "nodeclaim.tagging"
+
+    def __init__(self, store: st.Store, cloud):
+        self.store = store
+        self.cloud = cloud
+
+    def reconcile(self) -> bool:
+        did = False
+        for claim in self.store.list(st.NODECLAIMS):
+            if not claim.registered or not claim.provider_id:
+                continue
+            if claim.meta.annotations.get(TAGGED_ANNOTATION) == "true":
+                continue
+            instance_id = claim.provider_id.rsplit("/", 1)[-1]
+            try:
+                self.cloud.create_tags(
+                    instance_id,
+                    {
+                        "Name": claim.node_name or claim.name,
+                        "karpenter.sh/nodeclaim": claim.name,
+                        wk.NODEPOOL_LABEL: claim.nodepool,
+                    },
+                )
+            except Exception:
+                continue  # instance gone / throttled: retry next loop
+            claim.meta.annotations[TAGGED_ANNOTATION] = "true"
+            self.store.update(st.NODECLAIMS, claim)
+            did = True
+        return did

@@ -267,7 +267,10 @@ class DisruptionController:
                     continue
                 ok, claim_res = self._simulate([c], allow_replacement=True, require_cheaper=False)
                 if ok:
-                    names = [self._create_replacement(claim_res)] if claim_res else []
+                    try:
+                        names = [self._create_replacement(claim_res)] if claim_res else []
+                    except Exception:
+                        continue  # rejected replacement: skip this candidate/prefix
                     return Command(method, [c], replacement_names=names)
             return None
 
@@ -326,7 +329,10 @@ class DisruptionController:
                     hi = mid - 1
             if best is not None:
                 subset, claim_res = best
-                names = [self._create_replacement(claim_res)] if claim_res else []
+                try:
+                    names = [self._create_replacement(claim_res)] if claim_res else []
+                except Exception:
+                    return None  # rejected replacement: no command this loop
                 return Command(method, subset, replacement_names=names)
             return None
 
@@ -340,7 +346,10 @@ class DisruptionController:
                 continue
             ok, claim_res = self._simulate([c], allow_replacement=True, require_cheaper=True)
             if ok and self._spot_flexibility_ok_res(c, claim_res):
-                names = [self._create_replacement(claim_res)] if claim_res else []
+                try:
+                    names = [self._create_replacement(claim_res)] if claim_res else []
+                except Exception:
+                    continue  # rejected replacement: skip this candidate/prefix
                 return Command(method, [c], replacement_names=names)
         return None
 
@@ -437,7 +446,10 @@ class DisruptionController:
         for k in sorted((k for k, v in probed.items() if acceptable(k, v)), reverse=True):
             ok, claim_res = self._simulate(pool[:k], allow_replacement=True, require_cheaper=True)
             if ok:
-                names = [self._create_replacement(claim_res)] if claim_res else []
+                try:
+                    names = [self._create_replacement(claim_res)] if claim_res else []
+                except Exception:
+                    continue  # rejected replacement: skip this candidate/prefix
                 return Command(method, pool[:k], replacement_names=names)
         return None
 
@@ -473,7 +485,10 @@ class DisruptionController:
                         continue
                 ok, claim_res = self._simulate([c], allow_replacement=True, require_cheaper=True)
                 if ok and self._spot_flexibility_ok_res(c, claim_res):
-                    names = [self._create_replacement(claim_res)] if claim_res else []
+                    try:
+                        names = [self._create_replacement(claim_res)] if claim_res else []
+                    except Exception:
+                        continue  # rejected replacement: skip this candidate/prefix
                     return Command(method, [c], replacement_names=names)
         return None
 
@@ -569,8 +584,7 @@ class DisruptionController:
     def _create_replacement(self, claim_res) -> str:
         nodepools = {p.name: p for p in self.store.list(st.NODEPOOLS)}
         np_obj = nodepools[claim_res.nodepool]
-        self._provisioner_helper._claim_seq += 1
-        name = f"{claim_res.nodepool}-r{self._provisioner_helper._claim_seq:05d}"
+        name = self._provisioner_helper._next_claim_name(claim_res.nodepool, suffix="r")
         reqs = type(claim_res.requirements)(claim_res.requirements)
         reqs.add(Requirement.create(wk.INSTANCE_TYPE_LABEL, IN, claim_res.instance_type_names))
         from ..api.objects import NodeClaim, ObjectMeta
@@ -590,6 +604,8 @@ class DisruptionController:
             expire_after_s=np_obj.template.expire_after_s,
             instance_type_options=list(claim_res.instance_type_names),
         )
+        # may raise (admission/conflict): callers treat a failed replacement
+        # as "no command this loop" instead of crashing the reconcile
         self.store.create(st.NODECLAIMS, claim)
         return name
 

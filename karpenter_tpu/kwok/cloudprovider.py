@@ -43,6 +43,7 @@ class KwokCloudProvider(CloudProvider):
         unavailable: Optional[UnavailableOfferings] = None,
         reservations: Optional[CapacityReservationProvider] = None,
         max_launch_types: int = 60,
+        discovered=None,
     ):
         self.cloud = cloud
         self._types = list(instance_types)
@@ -50,8 +51,9 @@ class KwokCloudProvider(CloudProvider):
         self.unavailable = unavailable or UnavailableOfferings()
         self.reservations = reservations or CapacityReservationProvider()
         self.max_launch_types = max_launch_types
+        self.discovered = discovered  # DiscoveredCapacityCache | None
         self._lock = threading.Lock()
-        self._ice_seq = (-1, -1)
+        self._ice_seq = (-1, -1, -1)
         self._masked_cache: List[InstanceType] = []
 
     # -- instance types -----------------------------------------------------
@@ -61,9 +63,15 @@ class KwokCloudProvider(CloudProvider):
         Rebuilt only when the ICE SeqNum moves (offering/offering.go:181-199
         cache-key protocol)."""
         with self._lock:
-            seq = (self.unavailable.seq_num, self._reservation_version())
+            seq = (
+                self.unavailable.seq_num,
+                self._reservation_version(),
+                self.discovered.seq if self.discovered is not None else -1,
+            )
             if seq == self._ice_seq:
                 return self._masked_cache
+            from ..utils.resources import MEMORY
+
             out: List[InstanceType] = []
             for it in self._types:
                 offerings = [
@@ -78,11 +86,20 @@ class KwokCloudProvider(CloudProvider):
                     )
                     for o in it.offerings
                 ]
+                capacity = it.capacity
+                if self.discovered is not None:
+                    # discovered-capacity learning: observed memory from live
+                    # nodes replaces the catalog's VM-overhead ESTIMATE
+                    # (instancetype.go:320-344)
+                    mem = self.discovered.memory(it.name)
+                    if mem is not None and mem != capacity.get(MEMORY):
+                        capacity = type(it.capacity)(it.capacity)
+                        capacity[MEMORY] = mem
                 out.append(
                     InstanceType(
                         name=it.name,
                         requirements=Requirements(it.requirements),
-                        capacity=it.capacity,
+                        capacity=capacity,
                         overhead=it.overhead,
                         offerings=offerings,
                     )

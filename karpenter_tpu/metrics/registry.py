@@ -182,6 +182,20 @@ DISRUPTION_DECISIONS = REGISTRY.register(
         ("decision", "reason"),
     )
 )
+OFFERING_AVAILABLE = REGISTRY.register(
+    Gauge(
+        "karpenter_cloudprovider_instance_type_offering_available",
+        "Per-offering availability (controllers/metrics/metrics.go:30-58)",
+        ("instance_type", "zone", "capacity_type"),
+    )
+)
+OFFERING_PRICE = REGISTRY.register(
+    Gauge(
+        "karpenter_cloudprovider_instance_type_offering_price_estimate",
+        "Per-offering price estimate (controllers/metrics/metrics.go:30-58)",
+        ("instance_type", "zone", "capacity_type"),
+    )
+)
 CLOUDPROVIDER_DURATION = REGISTRY.register(
     Histogram(
         "karpenter_cloudprovider_duration_seconds",

@@ -69,6 +69,7 @@ def main(argv=None) -> int:
         snapshot_path=o.snapshot_path or None,
         snapshot_interval_s=o.snapshot_interval_s,
         warm_start=o.warm_start and o.solver_backend == "tpu",
+        leader_elect=o.leader_elect,
     )
     serve_endpoints(o.metrics_port, o.health_probe_port)
     log.info("karpenter-tpu starting: solver=%s metrics=:%d", o.solver_backend, o.metrics_port)

@@ -63,10 +63,28 @@ def new_kwok_operator(
     snapshot_path: Optional[str] = None,
     snapshot_interval_s: float = 5.0,
     warm_start: bool = False,
+    leader_elect: bool = False,
+    identity: str = "karpenter-tpu-0",
+    shared_store: Optional[st.Store] = None,
+    shared_cloud: Optional[KwokCloud] = None,
 ) -> Operator:
-    store = st.Store()
+    store = shared_store if shared_store is not None else st.Store()
+    from ..api.validation import admission_validator
+
+    store.set_validator(st.NODEPOOLS, admission_validator)
+    store.set_validator(st.NODECLAIMS, admission_validator)
     types = list(instance_types) if instance_types is not None else generate(CatalogSpec())
-    cloud = KwokCloud(store, types, rate_limits=rate_limits, clock=clock)
+    cloud = (
+        shared_cloud
+        if shared_cloud is not None
+        else KwokCloud(store, types, rate_limits=rate_limits, clock=clock)
+    )
+    from ..providers.discovered import (
+        DiscoveredCapacityCache,
+        DiscoveredCapacityController,
+    )
+
+    discovered = DiscoveredCapacityCache()
     if snapshot_path is not None:
         # restore BEFORE any controller runs: the reference's kwok provider
         # hydrates instances from ConfigMaps at boot (kwok/ec2/ec2.go:112-232)
@@ -74,7 +92,14 @@ def new_kwok_operator(
 
         restore_snapshot(store, cloud, snapshot_path, now=clock())
     reservations = CapacityReservationProvider(clock=clock)
-    cloud_provider = KwokCloudProvider(cloud, types, reservations=reservations)
+    cloud_provider = KwokCloudProvider(
+        cloud, types, reservations=reservations, discovered=discovered
+    )
+    # metrics decorator (metrics.Decorate analog, main.go:42): every
+    # CloudProvider call records duration + errors transparently
+    from ..cloudprovider.metrics import decorate
+
+    cloud_provider = decorate(cloud_provider)
     cluster = Cluster(store, clock=clock)
     solver = solver or ReferenceSolver()
     provisioner = Provisioner(
@@ -90,7 +115,12 @@ def new_kwok_operator(
     from ..controllers.volume import VolumeTopologyController
 
     queue = InterruptionQueue()
-    manager = Manager()
+    elector = None
+    if leader_elect:
+        from ..controllers.leaderelection import LeaderElector
+
+        elector = LeaderElector(store, identity=identity, clock=clock)
+    manager = Manager(elector=elector)
     manager.register(
         VolumeTopologyController(store),
         provisioner,
@@ -107,6 +137,14 @@ def new_kwok_operator(
         InterruptionController(store, queue, unavailable=cloud_provider.unavailable),
         RepairController(store, cloud_provider, clock=clock),
         CapacityReservationFlipController(store, cloud, reservations, clock=clock),
+        DiscoveredCapacityController(store, discovered),
+    )
+    from ..controllers.offeringmetrics import OfferingMetricsController
+    from ..controllers.tagging import TaggingController
+
+    manager.register(
+        TaggingController(store, cloud),
+        OfferingMetricsController(cloud_provider, clock=clock),
     )
     if disruption:
         from ..disruption.controller import DisruptionController

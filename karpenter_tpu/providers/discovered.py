@@ -1,0 +1,58 @@
+"""Discovered-capacity learning (instancetype.go:320-344 behaviorally).
+
+The catalog's memory capacity is an ESTIMATE (VM overhead percent); real
+nodes report their true capacity at registration. The cache learns observed
+memory per instance type from live Nodes and the provider folds it into the
+served catalog — so the scheduler packs against reality, not the estimate.
+A seq number invalidates the provider's masked-catalog cache on change
+(same protocol as the ICE cache).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from ..api import wellknown as wk
+from ..controllers import store as st
+from ..utils.resources import MEMORY
+
+
+class DiscoveredCapacityCache:
+    def __init__(self):
+        self._memory: Dict[str, int] = {}
+        self.seq = 0
+
+    def record(self, instance_type: str, memory_bytes: int) -> None:
+        if memory_bytes <= 0:
+            return
+        if self._memory.get(instance_type) != memory_bytes:
+            self._memory[instance_type] = memory_bytes
+            self.seq += 1
+
+    def memory(self, instance_type: str) -> Optional[int]:
+        return self._memory.get(instance_type)
+
+
+class DiscoveredCapacityController:
+    """Hydrates the cache from registered Nodes (the reference's
+    providers/instancetype/capacity controller, capacity/controller.go:54-96)."""
+
+    name = "providers.instancetype.capacity"
+
+    def __init__(self, store: st.Store, cache: DiscoveredCapacityCache):
+        self.store = store
+        self.cache = cache
+
+    def reconcile(self) -> bool:
+        before = self.cache.seq
+        for node in self.store.list(st.NODES):
+            if not node.ready:
+                continue
+            it = node.meta.labels.get(wk.INSTANCE_TYPE_LABEL)
+            if not it:
+                continue
+            mem = node.capacity.get(MEMORY)
+            if mem:
+                self.cache.record(it, int(mem))
+        return False  # learning is not cluster progress (seq drives rebuilds)

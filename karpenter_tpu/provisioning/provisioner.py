@@ -111,6 +111,15 @@ class Provisioner:
             preference_policy=self.preference_policy,
         )
 
+    def _next_claim_name(self, nodepool: str, suffix: str = "") -> str:
+        """Store-aware name allocation: a freshly-promoted HA standby (or a
+        restart) must not collide with claims the previous leader created."""
+        while True:
+            self._claim_seq += 1
+            name = f"{nodepool}-{suffix}{self._claim_seq:05d}"
+            if self.store.try_get(st.NODECLAIMS, name) is None:
+                return name
+
     # -- reconcile ----------------------------------------------------------
 
     def reconcile(self) -> bool:
@@ -139,8 +148,7 @@ class Provisioner:
             np_obj = nodepools.get(claim_res.nodepool)
             if np_obj is None:
                 continue
-            self._claim_seq += 1
-            name = f"{claim_res.nodepool}-{self._claim_seq:05d}"
+            name = self._next_claim_name(claim_res.nodepool)
             reqs = type(claim_res.requirements)(claim_res.requirements)
             reqs.add(
                 Requirement.create(
@@ -171,7 +179,18 @@ class Provisioner:
                 termination_grace_period_s=np_obj.template.termination_grace_period_s,
                 instance_type_options=list(claim_res.instance_type_names),
             )
-            self.store.create(st.NODECLAIMS, claim)
+            try:
+                self.store.create(st.NODECLAIMS, claim)
+            except Exception as e:
+                # per-claim isolation (the reference handles create errors
+                # per NodeClaim): one rejected claim (admission/conflict)
+                # must not starve the rest of the batch or the nominations
+                import logging
+
+                logging.getLogger("karpenter_tpu").warning(
+                    "nodeclaim %s rejected: %s", name, e
+                )
+                continue
             did = True
         for uid, placement in result.placements.items():
             if placement[0] == "node":

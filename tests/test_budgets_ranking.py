@@ -144,14 +144,19 @@ class TestBudgetWindows:
         op.manager.settle()
         assert len(op.store.list(st.NODES)) < 2
 
-    def test_schedule_without_duration_never_constrains(self):
+    def test_schedule_without_duration_rejected_at_admission(self):
+        # the CRD rule ("'schedule' must be set with 'duration'",
+        # karpenter.sh_nodepools.yaml:140) now runs as store admission; the
+        # controller's never-constrains defense stays for objects that
+        # bypass admission (e.g. restored from an old snapshot)
+        from karpenter_tpu.api.validation import ValidationError
+
         op = self._op()
         broken = [Budget(nodes="0", schedule="0 9 * * *", duration_s=None)]
-        two_node_setup(op, budgets=broken)
+        with pytest.raises(ValidationError):
+            op.store.create(st.NODEPOOLS, mkpool_budgets(broken))
         dc = self._dc(op)
-        dc.wall_clock = FakeWallClock(ts(2026, 7, 29, 9, 30))
-        op.manager.settle()
-        assert len(op.store.list(st.NODES)) < 2
+        assert dc._budget_active(Budget(nodes="0", schedule="0 9 * * *", duration_s=None)) is False
 
 
 class TestRanking:

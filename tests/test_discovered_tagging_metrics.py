@@ -1,0 +1,86 @@
+"""Discovered-capacity learning, post-registration tagging, per-offering
+gauges, and the CloudProvider metrics decorator (VERDICT r3 missing #8 +
+COMPONENTS partial rows: tagging, metrics gauge fill, metrics decorator).
+"""
+
+import pytest
+
+from karpenter_tpu.api import wellknown as wk
+from karpenter_tpu.controllers import store as st
+from karpenter_tpu.controllers.tagging import TAGGED_ANNOTATION
+from karpenter_tpu.metrics.registry import REGISTRY
+from karpenter_tpu.operator.operator import new_kwok_operator
+from karpenter_tpu.utils.resources import MEMORY
+
+from tests.test_e2e_kwok import FakeClock, mkpod, mkpool
+
+
+@pytest.fixture
+def op():
+    clock = FakeClock()
+    o = new_kwok_operator(clock=clock)
+    o.clock = clock
+    return o
+
+
+def provision_one(op):
+    op.store.create(st.NODEPOOLS, mkpool())
+    op.store.create(st.PODS, mkpod("p0", cpu="500m"))
+    op.manager.settle()
+    return op.store.list(st.NODES)[0], op.store.list(st.NODECLAIMS)[0]
+
+
+class TestDiscoveredCapacity:
+    def test_observed_memory_replaces_estimate(self, op):
+        node, claim = provision_one(op)
+        it_name = node.meta.labels[wk.INSTANCE_TYPE_LABEL]
+        catalog_mem = next(
+            it.capacity.get(MEMORY)
+            for it in op.cloud_provider.get_instance_types("")
+            if it.name == it_name
+        )
+        # the node reports LESS memory than the catalog estimated (real
+        # hypervisor overhead): the served catalog must learn it
+        observed = int(catalog_mem - 512 * 1024**2)
+        node.capacity[MEMORY] = observed
+        op.store.update(st.NODES, node)
+        op.manager.settle()
+        served = next(
+            it for it in op.cloud_provider.get_instance_types("") if it.name == it_name
+        )
+        assert served.capacity.get(MEMORY) == observed
+
+    def test_learning_bumps_catalog_seq(self, op):
+        node, _ = provision_one(op)
+        before = id(op.cloud_provider.get_instance_types(""))
+        node.capacity[MEMORY] = int(node.capacity.get(MEMORY)) - 1024**2
+        op.store.update(st.NODES, node)
+        op.manager.settle()
+        after = op.cloud_provider.get_instance_types("")
+        assert id(after) != before, "catalog cache must rebuild on learning"
+
+
+class TestTagging:
+    def test_post_registration_tags(self, op):
+        node, claim = provision_one(op)
+        instance_id = claim.provider_id.rsplit("/", 1)[-1]
+        inst = next(i for i in op.cloud.describe_instances() if i.id == instance_id)
+        assert inst.tags.get("karpenter.sh/nodeclaim") == claim.name
+        assert inst.tags.get("Name") == claim.node_name
+        assert inst.tags.get(wk.NODEPOOL_LABEL) == claim.nodepool
+        refreshed = op.store.get(st.NODECLAIMS, claim.name)
+        assert refreshed.meta.annotations.get(TAGGED_ANNOTATION) == "true"
+
+
+class TestMetrics:
+    def test_offering_gauges_filled(self, op):
+        provision_one(op)
+        text = REGISTRY.expose()
+        assert "karpenter_cloudprovider_instance_type_offering_available" in text
+        assert "karpenter_cloudprovider_instance_type_offering_price_estimate" in text
+
+    def test_cloudprovider_calls_metered(self, op):
+        provision_one(op)
+        text = REGISTRY.expose()
+        assert 'karpenter_cloudprovider_duration_seconds' in text
+        assert 'method="create"' in text or "method=\"get_instance_types\"" in text

