@@ -140,6 +140,8 @@ def validate_nodepool(np_obj) -> List[str]:
                 Cron(b.schedule)
             except ValueError as e:
                 errors.append(f"budgets: {e}")
+    if not tmpl.node_class_ref:
+        errors.append("nodeClassRef: name may not be empty")
     return errors
 
 

@@ -42,7 +42,12 @@ class Lease:
 class LeaderElector:
     """Contends for the leader lease; call tick() regularly (the manager
     does). Defaults mirror kube leader election (15s lease / 10s renew /
-    2s retry)."""
+    2s retry).
+
+    `identity` MUST be unique per process (kube's hostname_uuid convention;
+    the operator defaults to pid+uuid). Identity-match reclaims the lease
+    without waiting for expiry — correct for a restarted holder, split-brain
+    if two live processes ever share an identity."""
 
     def __init__(
         self,
@@ -97,8 +102,13 @@ class LeaderElector:
                 self._leading = True
             except st.Conflict:
                 self._leading = False  # lost the creation race
-        elif lease.holder == self.identity and self._leading:
-            if now - lease.renew_time >= self.renew_s / 2:
+        elif lease.holder == self.identity:
+            # Holder-identity match renews even when _leading is False — a
+            # restarted leader with the same identity reclaims its own
+            # unexpired lease immediately (kube renews on identity match; the
+            # reclaim goes through CAS so two same-identity processes racing
+            # still serialize on the resource version).
+            if not self._leading or now - lease.renew_time >= self.renew_s / 2:
                 # a failed renewal CAS means someone took the lease from us
                 self._leading = self._cas(lease, self.identity, now)
             else:
@@ -114,5 +124,7 @@ class LeaderElector:
         """Release the lease voluntarily (clean shutdown hands off fast)."""
         lease: Optional[Lease] = self.store.try_get(LEASES, LEADER_LEASE_NAME)
         if lease is not None and lease.holder == self.identity:
-            self._cas(lease, self.identity, -self.lease_s)  # instantly expired
+            # empty holder + expired: candidates take over at once, and this
+            # process's identity no longer matches (it will not auto-reclaim)
+            self._cas(lease, "", -self.lease_s)
         self._leading = False

@@ -90,7 +90,7 @@ class Store:
         self._drain()
         return obj
 
-    def update(self, kind: str, obj: Any) -> Any:
+    def _admit_update(self, kind: str, obj: Any) -> None:
         # Admission on update: deleting objects are exempt (finalizer removal
         # must always proceed), and objects whose STORED state already fails
         # validation are grandfathered (e.g. restored from a pre-rule
@@ -111,6 +111,9 @@ class Store:
                         grandfathered = True
                 if not grandfathered:
                     raise
+
+    def update(self, kind: str, obj: Any) -> Any:
+        self._admit_update(kind, obj)
         with self._lock:
             key = self._key(obj)
             cur = self._objects[kind].get(key)
@@ -131,7 +134,9 @@ class Store:
         """Compare-and-swap update: succeeds only if the stored object's
         resource_version still equals expected_rv (real optimistic
         concurrency for contended objects like the leader lease — callers
-        must write a FRESH object, not mutate the stored one)."""
+        must write a FRESH object, not mutate the stored one). Same admission
+        as update(): CAS is not a validation bypass."""
+        self._admit_update(kind, obj)
         with self._lock:
             key = self._key(obj)
             cur = self._objects[kind].get(key)

@@ -9,6 +9,7 @@ reference's tagged-annotation)."""
 from __future__ import annotations
 
 from ..api import wellknown as wk
+from ..kwok.ratelimit import ThrottleError
 from . import store as st
 
 TAGGED_ANNOTATION = "karpenter.tpu/tagged"
@@ -38,8 +39,10 @@ class TaggingController:
                         wk.NODEPOOL_LABEL: claim.nodepool,
                     },
                 )
-            except Exception:
-                continue  # instance gone / throttled: retry next loop
+            except ThrottleError:
+                continue  # throttled: retry next loop (instance-gone is a
+                # silent no-op in the cloud; anything else is a programming
+                # error that must surface, not be retried forever)
             claim.meta.annotations[TAGGED_ANNOTATION] = "true"
             self.store.update(st.NODECLAIMS, claim)
             did = True

@@ -1,0 +1,251 @@
+"""Deploy-artifact renderer: the Helm-chart/values analog (L7).
+
+The reference ships `charts/karpenter` whose values.yaml materializes the
+flag table into a Deployment's KARPENTER_* env plus the HA scaffolding
+around it (2 replicas + leader election + PDB, service account, metrics
+Service — charts/karpenter/values.yaml, templates/deployment.yaml:91-170).
+Its CRD chart ships the API schemas (charts/karpenter-crd).
+
+This framework's API server is the in-process store, so the CRD half lives
+in `api/validation.py` (admission rules); this module renders the runtime
+half: a values dict → Kubernetes manifests. The values→env mapping is
+DERIVED from `operator/options.py` (same `_env_name`, same dataclass
+fields), so the chart can never drift from the flag table — the round-trip
+test parses the rendered env back through `options.parse` and asserts
+identity (the property the reference maintains by hand via hack/docs).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import fields
+from typing import Any, Dict, List, Optional
+
+from ..operator.options import Options, _env_name
+
+# chart-surface defaults mirroring charts/karpenter/values.yaml (subset that
+# is meaningful for this runtime: HA, probes, resources, settings)
+DEFAULT_VALUES: Dict[str, Any] = {
+    "nameOverride": "",
+    "namespace": "karpenter",
+    "image": "karpenter-tpu:latest",
+    "imagePullPolicy": "IfNotPresent",
+    "replicas": 2,  # HA: leader + standby (values.yaml "replicas: 2")
+    "revisionHistoryLimit": 10,
+    "podDisruptionBudget": {"maxUnavailable": 1},
+    "additionalLabels": {},
+    "podAnnotations": {},
+    "serviceAccount": {"create": True, "name": "", "annotations": {}},
+    "priorityClassName": "system-cluster-critical",
+    "controller": {
+        # reference controller footprint (Makefile:16-19)
+        "resources": {
+            "requests": {"cpu": "1", "memory": "1Gi"},
+            "limits": {"cpu": "1", "memory": "1Gi"},
+        },
+        "env": [],  # extra raw env entries appended verbatim
+    },
+    # every key here must be an Options field (camelCase of the snake_case
+    # name); rendered as KARPENTER_* env. Unlisted fields keep code defaults.
+    "settings": {
+        "batchIdleDurationS": 1.0,
+        "batchMaxDurationS": 10.0,
+        "featureGates": "",
+        "preferencePolicy": "Respect",
+        "leaderElect": True,
+        "solverBackend": "tpu",
+        "warmStart": True,
+    },
+}
+
+_OPTION_FIELDS = {f.name: f for f in fields(Options)}
+
+
+def _camel(snake: str) -> str:
+    head, *rest = snake.split("_")
+    return head + "".join(w.capitalize() for w in rest)
+
+
+_CAMEL_TO_SNAKE = {_camel(name): name for name in _OPTION_FIELDS}
+
+
+def merge_values(overrides: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Deep-merge user overrides onto DEFAULT_VALUES (helm `-f` semantics)."""
+    out = copy.deepcopy(DEFAULT_VALUES)
+
+    def deep(dst: Dict[str, Any], src: Dict[str, Any]) -> None:
+        for k, v in src.items():
+            if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                deep(dst[k], v)
+            else:
+                dst[k] = v
+
+    if overrides:
+        deep(out, overrides)
+    return out
+
+
+def settings_env(settings: Dict[str, Any]) -> List[Dict[str, str]]:
+    """values.settings → KARPENTER_* env entries, validated against Options.
+
+    Unknown keys raise (the chart cannot silently carry dead flags — the
+    reference regenerates its settings table from code for the same reason,
+    website/.../reference/settings.md:11).
+    """
+    env = []
+    for key in sorted(settings):
+        snake = _CAMEL_TO_SNAKE.get(key)
+        if snake is None:
+            raise ValueError(
+                f"values.settings.{key} does not match any option "
+                f"(known: {sorted(_CAMEL_TO_SNAKE)})"
+            )
+        v = settings[key]
+        if isinstance(v, bool):
+            sv = "true" if v else "false"
+        else:
+            sv = str(v)
+        env.append({"name": _env_name(snake), "value": sv})
+    return env
+
+
+def _meta(name: str, values: Dict[str, Any], extra: Optional[Dict[str, str]] = None):
+    labels = {"app.kubernetes.io/name": name, **values["additionalLabels"]}
+    m: Dict[str, Any] = {"name": name, "namespace": values["namespace"], "labels": labels}
+    if extra:
+        m["annotations"] = dict(extra)
+    return m
+
+
+def render(overrides: Optional[Dict[str, Any]] = None) -> List[Dict[str, Any]]:
+    """values → [ServiceAccount, Service, PodDisruptionBudget, Deployment]."""
+    v = merge_values(overrides)
+    name = v["nameOverride"] or "karpenter-tpu"
+    opts = Options()  # code defaults → ports for probes/service
+    sa_name = v["serviceAccount"]["name"] or name
+    out: List[Dict[str, Any]] = []
+    if v["serviceAccount"]["create"]:
+        out.append(
+            {
+                "apiVersion": "v1",
+                "kind": "ServiceAccount",
+                "metadata": _meta(sa_name, v, v["serviceAccount"]["annotations"] or None),
+            }
+        )
+    out.append(
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": _meta(name, v),
+            "spec": {
+                "type": "ClusterIP",
+                "selector": {"app.kubernetes.io/name": name},
+                "ports": [
+                    {"name": "http-metrics", "port": opts.metrics_port, "protocol": "TCP"}
+                ],
+            },
+        }
+    )
+    out.append(
+        {
+            "apiVersion": "policy/v1",
+            "kind": "PodDisruptionBudget",
+            "metadata": _meta(name, v),
+            "spec": {
+                "maxUnavailable": v["podDisruptionBudget"]["maxUnavailable"],
+                "selector": {"matchLabels": {"app.kubernetes.io/name": name}},
+            },
+        }
+    )
+    env = settings_env(v["settings"]) + list(v["controller"]["env"])
+    probe_port = opts.health_probe_port
+    out.append(
+        {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": _meta(name, v),
+            "spec": {
+                "replicas": v["replicas"],
+                "revisionHistoryLimit": v["revisionHistoryLimit"],
+                "strategy": {"rollingUpdate": {"maxUnavailable": 1}},
+                "selector": {"matchLabels": {"app.kubernetes.io/name": name}},
+                "template": {
+                    "metadata": {
+                        "labels": {"app.kubernetes.io/name": name},
+                        "annotations": dict(v["podAnnotations"]),
+                    },
+                    "spec": {
+                        "serviceAccountName": sa_name,
+                        "priorityClassName": v["priorityClassName"],
+                        "securityContext": {"runAsNonRoot": True},
+                        # spread replicas across hosts: a co-located standby
+                        # shares the leader's failure domain
+                        "topologySpreadConstraints": [
+                            {
+                                "maxSkew": 1,
+                                "topologyKey": "kubernetes.io/hostname",
+                                "whenUnsatisfiable": "DoNotSchedule",
+                                "labelSelector": {
+                                    "matchLabels": {"app.kubernetes.io/name": name}
+                                },
+                            }
+                        ],
+                        "containers": [
+                            {
+                                "name": "controller",
+                                "image": v["image"],
+                                "imagePullPolicy": v["imagePullPolicy"],
+                                "command": ["python", "-m", "karpenter_tpu.operator"],
+                                "env": env,
+                                "ports": [
+                                    {
+                                        "name": "http-metrics",
+                                        "containerPort": opts.metrics_port,
+                                    },
+                                    {
+                                        "name": "http-probe",
+                                        "containerPort": probe_port,
+                                    },
+                                ],
+                                "livenessProbe": {
+                                    "httpGet": {"path": "/healthz", "port": probe_port},
+                                    "initialDelaySeconds": 30,
+                                    "timeoutSeconds": 30,
+                                },
+                                "readinessProbe": {
+                                    "httpGet": {"path": "/readyz", "port": probe_port},
+                                    "timeoutSeconds": 30,
+                                },
+                                "resources": v["controller"]["resources"],
+                            }
+                        ],
+                    },
+                },
+            },
+        }
+    )
+    return out
+
+
+def render_yaml(overrides: Optional[Dict[str, Any]] = None) -> str:
+    import yaml
+
+    return "---\n".join(
+        yaml.safe_dump(m, sort_keys=False, default_flow_style=False) for m in render(overrides)
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    """`python -m karpenter_tpu.deploy [-f values.yaml]` — the `helm template`."""
+    import argparse
+
+    import yaml
+
+    ap = argparse.ArgumentParser(prog="karpenter-tpu-deploy")
+    ap.add_argument("-f", "--values", help="values YAML file with overrides")
+    args = ap.parse_args(argv)
+    overrides = None
+    if args.values:
+        with open(args.values) as f:
+            overrides = yaml.safe_load(f) or {}
+    print(render_yaml(overrides))

@@ -64,7 +64,7 @@ def new_kwok_operator(
     snapshot_interval_s: float = 5.0,
     warm_start: bool = False,
     leader_elect: bool = False,
-    identity: str = "karpenter-tpu-0",
+    identity: str = "",
     shared_store: Optional[st.Store] = None,
     shared_cloud: Optional[KwokCloud] = None,
 ) -> Operator:
@@ -119,6 +119,14 @@ def new_kwok_operator(
     if leader_elect:
         from ..controllers.leaderelection import LeaderElector
 
+        if not identity:
+            # unique per process, like kube's hostname_uuid holder identity:
+            # identity-match reclaims its own lease instantly, so two
+            # processes must never share one by default (split-brain)
+            import os as _os
+            import uuid as _uuid
+
+            identity = f"karpenter-tpu-{_os.getpid()}-{_uuid.uuid4().hex[:8]}"
         elector = LeaderElector(store, identity=identity, clock=clock)
     manager = Manager(elector=elector)
     manager.register(

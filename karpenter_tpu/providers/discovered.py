@@ -24,9 +24,16 @@ class DiscoveredCapacityCache:
         self.seq = 0
 
     def record(self, instance_type: str, memory_bytes: int) -> None:
+        # Keep the MINIMUM observation per type: deterministic whatever order
+        # nodes are listed in (two nodes reporting different memory cannot
+        # flip-flop the value — and a flip-flop would bump seq every
+        # reconcile, forcing the provider to rebuild the ~600-type catalog on
+        # every get_instance_types call), and conservative (the scheduler
+        # never packs against more memory than some live node reported).
         if memory_bytes <= 0:
             return
-        if self._memory.get(instance_type) != memory_bytes:
+        cur = self._memory.get(instance_type)
+        if cur is None or memory_bytes < cur:
             self._memory[instance_type] = memory_bytes
             self.seq += 1
 

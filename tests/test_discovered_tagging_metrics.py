@@ -84,3 +84,21 @@ class TestMetrics:
         text = REGISTRY.expose()
         assert 'karpenter_cloudprovider_duration_seconds' in text
         assert 'method="create"' in text or "method=\"get_instance_types\"" in text
+
+
+class TestDiscoveredStability:
+    def test_disagreeing_nodes_do_not_flip_flop(self):
+        """Two live nodes of one type reporting different memory must not
+        alternate the learned value (each flip bumps seq, and every seq bump
+        rebuilds the served ~600-type catalog): the cache keeps the
+        deterministic minimum and seq moves only on a new low."""
+        from karpenter_tpu.providers.discovered import DiscoveredCapacityCache
+
+        c = DiscoveredCapacityCache()
+        for _ in range(5):  # reconcile loop listing both nodes, any order
+            c.record("t3.large", 100)
+            c.record("t3.large", 90)
+        assert c.memory("t3.large") == 90
+        assert c.seq == 2, "one bump per new minimum, not one per reconcile"
+        c.record("t3.large", 95)  # higher observation: no churn
+        assert c.memory("t3.large") == 90 and c.seq == 2

@@ -100,3 +100,36 @@ class TestStandbyHandoff:
         assert standby.store.get(st.PODS, "p1").node_name is not None
         lease = standby.store.get(LEASES, LEADER_LEASE_NAME)
         assert lease.holder == "standby"
+
+
+class TestRestartAndResign:
+    def test_restarted_leader_reclaims_own_lease(self):
+        """A leader that crashes and comes back with the SAME identity renews
+        its unexpired lease immediately (kube renews on identity match) —
+        no dead window of up to lease_s with zero active controllers."""
+        store = st.Store()
+        clock = FakeClock()
+        a = LeaderElector(store, "a", lease_s=15, clock=clock)
+        a.tick()
+        assert a.is_leader()
+        clock.advance(1)  # well within the lease
+        a2 = LeaderElector(store, "a", lease_s=15, clock=clock)  # restart
+        a2.tick()
+        assert a2.is_leader(), "identity match must reclaim without waiting"
+        # and the reclaim was a real CAS renewal, not just a local flag
+        assert store.get(LEASES, LEADER_LEASE_NAME).renew_time == clock()
+
+    def test_resign_clears_holder(self):
+        """resign() empties the holder: the resigner does not auto-reclaim on
+        its next tick; another candidate takes the expired lease at once."""
+        store = st.Store()
+        clock = FakeClock()
+        a = LeaderElector(store, "a", clock=clock)
+        b = LeaderElector(store, "b", clock=clock)
+        a.tick()
+        a.resign()
+        assert store.get(LEASES, LEADER_LEASE_NAME).holder == ""
+        b.tick()
+        assert b.is_leader()
+        a.tick()
+        assert not a.is_leader()

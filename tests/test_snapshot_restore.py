@@ -70,7 +70,7 @@ def test_orphaned_instance_gc_after_restore(tmp_path):
     pod.node_name = None
     pod.phase = "Pending"
     op.store.update(st.PODS, pod)
-    save_snapshot(op.store, op.cloud, str(tmp_path / "snap.bin"))
+    save_snapshot(op.store, op.cloud, str(tmp_path / "snap.bin"), now=op.clock())
 
     op2 = boot(tmp_path)
     assert len(op2.cloud.describe_instances()) == 1, "orphan restored"

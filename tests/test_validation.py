@@ -133,3 +133,26 @@ class TestStoreAdmission:
         upd.weight = 7
         op.store.update(st.NODEPOOLS, upd)  # must not brick the object
         assert op.store.get(st.NODEPOOLS, "p").weight == 7
+
+
+    def test_update_if_enforces_admission(self):
+        """CAS updates go through the same admission as update() — update_if
+        is generic store infrastructure, not a lease-only side door."""
+        import copy
+
+        op = new_kwok_operator(clock=FakeClock())
+        good = mk()
+        op.store.create(st.NODEPOOLS, good)
+        bad = copy.deepcopy(good)
+        bad.disruption.budgets = [Budget(nodes="-3")]
+        with pytest.raises(ValidationError):
+            op.store.update_if(st.NODEPOOLS, bad, good.meta.resource_version)
+        assert op.store.get(st.NODEPOOLS, "p").disruption.budgets[0].nodes != "-3"
+
+
+    def test_empty_nodeclass_ref_rejected(self):
+        op = new_kwok_operator(clock=FakeClock())
+        bad = mk()
+        bad.template.node_class_ref = ""
+        with pytest.raises(ValidationError, match="nodeClassRef"):
+            op.store.create(st.NODEPOOLS, bad)
