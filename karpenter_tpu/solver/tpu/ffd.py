@@ -55,6 +55,27 @@ import numpy as np
 INT32_MAX = jnp.int32(2**31 - 1)
 BIG = jnp.int32(2**30)
 
+# Kernel diagnostic for event-engine perf work: when set, each zoned run's
+# `leftover` output REPORTS THE NUMBER OF WHILE-LOOP EVENTS CONSUMED instead
+# of unplaced pods. This corrupts solver results (decode sees phantom
+# unplaced pods) and is read at TRACE time, so it bakes into the jit cache —
+# never set it in a process that serves real solves. Used by perf probes to
+# verify the closed-form batching paths (cycle / water-fill mega / aff bulk)
+# are actually firing instead of per-claim trickle events.
+import os as _os
+
+_DEBUG_EVENTS = _os.environ.get("KTPU_DEBUG_EVENTS", "").lower() not in (
+    "", "0", "false", "no",
+)
+if _DEBUG_EVENTS:
+    import sys as _sys
+
+    print(
+        "karpenter_tpu.solver.tpu.ffd: KTPU_DEBUG_EVENTS set — leftover "
+        "outputs are EVENT COUNTS, solver results are invalid",
+        file=_sys.stderr,
+    )
+
 # Positional argument table for ffd_solve. consolidate.py and backend.py
 # derive indices from THIS table — never hand-count positions. The batched
 # consolidation evaluator (consolidate._batched_ffd) substitutes run_count,
@@ -511,6 +532,10 @@ def ffd_solve(
             has_tsc = psig_g >= 0
             psig = jnp.clip(psig_g, 0, V - 1)
             cap_p = v_cap[psig]
+            # self-matching spread: the group's own pods count toward its TSC
+            # selector, so pours advance the rotation — the closed forms
+            # below assume this (owner-not-member spreads stay eventful)
+            is_self = member_v[psig]
             asig_g = v_aff[g]
             has_affs = asig_g >= 0
             asig = jnp.clip(asig_g, 0, V - 1)
@@ -775,6 +800,44 @@ def ffd_solve(
                 )
                 q_p = jnp.where(self_anti, jnp.minimum(q_p, 1), q_p)
 
+                # ---- (C) fixed-zone bulk drain ----------------------------
+                # Positive zone affinity after bootstrap (or anti-free lex
+                # commit): the commit zone is the count-argmax, every pour
+                # reinforces it, and with every eligible claim committed to
+                # that same zone the drain phase is one first-fit prefix
+                # pour over claim slots — the budgeted multi-open (A) then
+                # funds the remainder in the SAME event. Without this, a
+                # late run of small pods trickle-drains the residue of every
+                # earlier claim one event at a time (config 4's cost).
+                # committed mode: zone members exist; all pours reinforce the
+                # count-argmax zone, so it cannot move mid-pour
+                aff_committed = (
+                    any_present & (nz_fin_p == 1)
+                    & jnp.all(~elig_m | ((bits_eff & ~zone_col_mask[z_p]) == 0))
+                )
+                # zone-free bootstrap mode: no committed members anywhere, a
+                # self-matching group satisfies its term claim-locally, and
+                # as long as every eligible claim and every fresh open stays
+                # MULTI-zone, no pour records a zone count (count_contrib
+                # single-zone rule) — any_present stays false throughout, so
+                # the whole drain is mode-stable
+                ze_cnt = jnp.sum(zone_sets(bits_eff), axis=1)  # [M]
+                aff_zonefree = (
+                    ~any_present & is_member_a
+                    & jnp.all(~elig_m | (ze_cnt > 1)) & (nz_fin_p > 1)
+                )
+                aff_bulk = (
+                    has_affs & ~has_tsc & ~self_anti
+                    & ~jnp.any(owned_anti) & ~jnp.any(member_anti)
+                    & ~found_e & found_c & found_p
+                    & (aff_committed | aff_zonefree)
+                )
+                caps_aff = jnp.where(elig_m, jnp.minimum(k_m, c_host), 0)
+                pref_aff = jnp.cumsum(caps_aff) - caps_aff
+                aff_drain_m = jnp.where(
+                    aff_bulk, jnp.clip(remaining - pref_aff, 0, caps_aff), 0
+                ).astype(jnp.int32)
+
                 # ---- balanced-phase cycle batching ------------------------
                 # condition: pure single-TSC group, equal counts across
                 # eligible zones, no eligible multi-zone claim, and every
@@ -789,7 +852,14 @@ def ffd_solve(
                     & ~jnp.any(member_anti)
                     & ~jnp.any(owned_anti)
                 )
-                cyc_ok = pure_tsc & counts_equal & ~multi_claim & (found_e | found_c)
+                # is_self: like the water-fill form, the cycle assumes pours
+                # advance the rotation counts — an owner-not-member spread
+                # never moves its counts, so the sequential pour fills the
+                # lex-first target to capacity instead of rotating
+                cyc_ok = (
+                    pure_tsc & is_self & counts_equal & ~multi_claim
+                    & (found_e | found_c)
+                )
                 # per-zone first targets (nodes before claims), unrolled on Z
                 tgt_cap_list = []
                 tgt_has_list = []
@@ -834,7 +904,10 @@ def ffd_solve(
                 # instead of one event per claim (config 4's cost).
                 full_p = jnp.minimum(kmax_p[p_star], fresh_allow)
                 multi_ok = ~has_tsc & ~self_anti
-                q_tot_p = jnp.where(multi_ok, jnp.minimum(remaining, Bz_p), q_p)
+                # under an (C) aff-bulk drain the open stage funds only what
+                # the claim drains leave over
+                rem_p = remaining - jnp.sum(aff_drain_m)
+                q_tot_p = jnp.where(multi_ok, jnp.minimum(rem_p, Bz_p), q_p)
                 headroom_p = pool_limit[p_star] - p_usage[p_star]  # [R]
                 ch_p = charge_one_p[p_star]
                 trips_p = jnp.min(jnp.where(
@@ -851,16 +924,20 @@ def ffd_solve(
                     1,
                 ).astype(jnp.int32)
 
-                # ---- (B) closed-form generation batching -------------------
-                # Balanced pure-TSC pours into FRESH claims (config 3's
-                # cost): with equal counts, no eligible node/claim targets,
-                # one covering pool, and uniform per-zone type capacity, the
-                # sequential engine opens claims in generation-major /
-                # lex-zone-minor order (claims open when cap-chunk rotation
-                # crosses each kmax boundary; kmax >= cap keeps that order)
-                # and fills each to kmax — so the ENTIRE run lays out in
-                # closed form: zone rank r receives the cap-chunk share T_z,
-                # and zone z's g-th claim takes min(kmax, T_z - g*kmax).
+                # ---- (B) closed-form water-fill batching -------------------
+                # Pure maxSkew-1 self-matching spread (config 3's cost): the
+                # sequential pour is a strict (level, lex-zone) rotation —
+                # each pod goes to the lex-first minimum-count zone — so with
+                # one covering pool, uniform per-zone type capacity, at most
+                # ONE single-zone claim target per zone and no node targets,
+                # the ENTIRE remaining run lays out in closed form even from
+                # UNBALANCED starting counts: water-fill the zone counts
+                # (floors = current counts, remainder to the lex-first zones
+                # at the water line), drain each zone's claim target first,
+                # then open fresh claims; fresh slot order sorts by key
+                # (count at open = c_z + drained_z + g*kmax, lex zone), which
+                # for balanced counts reduces to the generation-major /
+                # lex-zone-minor order of the earlier balanced-only form.
                 pz_star = pz_bits[p_star]
                 off_zt_star = (
                     (zone_col_mask[:, None] & pz_star) & offer_zc_bits[None, :]
@@ -890,24 +967,7 @@ def ffd_solve(
                 charge0 = charge_zr[z_first]
                 charge_eq = jnp.all(~elig[:, None] | (charge_zr == charge0[None, :]))
                 covers = jnp.all(~elig | pzz[p_star])
-                cap_sk = jnp.maximum(cap_p, 1)
-                nz_e = jnp.sum(elig).astype(jnp.int32)
-                C_tot = remaining // cap_sk
-                lo_rem = remaining % cap_sk
-                rank_z = (jnp.cumsum(elig) - 1).astype(jnp.int32)  # valid where elig
-                fc_z = jnp.where(
-                    elig,
-                    jnp.maximum(
-                        (C_tot - rank_z + nz_e - 1) // jnp.maximum(nz_e, 1), 0
-                    ),
-                    0,
-                ).astype(jnp.int32)
-                T_zv = (cap_sk * fc_z + jnp.where(
-                    elig & (rank_z == (C_tot % jnp.maximum(nz_e, 1))), lo_rem, 0
-                )).astype(jnp.int32)
                 km0 = jnp.maximum(kmax0, 1)
-                n_z = -(-T_zv // km0)  # claims per zone [Z]
-                n_mega = jnp.sum(n_z).astype(jnp.int32)
                 trips0 = jnp.min(jnp.where(
                     charge0 > 0,
                     jnp.maximum(
@@ -917,51 +977,132 @@ def ffd_solve(
                     ),
                     BIG,
                 )).astype(jnp.int32)
+                # per-zone claim targets: ALL eligible single-zone claims
+                # drain first-fit in slot order (zone totals are fixed by the
+                # water-fill, and within a zone first-fit always fills the
+                # lowest eligible slot, so a prefix pour is exact regardless
+                # of how the sequential rotation interleaves zones)
+                cand_z = elig_m_z & elig[None, :]  # [M, Z]
+                k_pz = jnp.max(
+                    jnp.where(off_zt & fit_base[:, None, :], k_raw[:, None, :], 0),
+                    axis=2,
+                )  # [M, Z] per-zone claim space
+                caps_mz = jnp.where(
+                    cand_z, jnp.minimum(k_pz, c_host[:, None]), 0
+                )  # [M, Z]
+                no_node = jnp.all(~elig | (pos_node >= BIG))
+                tgts_ok = ~jnp.any(cand_z & (zcount_m > 1)[:, None])
+                # water-fill: theta = max level with sum(max(0, theta-c)) <=
+                # remaining, solved on the sorted counts; remainder pods go
+                # one each to the lex-first zones sitting at the water line
+                celig = jnp.where(elig, cnt_p, BIG)
+                cs = jnp.sort(celig)  # ascending, BIG-padded
+                kk = jnp.arange(1, Z + 1, dtype=jnp.int32)
+                pref = jnp.cumsum(jnp.where(cs < BIG, cs, 0))
+                nz_e = jnp.sum(elig).astype(jnp.int32)
+                th_k = (remaining + pref) // kk
+                cs_next = jnp.concatenate([cs[1:], jnp.full((1,), BIG, cs.dtype)])
+                ok_k = (kk <= nz_e) & (th_k >= cs) & (th_k <= cs_next)
+                theta = jnp.max(jnp.where(ok_k, th_k, -BIG))
+                sfill = jnp.sum(jnp.where(elig, jnp.clip(theta - celig, 0, BIG), 0))
+                r_rem = remaining - sfill
+                at_lvl = elig & (celig <= theta)
+                lexr = jnp.cumsum(at_lvl.astype(jnp.int32)) - 1
+                bonus = at_lvl & (lexr < r_rem)
+                T_zv = (
+                    jnp.where(elig, jnp.clip(theta - celig, 0, BIG), 0)
+                    + bonus.astype(jnp.int32)
+                ).astype(jnp.int32)  # per-zone total adds
+                pref_mz = jnp.cumsum(caps_mz, axis=0) - caps_mz
+                take_mz = jnp.clip(
+                    T_zv[None, :] - pref_mz, 0, caps_mz
+                ).astype(jnp.int32)  # per-(claim, zone) drains
+                tm_z = jnp.sum(take_mz, axis=0)  # [Z] target drains
+                fr_z = T_zv - tm_z  # fresh-claim pods
+                n_z = -(-fr_z // km0)  # fresh claims per zone [Z]
+                n_mega = jnp.sum(n_z).astype(jnp.int32)
                 mega_ok = (
-                    pure_tsc & counts_equal & ~found_e & ~found_c & found_p
+                    pure_tsc & is_self & no_node & tgts_ok & found_p
                     # cap == 1 ONLY: with maxSkew >= 2 the per-pod first-fit
                     # re-admits earlier claims mid-rotation (skew headroom),
-                    # so pours are not clean cap-chunks; maxSkew=1 rotation
-                    # is strict and the closed form is exact
+                    # so pours are not clean rotation chunks; maxSkew=1 is
+                    # strict and the closed form is exact
                     & (cap_p == 1)
-                    & (kmax0 > 0) & (kmax0 >= cap_sk) & kmax_eq & charge_eq & covers
+                    & (kmax0 > 0) & kmax_eq & charge_eq & covers
                     & (fresh_allow >= kmax0)
                     & (n_mega <= M - used) & (trips0 >= n_mega)
-                    & (nz_e >= 1) & (remaining > 0)
+                    & (remaining > 0)
+                    # theta-solve sanity: the fill must account for every pod
+                    & (jnp.sum(T_zv) == remaining)
                 )
-                # slot -> (generation, zone) map: cnt(G) = sum_z min(n_z, G);
-                # slot j's generation is the largest G with cnt(G) <= j, its
-                # zone the (j - cnt(g))-th lex zone still needing claims
-                Garr = jnp.arange(1, M + 1, dtype=jnp.int32)  # [M]
-                cnt_arr = jnp.sum(
-                    jnp.minimum(n_z[None, :], Garr[:, None])
-                    * elig[None, :].astype(jnp.int32),
-                    axis=1,
-                )  # [M]
+                # fresh-claim slot order: rank claims (z, g) by key
+                # (open level = c_z + tm_z + g*kmax, lex zone) and scatter
+                base_z = jnp.where(elig, cnt_p + tm_z, BIG)  # [Z]
+                Garr = jnp.arange(M, dtype=jnp.int32)
+                K_zg = base_z[:, None] + Garr[None, :] * km0  # [Z, M] keys
+                diff = K_zg[:, :, None] - base_z[None, None, :]  # [Z, M, Z]
+                below = jnp.clip(-(-diff // km0), 0, n_z[None, None, :])
+                tied = (
+                    (diff >= 0)
+                    & (diff % km0 == 0)
+                    & ((diff // km0) < n_z[None, None, :])
+                    & (zidx[None, None, :] < zidx[:, None, None])
+                )
+                rank_zg = (jnp.sum(below, axis=2) + jnp.sum(tied, axis=2)).astype(
+                    jnp.int32
+                )  # [Z, M]
+                valid_zg = (Garr[None, :] < n_z[:, None]) & elig[:, None]
+                scat_idx = jnp.where(valid_zg, rank_zg, M)  # OOB rows dropped
+                scat_z = (
+                    jnp.zeros((M,), jnp.int32)
+                    .at[scat_idx.reshape(-1)]
+                    .set(
+                        jnp.broadcast_to(zidx[:, None], (Z, M)).reshape(-1),
+                        mode="drop",
+                    )
+                )
+                take_fr = jnp.clip(
+                    fr_z[:, None] - Garr[None, :] * km0, 0, km0
+                ).astype(jnp.int32)
+                scat_take = (
+                    jnp.zeros((M,), jnp.int32)
+                    .at[scat_idx.reshape(-1)]
+                    .set(take_fr.reshape(-1), mode="drop")
+                )
                 j_off = midx - used  # [M]
-                g_j = jnp.sum(cnt_arr[None, :] <= j_off[:, None], axis=1).astype(jnp.int32)
-                cnt_g = jnp.where(g_j > 0, cnt_arr[jnp.clip(g_j - 1, 0, M - 1)], 0)
-                p_j = j_off - cnt_g
-                ok_zm = elig[None, :] & (n_z[None, :] > g_j[:, None])  # [M, Z]
-                rnk = jnp.cumsum(ok_zm.astype(jnp.int32), axis=1) - 1
-                zsel = jnp.argmax(ok_zm & (rnk == p_j[:, None]), axis=1).astype(jnp.int32)
                 in_mega = mega_ok & (j_off >= 0) & (j_off < n_mega)
-                take_mega = jnp.where(
-                    in_mega, jnp.clip(T_zv[zsel] - g_j * km0, 0, km0), 0
+                jc = jnp.clip(j_off, 0, M - 1)
+                zsel = scat_z[jc]
+                take_mega = jnp.where(in_mega, scat_take[jc], 0).astype(jnp.int32)
+                # target-drain quantities land on their existing slots
+                # (claims are single-zone under tgts_ok, so the per-zone
+                # takes of one claim never overlap)
+                drain_m = jnp.where(
+                    mega_ok, jnp.sum(take_mz, axis=1), 0
                 ).astype(jnp.int32)
 
                 # ---- selection & unified masked apply ---------------------
-                use_e = found_e & ~cyc_ok
-                use_c = ~found_e & found_c & ~cyc_ok
-                use_p = ~found_e & ~found_c & found_p & ~cyc_ok & ~mega_ok
+                # the water-fill mega subsumes the balanced cycle (balanced
+                # counts are its special case) and may fire with existing
+                # claim targets (found_c) — it takes precedence everywhere
+                cyc_eff = cyc_ok & ~mega_ok
+                use_e = found_e & ~cyc_eff & ~mega_ok
+                use_c = ~found_e & found_c & ~cyc_eff & ~mega_ok & ~aff_bulk
+                # aff_bulk keeps the open stage live even though found_c is
+                # true: the bulk drain and the multi-open share the event
+                use_p = (
+                    ~found_e & (~found_c | aff_bulk) & found_p
+                    & ~cyc_eff & ~mega_ok
+                )
 
                 take_e_add = (
                     jnp.where(use_e & (eidx == e_star), q_e, 0)
-                    + jnp.where(cyc_ok & tgt_e_1h, per_tgt, 0)
+                    + jnp.where(cyc_eff & tgt_e_1h, per_tgt, 0)
                 ).astype(jnp.int32)
                 take_c_add = (
                     jnp.where(use_c & (midx == m_star), q_c, 0)
-                    + jnp.where(cyc_ok & tgt_c_1h, per_tgt, 0)
+                    + jnp.where(cyc_eff & tgt_c_1h, per_tgt, 0)
+                    + aff_drain_m
                 ).astype(jnp.int32)
 
                 # existing-node state
@@ -991,6 +1132,31 @@ def ffd_solve(
                     jnp.int32
                 )
                 c_vo_st = c_vo_st | (added[:, None] & owned_anti[None, :])
+
+                # water-fill target drains: pour tm_z into each zone's
+                # single-zone claim target (zone bits unchanged — the claim
+                # is already committed to that zone; pure_tsc ⇒ no anti
+                # registration). k_raw is the event-start fit count, so the
+                # capacity narrowing matches a sequential pod-by-pod pour.
+                drained = drain_m > 0
+                ok_off_all = (c_zc_bits[:, None] & offer_zc_bits[None, :]) != 0
+                c_cum = c_cum + drain_m[:, None] * req[None, :]
+                c_mask = jnp.where(
+                    drained[:, None],
+                    c_mask & compat_t[None, :] & ok_off_all
+                    & (k_raw >= drain_m[:, None]),
+                    c_mask,
+                )
+                c_gbits = c_gbits | jnp.where(
+                    drained[:, None], gword[None, :], jnp.uint32(0)
+                )
+                c_cm = c_cm + drain_m[:, None] * member_g[None, :].astype(jnp.int32)
+                c_co = c_co + (
+                    drained[:, None] & owner_g[None, :] & (q_kind[None, :] == 1)
+                ).astype(jnp.int32)
+                c_vm_st = c_vm_st + drain_m[:, None] * member_v[None, :].astype(
+                    jnp.int32
+                )
 
                 # new-claim open: n_open_p slots in the committed zone (A)
                 is_new = use_p & (j_off >= 0) & (j_off < n_open_p)
@@ -1099,12 +1265,12 @@ def ffd_solve(
 
                 placed = (
                     jnp.sum(take_e_add) + jnp.sum(take_c_add) + jnp.sum(tq)
-                    + jnp.sum(take_mega)
+                    + jnp.sum(take_mega) + jnp.sum(drain_m)
                 )
                 remaining = remaining - placed
                 progress = placed > 0
                 take_e_acc2 = take_e_acc + take_e_add
-                take_c_acc2 = take_c_acc + take_c_add + tq + take_mega
+                take_c_acc2 = take_c_acc + take_c_add + tq + take_mega + drain_m
                 return (remaining, progress, fuel - 1, take_e_acc2, take_c_acc2,
                         e_cum, c_cum, c_mask, c_zc_bits, c_gbits, c_pool, used,
                         p_usage, e_cm, e_co, c_cm, c_co, v_count, v_owner_z,
@@ -1121,6 +1287,10 @@ def ffd_solve(
             (remaining, _progress, _fuel, take_e_acc, take_c_acc, e_cum, c_cum,
              c_mask, c_zc_bits, c_gbits, c_pool, used, p_usage, e_cm, e_co,
              c_cm, c_co, v_count, v_owner_z, c_vm_f, c_vo_f) = out
+            if _DEBUG_EVENTS:
+                # kernel diagnostic (perf work ONLY — see flag definition):
+                # report events consumed instead of unplaced pods
+                remaining = (remaining0 + jnp.int32(8)) - _fuel
             new_state = FFDState(
                 e_cum=e_cum, c_cum=c_cum, c_mask=c_mask, c_zc_bits=c_zc_bits,
                 c_gbits=c_gbits, c_pool=c_pool, used=used, p_usage=p_usage,

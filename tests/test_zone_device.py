@@ -582,3 +582,136 @@ class TestEventBatchingParity:
             SolverInput(pods=[owner] + members, nodes=[], nodepools=[pool()],
                         zones=ZONES)
         )
+
+
+class TestClosedFormBatching:
+    """Directed parity for the water-fill mega + aff-bulk closed forms
+    (round 4): each scenario is shaped so the eventful path would need many
+    trickle events, and the closed form must reproduce the sequential layout
+    bit-for-bit — unbalanced starting counts, multi-claim residue drains,
+    and both affinity modes (claim-local bootstrap / zone-committed)."""
+
+    def test_waterfill_from_unbalanced_counts(self):
+        # 2cpu pods pinned to zone-1a run first (FFD size order) and seed
+        # unbalanced sig counts; the spread run then water-fills from floors
+        # (7, 0, 0) — the balanced-only closed form never fires here
+        pods = [
+            mkpod(f"pin{i}", cpu="2", labels={"app": "w"},
+                  node_selector={wk.ZONE_LABEL: "zone-1a"})
+            for i in range(7)
+        ]
+        pods += [
+            mkpod(f"s{i:03d}", cpu="1", labels={"app": "w"},
+                  topology_spread=[TSC1])
+            for i in range(90)
+        ]
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+
+    def test_waterfill_multi_residue_drains(self):
+        # three same-sig waves, descending size: each leaves partially-full
+        # claims, and the last (tiny) wave must drain SEVERAL residues per
+        # zone in slot order before opening fresh claims
+        pods = []
+        for wave, (cpu, n) in enumerate([("2", 40), ("1", 40), ("100m", 200)]):
+            pods += [
+                mkpod(f"w{wave}p{i:03d}", cpu=cpu, mem="256Mi",
+                      labels={"app": "w"}, topology_spread=[TSC1])
+                for i in range(n)
+            ]
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+
+    def test_waterfill_with_node_targets_stays_exact(self):
+        # eligible nodes in eligible zones disable the closed form (no_node
+        # guard) — the eventful path must still match the oracle
+        nodes = [mknode("n-a", "zone-1a"), mknode("n-b", "zone-1b")]
+        pods = [
+            mkpod(f"s{i:03d}", cpu="1", labels={"app": "w"},
+                  topology_spread=[TSC1])
+            for i in range(60)
+        ]
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=nodes, nodepools=[pool()], zones=ZONES)
+        )
+
+    def test_owner_not_member_spread(self):
+        # the TSC selector does NOT match the pods' own labels: pours never
+        # advance the rotation counts, so the closed forms must stay off
+        # (is_self guard) and the eventful path must match the oracle
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.ZONE_LABEL, label_selector={"app": "w"}
+        )
+        pods = [
+            mkpod(f"x{i:02d}", cpu="1", labels={"app": "x"},
+                  topology_spread=[tsc])
+            for i in range(12)
+        ]
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+
+    def test_owner_not_member_spread_with_node_targets(self):
+        # same, but with a node target in EVERY zone: balanced zero counts
+        # satisfy every other cycle condition, so without the is_self guard
+        # the cycle would rotate 4/4/4 across nodes while the sequential
+        # pour (counts never advance → every zone stays allowed) fills the
+        # lex-first node to capacity first
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.ZONE_LABEL, label_selector={"app": "w"}
+        )
+        nodes = [mknode(f"n-{z[-1]}", z) for z in ZONES]
+        pods = [
+            mkpod(f"x{i:02d}", cpu="1", labels={"app": "x"},
+                  topology_spread=[tsc])
+            for i in range(12)
+        ]
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=nodes, nodepools=[pool()], zones=ZONES)
+        )
+
+    def test_aff_bulk_zone_free_bootstrap(self):
+        # self-matching positive zone affinity with no committed zone
+        # anywhere: pods satisfy the term claim-locally; the tiny second
+        # wave drains every first-wave residue in one prefix pour
+        def web(n, prefix, cpu, mem):
+            return [
+                mkpod(f"{prefix}{i:03d}", cpu=cpu, mem=mem,
+                      labels={"svc": "web"},
+                      affinity_terms=[PodAffinityTerm(
+                          label_selector={"svc": "web"},
+                          topology_key=wk.ZONE_LABEL, anti=False)])
+                for i in range(n)
+            ]
+        pods = web(30, "a", "2", "2Gi") + web(120, "b", "100m", "64Mi")
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+
+    def test_aff_bulk_committed_mode(self):
+        # a pinned member commits the zone first (single-zone claim records
+        # the count), so the affinity waves run in committed mode: drains
+        # and opens all pin to the argmax zone
+        pods = [
+            mkpod("seed", cpu="2", labels={"svc": "web"},
+                  node_selector={wk.ZONE_LABEL: "zone-1b"})
+        ]
+        pods += [
+            mkpod(f"f{i:03d}", cpu="1", labels={"svc": "web"},
+                  affinity_terms=[PodAffinityTerm(
+                      label_selector={"svc": "web"},
+                      topology_key=wk.ZONE_LABEL, anti=False)])
+            for i in range(60)
+        ]
+        pods += [
+            mkpod(f"g{i:03d}", cpu="100m", mem="64Mi", labels={"svc": "web"},
+                  affinity_terms=[PodAffinityTerm(
+                      label_selector={"svc": "web"},
+                      topology_key=wk.ZONE_LABEL, anti=False)])
+            for i in range(90)
+        ]
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
