@@ -99,6 +99,28 @@ class InterruptionController:
         self.store = store
         self.queue = queue
         self.unavailable = unavailable or UnavailableOfferings()
+        # instance-id -> claim-name index: the reference's status.instanceID
+        # field indexer (operator.go:284-305) — interruption is the hot path
+        # where a per-message linear scan over claims would be O(msgs×claims).
+        # Watch-driven (informer-style) so it is exact under mid-batch
+        # additions: a claim whose provider_id lands between batch start and
+        # message handling is indexed by its MODIFIED event before the
+        # message's lookup runs (watch delivery is synchronous with the
+        # mutation's drain). Deletions race benignly: the existence re-check
+        # in _claim_by_instance drops stale hits.
+        self._index: Dict[str, str] = {}
+        self._index_lock = threading.Lock()
+        store.watch(st.NODECLAIMS, self._on_claim_event)
+
+    def _on_claim_event(self, event: str, kind: str, obj) -> None:
+        if not getattr(obj, "provider_id", None):
+            return
+        iid = obj.provider_id.rsplit("/", 1)[-1]
+        with self._index_lock:
+            if event == "DELETED":
+                self._index.pop(iid, None)
+            else:
+                self._index[iid] = obj.name
 
     def reconcile(self) -> bool:
         batch = self.queue.receive()
@@ -138,7 +160,15 @@ class InterruptionController:
     def _claim_by_instance(self, instance_id: str):
         if not instance_id:
             return None
-        for c in self.store.list(st.NODECLAIMS):
-            if c.provider_id and c.provider_id.rsplit("/", 1)[-1] == instance_id:
-                return c
-        return None
+        with self._index_lock:
+            name = self._index.get(instance_id)
+        if name is None:
+            return None
+        c = self.store.try_get(st.NODECLAIMS, name)
+        if (
+            c is None
+            or not c.provider_id
+            or c.provider_id.rsplit("/", 1)[-1] != instance_id
+        ):
+            return None  # deleted or re-assigned since the index refresh
+        return c

@@ -26,8 +26,10 @@ from . import options as opts
 from .operator import new_kwok_operator
 
 
-def serve_endpoints(port: int, health_port: int) -> None:
-    """Prometheus metrics + health probes (operator manager equivalents)."""
+def serve_endpoints(port: int, health_port: int, enable_profiling: bool = False):
+    """Prometheus metrics + health probes (operator manager equivalents);
+    /debug/pprof/* sampling profiler behind --enable-profiling
+    (settings.md:23)."""
 
     class MetricsHandler(BaseHTTPRequestHandler):
         def do_GET(self):
@@ -41,6 +43,15 @@ def serve_endpoints(port: int, health_port: int) -> None:
                 self.send_response(200)
                 self.end_headers()
                 self.wfile.write(b"ok")
+            elif self.path.startswith("/debug/pprof/") and enable_profiling:
+                from . import profiling
+
+                path, _, query = self.path.partition("?")
+                status, body = profiling.handle(path, query)
+                self.send_response(status)
+                self.send_header("Content-Type", "text/plain")
+                self.end_headers()
+                self.wfile.write(body.encode())
             else:
                 self.send_response(404)
                 self.end_headers()
@@ -50,6 +61,7 @@ def serve_endpoints(port: int, health_port: int) -> None:
 
     srv = ThreadingHTTPServer(("127.0.0.1", port), MetricsHandler)
     threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
 
 
 def main(argv=None) -> int:
@@ -71,7 +83,8 @@ def main(argv=None) -> int:
         warm_start=o.warm_start and o.solver_backend == "tpu",
         leader_elect=o.leader_elect,
     )
-    serve_endpoints(o.metrics_port, o.health_probe_port)
+    serve_endpoints(o.metrics_port, o.health_probe_port,
+                    enable_profiling=o.enable_profiling)
     log.info("karpenter-tpu starting: solver=%s metrics=:%d", o.solver_backend, o.metrics_port)
 
     if o.demo:

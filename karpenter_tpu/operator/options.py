@@ -36,6 +36,7 @@ class Options:
     # behavior
     log_level: str = "info"
     preference_policy: str = "Respect"  # settings.md:38
+    enable_profiling: bool = False  # /debug/pprof/* (settings.md:23)
     feature_gates: str = ""
     leader_elect: bool = True
     # solver backend: tpu | reference

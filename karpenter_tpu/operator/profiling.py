@@ -1,0 +1,95 @@
+"""Profiling endpoints — the `--enable-profiling` pprof analog.
+
+The reference exposes Go pprof on the metrics endpoint behind
+`--enable-profiling` (website/.../reference/settings.md:23). Go's CPU
+profile is a sampling profiler; the Python analog here samples
+`sys._current_frames()` across ALL threads on a fixed interval and
+aggregates inclusive/self hit counts per function — no dependencies, works
+on the live controller loop, and unlike `cProfile` it observes every
+thread (manager loop, batcher, snapshot, HTTP server), not just the caller.
+
+Endpoints (wired by operator/__main__.py when enabled):
+  /debug/pprof/profile?seconds=N  — sample for N seconds (default 5, max
+                                    60), return a flat text report sorted
+                                    by self samples
+  /debug/pprof/stacks             — instantaneous dump of every thread's
+                                    stack (the goroutine-profile analog)
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from collections import Counter
+from typing import Tuple
+
+SAMPLE_INTERVAL_S = 0.01  # 100 Hz, pprof's default sampling rate
+
+
+def sample_profile(seconds: float, interval_s: float = SAMPLE_INTERVAL_S) -> str:
+    """Sample all thread stacks for `seconds`; flat report by self-samples."""
+    seconds = max(0.1, min(float(seconds), 60.0))
+    me = threading.get_ident()
+    self_hits: Counter = Counter()
+    incl_hits: Counter = Counter()
+    n_samples = 0
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue  # the profiler's own sampling loop is noise
+            n_samples += 1
+            leaf = True
+            seen = set()
+            while frame is not None:
+                code = frame.f_code
+                key = (code.co_filename, code.co_name)
+                if leaf:
+                    self_hits[key] += 1
+                    leaf = False
+                if key not in seen:  # count recursion once per sample
+                    incl_hits[key] += 1
+                    seen.add(key)
+                frame = frame.f_back
+        time.sleep(interval_s)
+    lines = [
+        f"# sampling profile: {seconds:.1f}s @ {1 / interval_s:.0f}Hz, "
+        f"{n_samples} thread-samples",
+        f"{'self':>8} {'self%':>7} {'incl':>8}  function",
+    ]
+    total = max(n_samples, 1)
+    for key, self_n in self_hits.most_common(60):
+        fn, name = key
+        lines.append(
+            f"{self_n:>8} {100.0 * self_n / total:>6.1f}% {incl_hits[key]:>8}"
+            f"  {name} ({fn})"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def dump_stacks() -> str:
+    """Instantaneous all-thread stack dump (goroutine-profile analog)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in sys._current_frames().items():
+        out.append(f"--- thread {tid} ({names.get(tid, '?')}) ---")
+        out.extend(line.rstrip() for line in traceback.format_stack(frame))
+    return "\n".join(out) + "\n"
+
+
+def handle(path: str, query: str) -> Tuple[int, str]:
+    """Route a /debug/pprof request; returns (status, body)."""
+    if path == "/debug/pprof/profile":
+        seconds = 5.0
+        for part in query.split("&"):
+            if part.startswith("seconds="):
+                try:
+                    seconds = float(part.split("=", 1)[1])
+                except ValueError:
+                    return 400, "bad seconds\n"
+        return 200, sample_profile(seconds)
+    if path == "/debug/pprof/stacks":
+        return 200, dump_stacks()
+    return 404, "unknown profile endpoint\n"

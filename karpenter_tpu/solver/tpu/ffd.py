@@ -64,8 +64,9 @@ BIG = jnp.int32(2**30)
 # are actually firing instead of per-claim trickle events.
 import os as _os
 
-_DEBUG_EVENTS = _os.environ.get("KTPU_DEBUG_EVENTS", "").lower() not in (
-    "", "0", "false", "no",
+# positive allowlist, matching the repo's env-bool convention (options.py)
+_DEBUG_EVENTS = _os.environ.get("KTPU_DEBUG_EVENTS", "").lower() in (
+    "1", "true", "yes",
 )
 if _DEBUG_EVENTS:
     import sys as _sys

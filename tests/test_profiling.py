@@ -1,0 +1,57 @@
+"""--enable-profiling endpoints (the pprof analog, settings.md:23):
+sampling profile + all-thread stack dump on the metrics port, 404 when the
+flag is off."""
+
+import threading
+import time
+import urllib.request
+
+from karpenter_tpu.operator.__main__ import serve_endpoints
+from karpenter_tpu.operator.profiling import dump_stacks, sample_profile
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, ""
+
+
+def test_sample_profile_sees_other_threads():
+    stop = threading.Event()
+
+    def busy_loop_fn():
+        while not stop.is_set():
+            sum(range(500))
+
+    t = threading.Thread(target=busy_loop_fn, name="busy", daemon=True)
+    t.start()
+    try:
+        report = sample_profile(0.3, interval_s=0.005)
+    finally:
+        stop.set()
+    assert "busy_loop_fn" in report, report[:400]
+    assert "thread-samples" in report
+
+
+def test_stack_dump_lists_threads():
+    out = dump_stacks()
+    assert "--- thread" in out
+
+
+def test_endpoints_gated_on_flag():
+    srv_off = serve_endpoints(0, 0, enable_profiling=False)
+    port_off = srv_off.server_address[1]
+    status, _ = _get(port_off, "/debug/pprof/stacks")
+    assert status == 404
+    srv_on = serve_endpoints(0, 0, enable_profiling=True)
+    port_on = srv_on.server_address[1]
+    status, body = _get(port_on, "/debug/pprof/stacks")
+    assert status == 200 and "--- thread" in body
+    status, body = _get(port_on, "/debug/pprof/profile?seconds=0.2")
+    assert status == 200 and "thread-samples" in body
+    status, _ = _get(port_on, "/debug/pprof/nope")
+    assert status == 404
+    srv_off.shutdown()
+    srv_on.shutdown()
