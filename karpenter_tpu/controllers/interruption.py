@@ -163,6 +163,15 @@ class InterruptionController:
         with self._index_lock:
             name = self._index.get(instance_id)
         if name is None:
+            # Exactness fallback: watch delivery can lag a mutation when the
+            # dispatch queue is draining behind a slow watcher, so an index
+            # miss is re-checked against the store directly — a dropped
+            # message here would never be retried (reconcile deletes it).
+            # Misses are rare (unknown ids + that race), so the scan is off
+            # the hot path.
+            for c in self.store.list(st.NODECLAIMS):
+                if c.provider_id and c.provider_id.rsplit("/", 1)[-1] == instance_id:
+                    return c
             return None
         c = self.store.try_get(st.NODECLAIMS, name)
         if (

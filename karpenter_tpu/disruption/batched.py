@@ -133,8 +133,12 @@ class BatchedConsolidationEvaluator:
         except UnpackableInput:
             return None  # Z*C > 32 — sequential path takes over
         v_count0_host = np.asarray(args[_V_COUNT0])
-        # upload the shared arrays once; batched axes are re-uploaded per call
-        args = tuple(jax.device_put(a) for a in args)
+        # upload the shared arrays once — replicated across the candidate
+        # mesh when one exists, so per-dispatch traffic is the batched axes
+        # only, never the constant universe
+        from ..solver.tpu.consolidate import replicate_shared
+
+        args = replicate_shared(tuple(args))
 
         id_to_e = {nid: e for e, nid in enumerate(enc.node_ids)}
         node_idx = {cid: id_to_e[nid] for cid, nid in candidate_node.items()

@@ -52,17 +52,14 @@ _NODE_COMPAT = ARG_INDEX["node_compat"]
 _V_COUNT0 = ARG_INDEX["v_count0"]
 
 
-@functools.partial(
-    jax.jit, static_argnames=("max_claims", "emit_takes", "zone_engine")
-)
-def _batched_ffd(
+def _batched_ffd_core(
     shared_args,
     b_run_count,  # [B, Sp]
     b_v_count0,  # [B, Vp, Z]
     cand_member,  # [B, NC] bool — candidate ids in each subset
     node_cand,  # [E] int32 — candidate id owning node e (-1 none)
-    *,
-    max_claims: int,
+    # statics positional: pjit rejects kwargs when in_shardings is set
+    max_claims: int = 16,
     emit_takes: bool = True,
     zone_engine: bool = True,
 ):
@@ -83,6 +80,61 @@ def _batched_ffd(
         )
 
     return jax.vmap(one)(b_run_count, b_v_count0, cand_member)
+
+
+_batched_ffd = jax.jit(_batched_ffd_core, static_argnums=(5, 6, 7))
+
+# ---- multi-chip dispatch (SURVEY §2.10): the candidate batch axis is the
+# scale-out axis — shard it across a Mesh so each chip evaluates its shard
+# of subsets; the shared universe replicates; no cross-candidate
+# communication exists during the solve, so only the result gather rides
+# ICI. Single-device rigs keep the plain jit (no resharding overhead).
+_MESH = None
+_MESH_INIT = False
+
+
+def candidate_mesh():
+    global _MESH, _MESH_INIT
+    if not _MESH_INIT:
+        devs = jax.devices()
+        if len(devs) > 1:
+            from jax.sharding import Mesh
+
+            _MESH = Mesh(np.asarray(devs), ("candidates",))
+        _MESH_INIT = True
+    return _MESH
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_ffd():
+    """jit of the batched solve with candidate-axis sharding over the
+    process's one candidate mesh (built at most once — see candidate_mesh)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = _MESH
+    repl = NamedSharding(mesh, PartitionSpec())
+    shard = NamedSharding(mesh, PartitionSpec("candidates"))
+    n_shared = len(ARG_INDEX)
+    return jax.jit(
+        _batched_ffd_core,
+        static_argnums=(5, 6, 7),
+        in_shardings=((repl,) * n_shared, shard, shard, shard, repl),
+        out_shardings=shard,
+    )
+
+
+def replicate_shared(kernel_args: tuple) -> tuple:
+    """Commit the shared universe to every mesh device ONCE (prepare time):
+    without this, the jit's replicated in_shardings re-broadcasts the whole
+    constant universe on every dispatch — per-batch traffic proportional to
+    the problem, not the batch."""
+    mesh = candidate_mesh()
+    if mesh is None:
+        return tuple(jax.device_put(a) for a in kernel_args)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    repl = NamedSharding(mesh, PartitionSpec())
+    return tuple(jax.device_put(a, repl) for a in kernel_args)
 
 
 def simulate_subsets(
@@ -132,9 +184,17 @@ def simulate_subsets(
         NC = max(NC, max(candidate_node_idx) + 1)
     # bucket the traced dims so dispatches compile once per bucket, not once
     # per (candidate count, phase width); padded rows simulate an empty
-    # subset and are sliced off before verdict decoding
+    # subset and are sliced off before verdict decoding. The batch bucket
+    # must divide evenly across the candidate mesh when one exists.
     NC = ((NC + 63) // 64) * 64
-    Bp = max(8, ((B + 7) // 8) * 8)
+    mesh = candidate_mesh()
+    mult = 8
+    if mesh is not None:
+        import math
+
+        n_dev = int(mesh.devices.size)
+        mult = mult * n_dev // math.gcd(mult, n_dev)
+    Bp = max(mult, ((B + mult - 1) // mult) * mult)
 
     b_run_count = np.zeros((Bp, S), dtype=run_count_dtype)
     b_v_count0 = np.broadcast_to(v_count0, (Bp,) + v_count0.shape).copy()
@@ -157,15 +217,16 @@ def simulate_subsets(
     for cid, e in candidate_node_idx.items():
         if 0 <= e < E and cid < NC:
             node_cand[e] = cid
-    return _batched_ffd(
+    fn = _batched_ffd if mesh is None else _sharded_ffd()
+    return fn(
         tuple(kernel_args),
         jnp.asarray(b_run_count),
         jnp.asarray(b_v_count0),
         jnp.asarray(cand_member),
         jnp.asarray(node_cand),
-        max_claims=max_claims,
-        emit_takes=not verdict_only,
-        zone_engine=zone_engine,
+        max_claims,
+        not verdict_only,
+        zone_engine,
     )
 
 

@@ -120,3 +120,17 @@ class TestInterruptionThroughput:
         q.send(Message(kind=SPOT_INTERRUPTION, instance_id="i-fresh"))
         ctrl.reconcile()
         assert store.try_get(st.NODECLAIMS, "fresh") is None
+
+    def test_index_miss_falls_back_to_exact_scan(self):
+        """A lagging watch delivery (dispatch queue draining behind a slow
+        watcher) must not drop an interruption: an index miss re-checks the
+        store directly before giving up — messages are deleted either way,
+        so a miss here would never be retried."""
+        store = _mkstore(3)
+        q = InterruptionQueue()
+        ctrl = InterruptionController(store, q)
+        with ctrl._index_lock:
+            ctrl._index.pop("i-00002")  # simulate the lag
+        q.send(Message(kind=SPOT_INTERRUPTION, instance_id="i-00002"))
+        ctrl.reconcile()
+        assert store.try_get(st.NODECLAIMS, "c00002") is None
