@@ -109,6 +109,12 @@ class InterruptionController:
         # mutation's drain). Deletions race benignly: the existence re-check
         # in _claim_by_instance drops stale hits.
         self._index: Dict[str, str] = {}
+        # ids proven absent by a direct store scan (unknown instances,
+        # repeat messages for deleted claims): O(1) misses on the hot path
+        # instead of a per-message O(claims) scan. Exactness: any claim
+        # event that (re)binds a provider id discards its negative entry,
+        # and the scan that populates it reads the store directly.
+        self._negative: set = set()
         self._index_lock = threading.Lock()
         store.watch(st.NODECLAIMS, self._on_claim_event)
 
@@ -119,8 +125,12 @@ class InterruptionController:
         with self._index_lock:
             if event == "DELETED":
                 self._index.pop(iid, None)
+                self._negative.add(iid)
+                if len(self._negative) > 100_000:
+                    self._negative.clear()  # bounded; entries rebuild lazily
             else:
                 self._index[iid] = obj.name
+                self._negative.discard(iid)
 
     def reconcile(self) -> bool:
         batch = self.queue.receive()
@@ -162,16 +172,23 @@ class InterruptionController:
             return None
         with self._index_lock:
             name = self._index.get(instance_id)
+            known_absent = name is None and instance_id in self._negative
+        if known_absent:
+            return None
         if name is None:
             # Exactness fallback: watch delivery can lag a mutation when the
-            # dispatch queue is draining behind a slow watcher, so an index
+            # dispatch queue is draining behind a slow watcher, so a FIRST
             # miss is re-checked against the store directly — a dropped
             # message here would never be retried (reconcile deletes it).
-            # Misses are rare (unknown ids + that race), so the scan is off
-            # the hot path.
+            # A confirmed absence is remembered (negative set), so repeat
+            # unknown-id messages stay O(1) and the scan amortizes to once
+            # per distinct id per binding epoch.
             for c in self.store.list(st.NODECLAIMS):
                 if c.provider_id and c.provider_id.rsplit("/", 1)[-1] == instance_id:
                     return c
+            with self._index_lock:
+                if instance_id not in self._index:
+                    self._negative.add(instance_id)
             return None
         c = self.store.try_get(st.NODECLAIMS, name)
         if (

@@ -150,11 +150,12 @@ class BatchedConsolidationEvaluator:
         v_delta = None
         if enc.V:
             v_delta = {}
+            n_dom = len(enc.v_domains) if enc.v_domains is not None else len(enc.zones)
             for cid, e in node_idx.items():
-                z = int(enc.node_zone[e])
+                z = int(enc.v_node_domain[e])
                 if z < 0:
                     continue
-                d = np.zeros((enc.V, len(enc.zones)), dtype=np.int32)
+                d = np.zeros((enc.V, n_dom), dtype=np.int32)
                 d[:, z] = enc.node_v_member[e]
                 if d.any():
                     v_delta[cid] = d

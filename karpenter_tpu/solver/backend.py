@@ -185,16 +185,30 @@ def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
         out[tuple(slice(0, s) for s in a.shape)] = a
         return out
 
-    ckey = (id(enc.run_group), R, Z, C, Sp, Gp, Tp, Pp, Qp, Vp)
+    # domain axis of the V sigs: zone columns (default) or lex-ordered ct
+    # columns — the kernel's "zone" tables are really domain tables, and the
+    # joint packing is untouched either way (column masks select bits)
+    D = len(enc.v_domains) if enc.v_domains is not None else Z
+    ckey = (id(enc.run_group), R, Z, C, Sp, Gp, Tp, Pp, Qp, Vp, enc.v_axis)
     hit = _CORE_ARGS_CACHE.get(ckey)
     if hit is not None and hit[0] is enc.run_group:
         core_args = hit[1]
     else:
-        # per-zone joint-bit columns: bit z*C+c for every c
-        zone_col = np.zeros(Z, dtype=np.uint32)
-        for z in range(Z):
-            for c in range(C):
-                zone_col[z] |= np.uint32(1) << np.uint32(z * C + c)
+        zone_col = np.zeros(D, dtype=np.uint32)
+        if enc.v_axis == "ct":
+            # per-ct joint-bit columns: bit z*C+c for every z, in the
+            # CANONICAL domain order encode computed (enc.v_domains) — the
+            # single source of truth for the lex tiebreak shared with the
+            # native marshal swap
+            lex = [enc.capacity_types.index(d) for d in enc.v_domains]
+            for d, c in enumerate(lex):
+                for z in range(Z):
+                    zone_col[d] |= np.uint32(1) << np.uint32(z * C + c)
+        else:
+            # per-zone joint-bit columns: bit z*C+c for every c
+            for z in range(Z):
+                for c in range(C):
+                    zone_col[z] |= np.uint32(1) << np.uint32(z * C + c)
         type_charge = np.where(
             enc.charge_axes[None, :], enc.type_capacity, 0
         ).astype(np.int32)
@@ -270,8 +284,14 @@ def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
         ca["v_cap"],
         ca["v_primary"],
         ca["v_aff"],
-        jnp.asarray(pad(enc.v_count0, (Vp, Z))),
-        jnp.asarray(pad(enc.node_zone, (Ep,), fill=np.int32(-1))),
+        jnp.asarray(pad(enc.v_count0, (Vp, D))),
+        jnp.asarray(
+            pad(
+                enc.v_node_domain if enc.v_node_domain is not None else enc.node_zone,
+                (Ep,),
+                fill=np.int32(-1),
+            )
+        ),
         ca["zone_col_mask"],
     )
     from .tpu.ffd import ARG_SPEC
@@ -499,13 +519,14 @@ class TPUSolver(Solver):
             or enc.has_affinity
             or enc.G == 0
         ):
-            # Zone TSC/affinity and hostname constraints run on device (Q/V
-            # axes, tpu/ffd.py); what still routes the whole solve to the
-            # fallback chain: flagged fallback groups (OR'd node affinity,
-            # preferred terms, stacked zone constraints, ≥3-way custom-label
-            # conflicts), capacity-type TSC/affinity, positive hostname
-            # affinity, and duplicate node hostnames. Whole-solve fallback
-            # keeps semantics unforked.
+            # Zone/capacity-type TSC+affinity and hostname constraints run
+            # on device (Q/V axes, tpu/ffd.py; ct via the domain-axis swap);
+            # what still routes the whole solve to the fallback chain:
+            # flagged fallback groups (OR'd node affinity, preferred terms,
+            # stacked domain constraints, ≥3-way custom-label conflicts),
+            # solves mixing zone- and ct-granular sigs, positive hostname
+            # affinity, custom-key spread, and duplicate node hostnames.
+            # Whole-solve fallback keeps semantics unforked.
             self.stats["fallback_solves"] += 1
             return AsyncSolve(lambda: self.fallback.solve(qinp))
         handle = self._device_solve_async(enc)

@@ -18,10 +18,14 @@ tensors plus boolean constraint masks"). It performs:
     node compatibility, [G,P] nodepool admission, [P,T] pool-type admission,
     [T,Z,C] offering availability/price, [G,G] pairwise group compatibility.
 
-Pods the v1 device kernel cannot express (OR'd node-affinity alternatives,
-preferred affinities needing relaxation, ScheduleAnyway TSCs, or ≥3-way
+Pods the device kernel cannot express (OR'd node-affinity alternatives,
+preferred affinities needing relaxation, ScheduleAnyway TSCs under
+--preference-policy=Respect, custom-topology-key terms, stacked positive
+hostname terms, kind-2 groups that are also domain-constrained, or ≥3-way
 custom-label joint conflicts) are flagged `fallback` — the hybrid solver
 routes those to the reference path (see karpenter_tpu/solver/backend.py).
+Zone- and capacity-type-granular spread/affinity and positive hostname
+affinity all run ON DEVICE (V domain axis / Q kind 2).
 """
 
 from __future__ import annotations
@@ -215,8 +219,9 @@ class EncodedInput:
     sorted_uids: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=object))
 
     # topology / affinity (config 3-4) — filled by encode, used by tpu kernels
-    # True only for constructs still off-device (capacity-type TSC/affinity,
-    # duplicate node hostnames); zone terms run on device via the V axis.
+    # True only for constructs still off-device (custom-key spread, positive
+    # hostname affinity, mixed zone+ct domain axes, duplicate node
+    # hostnames); zone- and ct-granular terms run on device via the V axis.
     has_topology: bool = False
     has_affinity: bool = False
 
@@ -230,11 +235,18 @@ class EncodedInput:
     v_cap: Optional[np.ndarray] = None  # [V] int32 (maxSkew for TSC)
     v_primary: Optional[np.ndarray] = None  # [G] int32 — group's owned zone-TSC sig (-1)
     v_aff: Optional[np.ndarray] = None  # [G] int32 — group's owned positive-affinity sig (-1)
-    v_count0: Optional[np.ndarray] = None  # [V, Z] int32 initial matching-pod counts
+    v_count0: Optional[np.ndarray] = None  # [V, D] int32 initial matching-pod counts
     # per-node share of v_count0 (node e contributes node_v_member[e] at its
-    # zone) — lets the batched consolidation evaluator subtract a removed
-    # candidate node's bound pods from the zone counts per subset
+    # domain) — lets the batched consolidation evaluator subtract a removed
+    # candidate node's bound pods from the domain counts per subset
     node_v_member: Optional[np.ndarray] = None  # [E, V] int32
+    # which axis the V sigs spread over — "zone" (default) or "ct": the
+    # event engine is domain-generic, so capacity-type TSC/affinity runs on
+    # it by presenting lex-ordered ct values as the domain axis (the D in
+    # the shapes above); v_node_domain maps nodes into that axis
+    v_axis: str = "zone"
+    v_domains: Optional[List[str]] = None  # D axis values, lex order
+    v_node_domain: Optional[np.ndarray] = None  # [E] int32 (-1 unknown)
 
     @property
     def V(self) -> int:
@@ -438,6 +450,7 @@ class _EncodeCore:
     has_aff: bool
     hostname_sigs: Dict[tuple, int]
     zone_sigs: Dict[tuple, int]
+    v_axis: str  # "zone" | "ct" — which axis the V sigs are granular over
     q_member: np.ndarray
     q_owner: np.ndarray
     q_kind: np.ndarray
@@ -614,10 +627,15 @@ def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
     has_aff = False
     hostname_sigs: Dict[tuple, int] = {}  # (kind, sel_sig, cap) -> q index
     zone_sigs: Dict[tuple, int] = {}  # (kind, sel_sig, cap) -> v index
-    # per-group owned zone sigs, collected to fill v_owner / v_primary below
+    ct_sigs: Dict[tuple, int] = {}  # capacity-type-granular sigs (same shape)
+    # per-group owned sigs, collected to fill v_owner / v_primary below
     group_zone_tscs: List[List[tuple]] = []
     group_zone_antis: List[List[tuple]] = []
     group_zone_affs: List[List[tuple]] = []
+    group_ct_tscs: List[List[tuple]] = []
+    group_ct_antis: List[List[tuple]] = []
+    group_ct_affs: List[List[tuple]] = []
+    group_h2: List[bool] = []  # owns a positive hostname-affinity term
     respect_prefs = inp.preference_policy != "Ignore"
     for g, pl in enumerate(group_pods):
         pod = pl[0]
@@ -635,6 +653,9 @@ def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
         ztscs: List[tuple] = []
         zantis: List[tuple] = []
         zaffs: List[tuple] = []
+        ctscs: List[tuple] = []
+        cantis: List[tuple] = []
+        caffs: List[tuple] = []
         for t in pod.topology_spread:
             if t.when_unsatisfiable != "DoNotSchedule":
                 continue
@@ -647,30 +668,78 @@ def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
                 sig = (0, tuple(sorted(t.label_selector.items())), t.max_skew)
                 zone_sigs.setdefault(sig, len(zone_sigs))
                 ztscs.append(sig)
+            elif t.topology_key == wk.CAPACITY_TYPE_LABEL:
+                sig = (0, tuple(sorted(t.label_selector.items())), t.max_skew)
+                ct_sigs.setdefault(sig, len(ct_sigs))
+                ctscs.append(sig)
             else:
-                has_topo = True  # capacity-type spread: fallback path
+                has_topo = True  # custom-key spread: fallback path
+        has_h2 = False
+        n_h2 = 0
         for t in pod.affinity_terms:
             if t.weight is not None:
                 continue
             if t.anti and t.topology_key == wk.HOSTNAME_LABEL:
                 sig = (1, tuple(sorted(t.label_selector.items())), 1)
                 hostname_sigs.setdefault(sig, len(hostname_sigs))
+            elif t.topology_key == wk.HOSTNAME_LABEL:
+                # positive hostname affinity (kind 2): per-target allowance
+                # where members are present + a one-claim bootstrap budget
+                # (ffd._hostname_allowance / fast())
+                sig = (2, tuple(sorted(t.label_selector.items())), 0)
+                hostname_sigs.setdefault(sig, len(hostname_sigs))
+                has_h2 = True
+                n_h2 += 1
             elif t.topology_key == wk.ZONE_LABEL:
                 kind = 1 if t.anti else 2
                 sig = (kind, tuple(sorted(t.label_selector.items())), 1 if t.anti else 0)
                 zone_sigs.setdefault(sig, len(zone_sigs))
                 (zantis if t.anti else zaffs).append(sig)
+            elif t.topology_key == wk.CAPACITY_TYPE_LABEL:
+                kind = 1 if t.anti else 2
+                sig = (kind, tuple(sorted(t.label_selector.items())), 1 if t.anti else 0)
+                ct_sigs.setdefault(sig, len(ct_sigs))
+                (cantis if t.anti else caffs).append(sig)
             else:
-                has_aff = True  # ct terms / positive hostname affinity: fallback
-        # the zone event engine supports ONE owned zone TSC and ONE positive
-        # zone affinity per pod, not combined (the oracle's sequential
-        # narrowing order for stacked terms isn't expressed on device yet)
+                has_aff = True  # custom-key affinity: fallback
+        # the domain event engine supports ONE owned TSC and ONE positive
+        # affinity per pod, not combined (the oracle's sequential narrowing
+        # order for stacked terms isn't expressed on device yet)
         if len(ztscs) > 1 or len(zaffs) > 1 or (ztscs and zaffs):
+            fallback[g] = True
+        if len(ctscs) > 1 or len(caffs) > 1 or (ctscs and caffs):
+            fallback[g] = True
+        if n_h2 > 1:
+            # stacked positive hostname terms: the single-target bootstrap
+            # derivation only covers one term — oracle handles the corner
             fallback[g] = True
         group_zone_tscs.append(ztscs)
         group_zone_antis.append(zantis)
         group_zone_affs.append(zaffs)
+        group_ct_tscs.append(ctscs)
+        group_ct_antis.append(cantis)
+        group_ct_affs.append(caffs)
+        group_h2.append(has_h2)
         group_reqsets.append(pod.scheduling_requirements())
+
+    # ---- domain-axis resolution -------------------------------------------
+    # The V-axis event engine is domain-GENERIC: it sees only per-domain
+    # column masks of the joint (zone, ct) bits, per-domain counts, and a
+    # node→domain map — so capacity-type-granular constraints (the third of
+    # the reference's exactly-three topology keys, scheduling.md:383-387)
+    # run on the SAME engine by presenting the C axis as the domain axis.
+    # One solve drives one domain axis; a solve mixing zone- and ct-granular
+    # sigs falls back whole-solve (rare — the semantics would need two
+    # interleaved rotation states).
+    v_axis = "zone"
+    if ct_sigs and zone_sigs:
+        has_topo = True
+    elif ct_sigs:
+        v_axis = "ct"
+        zone_sigs = ct_sigs
+        group_zone_tscs = group_ct_tscs
+        group_zone_antis = group_ct_antis
+        group_zone_affs = group_ct_affs
 
     # ---- zone-sig (V axis) tables ------------------------------------------
     V = len(zone_sigs)
@@ -696,6 +765,16 @@ def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
         for sig in group_zone_affs[g]:
             v_owner[g, zone_sigs[sig]] = True
             v_aff[g] = zone_sigs[sig]
+    # kind-2 hostname affinity is implemented in the FAST branch only (the
+    # one-claim bootstrap budget is not threaded through the zoned event
+    # engine's open paths): a group owning one that is ALSO domain-
+    # constrained (owns V sigs or is a member of a domain anti — either
+    # routes it to the zoned branch) falls back
+    for g in range(G):
+        if group_h2[g] and (
+            v_owner[g].any() or (v_member[g] & (v_kind == 1)).any()
+        ):
+            fallback[g] = True
 
     Q = len(hostname_sigs)
     q_member = np.zeros((G, Q), dtype=bool)
@@ -724,6 +803,14 @@ def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
                     kind == 1
                     and t.weight is None
                     and t.anti
+                    and t.topology_key == wk.HOSTNAME_LABEL
+                    and tuple(sorted(t.label_selector.items())) == sel_sig
+                ):
+                    q_owner[g, q] = True
+                if (
+                    kind == 2
+                    and t.weight is None
+                    and not t.anti
                     and t.topology_key == wk.HOSTNAME_LABEL
                     and tuple(sorted(t.label_selector.items())) == sel_sig
                 ):
@@ -885,6 +972,7 @@ def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
         has_aff=has_aff,
         hostname_sigs=hostname_sigs,
         zone_sigs=zone_sigs,
+        v_axis=v_axis,
         q_member=q_member,
         q_owner=q_owner,
         q_kind=q_kind,
@@ -952,7 +1040,21 @@ def _encode_with_nodes(core: _EncodeCore, inp: SolverInput) -> EncodedInput:
         hostnames = [node_hostname(n) for n in inp.nodes]
         if len(set(hostnames)) < len(hostnames):
             has_topo = True
-    v_count0 = np.zeros((V, len(zones)), dtype=np.int32)
+    # domain axis for the V sigs: zone (default) or capacity-type, in LEX
+    # order — the engine's index-order tiebreaks must match the oracle's
+    # string-lex domain tiebreaks (scheduler._affinity_admits / commit rules)
+    if core.v_axis == "ct":
+        v_domains = sorted(cts)
+        dom_rank = {c: i for i, c in enumerate(v_domains)}
+        node_domain_of = lambda n: dom_rank.get(
+            n.labels.get(wk.CAPACITY_TYPE_LABEL, ""), -1
+        )
+    else:
+        v_domains = list(zones)
+        dom_rank = {z: i for i, z in enumerate(v_domains)}
+        node_domain_of = lambda n: dom_rank.get(n.labels.get(wk.ZONE_LABEL, ""), -1)
+    v_node_domain = np.full(E, -1, dtype=np.int32)
+    v_count0 = np.zeros((V, len(v_domains)), dtype=np.int32)
     node_v_member = np.zeros((E, V), dtype=np.int32)
     zsig_list = sorted(zone_sigs.items(), key=lambda kv: kv[1])
     all_req_keys = core.all_req_keys
@@ -961,19 +1063,20 @@ def _encode_with_nodes(core: _EncodeCore, inp: SolverInput) -> EncodedInput:
         node_free[e] = _quantize(n.free, rkeys, ceil=False)
         node_zone[e] = zid.get(n.labels.get(wk.ZONE_LABEL, ""), -1)
         node_ct[e] = cid.get(n.labels.get(wk.CAPACITY_TYPE_LABEL, ""), -1)
+        v_node_domain[e] = node_domain_of(n)
         for (kind, sel_sig, cap), q in sig_list:
             sel = dict(sel_sig)
             node_q_member[e, q] = sum(
                 1 for pl in n.pod_labels if all(pl.get(k) == v for k, v in sel.items())
             )
-        if node_zone[e] >= 0:
+        if v_node_domain[e] >= 0:
             for (kind, sel_sig, cap), v in zsig_list:
                 sel = dict(sel_sig)
                 cnt = sum(
                     1 for pl in n.pod_labels if all(pl.get(k) == vv for k, vv in sel.items())
                 )
                 node_v_member[e, v] = cnt
-                v_count0[v, node_zone[e]] += cnt
+                v_count0[v, v_node_domain[e]] += cnt
         if not n.schedulable:
             continue
         # Node-profile dedupe: strictly_compatible only reads the labels at
@@ -1049,4 +1152,7 @@ def _encode_with_nodes(core: _EncodeCore, inp: SolverInput) -> EncodedInput:
         v_aff=core.v_aff,
         v_count0=v_count0,
         node_v_member=node_v_member,
+        v_axis=core.v_axis,
+        v_domains=v_domains,
+        v_node_domain=v_node_domain,
     )

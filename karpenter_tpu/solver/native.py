@@ -77,28 +77,55 @@ def solve_encoded(enc: EncodedInput, max_claims: int = 1024):
     INT32_MAX = np.int32(2**31 - 1)
     type_charge = np.where(enc.charge_axes[None, :], enc.type_capacity, 0).astype(np.int32)
 
+    # Domain swap (v_axis == "ct"): the C++ core's "zone" axis is its V-sig
+    # domain axis, so capacity-type-granular constraints run by swapping the
+    # zone/ct roles at the marshaling boundary — group/pool admission
+    # matrices trade places, offer_avail transposes, and the ct side is
+    # re-ordered LEX (the core's index-order tiebreaks must match the
+    # oracle's string-lex domain tiebreaks). Zero C++ changes; outputs swap
+    # back below.
+    swap = enc.v_axis == "ct" and V > 0
+    if swap:
+        # canonical domain order from encode (enc.v_domains) — the single
+        # source of truth for the lex tiebreak shared with backend's columns
+        perm = [enc.capacity_types.index(d) for d in enc.v_domains]
+        inv = np.argsort(perm)
+        g_zone = enc.group_ct[:, perm]
+        g_ct = enc.group_zone
+        p_zone = enc.pool_ct[:, perm]
+        p_ct = enc.pool_zone
+        offer = enc.offer_avail.transpose(0, 2, 1)[:, perm, :]
+        n_zone = enc.v_node_domain
+        Zn, Cn = C, Z
+    else:
+        g_zone, g_ct = enc.group_zone, enc.group_ct
+        p_zone, p_ct = enc.pool_zone, enc.pool_ct
+        offer = enc.offer_avail
+        n_zone = enc.node_zone
+        Zn, Cn = Z, C
+
     take_e = np.zeros((S, E), np.int32)
     take_c = np.zeros((S, M), np.int32)
     leftover = np.zeros(S, np.int32)
     c_mask = np.zeros((M, T), np.uint8)
-    c_zone = np.zeros((M, Z), np.uint8)
-    c_ct = np.zeros((M, C), np.uint8)
+    c_zone = np.zeros((M, Zn), np.uint8)
+    c_ct = np.zeros((M, Cn), np.uint8)
     c_gmask = np.zeros((M, G), np.uint8)
     c_pool = np.zeros(M, np.int32)
     c_cum = np.zeros((M, R), np.int32)
     used = np.zeros(1, np.int32)
 
     rc = lib.ffd_solve_native(
-        S, G, T, E, P, R, Z, C, M, Q, V,
+        S, G, T, E, P, R, Zn, Cn, M, Q, V,
         i32(enc.run_group), i32(enc.run_count),
-        i32(enc.group_req), u8(enc.group_compat_t), u8(enc.group_zone), u8(enc.group_ct),
+        i32(enc.group_req), u8(enc.group_compat_t), u8(g_zone), u8(g_ct),
         u8(enc.group_pool), u8(enc.group_pair), u8(~enc.group_fallback),
-        i32(enc.type_alloc), i32(type_charge), u8(enc.offer_avail),
-        u8(enc.pool_type), u8(enc.pool_zone), u8(enc.pool_ct),
+        i32(enc.type_alloc), i32(type_charge), u8(offer),
+        u8(enc.pool_type), u8(p_zone), u8(p_ct),
         i32(enc.pool_daemon),
         i32(np.where(enc.pool_limit < 0, INT32_MAX, enc.pool_limit)),
         i32(enc.pool_usage),
-        i32(enc.node_free), u8(enc.node_compat), i32(enc.node_zone),
+        i32(enc.node_free), u8(enc.node_compat), i32(n_zone),
         u8(enc.q_member), u8(enc.q_owner), i32(enc.q_kind), i32(enc.q_cap),
         i32(enc.node_q_member), i32(enc.node_q_owner),
         u8(enc.v_member), u8(enc.v_owner), i32(enc.v_kind), i32(enc.v_cap),
@@ -107,6 +134,8 @@ def solve_encoded(enc: EncodedInput, max_claims: int = 1024):
     )
     if rc != 0:
         return None
+    if swap:
+        c_zone, c_ct = c_ct, c_zone[:, inv]
     # decode() argument order: ..., c_pool, c_gmask, c_cum, used
     return take_e, take_c, leftover, c_mask.astype(bool), c_zone.astype(bool), \
         c_ct.astype(bool), c_pool, c_gmask.astype(bool), c_cum, int(used[0])
@@ -128,10 +157,14 @@ class NativeSolver(Solver):
             or enc.has_topology
             or enc.has_affinity
             or enc.G == 0
+            # positive hostname affinity (Q kind 2) is a device-kernel
+            # feature the C++ core has not ported yet — oracle handles it
+            or (enc.q_kind is not None and (enc.q_kind == 2).any())
         ):
-            # hostname (Q) and zone (V) constraints run in the native core
-            # (per-pod placement path); what still routes to the oracle is
-            # the same set the device kernel can't express
+            # hostname (Q) and zone/ct-domain (V) constraints run in the
+            # native core (per-pod placement path); what still routes to
+            # the oracle is the same set the device kernel can't express,
+            # plus kind-2 hostname sigs
             self.stats["fallback_solves"] += 1
             return self.fallback.solve(qinp)
         try:

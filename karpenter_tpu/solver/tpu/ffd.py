@@ -194,8 +194,13 @@ def _hostname_allowance(cm, co, q_kind, q_cap, member_g, owner_g):
       TSC (kind 0), owner only   : ∞ while cm+1 ≤ cap, else 0
       anti (kind 1), owner       : 1 if member else ∞ — while cm == 0, else 0
       anti (kind 1), member only : ∞ while no owner pod present, else 0
+      affinity (kind 2), owner   : ∞ where matching pods present, else 0
+                                   (fresh-claim bootstrap is a claim-COUNT
+                                   cap handled by the caller, not a per-node
+                                   allowance — see fast())
     """
     kind0 = q_kind[None, :] == 0
+    kind2 = q_kind[None, :] == 2
     relevant = owner_g[None, :] | ((q_kind[None, :] == 1) & member_g[None, :])
     tsc_allow = jnp.where(
         member_g[None, :],
@@ -206,10 +211,15 @@ def _hostname_allowance(cm, co, q_kind, q_cap, member_g, owner_g):
         cm == 0, jnp.where(member_g[None, :], 1, BIG), 0
     )
     anti_member_allow = jnp.where(co == 0, BIG, 0)
+    pos_allow = jnp.where(cm > 0, BIG, 0)
     per_q = jnp.where(
         kind0,
         tsc_allow,
-        jnp.where(owner_g[None, :], anti_owner_allow, anti_member_allow),
+        jnp.where(
+            kind2,
+            pos_allow,
+            jnp.where(owner_g[None, :], anti_owner_allow, anti_member_allow),
+        ),
     )
     per_q = jnp.where(relevant, per_q, BIG)
     return jnp.maximum(jnp.min(per_q, axis=1), 0).astype(jnp.int32)
@@ -324,15 +334,23 @@ def ffd_solve(
         on_device = group_device[g]
         remaining0 = jnp.where(on_device, count, 0).astype(jnp.int32)
 
-        # fresh-node allowance under hostname constraints (counts start at 0)
+        # fresh-node allowance under hostname constraints (counts start at
+        # 0). Kind-2 (positive hostname affinity) is EXCLUDED here — at
+        # cm=0 it would zero every fresh claim, but its real semantics is a
+        # claim-COUNT budget: ONE bootstrap claim when no members exist
+        # anywhere (the group co-locates on it, self-satisfying the term),
+        # zero otherwise (a fresh claim can never already hold members).
         fresh_allow = _hostname_allowance(
             jnp.zeros((1, Q), jnp.int32),
             jnp.zeros((1, Q), jnp.int32),
             q_kind,
             q_cap,
             member_g,
-            owner_g,
+            owner_g & (q_kind != 2),
         )[0]
+        owned2 = owner_g & (q_kind == 2)  # [Q]
+        tot_m_q = jnp.sum(st.e_cm, axis=0) + jnp.sum(st.c_cm, axis=0)  # [Q]
+        boot_ok = jnp.all(~owned2 | (member_g & (tot_m_q == 0)))
 
         def count_contrib(take_e, take_c, c_zc_after):
             """[Z] recorded-pod count deltas: node zones + single-zone claims
@@ -350,13 +368,32 @@ def ffd_solve(
         # =================================================================
         def fast(st: FFDState):
             remaining = remaining0
+            # kind-2 bootstrap (positive hostname affinity, no members
+            # anywhere yet): the first pod lands FIRST-FIT anywhere — first
+            # eligible node, else first open claim, else one fresh claim —
+            # and the rest of the group follows it (members now exist only
+            # there). Under bootstrap the kind-2 allowance is ignored and the
+            # pour is restricted to that single target.
+            boot2 = jnp.any(owned2) & boot_ok
 
             # ---- 1. existing nodes ----------------------------------------
-            e_cap = _fit_count(node_free, st.e_cum, req)
-            e_cap = jnp.where(node_compat[g], e_cap, 0)
-            e_cap = jnp.minimum(
-                e_cap,
+            e_base = _fit_count(node_free, st.e_cum, req)
+            e_base = jnp.where(node_compat[g], e_base, 0)
+            owner_nb = owner_g & (q_kind != 2)
+            e_allow_nb = _hostname_allowance(
+                st.e_cm, st.e_co, q_kind, q_cap, member_g, owner_nb
+            )
+            e_cap_full = jnp.minimum(
+                e_base,
                 _hostname_allowance(st.e_cm, st.e_co, q_kind, q_cap, member_g, owner_g),
+            )
+            e_cap_boot = jnp.minimum(e_base, e_allow_nb)
+            has_e_boot = jnp.any(e_cap_boot > 0)
+            e_first = jnp.argmax(e_cap_boot > 0)
+            e_cap = jnp.where(
+                boot2,
+                jnp.where(eidx == e_first, e_cap_boot, 0),
+                e_cap_full,
             )
             take_e, remaining = _pour(e_cap, remaining)
             e_cum = st.e_cum + take_e[:, None] * req[None, :]
@@ -376,10 +413,23 @@ def ffd_solve(
             fit_nt = st.c_mask & compat_t[None, :] & ok_off  # [M, T]
             node_ok = is_open & pair_ok & pool_ok  # [M]
             k_nt = jnp.where(fit_nt & node_ok[:, None], k_nt, 0)
-            c_cap = jnp.max(k_nt, axis=1)  # [M]
-            c_cap = jnp.minimum(
-                c_cap,
+            c_base = jnp.max(k_nt, axis=1)  # [M]
+            c_allow_nb = _hostname_allowance(
+                st.c_cm, st.c_co, q_kind, q_cap, member_g, owner_nb
+            )
+            c_cap_full = jnp.minimum(
+                c_base,
                 _hostname_allowance(st.c_cm, st.c_co, q_kind, q_cap, member_g, owner_g),
+            )
+            c_cap_boot = jnp.minimum(c_base, c_allow_nb)
+            has_c_boot = jnp.any(c_cap_boot > 0)
+            c_first = jnp.argmax(c_cap_boot > 0)
+            c_cap = jnp.where(
+                boot2,
+                jnp.where(
+                    has_e_boot, 0, jnp.where(midx == c_first, c_cap_boot, 0)
+                ),
+                c_cap_full,
             )
             take_c, remaining = _pour(c_cap, remaining)
 
@@ -401,7 +451,7 @@ def ffd_solve(
             # ---- 3. new claims, pool by pool in priority order ------------
             def open_pool(p, carry):
                 (remaining, used, c_cum, c_mask, c_zc_bits, c_gbits, c_pool,
-                 p_usage, take_new, c_cm, c_co, c_vm) = carry
+                 p_usage, take_new, c_cm, c_co, c_vm, cap2) = carry
 
                 new_bits = pool_zc_bits[p] & g_zc  # u32
                 off_ok = (offer_zc_bits & new_bits) != 0  # [T]
@@ -439,6 +489,10 @@ def ffd_solve(
                 n_new = jnp.minimum(jnp.minimum(n_want, allow), slots_left).astype(
                     jnp.int32
                 )
+                # kind-2 bootstrap budget: at most cap2 new claims across
+                # ALL pools this run (1 when bootstrapping, 0 once members
+                # exist anywhere, BIG without kind-2 terms)
+                n_new = jnp.minimum(n_new, cap2)
                 eligible = gpool[p] & (full_take > 0)
                 n_new = jnp.where(eligible, n_new, 0)
 
@@ -498,16 +552,26 @@ def ffd_solve(
                 )
                 remaining = remaining - placed_new
                 used = used + n_new
+                cap2b = cap2 - n_new
                 return (remaining, used, c_cum, c_mask, c_zc_bits, c_gbits, c_pool,
-                        p_usage, take_new, c_cm, c_co, c_vm)
+                        p_usage, take_new, c_cm, c_co, c_vm, cap2b)
 
+            # kind-2 new-claim budget: ONE fresh bootstrap claim, and only
+            # when no eligible node/claim target existed (first-fit order);
+            # zero once members exist anywhere; unbounded without kind-2
+            new_claim_cap0 = jnp.where(
+                jnp.any(owned2),
+                jnp.where(boot2 & ~has_e_boot & ~has_c_boot, 1, 0),
+                BIG,
+            ).astype(jnp.int32)
             carry = (
                 remaining, st.used, c_cum, c_mask, c_zc_bits, c_gbits, st.c_pool,
                 st.p_usage, jnp.zeros((M,), jnp.int32), c_cm, c_co, c_vm,
+                new_claim_cap0,
             )
             carry = jax.lax.fori_loop(0, P, open_pool, carry)
             (remaining, used, c_cum, c_mask, c_zc_bits, c_gbits, c_pool2, p_usage,
-             take_new, c_cm, c_co, c_vm) = carry
+             take_new, c_cm, c_co, c_vm, _cap2) = carry
 
             take_c_total = take_c + take_new
             # zone-sig membership counts (this group may match other pods'

@@ -715,3 +715,208 @@ class TestClosedFormBatching:
         assert_zone_parity(
             SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
         )
+
+
+class TestCapacityTypeDomain:
+    """Capacity-type TSC/affinity ON DEVICE via the domain-axis swap
+    (round 4, closing the last spread/affinity fallback): the V engine is
+    domain-generic, so ct-granular sigs present lex-ordered capacity types
+    as the domain axis — same kernel, different column masks. The reference
+    supports exactly three topology keys; this is the third
+    (scheduling.md:383-387)."""
+
+    def _ct_tsc(self, max_skew=1):
+        return TopologySpreadConstraint(
+            max_skew=max_skew,
+            topology_key=wk.CAPACITY_TYPE_LABEL,
+            label_selector={"app": "w"},
+        )
+
+    def test_ct_spread_parity_on_device(self):
+        pods = [
+            mkpod(f"s{i:03d}", cpu="1", labels={"app": "w"},
+                  topology_spread=[self._ct_tsc()])
+            for i in range(60)
+        ]
+        ref, tpu = assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+        # the spread is real: maxSkew=1 over two capacity types needs at
+        # least one committed claim per ct
+        cts = set()
+        for c in tpu.claims:
+            r = c.requirements.get(wk.CAPACITY_TYPE_LABEL)
+            if r is not None and len(r.values_list()) == 1:
+                cts.add(r.values_list()[0])
+        assert cts == {"on-demand", "spot"}, cts
+
+    def test_ct_anti_affinity_parity_on_device(self):
+        # singleton locks: one per capacity type, third is unschedulable
+        pods = []
+        for i in range(3):
+            pods.append(
+                mkpod(f"l{i}", cpu="1", labels={"svc": f"lock-{i % 1}"},
+                      affinity_terms=[PodAffinityTerm(
+                          label_selector={"svc": "lock-0"},
+                          topology_key=wk.CAPACITY_TYPE_LABEL, anti=True)])
+            )
+            pods[-1].meta.labels = {"svc": "lock-0"}
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+
+    def test_ct_positive_affinity_parity_on_device(self):
+        pods = [
+            mkpod(f"w{i:03d}", cpu="500m", labels={"svc": "web"},
+                  affinity_terms=[PodAffinityTerm(
+                      label_selector={"svc": "web"},
+                      topology_key=wk.CAPACITY_TYPE_LABEL, anti=False)])
+            for i in range(40)
+        ]
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+
+    def test_ct_spread_with_existing_nodes(self):
+        nodes = [mknode("n-od", "zone-1a"), mknode("n-sp", "zone-1b")]
+        nodes[1].labels[wk.CAPACITY_TYPE_LABEL] = "spot"
+        pods = [
+            mkpod(f"s{i:03d}", cpu="1", labels={"app": "w"},
+                  topology_spread=[self._ct_tsc()])
+            for i in range(24)
+        ]
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=nodes, nodepools=[pool()], zones=ZONES)
+        )
+
+    def test_mixed_zone_and_ct_sigs_fall_back_exactly(self):
+        # one solve mixing zone- and ct-granular sigs: whole-solve fallback
+        # (one domain axis per solve) — parity must hold via the oracle
+        pods = [
+            mkpod(f"z{i:02d}", cpu="1", labels={"app": "w"},
+                  topology_spread=[TSC1])
+            for i in range(9)
+        ]
+        pods += [
+            mkpod(f"c{i:02d}", cpu="1", labels={"app": "w"},
+                  topology_spread=[self._ct_tsc()])
+            for i in range(9)
+        ]
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES),
+            expect_device=False,
+        )
+
+    def test_ct_spread_native_parity(self):
+        from karpenter_tpu.solver.native import NativeSolver
+
+        from karpenter_tpu.solver.encode import quantize_input as qi
+
+        pods = [
+            mkpod(f"s{i:03d}", cpu="1", labels={"app": "w"},
+                  topology_spread=[self._ct_tsc()])
+            for i in range(30)
+        ]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        ref = ReferenceSolver().solve(qi(inp))
+        solver = NativeSolver()
+        nat = solver.solve(inp)
+        assert solver.stats["native_solves"] == 1, solver.stats
+        assert set(ref.errors) == set(nat.errors)
+        assert ref.placements == nat.placements
+
+
+class TestPositiveHostnameAffinity:
+    """Positive hostname affinity ON DEVICE (Q kind 2, round 4): the group
+    co-locates on one node/claim — per-target allowance where members are
+    present, plus a one-claim bootstrap budget when no members exist
+    anywhere. Overflow pods are unschedulable, exactly as the oracle."""
+
+    def _aff(self, sel=None):
+        return PodAffinityTerm(
+            label_selector=sel or {"svc": "db"},
+            topology_key=wk.HOSTNAME_LABEL,
+            anti=False,
+        )
+
+    def _small_pool(self):
+        small = [t for t in CATALOG if t.name == "m5.large"]
+        return NodePoolSpec(
+            name="default", weight=0,
+            requirements=Requirements.of(
+                Requirement.create(wk.NODEPOOL_LABEL, IN, ["default"])
+            ),
+            taints=[], instance_types=small,
+        )
+
+    def test_bootstrap_one_claim_overflow_unschedulable(self):
+        pods = [
+            mkpod(f"d{i}", cpu="500m", mem="512Mi", labels={"svc": "db"},
+                  affinity_terms=[self._aff()])
+            for i in range(7)
+        ]
+        ref, tpu = assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[self._small_pool()],
+                        zones=ZONES)
+        )
+        assert len(tpu.claims) == 1, "the group must co-locate on ONE claim"
+        assert tpu.errors, "overflow pods must be unschedulable"
+
+    def test_members_on_existing_node_pin_the_group(self):
+        n = mknode("n-db", "zone-1a", matching=2, sel={"svc": "db"})
+        pods = [
+            mkpod(f"d{i}", cpu="500m", mem="512Mi", labels={"svc": "db"},
+                  affinity_terms=[self._aff()])
+            for i in range(5)
+        ]
+        ref, tpu = assert_zone_parity(
+            SolverInput(pods=pods, nodes=[n], nodepools=[self._small_pool()],
+                        zones=ZONES)
+        )
+        # members exist on n-db: pods join it (no bootstrap claim allowed)
+        assert not tpu.claims, [c.pod_uids for c in tpu.claims]
+
+    def test_owner_not_member_needs_existing_members(self):
+        # followers don't carry the label: no bootstrap is possible, so
+        # without member-holding targets every pod errors
+        pods = [
+            mkpod(f"f{i}", cpu="500m", labels={"role": "follower"},
+                  affinity_terms=[self._aff()])
+            for i in range(4)
+        ]
+        ref, tpu = assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[self._small_pool()],
+                        zones=ZONES)
+        )
+        assert len(tpu.errors) == 4
+
+    def test_mixed_with_plain_pods_and_spread(self):
+        # kind-2 group beside plain pods and a zone-spread group: the spread
+        # group keeps the zoned path, the kind-2 group keeps the fast path
+        pods = [
+            mkpod(f"d{i}", cpu="500m", mem="512Mi", labels={"svc": "db"},
+                  affinity_terms=[self._aff()])
+            for i in range(3)
+        ]
+        pods += [mkpod(f"u{i}", cpu="1") for i in range(5)]
+        pods += [
+            mkpod(f"s{i}", cpu="1", labels={"app": "w"}, topology_spread=[TSC1])
+            for i in range(6)
+        ]
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+
+    def test_h2_plus_own_zone_constraint_falls_back_exactly(self):
+        # a pod owning BOTH a positive hostname affinity and a zone TSC
+        # routes the whole solve to the oracle (the bootstrap budget is not
+        # threaded through the zoned engine) — parity must hold
+        pods = [
+            mkpod(f"x{i}", cpu="500m", labels={"svc": "db", "app": "w"},
+                  affinity_terms=[self._aff()], topology_spread=[TSC1])
+            for i in range(4)
+        ]
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES),
+            expect_device=False,
+        )
