@@ -124,10 +124,14 @@ class InterruptionController:
         iid = obj.provider_id.rsplit("/", 1)[-1]
         with self._index_lock:
             if event == "DELETED":
-                self._index.pop(iid, None)
-                self._negative.add(iid)
-                if len(self._negative) > 100_000:
-                    self._negative.clear()  # bounded; entries rebuild lazily
+                # only retire the mapping this claim actually owns — the id
+                # may have been re-bound to a newer live claim, whose entry
+                # (and interruptions) must survive the old claim's deletion
+                if self._index.get(iid) == obj.name:
+                    self._index.pop(iid, None)
+                    self._negative.add(iid)
+                    if len(self._negative) > 100_000:
+                        self._negative.clear()  # bounded; rebuilds lazily
             else:
                 self._index[iid] = obj.name
                 self._negative.discard(iid)
@@ -189,6 +193,8 @@ class InterruptionController:
             with self._index_lock:
                 if instance_id not in self._index:
                     self._negative.add(instance_id)
+                    if len(self._negative) > 100_000:
+                        self._negative.clear()  # same bound as the event path
             return None
         c = self.store.try_get(st.NODECLAIMS, name)
         if (

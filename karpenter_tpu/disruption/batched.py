@@ -112,6 +112,15 @@ class BatchedConsolidationEvaluator:
         enc = encode(quantize_input(inp))
         if enc.group_fallback.any() or enc.has_topology or enc.has_affinity or enc.G == 0:
             return None
+        if enc.q_kind is not None and (enc.q_kind == 2).any():
+            # positive hostname affinity: the kernel's bootstrap check reads
+            # GLOBAL member counts (sum of e_cm), and the batched evaluator
+            # removes candidate nodes only by compat-masking — a removed
+            # node hosting the sig's members would still suppress the
+            # bootstrap, wrongly rejecting the subset. No Q-axis analog of
+            # v_delta exists yet, so these universes take the sequential
+            # simulate path.
+            return None
 
         # Runs stay at NATURAL group granularity (enc.run_group/run_count):
         # same-group pods are fungible, so each subset is expressed as

@@ -197,10 +197,9 @@ def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
         zone_col = np.zeros(D, dtype=np.uint32)
         if enc.v_axis == "ct":
             # per-ct joint-bit columns: bit z*C+c for every z, in the
-            # CANONICAL domain order encode computed (enc.v_domains) — the
-            # single source of truth for the lex tiebreak shared with the
-            # native marshal swap
-            lex = [enc.capacity_types.index(d) for d in enc.v_domains]
+            # canonical domain order (enc.v_domain_perm — shared with the
+            # native marshal swap)
+            lex = enc.v_domain_perm
             for d, c in enumerate(lex):
                 for z in range(Z):
                     zone_col[d] |= np.uint32(1) << np.uint32(z * C + c)

@@ -249,6 +249,13 @@ class EncodedInput:
     v_node_domain: Optional[np.ndarray] = None  # [E] int32 (-1 unknown)
 
     @property
+    def v_domain_perm(self) -> List[int]:
+        """ct-mode only: indices into capacity_types in canonical v_domains
+        order — THE single source of the lex tiebreak, shared by the device
+        column masks (backend.kernel_args) and the native marshal swap."""
+        return [self.capacity_types.index(d) for d in self.v_domains]
+
+    @property
     def V(self) -> int:
         return 0 if self.v_kind is None else len(self.v_kind)
 

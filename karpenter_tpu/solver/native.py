@@ -86,9 +86,9 @@ def solve_encoded(enc: EncodedInput, max_claims: int = 1024):
     # back below.
     swap = enc.v_axis == "ct" and V > 0
     if swap:
-        # canonical domain order from encode (enc.v_domains) — the single
-        # source of truth for the lex tiebreak shared with backend's columns
-        perm = [enc.capacity_types.index(d) for d in enc.v_domains]
+        # canonical domain order (enc.v_domain_perm — shared with backend's
+        # device column masks)
+        perm = enc.v_domain_perm
         inv = np.argsort(perm)
         g_zone = enc.group_ct[:, perm]
         g_ct = enc.group_zone

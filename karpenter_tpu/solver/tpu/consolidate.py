@@ -93,14 +93,48 @@ _MESH = None
 _MESH_INIT = False
 
 
+def make_candidate_mesh(devices=None, hosts: int = 1):
+    """Mesh for the candidate batch axis.
+
+    Single-host: a flat 1-D mesh — the batch splits across chips, the
+    result gather rides ICI only. Multi-host (hosts > 1, e.g. under
+    jax.distributed across DCN-connected workers): a 2-level (dcn, ici)
+    mesh with device-major host order, so XLA partitions the candidate
+    axis hierarchically — each host's shard subdivides across its own
+    chips, and only the final verdict gather (a few bytes per subset)
+    crosses DCN. The solve itself needs NO cross-candidate communication
+    either way (SURVEY.md §2.10: independent solves are the scale axis)."""
+    from jax.sharding import Mesh
+
+    devs = list(devices if devices is not None else jax.devices())
+    if hosts > 1:
+        # group by owning process FIRST — jax.devices() id-order is not
+        # guaranteed process-contiguous on real topologies, and a naive
+        # reshape would put devices from different hosts in one "ici" row,
+        # silently routing per-shard traffic over DCN
+        by_proc: dict = {}
+        for d in devs:
+            by_proc.setdefault(getattr(d, "process_index", 0), []).append(d)
+        rows = sorted(by_proc.items())
+        per = min(len(r) for _, r in rows)
+        if len(rows) >= hosts and per > 0:
+            grid = np.asarray([r[:per] for _, r in rows[:hosts]])
+        else:  # virtual meshes (one process): contiguous split
+            per = len(devs) // hosts
+            grid = np.asarray(devs[: per * hosts]).reshape(hosts, per)
+        return Mesh(grid, ("dcn", "ici"))
+    return Mesh(np.asarray(devs), ("candidates",))
+
+
 def candidate_mesh():
     global _MESH, _MESH_INIT
     if not _MESH_INIT:
         devs = jax.devices()
         if len(devs) > 1:
-            from jax.sharding import Mesh
-
-            _MESH = Mesh(np.asarray(devs), ("candidates",))
+            # under jax.distributed each worker sees the GLOBAL device
+            # list; shard hierarchically so host boundaries align with DCN
+            n_proc = jax.process_count()
+            _MESH = make_candidate_mesh(devs, hosts=n_proc if n_proc > 1 else 1)
         _MESH_INIT = True
     return _MESH
 
@@ -113,7 +147,9 @@ def _sharded_ffd():
 
     mesh = _MESH
     repl = NamedSharding(mesh, PartitionSpec())
-    shard = NamedSharding(mesh, PartitionSpec("candidates"))
+    # the batch axis shards over EVERY mesh axis — (candidates,) flat on one
+    # host, (dcn, ici) hierarchically across hosts
+    shard = NamedSharding(mesh, PartitionSpec(tuple(mesh.axis_names)))
     n_shared = len(ARG_INDEX)
     return jax.jit(
         _batched_ffd_core,

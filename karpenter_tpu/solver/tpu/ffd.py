@@ -383,10 +383,15 @@ def ffd_solve(
             e_allow_nb = _hostname_allowance(
                 st.e_cm, st.e_co, q_kind, q_cap, member_g, owner_nb
             )
-            e_cap_full = jnp.minimum(
-                e_base,
-                _hostname_allowance(st.e_cm, st.e_co, q_kind, q_cap, member_g, owner_g),
-            )
+            # kind-2 component derived from the SAME counts (owner_g =
+            # owner_nb | owned2), so the allowance kernel runs once per axis
+            e_pos = jnp.min(
+                jnp.where(
+                    owned2[None, :], jnp.where(st.e_cm > 0, BIG, 0), BIG
+                ),
+                axis=1,
+            ).astype(jnp.int32)
+            e_cap_full = jnp.minimum(e_base, jnp.minimum(e_allow_nb, e_pos))
             e_cap_boot = jnp.minimum(e_base, e_allow_nb)
             has_e_boot = jnp.any(e_cap_boot > 0)
             e_first = jnp.argmax(e_cap_boot > 0)
@@ -417,10 +422,13 @@ def ffd_solve(
             c_allow_nb = _hostname_allowance(
                 st.c_cm, st.c_co, q_kind, q_cap, member_g, owner_nb
             )
-            c_cap_full = jnp.minimum(
-                c_base,
-                _hostname_allowance(st.c_cm, st.c_co, q_kind, q_cap, member_g, owner_g),
-            )
+            c_pos = jnp.min(
+                jnp.where(
+                    owned2[None, :], jnp.where(st.c_cm > 0, BIG, 0), BIG
+                ),
+                axis=1,
+            ).astype(jnp.int32)
+            c_cap_full = jnp.minimum(c_base, jnp.minimum(c_allow_nb, c_pos))
             c_cap_boot = jnp.minimum(c_base, c_allow_nb)
             has_c_boot = jnp.any(c_cap_boot > 0)
             c_first = jnp.argmax(c_cap_boot > 0)

@@ -224,3 +224,20 @@ class TestMultiNodePrefixes:
             pods=[], nodes=nodes, nodepools=[pool()], zones=ZONES
         )
         assert_verdicts_match(base, cpods, cnode, [[0, 1], [0, 1, 2], [1, 2]])
+
+
+def test_positive_hostname_affinity_universe_stays_sequential():
+    """Kind-2 (positive hostname affinity) universes must NOT take the
+    batched path: the kernel's bootstrap reads GLOBAL member counts, and the
+    evaluator removes candidate nodes only by compat-masking, so a removed
+    member-hosting node would wrongly suppress the bootstrap. prepare()
+    returns None and the controller's sequential simulate takes over."""
+    from karpenter_tpu.solver.backend import TPUSolver
+
+    base = SolverInput(pods=[], nodes=[mknode("n0", "zone-1a")],
+                       nodepools=[pool()], zones=ZONES)
+    aff = PodAffinityTerm(label_selector={"svc": "db"},
+                          topology_key=wk.HOSTNAME_LABEL, anti=False)
+    cand_pods = {0: [mkpod("d0", labels={"svc": "db"}, affinity_terms=[aff])]}
+    ev = BatchedConsolidationEvaluator(TPUSolver())
+    assert ev.evaluate(base, cand_pods, {0: "n0"}, [[0]]) is None

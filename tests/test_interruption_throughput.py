@@ -134,3 +134,22 @@ class TestInterruptionThroughput:
         q.send(Message(kind=SPOT_INTERRUPTION, instance_id="i-00002"))
         ctrl.reconcile()
         assert store.try_get(st.NODECLAIMS, "c00002") is None
+
+    def test_rebound_provider_id_survives_old_claim_deletion(self):
+        """Provider id re-bound to a newer claim: deleting the OLD claim
+        must not retire the new claim's index entry or poison the negative
+        cache — its interruptions still deliver."""
+        store = _mkstore(1)
+        q = InterruptionQueue()
+        ctrl = InterruptionController(store, q)
+        newc = NodeClaim(meta=ObjectMeta(name="newc"), nodepool="p",
+                         provider_id="kwok:///zone-1a/i-00000",
+                         instance_type="m5.large", zone="zone-1a",
+                         capacity_type="spot")
+        store.create(st.NODECLAIMS, newc)  # re-binds i-00000
+        store.delete(st.NODECLAIMS, "c00000")  # old claim goes away
+        q.send(Message(kind=SPOT_INTERRUPTION, instance_id="i-00000"))
+        ctrl.reconcile()
+        assert store.try_get(st.NODECLAIMS, "newc") is None, (
+            "interruption for the re-bound id was dropped"
+        )

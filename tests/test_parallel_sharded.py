@@ -115,3 +115,40 @@ def test_sharded_heterogeneous_batch_matches_per_row_sequential():
         np.testing.assert_array_equal(
             np.asarray(out.state.c_cum)[b], np.asarray(seq.state.c_cum), err_msg=f"row {b}"
         )
+
+
+class TestTwoLevelMesh:
+    """Multi-host shape: a (dcn, ici) 2-level mesh for the candidate axis —
+    validated on the virtual 8-device CPU mesh as 2 hosts x 4 chips. The
+    batch axis shards over both levels; results must be bit-identical to
+    the flat single-mesh dispatch (the solve has no cross-candidate
+    communication, so the hierarchy only changes WHERE shards live)."""
+
+    def test_two_level_verdicts_bit_identical(self):
+        import jax
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from karpenter_tpu.solver.tpu import consolidate as cons
+
+        if len(jax.devices()) < 8:
+            import pytest
+
+            pytest.skip("needs the 8-device virtual mesh")
+        mesh2 = cons.make_candidate_mesh(jax.devices()[:8], hosts=2)
+        assert mesh2.axis_names == ("dcn", "ici")
+        assert mesh2.devices.shape == (2, 4)
+        # drive the live evaluator twice: once with the process-default
+        # mesh, once with the 2-level mesh forced
+        import __graft_entry__ as ge
+
+        n1 = ge._dryrun_live_consolidation(8)
+        old_mesh, old_init = cons._MESH, cons._MESH_INIT
+        try:
+            cons._MESH, cons._MESH_INIT = mesh2, True
+            cons._sharded_ffd.cache_clear()
+            n2 = ge._dryrun_live_consolidation(8)
+        finally:
+            cons._MESH, cons._MESH_INIT = old_mesh, old_init
+            cons._sharded_ffd.cache_clear()
+        assert n1 == n2

@@ -376,6 +376,27 @@ class TestZoneFuzzParity:
                     TopologySpreadConstraint(max_skew=1, topology_key=wk.HOSTNAME_LABEL,
                                              label_selector=dict(rng.choice(self.SELS)))
                 )
+            elif r < 0.70:
+                # capacity-type domain terms (round 4: domain-axis swap) —
+                # may mix with other pods' zone sigs, exercising both the
+                # swapped device path and the mixed-axis fallback
+                if rng.random() < 0.6:
+                    tsp.append(
+                        TopologySpreadConstraint(
+                            max_skew=1, topology_key=wk.CAPACITY_TYPE_LABEL,
+                            label_selector=dict(rng.choice(self.SELS)))
+                    )
+                else:
+                    aft.append(PodAffinityTerm(
+                        label_selector=dict(rng.choice(self.SELS)),
+                        topology_key=wk.CAPACITY_TYPE_LABEL,
+                        anti=rng.random() < 0.5))
+            elif r < 0.76:
+                # positive hostname affinity (round 4: Q kind 2 bootstrap)
+                aft.append(PodAffinityTerm(
+                    label_selector=dict(labels) if labels and rng.random() < 0.6
+                    else dict(rng.choice(self.SELS)),
+                    topology_key=wk.HOSTNAME_LABEL, anti=False))
             sel = {}
             if rng.random() < 0.2:
                 sel = {wk.ZONE_LABEL: rng.choice(ZONES)}
