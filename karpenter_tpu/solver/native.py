@@ -157,14 +157,10 @@ class NativeSolver(Solver):
             or enc.has_topology
             or enc.has_affinity
             or enc.G == 0
-            # positive hostname affinity (Q kind 2) is a device-kernel
-            # feature the C++ core has not ported yet — oracle handles it
-            or (enc.q_kind is not None and (enc.q_kind == 2).any())
         ):
-            # hostname (Q) and zone/ct-domain (V) constraints run in the
-            # native core (per-pod placement path); what still routes to
-            # the oracle is the same set the device kernel can't express,
-            # plus kind-2 hostname sigs
+            # hostname (Q, incl. kind-2 positive affinity), zone/ct-domain
+            # (V) constraints all run in the native core; what still routes
+            # to the oracle is the same set the device kernel can't express
             self.stats["fallback_solves"] += 1
             return self.fallback.solve(qinp)
         try:

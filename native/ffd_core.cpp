@@ -55,12 +55,16 @@ inline int32_t hostname_allow(const int32_t* cm, const int32_t* co,
   int32_t allow = BIG;
   for (int32_t q = 0; q < Q; ++q) {
     const bool member = member_g[q], owner = owner_g[q];
-    const bool kind0 = q_kind[q] == 0;
-    const bool relevant = owner || (!kind0 && member);
+    const int32_t kind = q_kind[q];
+    const bool relevant = owner || (kind == 1 && member);
     if (!relevant) continue;
     int32_t a;
-    if (kind0) {
+    if (kind == 0) {
       a = member ? (q_cap[q] - cm[q]) : (cm[q] + 1 <= q_cap[q] ? BIG : 0);
+    } else if (kind == 2) {
+      // positive hostname affinity: join only member-holding targets;
+      // the fresh-claim bootstrap is a claim-count budget at the caller
+      a = (cm[q] > 0) ? BIG : 0;
     } else if (owner) {
       a = (cm[q] == 0) ? (member ? 1 : BIG) : 0;
     } else {  // anti, member only
@@ -181,9 +185,33 @@ int ffd_solve_native(
       if (member_v_g[v] && v_kind[v] == 1) zone_constrained = true;
     }
 
+    // kind-2 (positive hostname affinity) bookkeeping: owner mask with
+    // kind-2 columns cleared (fresh allowance + bootstrap pour ignore them),
+    // plus the one-claim bootstrap budget — while no members of every owned
+    // kind-2 sig exist anywhere, the group lands FIRST-FIT on a single
+    // target (first node, else first claim, else one fresh claim) and
+    // co-locates there; once members exist, only member-holding targets
+    // admit and no fresh claims open (ffd.py fast() mirror).
+    std::vector<uint8_t> owner_nb(static_cast<size_t>(std::max(Q, 1)));
+    bool any2 = false, boot_all = true;
+    for (int32_t q = 0; q < Q; ++q) {
+      owner_nb[q] = (owner_q[q] && q_kind[q] != 2) ? 1 : 0;
+      if (owner_q[q] && q_kind[q] == 2) {
+        any2 = true;
+        long long tot = 0;
+        for (int32_t e = 0; e < E; ++e) tot += e_cm[static_cast<size_t>(e) * Q + q];
+        for (int32_t m = 0; m < used; ++m) tot += c_cm[static_cast<size_t>(m) * Q + q];
+        if (!member_q[q] || tot > 0) boot_all = false;
+      }
+    }
+    const bool boot2 = any2 && boot_all;
+    const uint8_t* owner_eff = boot2 ? owner_nb.data() : owner_q;
+    int32_t new_claim_cap = any2 ? (boot2 ? 1 : 0) : BIG;
+    bool boot_done = false;
+
     const int32_t fresh_allow = hostname_allow(
         std::vector<int32_t>(Q, 0).data(), std::vector<int32_t>(Q, 0).data(),
-        q_kind, q_cap, member_q, owner_q, Q);
+        q_kind, q_cap, member_q, owner_nb.data(), Q);
 
     // run-level zone-count contribution bookkeeping (fast path): which
     // claims received pods this run, and per-zone node takes
@@ -217,7 +245,7 @@ int ffd_solve_native(
         cap = std::min(cap, hostname_allow(
             e_cm.data() + static_cast<size_t>(e) * Q,
             e_co.data() + static_cast<size_t>(e) * Q,
-            q_kind, q_cap, member_q, owner_q, Q));
+            q_kind, q_cap, member_q, owner_eff, Q));
         int32_t take = std::min(cap, remaining);
         if (take > 0) {
           take_e[static_cast<size_t>(s) * E + e] = take;
@@ -229,11 +257,12 @@ int ffd_solve_native(
           }
           if (node_zone[e] >= 0) node_take_z[node_zone[e]] += take;
           remaining -= take;
+          if (boot2) { boot_done = true; break; }  // single bootstrap target
         }
       }
 
       // ---- 2. open claims -------------------------------------------------
-      for (int32_t m = 0; m < used && remaining > 0; ++m) {
+      for (int32_t m = 0; m < used && remaining > 0 && !boot_done; ++m) {
         const int32_t p = c_pool[m];
         if (p < 0 || !group_pool[static_cast<size_t>(g) * P + p]) continue;
         bool pair_ok = true;
@@ -267,7 +296,7 @@ int ffd_solve_native(
         cap = std::min(cap, hostname_allow(
             c_cm.data() + static_cast<size_t>(m) * Q,
             c_co.data() + static_cast<size_t>(m) * Q,
-            q_kind, q_cap, member_q, owner_q, Q));
+            q_kind, q_cap, member_q, owner_eff, Q));
         int32_t take = std::min(cap, remaining);
         if (take > 0) {
           take_c[static_cast<size_t>(s) * M + m] += take;
@@ -289,11 +318,13 @@ int ffd_solve_native(
           for (int32_t v = 0; v < V; ++v)
             if (member_v_g[v]) c_vm[static_cast<size_t>(m) * V + v] += take;
           remaining -= take;
+          if (boot2) { boot_done = true; break; }  // single bootstrap target
         }
       }
+      if (boot_done) new_claim_cap = 0;  // bootstrap target found: no opens
 
       // ---- 3. new claims, pool by pool ------------------------------------
-      for (int32_t p = 0; p < P && remaining > 0; ++p) {
+      for (int32_t p = 0; p < P && remaining > 0 && new_claim_cap > 0; ++p) {
         if (!group_pool[static_cast<size_t>(g) * P + p]) continue;
         bool over = false;
         for (int32_t r = 0; r < R; ++r)
@@ -339,7 +370,7 @@ int ffd_solve_native(
           charge_one[r] = (mn == BIG) ? 0 : mn;
         }
 
-        while (remaining > 0) {
+        while (remaining > 0 && new_claim_cap > 0) {
           bool blocked = false;
           for (int32_t r = 0; r < R; ++r)
             if (p_usage[static_cast<size_t>(p) * R + r] >=
@@ -373,6 +404,7 @@ int ffd_solve_native(
           for (int32_t r = 0; r < R; ++r)
             p_usage[static_cast<size_t>(p) * R + r] += charge_one[r];
           remaining -= take;
+          if (new_claim_cap != BIG) --new_claim_cap;  // kind-2 budget
         }
         if (overflow) break;
       }

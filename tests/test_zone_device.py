@@ -897,6 +897,20 @@ class TestPositiveHostnameAffinity:
         # members exist on n-db: pods join it (no bootstrap claim allowed)
         assert not tpu.claims, [c.pod_uids for c in tpu.claims]
 
+    def test_bootstrap_onto_existing_node(self):
+        # zero members anywhere + compatible EXISTING nodes: the bootstrap
+        # lands on the first node first-fit; overflow beyond it errors
+        nodes = [mknode("n-a", "zone-1a"), mknode("n-b", "zone-1b")]
+        pods = [
+            mkpod(f"d{i}", cpu="2", labels={"svc": "db"},
+                  affinity_terms=[self._aff()])
+            for i in range(6)
+        ]
+        ref, tpu = assert_zone_parity(
+            SolverInput(pods=pods, nodes=nodes, nodepools=[pool()], zones=ZONES)
+        )
+        assert not tpu.claims and tpu.errors
+
     def test_owner_not_member_needs_existing_members(self):
         # followers don't carry the label: no bootstrap is possible, so
         # without member-holding targets every pod errors
@@ -940,4 +954,103 @@ class TestPositiveHostnameAffinity:
         assert_zone_parity(
             SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES),
             expect_device=False,
+        )
+
+
+class TestPositiveHostnameAffinityNative:
+    """The C++ core's kind-2 port must match the oracle on the same shapes
+    the device tests pin (bootstrap single target, member pinning,
+    owner-not-member, overflow-unschedulable)."""
+
+    def _native_parity(self, inp):
+        from karpenter_tpu.solver.native import NativeSolver
+
+        ref = ReferenceSolver().solve(quantize_input(inp))
+        solver = NativeSolver()
+        nat = solver.solve(inp)
+        assert solver.stats["native_solves"] == 1, solver.stats
+        assert set(ref.errors) == set(nat.errors), (
+            f"ref={sorted(ref.errors)} nat={sorted(nat.errors)}"
+        )
+        assert ref.placements == nat.placements, _diff(ref.placements, nat.placements)
+        return ref, nat
+
+    def test_native_bootstrap_and_overflow(self):
+        small = [t for t in CATALOG if t.name == "m5.large"]
+        spool = NodePoolSpec(
+            name="default", weight=0,
+            requirements=Requirements.of(
+                Requirement.create(wk.NODEPOOL_LABEL, IN, ["default"])
+            ),
+            taints=[], instance_types=small,
+        )
+        aff = PodAffinityTerm(label_selector={"svc": "db"},
+                              topology_key=wk.HOSTNAME_LABEL, anti=False)
+        pods = [
+            mkpod(f"d{i}", cpu="500m", mem="512Mi", labels={"svc": "db"},
+                  affinity_terms=[aff])
+            for i in range(7)
+        ]
+        ref, nat = self._native_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[spool], zones=ZONES)
+        )
+        assert len(nat.claims) == 1 and nat.errors
+
+    def test_native_member_node_pinning(self):
+        aff = PodAffinityTerm(label_selector={"svc": "db"},
+                              topology_key=wk.HOSTNAME_LABEL, anti=False)
+        n = mknode("n-db", "zone-1a", matching=2, sel={"svc": "db"})
+        pods = [
+            mkpod(f"d{i}", cpu="500m", labels={"svc": "db"},
+                  affinity_terms=[aff])
+            for i in range(5)
+        ]
+        ref, nat = self._native_parity(
+            SolverInput(pods=pods, nodes=[n], nodepools=[pool()], zones=ZONES)
+        )
+        assert not nat.claims
+
+    def test_native_owner_not_member(self):
+        aff = PodAffinityTerm(label_selector={"svc": "db"},
+                              topology_key=wk.HOSTNAME_LABEL, anti=False)
+        pods = [
+            mkpod(f"f{i}", cpu="500m", labels={"role": "follower"},
+                  affinity_terms=[aff])
+            for i in range(4)
+        ]
+        ref, nat = self._native_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+        assert len(nat.errors) == 4
+
+    def test_native_mixed_with_plain(self):
+        aff = PodAffinityTerm(label_selector={"svc": "db"},
+                              topology_key=wk.HOSTNAME_LABEL, anti=False)
+        pods = [
+            mkpod(f"d{i}", cpu="500m", mem="512Mi", labels={"svc": "db"},
+                  affinity_terms=[aff])
+            for i in range(3)
+        ]
+        pods += [mkpod(f"u{i}", cpu="1") for i in range(5)]
+        self._native_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+
+    def test_native_bootstrap_onto_existing_node(self):
+        # zero members anywhere + a compatible EXISTING node: the bootstrap
+        # lands on that single node first-fit (not a fresh claim, not spread
+        # across several nodes) and the rest of the group follows it
+        aff = PodAffinityTerm(label_selector={"svc": "db"},
+                              topology_key=wk.HOSTNAME_LABEL, anti=False)
+        nodes = [mknode("n-a", "zone-1a"), mknode("n-b", "zone-1b")]
+        pods = [
+            mkpod(f"d{i}", cpu="2", labels={"svc": "db"},
+                  affinity_terms=[aff])
+            for i in range(6)  # 6x2cpu > one 8cpu node: overflow must error
+        ]
+        ref, nat = self._native_parity(
+            SolverInput(pods=pods, nodes=nodes, nodepools=[pool()], zones=ZONES)
+        )
+        assert not nat.claims and nat.errors, (
+            [c.pod_uids for c in nat.claims], nat.errors
         )
