@@ -56,10 +56,23 @@ _FAMILIES = [
     ("t3", 4, 0.0416, "amd64", None),
     ("t3a", 4, 0.0376, "amd64", None),
     ("t4g", 4, 0.0336, "arm64", None),
+    # AMD 3rd/4th-gen line
+    ("m6a", 4, 0.0432, "amd64", None),
+    ("c6a", 2, 0.0383, "amd64", None),
+    ("r6a", 8, 0.0567, "amd64", None),
+    ("m7a", 4, 0.05796, "amd64", None),
+    ("c7a", 2, 0.05133, "amd64", None),
+    ("r7a", 8, 0.07607, "amd64", None),
+    # graviton 4
+    ("c8g", 2, 0.03987, "arm64", None),
     # storage optimized
     ("i3", 8, 0.078, "amd64", None),
+    ("i3en", 8, 0.1092, "amd64", None),
     ("i4i", 8, 0.0858, "amd64", None),
+    ("im4gn", 6, 0.091, "arm64", None),
     ("d3", 8, 0.0624, "amd64", None),
+    # high memory network/storage
+    ("x2iedn", 32, 0.1668, "amd64", None),
     # accelerated
     ("g4dn", 8, 0.1578, "amd64", ("nvidia.com/gpu", 1)),
     ("g5", 8, 0.1512, "amd64", ("nvidia.com/gpu", 1)),
