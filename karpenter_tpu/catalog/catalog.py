@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import hashlib
 from dataclasses import dataclass
+import re
+
 from typing import Dict, List, Optional, Sequence
 
 from ..api import wellknown as wk
@@ -85,7 +87,7 @@ _FAMILIES = [
 
 # Variant suffixes applied to mainstream families, shaped like EC2's d (local
 # NVMe), n (network-optimized), and dn combos — expands the catalog to the
-# reference's ~700-type scale.
+# reference's ~700-type scale (726+ with the round-4 families).
 _VARIANTS = [
     ("d", 1.06, {"m5", "m6i", "m6g", "c5", "c6i", "c6g", "r5", "r6i", "r6g", "i3", "z1d"}),
     ("n", 1.12, {"m5", "c5", "r5", "c6g", "m6i", "c6i"}),
@@ -122,6 +124,8 @@ _SIZES = [
     ("48xlarge", 192),
     ("metal", 96),
 ]
+
+_BURSTABLE = {"t3", "t3a", "t4g"}  # name-prefix tests would eat trn1 too
 
 _GPU_SIZES = {"xlarge", "2xlarge", "4xlarge", "8xlarge", "12xlarge", "16xlarge", "24xlarge", "48xlarge"}
 
@@ -178,13 +182,13 @@ class CatalogSpec:
 
 
 def generate(spec: CatalogSpec = CatalogSpec()) -> List[InstanceType]:
-    """Build the full deterministic catalog (~700 instance types)."""
+    """Build the full deterministic catalog (~730 instance types)."""
     out: List[InstanceType] = []
     for family, ratio, per_vcpu, arch, accel in _expanded_families():
         for size, vcpus in _SIZES:
             if accel and size not in _GPU_SIZES:
                 continue
-            if family.startswith("t") and vcpus > 8:
+            if family in _BURSTABLE and vcpus > 8:
                 continue  # burstable families stop at 2xlarge
             if family in ("p3", "p4d", "trn1", "dl1") and vcpus < 16:
                 continue
@@ -217,7 +221,7 @@ def generate(spec: CatalogSpec = CatalogSpec()) -> List[InstanceType]:
             offerings: List[Offering] = []
             for zone in spec.zones:
                 offerings.append(Offering(zone=zone, capacity_type=wk.CAPACITY_TYPE_ON_DEMAND, price=od_price))
-                if spec.spot and not family.startswith("t"):
+                if spec.spot and family not in _BURSTABLE:
                     discount = 0.55 + 0.25 * _h(f"{name}/{zone}")  # 55-80% off-ish band
                     offerings.append(
                         Offering(
@@ -226,7 +230,8 @@ def generate(spec: CatalogSpec = CatalogSpec()) -> List[InstanceType]:
                             price=round(od_price * (1 - discount), 5),
                         )
                     )
-            generation = int(family[1]) if family[1].isdigit() else 0
+            m_gen = re.search(r"\d", family)
+            generation = int(m_gen.group()) if m_gen else 0
             reqs = Requirements.of(
                 Requirement.create("karpenter.tpu/instance-cpu", IN, [str(vcpus * 1000)]),
                 Requirement.create("karpenter.tpu/instance-memory-mib", IN, [str(mem_bytes // MIB)]),
