@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..api.objects import ObjectMeta
+from ..metrics.registry import LEADER
 from . import store as st
 
 LEASES = "leases"
@@ -118,6 +119,7 @@ class LeaderElector:
             self._leading = self._cas(lease, self.identity, now)
         else:
             self._leading = False
+        LEADER.set(1.0 if self._leading else 0.0)
         return self._leading != was
 
     def resign(self) -> None:
@@ -128,3 +130,4 @@ class LeaderElector:
             # process's identity no longer matches (it will not auto-reclaim)
             self._cas(lease, "", -self.lease_s)
         self._leading = False
+        LEADER.set(0.0)

@@ -182,6 +182,22 @@ DISRUPTION_DECISIONS = REGISTRY.register(
         ("decision", "reason"),
     )
 )
+SOLVER_SOLVES = REGISTRY.register(
+    Counter(
+        "karpenter_tpu_solver_solves_total",
+        "Solves by EXECUTING backend (device kernel / native C++ core / "
+        "python oracle) — each concrete executor counts itself exactly "
+        "once per logical solve; delegation layers count nothing "
+        "(fallback-chain visibility; this framework's addition)",
+        ("backend",),
+    )
+)
+LEADER = REGISTRY.register(
+    Gauge(
+        "karpenter_leader",
+        "1 while this instance holds the leader lease, else 0",
+    )
+)
 OFFERING_AVAILABLE = REGISTRY.register(
     Gauge(
         "karpenter_cloudprovider_instance_type_offering_available",

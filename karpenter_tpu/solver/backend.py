@@ -28,6 +28,7 @@ from ..provisioning.scheduler import (
     SolverResult,
 )
 from ..scheduling.requirements import IN, Requirement, Requirements
+from ..metrics.registry import SOLVER_SOLVES
 from ..utils.resources import PODS, Resources
 from .encode import EncodedInput, UnpackableInput, encode, quantize_input
 
@@ -40,6 +41,9 @@ class Solver(abc.ABC):
 
 class ReferenceSolver(Solver):
     def solve(self, inp: SolverInput) -> SolverResult:
+        # each CONCRETE executor counts itself exactly once per logical
+        # solve; delegation layers count nothing (no double counting)
+        SOLVER_SOLVES.inc(backend="oracle")
         return canonicalize_placements(inp, Scheduler(inp).solve())
 
 
@@ -539,6 +543,7 @@ class TPUSolver(Solver):
                 self.stats["fallback_solves"] += 1
                 return self.fallback.solve(qinp)
             self.stats["device_solves"] += 1
+            SOLVER_SOLVES.inc(backend="device")
             return out
 
         return AsyncSolve(finish)

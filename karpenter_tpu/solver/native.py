@@ -17,6 +17,7 @@ from typing import Optional
 import numpy as np
 
 from ..provisioning.scheduler import SolverInput, SolverResult
+from ..metrics.registry import SOLVER_SOLVES
 from .backend import ReferenceSolver, Solver, decode
 from .encode import EncodedInput, encode, quantize_input
 
@@ -162,7 +163,7 @@ class NativeSolver(Solver):
             # (V) constraints all run in the native core; what still routes
             # to the oracle is the same set the device kernel can't express
             self.stats["fallback_solves"] += 1
-            return self.fallback.solve(qinp)
+            return self.fallback.solve(qinp)  # executor counts itself
         try:
             out = solve_encoded(enc, self.max_claims)
         except (OSError, subprocess.CalledProcessError):
@@ -179,4 +180,5 @@ class NativeSolver(Solver):
             self.stats["fallback_solves"] += 1
             return self.fallback.solve(qinp)
         self.stats["native_solves"] += 1
+        SOLVER_SOLVES.inc(backend="native")
         return result

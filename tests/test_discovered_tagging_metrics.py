@@ -102,3 +102,31 @@ class TestDiscoveredStability:
         assert c.seq == 2, "one bump per new minimum, not one per reconcile"
         c.record("t3.large", 95)  # higher observation: no churn
         assert c.memory("t3.large") == 90 and c.seq == 2
+
+
+class TestSolverAndLeaderSeries:
+    def test_solver_backend_counter_and_leader_gauge(self):
+        from karpenter_tpu.controllers import store as st2
+        from karpenter_tpu.controllers.leaderelection import LeaderElector
+        from karpenter_tpu.metrics.registry import LEADER, REGISTRY, SOLVER_SOLVES
+        from karpenter_tpu.solver.backend import TPUSolver
+        from karpenter_tpu.provisioning.scheduler import SolverInput
+
+        before = SOLVER_SOLVES.value(backend="device")
+        from tests.test_zone_device import ZONES, mkpod, pool
+
+        TPUSolver().solve(
+            SolverInput(pods=[mkpod("m0")], nodes=[], nodepools=[pool()],
+                        zones=ZONES)
+        )
+        assert SOLVER_SOLVES.value(backend="device") == before + 1
+        s = st2.Store()
+        el = LeaderElector(s, "me")
+        el.tick()
+        assert LEADER.value() == 1.0
+        el.resign()  # drops the gauge immediately (a lone elector would
+        # legitimately re-win the freed lease on its next tick)
+        assert LEADER.value() == 0.0
+        text = REGISTRY.expose()
+        assert "karpenter_tpu_solver_solves_total" in text
+        assert "karpenter_leader" in text
