@@ -119,7 +119,7 @@ class LeaderElector:
             self._leading = self._cas(lease, self.identity, now)
         else:
             self._leading = False
-        LEADER.set(1.0 if self._leading else 0.0)
+        LEADER.set(1.0 if self._leading else 0.0, identity=self.identity)
         return self._leading != was
 
     def resign(self) -> None:
@@ -130,4 +130,4 @@ class LeaderElector:
             # process's identity no longer matches (it will not auto-reclaim)
             self._cas(lease, "", -self.lease_s)
         self._leading = False
-        LEADER.set(0.0)
+        LEADER.set(0.0, identity=self.identity)

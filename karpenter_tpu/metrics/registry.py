@@ -195,7 +195,10 @@ SOLVER_SOLVES = REGISTRY.register(
 LEADER = REGISTRY.register(
     Gauge(
         "karpenter_leader",
-        "1 while this instance holds the leader lease, else 0",
+        "1 while the labeled elector identity holds the leader lease, else "
+        "0 (labeled so co-hosted electors — the in-process HA test "
+        "configuration — never overwrite each other's series)",
+        ("identity",),
     )
 )
 OFFERING_AVAILABLE = REGISTRY.register(

@@ -123,10 +123,15 @@ class TestSolverAndLeaderSeries:
         s = st2.Store()
         el = LeaderElector(s, "me")
         el.tick()
-        assert LEADER.value() == 1.0
+        assert LEADER.value(identity="me") == 1.0
+        # a co-hosted standby must not overwrite the leader's series
+        el2 = LeaderElector(s, "standby")
+        el2.tick()
+        assert LEADER.value(identity="me") == 1.0
+        assert LEADER.value(identity="standby") == 0.0
         el.resign()  # drops the gauge immediately (a lone elector would
         # legitimately re-win the freed lease on its next tick)
-        assert LEADER.value() == 0.0
+        assert LEADER.value(identity="me") == 0.0
         text = REGISTRY.expose()
         assert "karpenter_tpu_solver_solves_total" in text
         assert "karpenter_leader" in text

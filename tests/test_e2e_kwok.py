@@ -255,3 +255,58 @@ class TestDisruptionE2E:
         assert len(nodes) < 3  # consolidated (>=2 deleted, <=1 replacement)
         pods = op.store.list(st.PODS)
         assert all(p.node_name for p in pods)
+
+
+def test_round4_features_through_the_control_loop():
+    """Integration: ct-spread, positive hostname affinity, and zone spread
+    pods all converge through the FULL control loop (provisioner → launch →
+    registration → binding) in one cluster — the features are end-to-end
+    capabilities, not solver-only paths."""
+    from karpenter_tpu.api.objects import PodAffinityTerm, TopologySpreadConstraint
+
+    clock = FakeClock()
+    op = new_kwok_operator(clock=clock)
+    op.store.create(st.NODEPOOLS, mkpool())
+    for i in range(6):
+        p = mkpod(f"ct{i}", cpu="500m")
+        p.meta.labels["tier"] = "ct"
+        p.topology_spread = [TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.CAPACITY_TYPE_LABEL,
+            label_selector={"tier": "ct"})]
+        op.store.create(st.PODS, p)
+    for i in range(4):
+        p = mkpod(f"db{i}", cpu="250m")
+        p.meta.labels["svc"] = "db"
+        p.affinity_terms = [PodAffinityTerm(
+            label_selector={"svc": "db"}, topology_key=wk.HOSTNAME_LABEL,
+            anti=False)]
+        op.store.create(st.PODS, p)
+    for i in range(6):
+        p = mkpod(f"zs{i}", cpu="500m")
+        p.meta.labels["app"] = "zs"
+        p.topology_spread = [TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.ZONE_LABEL,
+            label_selector={"app": "zs"})]
+        op.store.create(st.PODS, p)
+    op.manager.settle()
+    pods = op.store.list(st.PODS)
+    bound = [p for p in pods if p.node_name]
+    assert len(bound) == 16, [
+        (p.meta.name, p.node_name) for p in pods if not p.node_name
+    ]
+    nodes = {n.meta.name: n for n in op.store.list(st.NODES)}
+    # ct spread: both capacity types present among the ct pods' nodes
+    cts = {
+        nodes[p.node_name].meta.labels[wk.CAPACITY_TYPE_LABEL]
+        for p in pods if p.meta.labels.get("tier") == "ct"
+    }
+    assert cts == {"on-demand", "spot"}, cts
+    # hostname affinity: every db pod co-located on ONE node
+    db_nodes = {p.node_name for p in pods if p.meta.labels.get("svc") == "db"}
+    assert len(db_nodes) == 1, db_nodes
+    # zone spread: the zs pods cover all three AZs (6 pods, maxSkew 1)
+    zs_zones = {
+        nodes[p.node_name].meta.labels[wk.ZONE_LABEL]
+        for p in pods if p.meta.labels.get("app") == "zs"
+    }
+    assert len(zs_zones) == 3, zs_zones
