@@ -447,7 +447,7 @@ def main() -> None:
     res = e2e_solver.solve(e2e_inp)
     e2e_first = time.perf_counter() - t0
     e2e_times = []
-    for _ in range(8):
+    for _ in range(12):
         t0 = time.perf_counter()
         res = e2e_solver.solve(e2e_inp)
         e2e_times.append((time.perf_counter() - t0) * 1000)
