@@ -56,6 +56,13 @@ _TPU_DOMAIN_ALLOWED_SUFFIXES = (
     "instance-memory-mib",
 )
 _RESTRICTED_DOMAINS = ("karpenter.sh", "kubernetes.io", "k8s.io", "karpenter.tpu")
+# operator-usable domains the reference carves out of the restricted set
+# (karpenter.sh_nodepools.yaml:202-208)
+_CARVED_OUT_DOMAINS = (
+    "node.kubernetes.io",
+    "node-restriction.kubernetes.io",
+    "kops.k8s.io",
+)
 _BUDGET_NODES_RE = re.compile(r"^((100|[0-9]{1,2})%|[0-9]+)$")
 
 
@@ -72,7 +79,7 @@ def _key_restricted(key: str) -> bool:
     # the reference carves out whole operator-usable domains
     # (karpenter.sh_nodepools.yaml:202-208): node.kubernetes.io,
     # node-restriction.kubernetes.io, and kops.k8s.io
-    for carved in ("node.kubernetes.io", "node-restriction.kubernetes.io", "kops.k8s.io"):
+    for carved in _CARVED_OUT_DOMAINS:
         if dom == carved or dom.endswith("." + carved):
             return False
     for restricted in _RESTRICTED_DOMAINS:
@@ -166,3 +173,52 @@ def admission_validator(kind: str, obj) -> None:
         return
     if errors:
         raise ValidationError(errors)
+
+
+def rules_document() -> list:
+    """Machine-readable export of the admission rules — the analog of the
+    reference's CRD yamls with injected x-kubernetes-validations
+    (charts/karpenter-crd, pkg/apis/crds/karpenter.sh_nodepools.yaml): the
+    store IS this framework's API server, so the schema artifact is
+    GENERATED from the enforcing code rather than maintained beside it,
+    and can never drift. Rendered by `python -m karpenter_tpu.deploy
+    --crds`."""
+    return [
+        {
+            "apiVersion": "karpenter.tpu/v1",
+            "kind": "ValidationRules",
+            "metadata": {"name": "nodepools.karpenter.sh"},
+            "spec": {
+                "restrictedLabelDomains": list(_RESTRICTED_DOMAINS),
+                "carvedOutDomains": list(_CARVED_OUT_DOMAINS),
+                "wellKnownAllowedKeys": sorted(_WELLKNOWN_ALLOWED),
+                "tpuDomainAllowedSuffixes": list(_TPU_DOMAIN_ALLOWED_SUFFIXES),
+                "forbiddenTemplateLabels": [wk.HOSTNAME_LABEL, wk.NODEPOOL_LABEL],
+                "requirementOperators": {
+                    "In": "requires at least one value",
+                    "Gt/Lt": "require a single non-negative integer value",
+                    "minValues": "1..50, and an In set must carry at least "
+                                 "minValues values",
+                },
+                "budgets": {
+                    "nodes": _BUDGET_NODES_RE.pattern,
+                    "schedule": "cron, must be set together with duration",
+                },
+                "nodeClassRef": "name may not be empty",
+            },
+        },
+        {
+            "apiVersion": "karpenter.tpu/v1",
+            "kind": "ValidationRules",
+            "metadata": {"name": "nodeclaims.karpenter.sh"},
+            "spec": {
+                # requirements flow through the same _validate_requirement
+                # path as NodePools: identical domain carve-outs/allowlists
+                "restrictedLabelDomains": list(_RESTRICTED_DOMAINS),
+                "carvedOutDomains": list(_CARVED_OUT_DOMAINS),
+                "wellKnownAllowedKeys": sorted(_WELLKNOWN_ALLOWED),
+                "tpuDomainAllowedSuffixes": list(_TPU_DOMAIN_ALLOWED_SUFFIXES),
+                "exemptKeys": [wk.NODEPOOL_LABEL, wk.INSTANCE_TYPE_LABEL],
+            },
+        },
+    ]

@@ -243,7 +243,19 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     ap = argparse.ArgumentParser(prog="karpenter-tpu-deploy")
     ap.add_argument("-f", "--values", help="values YAML file with overrides")
+    ap.add_argument(
+        "--crds", action="store_true",
+        help="emit the admission-rule documents (the CRD-chart analog) "
+             "instead of the runtime manifests",
+    )
     args = ap.parse_args(argv)
+    if args.crds:
+        from ..api.validation import rules_document
+
+        print("---\n".join(
+            yaml.safe_dump(d, sort_keys=False) for d in rules_document()
+        ))
+        return
     overrides = None
     if args.values:
         with open(args.values) as f:

@@ -102,3 +102,26 @@ def test_yaml_output_parses_and_merge_is_deep():
     # deep-merge preserved the sibling default, and DEFAULT_VALUES unmutated
     assert res["requests"]["memory"] == "1Gi"
     assert DEFAULT_VALUES["controller"]["resources"]["requests"]["cpu"] == "1"
+
+
+def test_crds_export_reflects_enforced_rules():
+    """--crds emits the admission rules GENERATED from the enforcing code
+    (the CRD-chart analog) — spot-check values against the validators."""
+    from karpenter_tpu.api import validation as v
+    from karpenter_tpu.api import wellknown as wk
+
+    docs = v.rules_document()
+    assert [d["metadata"]["name"] for d in docs] == [
+        "nodepools.karpenter.sh", "nodeclaims.karpenter.sh",
+    ]
+    spec = docs[0]["spec"]
+    assert set(spec["restrictedLabelDomains"]) == set(v._RESTRICTED_DOMAINS)
+    assert set(spec["carvedOutDomains"]) == set(v._CARVED_OUT_DOMAINS)
+    assert wk.ZONE_LABEL in spec["wellKnownAllowedKeys"]
+    assert spec["budgets"]["nodes"] == v._BUDGET_NODES_RE.pattern
+    # nodeclaims share the requirement path: allowlists must be present too
+    assert docs[1]["spec"]["wellKnownAllowedKeys"] == spec["wellKnownAllowedKeys"]
+    # real round-trip through the CLI's multi-doc YAML output
+    blob = "---\n".join(yaml.safe_dump(d, sort_keys=False) for d in docs)
+    parsed = list(yaml.safe_load_all(blob))
+    assert parsed == docs
