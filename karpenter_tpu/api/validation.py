@@ -112,8 +112,8 @@ def _validate_requirement(key: str, r, errors: List[str], where: str) -> None:
                 f"single positive integer value"
             )
     if r.min_values:
-        if r.min_values > 50:
-            errors.append(f"{where}: minValues must be <= 50")
+        if r.min_values > 50 or r.min_values < 1:
+            errors.append(f"{where}: minValues must be within 1..50")
         if not r.complement and r.values and len(r.values) < r.min_values:
             errors.append(
                 f"{where}: requirements with 'minValues' must have at least "

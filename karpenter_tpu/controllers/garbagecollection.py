@@ -24,12 +24,33 @@ class GarbageCollectionController:
         self.clock = clock
 
     def reconcile(self) -> bool:
+        # ONE DescribeInstances per tick: both directions derive from the
+        # same snapshot (consistent view; half the non-mutating rate-limit
+        # pressure of two calls)
+        instances = self.cloud.describe_instances()
+        live = {i.id for i in instances}
         claim_ids = set()
+        did = False
         for c in self.store.list(st.NODECLAIMS):
-            if c.provider_id:
-                claim_ids.add(c.provider_id.rsplit("/", 1)[-1])
+            if not c.provider_id:
+                continue
+            iid = c.provider_id.rsplit("/", 1)[-1]
+            claim_ids.add(iid)
+            # the OTHER reconcile direction: a launched claim whose instance
+            # vanished (terminated out from under us — spot reclaim, manual
+            # kill) must be deleted, or it lingers as phantom in-flight
+            # capacity the provisioner packs pending pods onto forever. The
+            # reference's lifecycle gets this from CloudProvider.Get
+            # returning NodeClaimNotFoundError; termination handles the
+            # finalizer drain (the node object is already gone).
+            if iid not in live and not c.meta.deleting:
+                try:
+                    self.store.delete(st.NODECLAIMS, c.name)
+                except st.NotFound:
+                    pass
+                did = True
         orphans = []
-        for inst in self.cloud.describe_instances():
+        for inst in instances:
             if inst.id in claim_ids:
                 continue
             if self.clock() - inst.launch_time < self.grace_s:
@@ -38,4 +59,4 @@ class GarbageCollectionController:
         if orphans:
             self.cloud.terminate_instances(orphans)
             return True
-        return False
+        return did

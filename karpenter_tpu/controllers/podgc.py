@@ -1,0 +1,35 @@
+"""Pod GC: re-pose pods bound to vanished nodes.
+
+The reference leans on kube-controller-manager's podgc: when a Node object
+disappears abruptly (kwok's node-killer purges Nodes whose instance
+vanished, ec2.go:219-262), podgc deletes the orphaned pods and their
+workload controllers recreate them as Pending — which is what re-triggers
+provisioning. This framework's store IS the API server and pods stand in
+for their workloads, so the analog re-poses the pod itself: node_name
+cleared, phase back to Pending. Without this, a pod bound to a killed
+node is stuck forever (graceful drain re-poses only pods on nodes that go
+through termination).
+"""
+
+from __future__ import annotations
+
+from . import store as st
+
+
+class PodGCController:
+    name = "podgc"
+
+    def __init__(self, store: st.Store):
+        self.store = store
+
+    def reconcile(self) -> bool:
+        node_names = {n.meta.name for n in self.store.list(st.NODES)}
+        did = False
+        for pod in self.store.list(st.PODS):
+            if pod.meta.deleting or not pod.node_name:
+                continue
+            if pod.node_name in node_names:
+                continue
+            st.repose_pod(self.store, pod)
+            did = True
+        return did

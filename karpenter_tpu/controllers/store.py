@@ -227,6 +227,16 @@ class Store:
                 self._dispatch_lock.release()
 
 
+def repose_pod(store: "Store", pod) -> None:
+    """Unbind a pod back to Pending (the ReplicaSet-recreates-it analog).
+    THE one re-pose idiom — eviction, forced drain, disruption pre-spin,
+    and pod GC all route here so the operation can grow steps (nomination
+    clearing, events) without the call sites diverging."""
+    pod.node_name = None
+    pod.phase = "Pending"
+    store.update(PODS, pod)
+
+
 # Canonical kind names
 PODS = "pods"
 NODES = "nodes"

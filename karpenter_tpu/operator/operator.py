@@ -18,6 +18,7 @@ from ..cloudprovider.types import InstanceType
 from ..controllers import store as st
 from ..controllers.binder import Binder
 from ..controllers.garbagecollection import GarbageCollectionController
+from ..controllers.podgc import PodGCController
 from ..controllers.capacityreservation import CapacityReservationFlipController
 from ..controllers.interruption import InterruptionController, InterruptionQueue
 from ..controllers.manager import Manager
@@ -140,6 +141,7 @@ def new_kwok_operator(
         LivenessController(store, clock=clock),
         ExpirationController(store, clock=clock),
         GarbageCollectionController(store, cloud, clock=clock),
+        PodGCController(store),
         NodeClassController(store, catalog=types),
         DriftController(store),
         InterruptionController(store, queue, unavailable=cloud_provider.unavailable),

@@ -51,9 +51,7 @@ class EvictionQueue:
             return False
         # eviction unbinds; the pod returns to Pending for the provisioner
         # (mirrors a ReplicaSet recreating the pod elsewhere)
-        pod.node_name = None
-        pod.phase = "Pending"
-        self.store.update(st.PODS, pod)
+        st.repose_pod(self.store, pod)
         return True
 
 
@@ -138,9 +136,7 @@ class TerminationController:
                 if not self._drainable(pod, node):
                     continue
                 if force:
-                    pod.node_name = None
-                    pod.phase = "Pending"
-                    self.store.update(st.PODS, pod)
+                    st.repose_pod(self.store, pod)
                     did = True
                 elif self.eviction.evict(pod):
                     did = True

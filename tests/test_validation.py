@@ -77,7 +77,7 @@ class TestRules:
             Requirement.create("karpenter.tpu/instance-family", EXISTS, (), min_values=51)
         )
         errs = validate_nodepool(mk(reqs=reqs))
-        assert any("<= 50" in e for e in errs)
+        assert any("1..50" in e for e in errs)
 
     def test_hostname_label_restricted(self):
         errs = validate_nodepool(mk(labels={wk.HOSTNAME_LABEL: "x"}))
@@ -155,4 +155,19 @@ class TestStoreAdmission:
         bad = mk()
         bad.template.node_class_ref = ""
         with pytest.raises(ValidationError, match="nodeClassRef"):
+            op.store.create(st.NODEPOOLS, bad)
+
+
+    def test_min_values_lower_bound(self):
+        from karpenter_tpu.scheduling.requirements import IN, Requirement, Requirements
+
+        op = new_kwok_operator(clock=FakeClock())
+        bad = mk()
+        bad.template.requirements = bad.template.requirements.union(
+            Requirements.of(
+                Requirement.create("karpenter.tpu/instance-family", IN,
+                                   ["m5", "c5"], min_values=-3)
+            )
+        )
+        with pytest.raises(ValidationError, match="1..50"):
             op.store.create(st.NODEPOOLS, bad)
