@@ -111,7 +111,8 @@ def _validate_requirement(key: str, r, errors: List[str], where: str) -> None:
                 f"{where}: requirements operator 'Gt' or 'Lt' must have a "
                 f"single positive integer value"
             )
-    if r.min_values:
+    if r.min_values is not None:
+        # explicit 0 is rejected too (CRD minimum: 1); unset is None
         if r.min_values > 50 or r.min_values < 1:
             errors.append(f"{where}: minValues must be within 1..50")
         if not r.complement and r.values and len(r.values) < r.min_values:

@@ -77,6 +77,29 @@ def _check_invariants(op, step):
             assert len(bound) <= cap_pods, f"step {step}: pod slots oversubscribed"
 
 
+def _assert_converged(op):
+    """Every surviving pod bound (hostname-affinity overflow is
+    legitimately Pending, as in kube) and every instance owned by a live
+    claim (no leaks)."""
+    pods = [p for p in op.store.list(st.PODS) if not p.meta.deleting]
+    stuck = [
+        p.meta.name
+        for p in pods
+        if not p.node_name and not any(
+            a.topology_key == wk.HOSTNAME_LABEL and not a.anti
+            for a in p.affinity_terms
+        )
+    ]
+    assert not stuck, f"unconverged pods after settle: {stuck}"
+    claim_ids = {
+        c.provider_id.rsplit("/", 1)[-1]
+        for c in op.store.list(st.NODECLAIMS)
+        if c.provider_id
+    }
+    leaked = [x.id for x in op.cloud.describe_instances() if x.id not in claim_ids]
+    assert not leaked, f"leaked instances: {leaked}"
+
+
 @pytest.mark.parametrize("seed", range(4))
 def test_chaos_churn_converges(seed):
     rng = random.Random(1000 + seed)
@@ -117,26 +140,56 @@ def test_chaos_churn_converges(seed):
     clock.advance(120)
     op.manager.settle()
     _check_invariants(op, "end")
-    pods = [p for p in op.store.list(st.PODS) if not p.meta.deleting]
-    unbound = [p for p in pods if not p.node_name]
-    # positive hostname affinity pods are LEGITIMATELY unschedulable when
-    # their co-location node is full (the group pins to one node; overflow
-    # stays Pending — same as kube); everything else must converge
-    legit = {
-        p.meta.name
-        for p in unbound
-        if any(
-            a.topology_key == wk.HOSTNAME_LABEL and not a.anti
-            for a in p.affinity_terms
-        )
-    }
-    stuck = [p.meta.name for p in unbound if p.meta.name not in legit]
-    assert not stuck, f"unconverged pods after settle: {stuck}"
-    # conservation: every instance belongs to a live claim (no leaks)
-    claim_ids = {
-        c.provider_id.rsplit("/", 1)[-1]
-        for c in op.store.list(st.NODECLAIMS)
-        if c.provider_id
-    }
-    leaked = [x.id for x in op.cloud.describe_instances() if x.id not in claim_ids]
-    assert not leaked, f"leaked instances: {leaked}"
+    _assert_converged(op)
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_chaos_with_crash_restore(seed, tmp_path):
+    """Kill the control plane mid-churn and restore from the periodic
+    snapshot: the rebuilt cluster must pass the same invariants and
+    converge — durability under fire, not just in the directed
+    snapshot tests."""
+    rng = random.Random(2000 + seed)
+    snap = str(tmp_path / "snap.bin")
+    clock = FakeClock()
+    op = new_kwok_operator(clock=clock, snapshot_path=snap,
+                           snapshot_interval_s=2.0)
+    op.store.create(st.NODEPOOLS, mkpool())
+    i = 0
+
+    def churn(op, steps):
+        nonlocal i
+        for step in range(steps):
+            action = rng.random()
+            if action < 0.55:
+                for _ in range(rng.randint(1, 3)):
+                    op.store.create(st.PODS, _mkpod(rng, i))
+                    i += 1
+            elif action < 0.75:
+                insts = op.cloud.describe_instances()
+                if insts:
+                    op.interruption_queue.send(Message(
+                        kind=SPOT_INTERRUPTION,
+                        instance_id=rng.choice(insts).id))
+            else:
+                insts = op.cloud.describe_instances()
+                if insts:
+                    op.cloud.terminate_instances([rng.choice(insts).id])
+            op.manager.tick()
+            clock.advance(1)
+            _check_invariants(op, step)
+
+    churn(op, 25)
+    # hard crash: a fresh operator restores from the snapshot file (shares
+    # the FakeClock: the restore rebase handles epoch continuity)
+    op2 = new_kwok_operator(clock=clock, snapshot_path=snap,
+                            snapshot_interval_s=2.0)
+    _check_invariants(op2, "post-restore")
+    churn(op2, 25)
+    clock.advance(120)
+    op2.manager.settle()
+    clock.advance(120)
+    op2.manager.settle()
+    _check_invariants(op2, "end")
+    _assert_converged(op2)
+

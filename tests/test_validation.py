@@ -171,3 +171,17 @@ class TestStoreAdmission:
         )
         with pytest.raises(ValidationError, match="1..50"):
             op.store.create(st.NODEPOOLS, bad)
+
+    def test_min_values_zero_rejected(self):
+        from karpenter_tpu.scheduling.requirements import IN, Requirement, Requirements
+
+        op = new_kwok_operator(clock=FakeClock())
+        bad = mk()
+        bad.template.requirements = bad.template.requirements.union(
+            Requirements.of(
+                Requirement.create("karpenter.tpu/instance-family", IN,
+                                   ["m5", "c5"], min_values=0)
+            )
+        )
+        with pytest.raises(ValidationError, match="1..50"):
+            op.store.create(st.NODEPOOLS, bad)
