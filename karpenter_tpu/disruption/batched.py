@@ -44,7 +44,7 @@ class SubsetVerdict:
     replacement_type_count: int  # surviving instance types (spot >=15 rule)
 
 
-def tiered_prefix_search(evaluate_ks, n_max: int, acceptable, width: int = 32):
+def tiered_prefix_search(evaluate_ks, n_max: int, acceptable, width: int = 64):
     """Largest-acceptable-prefix search over prefix lengths [2, n_max].
 
     evaluate_ks(ks) -> verdicts for prefixes of those lengths;
@@ -54,6 +54,11 @@ def tiered_prefix_search(evaluate_ks, n_max: int, acceptable, width: int = 32):
     fully enumerated — O(log_width(N)) batched dispatches instead of O(N)
     sequential re-solves (config 5). Shared by the disruption controller
     and bench.py so the measured loop IS the production loop.
+
+    width=64 makes fleets up to ~width² (≈4k) candidates exactly TWO
+    dispatches (ladder + one enumerated gap): on a tunneled link each
+    dispatch costs a ~70-80 ms roundtrip, which dominates the kernel, while
+    the wider batch row count is nearly free on device.
 
     Returns (k_best — 1 when nothing accepted, probed {k: verdict},
     dispatches)."""

@@ -91,7 +91,7 @@ class DisruptionController:
         replacement_timeout_s: float = 10 * 60,
         multi_node_max_candidates: int = 100,
         multi_node_max_candidates_batched: int = 10_000,
-        batch_phase_width: int = 32,
+        batch_phase_width: int = 64,  # two-dispatch search ≤ ~4k candidates
     ):
         self.store = store
         self.cluster = cluster
