@@ -301,10 +301,11 @@ def bench_config5():
     cand_per_s = probed2 / (p50 / 1000.0)
     print(
         f"[bench] config5 10k-node multi-consolidation: search p50={p50:.0f}ms "
-        f"({cand_per_s:.0f} full-fleet subset evals/s, prefix={k} nodes)",
+        f"({cand_per_s:.0f} full-fleet subset evals/s, prefix={k} nodes, "
+        f"{disp} dispatches)",
         file=sys.stderr,
     )
-    return p50, cand_per_s, k
+    return p50, cand_per_s, k, disp
 
 
 def build_s_stress_input(num_pods: int = 50_000, n_specs: int = 2_000):
@@ -489,7 +490,7 @@ def main() -> None:
     c4_p50 = _bench_config("config4 affinity e2e (50k pods)", build_config4_input(50_000))
 
     # ---- config 5: 10k-node multi-node consolidation ---------------------
-    c5_p50, c5_rate, c5_k = bench_config5()
+    c5_p50, c5_rate, c5_k, c5_d = bench_config5()
 
     # ---- scan-axis stress: ~2000 distinct specs (S >> headline configs) --
     ss_p50 = _bench_config(
@@ -513,6 +514,7 @@ def main() -> None:
                 "config5_eval_p50_ms": round(c5_p50, 2),
                 "config5_subset_evals_per_s": round(c5_rate, 1),
                 "config5_prefix_nodes": c5_k,
+                "config5_dispatches": c5_d,
                 "s_stress_e2e_p50_ms": round(ss_p50, 2),
                 "first_call_s": round(compile_s, 2),
             }
