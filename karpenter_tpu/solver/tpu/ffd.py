@@ -21,15 +21,26 @@ intersect componentwise — so each claim's joint feasibility is one uint32
 and the joint "does any offering survive" check is a single [M,T] bitwise
 AND. Group-membership state packs the same way into ceil(G/32) words.
 
-Zone topology spread + inter-pod affinity (BASELINE configs 3-4) run through
-the **zone event engine**: a `lax.while_loop` entered (per run, via
-`lax.cond`) only for groups owning zone constraints. Each event places a
-closed-form batch of pods — per-zone consecutive budgets `m2 + maxSkew − cnt`
-for spread (SPEC.md skew rule), blocked/present zone sets for (anti-)affinity,
-claim zone commitment to `argmin(count, lex)` / `argmax(count, lex)` — and
-the *balanced phase* (equal counts across eligible zones) batches whole
-rotation rounds at once, so events scale with targets touched, not pods.
-Every event places ≥1 pod, bounding the loop by `remaining`.
+Domain topology spread + inter-pod affinity (BASELINE configs 3-4) run
+through the **domain event engine**: a `lax.while_loop` entered (per run,
+via `lax.cond`) only for groups owning V-axis constraints. The engine is
+domain-GENERIC — it sees per-domain column masks over the joint (zone, ct)
+bits, per-domain counts, and a node→domain map — so zone-granular AND
+capacity-type-granular sigs run on the same kernel (encode picks the axis;
+mixed-axis solves fall back). Each event places a closed-form batch of
+pods: per-domain consecutive budgets `m2 + maxSkew − cnt` for spread
+(SPEC.md skew rule), blocked/present domain sets for (anti-)affinity,
+claim domain commitment to `argmin(count, lex)` / `argmax(count, lex)`.
+Three closed forms keep events at ≤1 per run on the headline configs:
+*water-fill mega* (pure maxSkew-1 self-matching spread lays out entirely —
+water-fill the counts from ARBITRARY floors, drain per-domain claim
+targets by prefix pour, open fresh claims slot-ordered by (count-at-open,
+lex)); *fixed-zone affinity bulk* (post-bootstrap positive affinity drains
+every eligible claim in one prefix pour + budgeted multi-open); and
+*balanced cycles* (equal counts with targets everywhere batch whole
+rotation rounds). Positive HOSTNAME affinity is a Q-axis closed form in
+the fast branch (member-gated allowance + a one-target first-fit
+bootstrap). Every event places ≥1 pod, bounding the loop by `remaining`.
 
 Per-step work is O((E+M)·T·R) fully-vectorized integer ops — VPU-friendly,
 HBM-bandwidth-bound, no data-dependent Python control flow, static shapes
