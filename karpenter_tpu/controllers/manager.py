@@ -66,8 +66,20 @@ class Manager:
                 self._stop.wait(interval_s)
 
         t = threading.Thread(target=loop, daemon=True, name="karpenter-tpu-manager")
+        self._loop_thread = t
         t.start()
         return t
 
     def stop(self) -> None:
         self._stop.set()
+        # join the loop BEFORE resigning: an in-flight tick could otherwise
+        # observe the resigned (expired) lease and CAS-re-acquire it on the
+        # way out, leaving the dead process holding a fresh lease
+        t = getattr(self, "_loop_thread", None)
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=30)
+        if self.elector is not None:
+            # clean shutdown hands off immediately: resign empties the lease
+            # holder so a standby acquires on its next tick instead of
+            # waiting out the full lease duration (kube's ReleaseOnCancel)
+            self.elector.resign()

@@ -133,3 +133,22 @@ class TestRestartAndResign:
         assert b.is_leader()
         a.tick()
         assert not a.is_leader()
+
+
+def test_manager_stop_resigns_for_fast_handoff():
+    """Clean shutdown must hand off immediately (kube ReleaseOnCancel):
+    Manager.stop resigns the lease so the standby acquires on its NEXT tick
+    instead of waiting out the lease duration."""
+    from karpenter_tpu.controllers.manager import Manager
+
+    store = st.Store()
+    clock = FakeClock()
+    a = LeaderElector(store, "a", lease_s=15, clock=clock)
+    b = LeaderElector(store, "b", lease_s=15, clock=clock)
+    ma = Manager(elector=a)
+    ma.tick()
+    assert a.is_leader()
+    ma.stop()  # clean shutdown
+    clock.advance(0.1)  # far inside what WOULD have been the lease window
+    b.tick()
+    assert b.is_leader(), "standby must take over without waiting for expiry"
