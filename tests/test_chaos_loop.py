@@ -54,6 +54,17 @@ def _mkpod(rng, i):
         p.affinity_terms = [PodAffinityTerm(
             label_selector={"svc": "db"}, topology_key=wk.HOSTNAME_LABEL,
             anti=False)]
+    elif r < 0.40:
+        # zone anti-affinity singleton lock (unique key per pod: each is
+        # its own group, one per zone at most)
+        p.meta.labels["lock"] = f"l{i % 5}"
+        p.affinity_terms = [PodAffinityTerm(
+            label_selector={"lock": f"l{i % 5}"},
+            topology_key=wk.ZONE_LABEL, anti=True)]
+    elif r < 0.48:
+        p.node_selector = {
+            wk.ZONE_LABEL: rng.choice(("zone-1a", "zone-1b", "zone-1c"))
+        }
     return p
 
 
@@ -78,15 +89,17 @@ def _check_invariants(op, step):
 
 
 def _assert_converged(op):
-    """Every surviving pod bound (hostname-affinity overflow is
-    legitimately Pending, as in kube) and every instance owned by a live
-    claim (no leaks)."""
+    """Every surviving pod bound, and every instance owned by a live claim
+    (no leaks). Two classes are legitimately Pending, as in kube: positive
+    hostname affinity whose co-location node is full, and anti-affinity
+    groups that exhausted their domains (3 zones -> at most 3 pods per
+    anti lock)."""
     pods = [p for p in op.store.list(st.PODS) if not p.meta.deleting]
     stuck = [
         p.meta.name
         for p in pods
         if not p.node_name and not any(
-            a.topology_key == wk.HOSTNAME_LABEL and not a.anti
+            (a.topology_key == wk.HOSTNAME_LABEL and not a.anti) or a.anti
             for a in p.affinity_terms
         )
     ]
