@@ -125,3 +125,31 @@ def test_crds_export_reflects_enforced_rules():
     blob = "---\n".join(yaml.safe_dump(d, sort_keys=False) for d in docs)
     parsed = list(yaml.safe_load_all(blob))
     assert parsed == docs
+
+
+def test_default_manifests_match_golden():
+    """Golden-file discipline (the reference's userdata goldens,
+    pkg/providers/launchtemplate/testdata/*.golden): the default-rendered
+    manifests are a reviewed artifact — any change must be deliberate.
+    Regenerate with:
+      python -c "from karpenter_tpu.deploy.render import render_yaml; \
+open('tests/testdata/deploy_default.golden.yaml','w').write(render_yaml())"
+    """
+    import os
+
+    here = os.path.dirname(__file__)
+    golden = open(os.path.join(here, "testdata", "deploy_default.golden.yaml")).read()
+    assert render_yaml() == golden
+
+
+def test_crds_export_matches_golden():
+    from karpenter_tpu.api.validation import rules_document
+
+    import os
+
+    here = os.path.dirname(__file__)
+    golden = open(os.path.join(here, "testdata", "crds.golden.yaml")).read()
+    blob = "---\n".join(
+        yaml.safe_dump(d, sort_keys=False) for d in rules_document()
+    )
+    assert blob == golden
