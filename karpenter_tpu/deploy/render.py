@@ -235,6 +235,18 @@ def render_yaml(overrides: Optional[Dict[str, Any]] = None) -> str:
     )
 
 
+def crds_yaml() -> str:
+    """The --crds artifact, ONE serialization shared by the CLI and the
+    golden test (so the golden pins what actually ships)."""
+    import yaml
+
+    from ..api.validation import rules_document
+
+    return "---\n".join(
+        yaml.safe_dump(d, sort_keys=False) for d in rules_document()
+    )
+
+
 def main(argv: Optional[List[str]] = None) -> None:
     """`python -m karpenter_tpu.deploy [-f values.yaml]` — the `helm template`."""
     import argparse
@@ -250,11 +262,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     )
     args = ap.parse_args(argv)
     if args.crds:
-        from ..api.validation import rules_document
-
-        print("---\n".join(
-            yaml.safe_dump(d, sort_keys=False) for d in rules_document()
-        ))
+        print(crds_yaml())
         return
     overrides = None
     if args.values:

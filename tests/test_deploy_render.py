@@ -135,21 +135,19 @@ def test_default_manifests_match_golden():
       python -c "from karpenter_tpu.deploy.render import render_yaml; \
 open('tests/testdata/deploy_default.golden.yaml','w').write(render_yaml())"
     """
-    import os
-
     here = os.path.dirname(__file__)
     golden = open(os.path.join(here, "testdata", "deploy_default.golden.yaml")).read()
     assert render_yaml() == golden
 
 
 def test_crds_export_matches_golden():
-    from karpenter_tpu.api.validation import rules_document
-
-    import os
+    """Pins the SHIPPED artifact: the golden compares against the same
+    crds_yaml() the CLI prints. Regenerate with:
+      python -c "from karpenter_tpu.deploy.render import crds_yaml; \
+open('tests/testdata/crds.golden.yaml','w').write(crds_yaml())"
+    """
+    from karpenter_tpu.deploy.render import crds_yaml
 
     here = os.path.dirname(__file__)
     golden = open(os.path.join(here, "testdata", "crds.golden.yaml")).read()
-    blob = "---\n".join(
-        yaml.safe_dump(d, sort_keys=False) for d in rules_document()
-    )
-    assert blob == golden
+    assert crds_yaml() == golden
