@@ -1054,3 +1054,67 @@ class TestPositiveHostnameAffinityNative:
         assert not nat.claims and nat.errors, (
             [c.pod_uids for c in nat.claims], nat.errors
         )
+
+
+class TestPoolLimitsTaintsFuzz:
+    """Fuzz axes the main generator doesn't stress: multiple weighted pools
+    with LIMITS and TAINTS + randomized tolerations, crossed with every
+    domain-constraint family — pool-limit charging interacts with the
+    closed forms' funding math (trips0) and taints with pool admission.
+    A 48-seed offline sweep passed when this landed; CI keeps 8."""
+
+    SELS = [{"app": "a"}, {"app": "b"}, {"svc": "web"}]
+
+    def _scenario(self, seed):
+        from karpenter_tpu.api.objects import Taint, Toleration
+
+        rng = random.Random(seed)
+        pools = []
+        for pi in range(rng.randint(1, 3)):
+            reqs = Requirements.of(
+                Requirement.create(wk.NODEPOOL_LABEL, IN, [f"p{pi}"])
+            )
+            taints = []
+            if rng.random() < 0.5:
+                taints.append(Taint(key=f"team-{pi}", value="x", effect="NoSchedule"))
+            limits = {}
+            if rng.random() < 0.6:
+                limits = {"cpu": rng.choice([4000, 8000, 16000, 32000])}
+            pools.append(NodePoolSpec(
+                name=f"p{pi}", weight=rng.randint(0, 50), requirements=reqs,
+                taints=taints, instance_types=CATALOG, limits=limits,
+            ))
+        nodes = [mknode(f"n{j}", rng.choice(ZONES)) for j in range(rng.randint(0, 3))]
+        pods = []
+        for i in range(rng.randint(6, 28)):
+            from karpenter_tpu.api.objects import Toleration as _T
+
+            labels = dict(rng.choice(self.SELS)) if rng.random() < 0.6 else {}
+            tols = []
+            for pi in range(3):
+                if rng.random() < 0.4:
+                    tols.append(_T(key=f"team-{pi}", operator="Equal",
+                                   value="x", effect="NoSchedule"))
+            tsp, aft = [], []
+            r = rng.random()
+            if r < 0.25:
+                tsp.append(TopologySpreadConstraint(
+                    max_skew=1, topology_key=wk.ZONE_LABEL,
+                    label_selector=dict(rng.choice(self.SELS))))
+            elif r < 0.4:
+                tsp.append(TopologySpreadConstraint(
+                    max_skew=1, topology_key=wk.CAPACITY_TYPE_LABEL,
+                    label_selector=dict(rng.choice(self.SELS))))
+            elif r < 0.5:
+                aft.append(PodAffinityTerm(
+                    label_selector=dict(rng.choice(self.SELS)),
+                    topology_key=wk.ZONE_LABEL, anti=rng.random() < 0.5))
+            pods.append(mkpod(
+                f"q{i:03d}", cpu=rng.choice(["500m", "1", "2"]), labels=labels,
+                topology_spread=tsp, affinity_terms=aft, tolerations=tols,
+            ))
+        return SolverInput(pods=pods, nodes=nodes, nodepools=pools, zones=ZONES)
+
+    @pytest.mark.parametrize("seed", range(300, 308))
+    def test_fuzz_limits_taints(self, seed):
+        assert_zone_parity(self._scenario(seed), expect_device=False)
