@@ -1118,3 +1118,46 @@ class TestPoolLimitsTaintsFuzz:
     @pytest.mark.parametrize("seed", range(300, 308))
     def test_fuzz_limits_taints(self, seed):
         assert_zone_parity(self._scenario(seed), expect_device=False)
+
+
+class TestIgnorePolicyFuzz:
+    """--preference-policy=Ignore keeps preference-carrying pods ON DEVICE
+    (preferred terms drop before encode): fuzz ScheduleAnyway spreads and
+    weighted affinity beside required zone spread, asserting parity against
+    the oracle under the same policy AND that the device path served every
+    solve. A 40-seed offline sweep passed when this landed; CI keeps 4."""
+
+    SELS = [{"app": "a"}, {"app": "b"}]
+
+    def _scenario(self, seed):
+        rng = random.Random(seed)
+        pods = []
+        for i in range(rng.randint(6, 24)):
+            labels = dict(rng.choice(self.SELS)) if rng.random() < 0.6 else {}
+            tsp, aft = [], []
+            r = rng.random()
+            if r < 0.3:
+                tsp.append(TopologySpreadConstraint(
+                    max_skew=1, topology_key=wk.ZONE_LABEL,
+                    label_selector=dict(rng.choice(self.SELS)),
+                    when_unsatisfiable="ScheduleAnyway"))
+            elif r < 0.5:
+                aft.append(PodAffinityTerm(
+                    label_selector=dict(rng.choice(self.SELS)),
+                    topology_key=wk.ZONE_LABEL,
+                    anti=rng.random() < 0.5, weight=rng.choice([1, 50, 100])))
+            elif r < 0.7:
+                tsp.append(TopologySpreadConstraint(
+                    max_skew=1, topology_key=wk.ZONE_LABEL,
+                    label_selector=dict(rng.choice(self.SELS))))
+            pods.append(mkpod(f"g{i:03d}", cpu=rng.choice(["500m", "1"]),
+                              labels=labels, topology_spread=tsp,
+                              affinity_terms=aft))
+        nodes = [mknode(f"n{j}", rng.choice(ZONES))
+                 for j in range(rng.randint(0, 2))]
+        return SolverInput(pods=pods, nodes=nodes, nodepools=[pool()],
+                           zones=ZONES, preference_policy="Ignore")
+
+    @pytest.mark.parametrize("seed", range(500, 504))
+    def test_fuzz_ignore_policy(self, seed):
+        assert_zone_parity(self._scenario(seed), expect_device=True)
