@@ -241,3 +241,50 @@ def test_positive_hostname_affinity_universe_stays_sequential():
     cand_pods = {0: [mkpod("d0", labels={"svc": "db"}, affinity_terms=[aff])]}
     ev = BatchedConsolidationEvaluator(TPUSolver())
     assert ev.evaluate(base, cand_pods, {0: "n0"}, [[0]]) is None
+
+
+class TestCapacityTypeDomainConsolidation:
+    """Differential for the batched evaluator under the CT domain axis
+    (round 4): ct-granular sigs no longer set has_topology, so these
+    universes take the batched path with the swapped domain — the
+    per-subset v_delta subtraction must key on the node's CAPACITY TYPE,
+    not its zone."""
+
+    def _scenario(self, spread_blocked: bool):
+        # candidate c0 (on-demand) holds a ct-spread member; absorber n1
+        # (spot) holds the other. Removing c0 re-poses its member: with
+        # maxSkew=1 over {on-demand, spot}, the re-posed pod must be able
+        # to land back on on-demand capacity — when the pool is restricted
+        # to spot only (spread_blocked), the rebalance is impossible and
+        # the subset must be rejected by BOTH paths.
+        member = mkpod(
+            "m0",
+            labels={"tier": "ct"},
+            topology_spread=[
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=wk.CAPACITY_TYPE_LABEL,
+                    label_selector={"tier": "ct"},
+                )
+            ],
+        )
+        n0 = mknode("n0", "zone-1a", pod_labels=[{"tier": "ct"}])
+        n1 = mknode("n1", "zone-1a", pod_labels=[{"tier": "ct"}, {"tier": "ct"}])
+        n1.labels[wk.CAPACITY_TYPE_LABEL] = "spot"
+        reqs = None
+        if spread_blocked:
+            reqs = Requirements.of(
+                Requirement.create(wk.CAPACITY_TYPE_LABEL, IN, ["spot"])
+            )
+        base = SolverInput(
+            pods=[], nodes=[n0, n1], nodepools=[pool(reqs=reqs)], zones=ZONES
+        )
+        return base, {0: [member]}, {0: "n0"}
+
+    def test_ct_delta_keys_on_capacity_type(self):
+        base, cpods, cnode = self._scenario(spread_blocked=False)
+        assert_verdicts_match(base, cpods, cnode, [[0]])
+
+    def test_ct_spread_reject_matches_sequential(self):
+        base, cpods, cnode = self._scenario(spread_blocked=True)
+        assert_verdicts_match(base, cpods, cnode, [[0]])
