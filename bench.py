@@ -15,10 +15,64 @@ Runs on the real chip (does NOT force cpu — the axon site hook's
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+# ---------------------------------------------------------------------------
+# Backend availability probe (round-4 lesson: the axon TPU tunnel can be down
+# OR can HANG jax backend init indefinitely — BENCH_r04.json was an rc=1
+# traceback because of it). Probe in a subprocess with a hard timeout, retry
+# with backoff, and if the chip never appears emit a parseable JSON line with
+# a backend_unavailable marker instead of hanging or crashing the driver.
+# ---------------------------------------------------------------------------
+
+_PROBE_SRC = (
+    "import jax, sys; d = jax.devices()[0]; "
+    "x = jax.numpy.ones((8, 8)); jax.block_until_ready(x @ x); "
+    "print(d.platform + '/' + d.device_kind)"
+)
+
+
+def probe_backend(timeout_s: float = 150.0):
+    """Returns 'platform/kind' if a usable accelerator answers within
+    timeout_s, else None. Runs in a subprocess so a hung tunnel cannot hang
+    the bench itself."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        print("[bench] backend probe timed out (tunnel hang)", file=sys.stderr)
+        return None
+    if out.returncode != 0:
+        tail = (out.stderr or "").strip().splitlines()[-1:] or ["?"]
+        print(f"[bench] backend probe failed: {tail[0]}", file=sys.stderr)
+        return None
+    return out.stdout.strip() or None
+
+
+def wait_for_backend(attempts: int = None, timeout_s: float = None,
+                     backoff_s: float = None):
+    """Retry the probe with linear backoff. ~13 min worst case — long enough
+    to ride out a tunnel blip, short enough not to eat the driver's budget."""
+    attempts = attempts or int(os.environ.get("KTPU_BENCH_PROBE_ATTEMPTS", "4"))
+    timeout_s = timeout_s or float(os.environ.get("KTPU_BENCH_PROBE_TIMEOUT_S", "150"))
+    backoff_s = backoff_s or float(os.environ.get("KTPU_BENCH_PROBE_BACKOFF_S", "60"))
+    for i in range(attempts):
+        plat = probe_backend(timeout_s)
+        if plat:
+            return plat
+        if i < attempts - 1:
+            wait = backoff_s * (i + 1)
+            print(f"[bench] retry {i + 1}/{attempts - 1} in {wait:.0f}s",
+                  file=sys.stderr)
+            time.sleep(wait)
+    return None
 
 
 def build_input(num_pods: int = 50_000):
@@ -351,7 +405,57 @@ def _bench_config(tag, inp, iters=5):
     return p50
 
 
+def _emit_unavailable(reason: str) -> None:
+    """One parseable JSON line the driver can record even with no chip
+    (VERDICT r4 'next round' #1): rc=0, explicit marker, no traceback."""
+    print(json.dumps({
+        "metric": "solve_p99_50k_pods_x_700_types",
+        "value": -1,
+        "unit": "ms",
+        "vs_baseline": 0.0,
+        "backend_unavailable": True,
+        "reason": reason,
+    }))
+
+
 def main() -> None:
+    plat = wait_for_backend()
+    if plat is None:
+        _emit_unavailable("accelerator backend never initialized "
+                          "(probe hang/failure after retries)")
+        return
+    if plat.startswith("cpu"):
+        # No accelerator answered; the axon hook fell back to host. Hardware
+        # numbers are impossible — say so instead of publishing CPU latencies
+        # as if they were chip latencies.
+        _emit_unavailable(f"only host backend available ({plat})")
+        return
+
+    # The tunnel can die BETWEEN the probe and the run (it did mid-round-4):
+    # a hung device call would otherwise hang the driver. Hard deadline on
+    # the whole measured section; on expiry emit the marker and exit 0.
+    import threading
+
+    deadline_s = float(os.environ.get("KTPU_BENCH_DEADLINE_S", "2700"))
+
+    def _watchdog():
+        _emit_unavailable(f"watchdog: bench exceeded {deadline_s:.0f}s "
+                          "(tunnel likely hung mid-run)")
+        sys.stdout.flush()
+        os._exit(0)
+
+    wd = threading.Timer(deadline_s, _watchdog)
+    wd.daemon = True
+    wd.start()
+    try:
+        _run(plat)
+    except Exception as e:  # noqa: BLE001 — always leave a parseable line
+        _emit_unavailable(f"bench aborted: {type(e).__name__}: {e}")
+    finally:
+        wd.cancel()
+
+
+def _run(plat: str) -> None:
     t0 = time.perf_counter()
     import jax
 

@@ -24,14 +24,19 @@ class GarbageCollectionController:
         self.clock = clock
 
     def reconcile(self) -> bool:
-        # ONE DescribeInstances per tick: both directions derive from the
-        # same snapshot (consistent view; half the non-mutating rate-limit
-        # pressure of two calls)
+        # Snapshot claims BEFORE DescribeInstances: for the claim-deletion
+        # direction, staleness then only means the live set GROWS after the
+        # claim list (an instance created concurrently is still visible),
+        # which can only make us keep a claim — never kill a healthy one.
+        # The opposite order had a window where an instance created between
+        # describe and the claim scan got its claim deleted (ADVICE r4).
+        claims = list(self.store.list(st.NODECLAIMS))
         instances = self.cloud.describe_instances()
         live = {i.id for i in instances}
+        now = self.clock()
         claim_ids = set()
         did = False
-        for c in self.store.list(st.NODECLAIMS):
+        for c in claims:
             if not c.provider_id:
                 continue
             iid = c.provider_id.rsplit("/", 1)[-1]
@@ -42,8 +47,16 @@ class GarbageCollectionController:
             # capacity the provisioner packs pending pods onto forever. The
             # reference's lifecycle gets this from CloudProvider.Get
             # returning NodeClaimNotFoundError; termination handles the
-            # finalizer drain (the node object is already gone).
-            if iid not in live and not c.meta.deleting:
+            # finalizer drain (the node object is already gone). Guarded by
+            # the same creation grace the reference puts on GC
+            # (garbagecollection/controller.go:57-60): a claim younger than
+            # grace_s may have an instance still materializing on the cloud
+            # side — never reap it on a single missing describe.
+            if (
+                iid not in live
+                and not c.meta.deleting
+                and now - c.meta.creation_timestamp >= self.grace_s
+            ):
                 try:
                     self.store.delete(st.NODECLAIMS, c.name)
                 except st.NotFound:

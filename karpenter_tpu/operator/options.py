@@ -67,6 +67,17 @@ class Options:
 
 def parse(argv: Optional[Sequence[str]] = None, cls=Options) -> Options:
     """argv > env (KARPENTER_*) > dataclass default."""
+    # KTPU_DEBUG_EVENTS rewires the solver kernel's `leftover` output to
+    # while-loop event counts at TRACE time (solver/tpu/ffd.py) — every
+    # solve in the process returns garbage placements. A perf session's
+    # leaked env var must never reach a serving operator: fail closed here,
+    # before any controller wiring.
+    if os.environ.get("KTPU_DEBUG_EVENTS", "").lower() in ("1", "true", "yes"):
+        raise SystemExit(
+            "refusing to start: KTPU_DEBUG_EVENTS is set — solver leftover "
+            "outputs would be event counts, not placements (unset it; the "
+            "flag exists only for offline kernel perf probes)"
+        )
     parser = argparse.ArgumentParser(prog="karpenter-tpu")
     for f in fields(cls):
         flag = "--" + f.name.replace("_", "-")

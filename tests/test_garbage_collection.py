@@ -1,0 +1,124 @@
+"""GC race-safety for the claim-deletion direction.
+
+The reference guards both GC directions against create/describe races with a
+CreationTimestamp grace (pkg/controllers/nodeclaim/garbagecollection/
+controller.go:57-60,85). Round-4 advisor finding: our claim-deletion
+direction snapshotted the cloud BEFORE listing claims and applied no grace,
+so a claim whose instance materialized between DescribeInstances and the
+claim scan was deleted while healthy. These tests pin the fix: claims are
+listed first (staleness only grows the live set) and young claims are never
+reaped on a single missing describe.
+"""
+
+import time
+
+from karpenter_tpu.api.objects import NodeClaim, ObjectMeta
+from karpenter_tpu.controllers import store as st
+from karpenter_tpu.controllers.garbagecollection import GarbageCollectionController
+from karpenter_tpu.kwok.cloud import Instance, KwokCloud
+
+from tests.test_e2e_kwok import FakeClock
+
+
+def _setup():
+    clock = FakeClock()
+    store = st.Store()
+    cloud = KwokCloud(store, [], clock=clock)
+    gc = GarbageCollectionController(store, cloud, grace_s=30.0, clock=clock)
+    return clock, store, cloud, gc
+
+
+def _mkclaim(name, iid, created_at):
+    return NodeClaim(
+        meta=ObjectMeta(name=name, uid=name, creation_timestamp=created_at),
+        provider_id=f"kwok://{iid}",
+        launched=True,
+    )
+
+
+def _mkinst(cloud, iid, launch_time):
+    inst = Instance(
+        id=iid, instance_type="t", zone="zone-1a", capacity_type="on-demand",
+        price=1.0, launch_time=launch_time,
+    )
+    cloud._instances[iid] = inst
+    return inst
+
+
+def test_young_claim_with_missing_instance_survives_grace():
+    clock, store, cloud, gc = _setup()
+    # claim just created; its CreateFleet may still be materializing
+    store.create(st.NODECLAIMS, _mkclaim("young", "i-young", clock()))
+    clock.advance(5)
+    gc.reconcile()
+    assert store.get(st.NODECLAIMS, "young") is not None
+
+    # once past grace with the instance still absent, it IS reaped
+    clock.advance(30)
+    gc.reconcile()
+    try:
+        got = store.get(st.NODECLAIMS, "young")
+    except st.NotFound:
+        got = None
+    assert got is None
+
+
+def test_old_claim_with_vanished_instance_deleted():
+    clock, store, cloud, gc = _setup()
+    store.create(st.NODECLAIMS, _mkclaim("old", "i-gone", clock() - 120))
+    gc.reconcile()
+    try:
+        got = store.get(st.NODECLAIMS, "old")
+    except st.NotFound:
+        got = None
+    assert got is None
+
+
+def test_instance_created_during_reconcile_keeps_claim():
+    """The exact advisor race: instance creation lands between the claim
+    scan and DescribeInstances. With claims listed FIRST, the late instance
+    is still visible to describe, so the (old, healthy) claim survives."""
+    clock, store, cloud, gc = _setup()
+    store.create(st.NODECLAIMS, _mkclaim("racy", "i-racy", clock() - 120))
+
+    orig_list = store.list
+
+    def list_then_create(kind):
+        out = orig_list(kind)
+        if kind == st.NODECLAIMS and "i-racy" not in cloud._instances:
+            _mkinst(cloud, "i-racy", clock())
+        return out
+
+    store.list = list_then_create
+    try:
+        gc.reconcile()
+    finally:
+        store.list = orig_list
+    assert store.get(st.NODECLAIMS, "racy") is not None
+    assert "i-racy" in {i.id for i in cloud.describe_instances()}
+
+
+def test_orphan_instance_terminated_after_grace():
+    clock, store, cloud, gc = _setup()
+    _mkinst(cloud, "i-orphan", clock())
+    gc.reconcile()  # young instance: kept
+    assert "i-orphan" in {i.id for i in cloud.describe_instances()}
+    clock.advance(31)
+    gc.reconcile()
+    assert "i-orphan" not in {
+        i.id for i in cloud.describe_instances() if i.state == "running"
+    }
+
+
+def test_debug_events_env_refuses_operator_start(monkeypatch):
+    """KTPU_DEBUG_EVENTS corrupts every solve in the process (solver/tpu/
+    ffd.py trace-time rewiring); the operator must fail closed (ADVICE r4)."""
+    import pytest
+
+    from karpenter_tpu.operator import options as opts
+
+    monkeypatch.setenv("KTPU_DEBUG_EVENTS", "1")
+    with pytest.raises(SystemExit):
+        opts.parse([])
+    monkeypatch.setenv("KTPU_DEBUG_EVENTS", "false")
+    assert opts.parse([]) is not None
