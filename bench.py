@@ -63,16 +63,21 @@ def wait_for_backend(attempts: int = None, timeout_s: float = None,
     attempts = attempts or int(os.environ.get("KTPU_BENCH_PROBE_ATTEMPTS", "4"))
     timeout_s = timeout_s or float(os.environ.get("KTPU_BENCH_PROBE_TIMEOUT_S", "150"))
     backoff_s = backoff_s or float(os.environ.get("KTPU_BENCH_PROBE_BACKOFF_S", "60"))
+    last = None
     for i in range(attempts):
         plat = probe_backend(timeout_s)
-        if plat:
+        if plat and not plat.startswith("cpu"):
             return plat
+        # cpu/* means the axon hook fell back to host (tunnel down-but-not-
+        # hung) — the most common outage mode; keep retrying it too. Track
+        # the FINAL attempt's state so main() reports how we actually ended.
+        last = plat
         if i < attempts - 1:
             wait = backoff_s * (i + 1)
-            print(f"[bench] retry {i + 1}/{attempts - 1} in {wait:.0f}s",
-                  file=sys.stderr)
+            print(f"[bench] got {plat!r}; retry {i + 1}/{attempts - 1} "
+                  f"in {wait:.0f}s", file=sys.stderr)
             time.sleep(wait)
-    return None
+    return last
 
 
 def build_input(num_pods: int = 50_000):
@@ -437,8 +442,11 @@ def main() -> None:
     import threading
 
     deadline_s = float(os.environ.get("KTPU_BENCH_DEADLINE_S", "2700"))
+    done = threading.Event()
 
     def _watchdog():
+        if done.is_set():
+            return  # run finished in the cancel window — don't double-emit
         _emit_unavailable(f"watchdog: bench exceeded {deadline_s:.0f}s "
                           "(tunnel likely hung mid-run)")
         sys.stdout.flush()
@@ -449,7 +457,9 @@ def main() -> None:
     wd.start()
     try:
         _run(plat)
+        done.set()
     except Exception as e:  # noqa: BLE001 — always leave a parseable line
+        done.set()
         _emit_unavailable(f"bench aborted: {type(e).__name__}: {e}")
     finally:
         wd.cancel()

@@ -36,7 +36,11 @@ class ObjectMeta:
     annotations: Dict[str, str] = field(default_factory=dict)
     finalizers: List[str] = field(default_factory=list)
     owner_refs: List[str] = field(default_factory=list)  # uids
-    creation_timestamp: float = field(default_factory=time.monotonic)
+    # None = "not yet persisted": Store.create stamps it from the store's
+    # injected clock, so age math (GC grace, disruption ranking, expiry)
+    # always compares against the same clock — a wall-clock default here
+    # silently breaks every sim-clock deployment (r5 review finding)
+    creation_timestamp: Optional[float] = None
     deletion_timestamp: Optional[float] = None
     resource_version: int = 0
 
@@ -350,7 +354,9 @@ class NodeClaim:
     registered: bool = False
     initialized: bool = False
     drifted: Optional[str] = None  # drift reason
-    last_transition: float = field(default_factory=time.monotonic)
+    # None = "not yet persisted" — Store.create stamps it (same sim-clock
+    # discipline as ObjectMeta.creation_timestamp)
+    last_transition: Optional[float] = None
 
     @property
     def name(self) -> str:

@@ -66,7 +66,7 @@ class GarbageCollectionController:
         for inst in instances:
             if inst.id in claim_ids:
                 continue
-            if self.clock() - inst.launch_time < self.grace_s:
+            if now - inst.launch_time < self.grace_s:
                 continue
             orphans.append(inst.id)
         if orphans:

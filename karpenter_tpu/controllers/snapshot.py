@@ -97,7 +97,8 @@ def restore_snapshot(store: st.Store, cloud, path: str, now: Optional[float] = N
     def rebase(obj) -> None:
         m = getattr(obj, "meta", None)
         if m is not None:
-            m.creation_timestamp += delta
+            if m.creation_timestamp is not None:
+                m.creation_timestamp += delta
             if m.deletion_timestamp:
                 m.deletion_timestamp += delta
         for f in ("last_transition", "launched_at", "registered_at"):

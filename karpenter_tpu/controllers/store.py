@@ -32,7 +32,8 @@ class NotFound(Exception):
 
 
 class Store:
-    def __init__(self):
+    def __init__(self, clock=time.monotonic):
+        self.clock = clock  # stamps creation_timestamp on create()
         self._lock = threading.RLock()
         self._objects: Dict[str, Dict[str, Any]] = defaultdict(dict)  # kind -> key -> obj
         self._watchers: List[Tuple[Optional[str], WatchFn]] = []
@@ -85,6 +86,13 @@ class Store:
             if key in self._objects[kind]:
                 raise Conflict(f"{kind} {key} already exists")
             obj.meta.resource_version = self._next_rv()
+            if obj.meta.creation_timestamp is None:
+                # the API-server stamp: every persisted object gets its age
+                # from the store's clock (callers may pre-stamp, e.g. the
+                # cloudprovider's instance-derived claims)
+                obj.meta.creation_timestamp = self.clock()
+            if getattr(obj, "last_transition", False) is None:
+                obj.last_transition = self.clock()
             self._objects[kind][key] = obj
             self._enqueue("ADDED", kind, obj)
         self._drain()

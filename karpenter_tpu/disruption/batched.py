@@ -117,15 +117,11 @@ class BatchedConsolidationEvaluator:
         enc = encode(quantize_input(inp))
         if enc.group_fallback.any() or enc.has_topology or enc.has_affinity or enc.G == 0:
             return None
-        if enc.q_kind is not None and (enc.q_kind == 2).any():
-            # positive hostname affinity: the kernel's bootstrap check reads
-            # GLOBAL member counts (sum of e_cm), and the batched evaluator
-            # removes candidate nodes only by compat-masking — a removed
-            # node hosting the sig's members would still suppress the
-            # bootstrap, wrongly rejecting the subset. No Q-axis analog of
-            # v_delta exists yet, so these universes take the sequential
-            # simulate path.
-            return None
+        # positive hostname affinity (kind 2) is handled on the batched path
+        # too: the evaluator zeroes removed nodes' node_q_member/node_q_owner
+        # ROWS per subset on device (consolidate._batched_ffd_core), so the
+        # kernel's global member sums (tot_m_q — the bootstrap check) match
+        # the sequential simulate's node deletion exactly.
 
         # Runs stay at NATURAL group granularity (enc.run_group/run_count):
         # same-group pods are fungible, so each subset is expressed as
@@ -167,10 +163,19 @@ class BatchedConsolidationEvaluator:
             n_dom = len(enc.v_domains) if enc.v_domains is not None else len(enc.zones)
             for cid, e in node_idx.items():
                 z = int(enc.v_node_domain[e])
-                if z < 0:
+                z2 = (
+                    int(enc.node_dom2[e]) if enc.node_dom2 is not None else -1
+                )
+                if z < 0 and z2 < 0:
                     continue
                 d = np.zeros((enc.V, n_dom), dtype=np.int32)
-                d[:, z] = enc.node_v_member[e]
+                if z >= 0:
+                    d[:, z] = enc.node_v_member[e]
+                if z2 >= 0:
+                    # mixed-axis universes: the node contributed to BOTH its
+                    # zone and its ct column (encode fills both) — subtract
+                    # both or ct-sig verdicts double-count removed pods
+                    d[:, z2] = enc.node_v_member[e]
                 if d.any():
                     v_delta[cid] = d
         return PreparedUniverse(

@@ -594,6 +594,7 @@ class DisruptionController:
                 name=name,
                 labels={wk.NODEPOOL_LABEL: claim_res.nodepool},
                 finalizers=[wk.TERMINATION_FINALIZER],
+                creation_timestamp=self.clock(),
             ),
             nodepool=claim_res.nodepool,
             node_class_ref=np_obj.template.node_class_ref,

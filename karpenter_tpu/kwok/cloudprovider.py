@@ -206,7 +206,10 @@ class KwokCloudProvider(CloudProvider):
 
         it = self._by_name.get(inst.instance_type)
         claim = NodeClaim(
-            meta=ObjectMeta(name=inst.tags.get("karpenter.sh/nodeclaim", inst.id)),
+            meta=ObjectMeta(
+                name=inst.tags.get("karpenter.sh/nodeclaim", inst.id),
+                creation_timestamp=inst.launch_time,
+            ),
             provider_id=f"kwok:///{inst.zone}/{inst.id}",
             instance_type=inst.instance_type,
             zone=inst.zone,

@@ -28,9 +28,10 @@ from ..metrics.registry import NODECLAIMS_CREATED, NODECLAIMS_TERMINATED
 class LaunchController:
     name = "nodeclaim.launch"
 
-    def __init__(self, store: st.Store, cloud_provider: CloudProvider):
+    def __init__(self, store: st.Store, cloud_provider: CloudProvider, clock=time.monotonic):
         self.store = store
         self.cloud_provider = cloud_provider
+        self.clock = clock
 
     def reconcile(self) -> bool:
         did = False
@@ -52,7 +53,7 @@ class LaunchController:
                 NODECLAIMS_TERMINATED.inc(nodepool=claim.nodepool, reason="insufficient_capacity")
                 did = True
                 continue
-            claim.last_transition = time.monotonic()
+            claim.last_transition = self.clock()
             self.store.update(st.NODECLAIMS, claim)
             did = True
         return did

@@ -70,6 +70,9 @@ def new_kwok_operator(
     shared_cloud: Optional[KwokCloud] = None,
 ) -> Operator:
     store = shared_store if shared_store is not None else st.Store()
+    # the operator's clock is authoritative for every age stamp, including a
+    # shared store/cloud handed in by an HA peer — one clock per deployment
+    store.clock = clock
     from ..api.validation import admission_validator
 
     store.set_validator(st.NODEPOOLS, admission_validator)
@@ -80,6 +83,7 @@ def new_kwok_operator(
         if shared_cloud is not None
         else KwokCloud(store, types, rate_limits=rate_limits, clock=clock)
     )
+    cloud.clock = clock  # same one-clock rule as store.clock above
     from ..providers.discovered import (
         DiscoveredCapacityCache,
         DiscoveredCapacityController,
@@ -133,7 +137,7 @@ def new_kwok_operator(
     manager.register(
         VolumeTopologyController(store),
         provisioner,
-        LaunchController(store, cloud_provider),
+        LaunchController(store, cloud_provider, clock=clock),
         RegistrationController(store, clock=clock),
         InitializationController(store, clock=clock),
         Binder(store, cluster),

@@ -168,6 +168,10 @@ class Provisioner:
                     labels={wk.NODEPOOL_LABEL: claim_res.nodepool},
                     annotations=annotations,
                     finalizers=[wk.TERMINATION_FINALIZER],
+                    # stamp from the injected clock, not the wall default:
+                    # GC grace and disruption age math compare against
+                    # self.clock(), which may be a sim clock
+                    creation_timestamp=self.clock(),
                 ),
                 nodepool=claim_res.nodepool,
                 node_class_ref=np_obj.template.node_class_ref,

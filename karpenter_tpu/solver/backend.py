@@ -199,6 +199,7 @@ def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
         core_args = hit[1]
     else:
         zone_col = np.zeros(D, dtype=np.uint32)
+        col_axis = np.zeros(D, dtype=np.int32)
         if enc.v_axis == "ct":
             # per-ct joint-bit columns: bit z*C+c for every z, in the
             # canonical domain order (enc.v_domain_perm — shared with the
@@ -207,6 +208,17 @@ def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
             for d, c in enumerate(lex):
                 for z in range(Z):
                     zone_col[d] |= np.uint32(1) << np.uint32(z * C + c)
+        elif enc.v_axis == "mixed":
+            # both axes concatenated: Z zone columns, then C lex-ordered ct
+            # columns — each column masks its value's joint bits
+            for z in range(Z):
+                for c in range(C):
+                    zone_col[z] |= np.uint32(1) << np.uint32(z * C + c)
+            ct_lex_idx = sorted(range(C), key=lambda i: enc.capacity_types[i])
+            for d, c in enumerate(ct_lex_idx):
+                col_axis[Z + d] = 1
+                for z in range(Z):
+                    zone_col[Z + d] |= np.uint32(1) << np.uint32(z * C + c)
         else:
             # per-zone joint-bit columns: bit z*C+c for every c
             for z in range(Z):
@@ -250,6 +262,12 @@ def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
             "v_primary": jnp.asarray(pad(enc.v_primary, (Gp,), fill=np.int32(-1))),
             "v_aff": jnp.asarray(pad(enc.v_aff, (Gp,), fill=np.int32(-1))),
             "zone_col_mask": jnp.asarray(zone_col),
+            "col_axis": jnp.asarray(col_axis),
+            "group_daxis": jnp.asarray(
+                pad(enc.group_daxis, (Gp,))
+                if enc.group_daxis is not None
+                else np.zeros(Gp, np.int32)
+            ),
         }
         if len(_CORE_ARGS_CACHE) >= _CORE_ARGS_CACHE_MAX:
             _CORE_ARGS_CACHE.pop(next(iter(_CORE_ARGS_CACHE)))
@@ -296,6 +314,13 @@ def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
             )
         ),
         ca["zone_col_mask"],
+        jnp.asarray(
+            pad(enc.node_dom2, (Ep,), fill=np.int32(-1))
+            if enc.node_dom2 is not None
+            else np.full(Ep, -1, np.int32)
+        ),
+        ca["col_axis"],
+        ca["group_daxis"],
     )
     from .tpu.ffd import ARG_SPEC
 
@@ -307,6 +332,7 @@ def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
         "pool_usage0", "node_free", "node_compat", "q_member", "q_owner", "q_kind",
         "q_cap", "node_q_member", "node_q_owner", "v_member", "v_owner", "v_kind",
         "v_cap", "v_primary", "v_aff", "v_count0", "node_zone", "zone_col_mask",
+        "node_dom2", "col_axis", "group_daxis",
     ], "kernel_args order out of sync with ffd.ARG_SPEC"
     dims = dict(
         S=S, G=G, T=T, E=E, P=P, R=R, Z=Z, C=C,
@@ -523,13 +549,14 @@ class TPUSolver(Solver):
             or enc.G == 0
         ):
             # Zone/capacity-type TSC+affinity and hostname constraints run
-            # on device (Q/V axes, tpu/ffd.py; ct via the domain-axis swap);
-            # what still routes the whole solve to the fallback chain:
-            # flagged fallback groups (OR'd node affinity, preferred terms,
-            # stacked domain constraints, ≥3-way custom-label conflicts),
-            # solves mixing zone- and ct-granular sigs, positive hostname
-            # affinity, custom-key spread, and duplicate node hostnames.
-            # Whole-solve fallback keeps semantics unforked.
+            # on device (Q/V axes, tpu/ffd.py; ct via the domain-axis swap;
+            # zone+ct MIXES via the concatenated-axis layout); what still
+            # routes the whole solve to the fallback chain: flagged fallback
+            # groups (OR'd node affinity, preferred terms, stacked domain
+            # constraints, single pods constrained on BOTH domain axes,
+            # ≥3-way custom-label conflicts), custom-key spread, and
+            # duplicate node hostnames. Whole-solve fallback keeps semantics
+            # unforked.
             self.stats["fallback_solves"] += 1
             return AsyncSolve(lambda: self.fallback.solve(qinp))
         handle = self._device_solve_async(enc)

@@ -21,11 +21,13 @@ tensors plus boolean constraint masks"). It performs:
 Pods the device kernel cannot express (OR'd node-affinity alternatives,
 preferred affinities needing relaxation, ScheduleAnyway TSCs under
 --preference-policy=Respect, custom-topology-key terms, stacked positive
-hostname terms, kind-2 groups that are also domain-constrained, or ≥3-way
-custom-label joint conflicts) are flagged `fallback` — the hybrid solver
-routes those to the reference path (see karpenter_tpu/solver/backend.py).
-Zone- and capacity-type-granular spread/affinity and positive hostname
-affinity all run ON DEVICE (V domain axis / Q kind 2).
+hostname terms, kind-2 groups that are also domain-constrained, single pods
+domain-constrained on BOTH the zone and ct axes, or ≥3-way custom-label
+joint conflicts) are flagged `fallback` — the hybrid solver routes those to
+the reference path (see karpenter_tpu/solver/backend.py). Zone- and
+capacity-type-granular spread/affinity run ON DEVICE — including solves
+MIXING the two axes (concatenated domain columns, per-group axis binding) —
+as does positive hostname affinity (V domain axis / Q kind 2).
 """
 
 from __future__ import annotations
@@ -247,6 +249,10 @@ class EncodedInput:
     v_axis: str = "zone"
     v_domains: Optional[List[str]] = None  # D axis values, lex order
     v_node_domain: Optional[np.ndarray] = None  # [E] int32 (-1 unknown)
+    # mixed-axis ("mixed") extras — see ffd.ARG_SPEC tail
+    sig_axis: Optional[np.ndarray] = None  # [V] i32 axis id per sig
+    group_daxis: Optional[np.ndarray] = None  # [G] i32 axis per group
+    node_dom2: Optional[np.ndarray] = None  # [E] i32 second-axis column (-1)
 
     @property
     def v_domain_perm(self) -> List[int]:
@@ -456,8 +462,10 @@ class _EncodeCore:
     has_topo: bool
     has_aff: bool
     hostname_sigs: Dict[tuple, int]
-    zone_sigs: Dict[tuple, int]
-    v_axis: str  # "zone" | "ct" — which axis the V sigs are granular over
+    zone_sigs: Dict[tuple, int]  # (axis, kind, sel_sig, cap) -> v index
+    v_axis: str  # "zone" | "ct" | "mixed" — domain-axis layout of the V sigs
+    sig_axis: np.ndarray  # [V] i32 — axis id per sig (0 zones, 1 cts)
+    group_daxis: np.ndarray  # [G] i32 — axis a constrained group's engine uses
     q_member: np.ndarray
     q_owner: np.ndarray
     q_kind: np.ndarray
@@ -735,43 +743,92 @@ def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
     # node→domain map — so capacity-type-granular constraints (the third of
     # the reference's exactly-three topology keys, scheduling.md:383-387)
     # run on the SAME engine by presenting the C axis as the domain axis.
-    # One solve drives one domain axis; a solve mixing zone- and ct-granular
-    # sigs falls back whole-solve (rare — the semantics would need two
-    # interleaved rotation states).
+    # A solve mixing zone- and ct-granular sigs runs with BOTH axes'
+    # columns concatenated on the domain axis ("mixed"): each sig and each
+    # constrained group binds to ONE axis (group_daxis), counts record per
+    # axis wherever a target's domain is determined, and only pods whose
+    # own constraint set genuinely spans both axes fall back.
     v_axis = "zone"
     if ct_sigs and zone_sigs:
-        has_topo = True
+        v_axis = "mixed"
     elif ct_sigs:
         v_axis = "ct"
-        zone_sigs = ct_sigs
-        group_zone_tscs = group_ct_tscs
-        group_zone_antis = group_ct_antis
-        group_zone_affs = group_ct_affs
 
-    # ---- zone-sig (V axis) tables ------------------------------------------
-    V = len(zone_sigs)
+    # normalize sigs to (axis, kind, sel, cap) keys; zone sigs keep their
+    # indices so single-axis solves stay bit- and shape-identical
+    if v_axis == "mixed":
+        vsigs = {(0,) + s: i for s, i in zone_sigs.items()}
+        off = len(zone_sigs)
+        vsigs.update({(1,) + s: off + i for s, i in ct_sigs.items()})
+        g_tscs = [
+            [(0,) + s for s in group_zone_tscs[g]]
+            + [(1,) + s for s in group_ct_tscs[g]]
+            for g in range(G)
+        ]
+        g_antis = [
+            [(0,) + s for s in group_zone_antis[g]]
+            + [(1,) + s for s in group_ct_antis[g]]
+            for g in range(G)
+        ]
+        g_affs = [
+            [(0,) + s for s in group_zone_affs[g]]
+            + [(1,) + s for s in group_ct_affs[g]]
+            for g in range(G)
+        ]
+    elif v_axis == "ct":
+        vsigs = {(0,) + s: i for s, i in ct_sigs.items()}
+        g_tscs = [[(0,) + s for s in group_ct_tscs[g]] for g in range(G)]
+        g_antis = [[(0,) + s for s in group_ct_antis[g]] for g in range(G)]
+        g_affs = [[(0,) + s for s in group_ct_affs[g]] for g in range(G)]
+    else:
+        vsigs = {(0,) + s: i for s, i in zone_sigs.items()}
+        g_tscs = [[(0,) + s for s in group_zone_tscs[g]] for g in range(G)]
+        g_antis = [[(0,) + s for s in group_zone_antis[g]] for g in range(G)]
+        g_affs = [[(0,) + s for s in group_zone_affs[g]] for g in range(G)]
+
+    # ---- domain-sig (V axis) tables -----------------------------------------
+    V = len(vsigs)
     v_member = np.zeros((G, V), dtype=bool)
     v_owner = np.zeros((G, V), dtype=bool)
     v_kind = np.zeros(V, dtype=np.int32)
     v_cap = np.zeros(V, dtype=np.int32)
+    sig_axis = np.zeros(V, dtype=np.int32)
     v_primary = np.full(G, -1, dtype=np.int32)
     v_aff = np.full(G, -1, dtype=np.int32)
-    for (kind, sel_sig, cap), v in zone_sigs.items():
+    group_daxis = np.zeros(G, dtype=np.int32)
+    for (ax, kind, sel_sig, cap), v in vsigs.items():
         v_kind[v] = kind
         v_cap[v] = cap
+        sig_axis[v] = ax
         sel = dict(sel_sig)
         for g, pl in enumerate(group_pods):
             if all(pl[0].meta.labels.get(k) == val for k, val in sel.items()):
                 v_member[g, v] = True
     for g in range(G):
-        for sig in group_zone_tscs[g]:
-            v_owner[g, zone_sigs[sig]] = True
-            v_primary[g] = zone_sigs[sig]
-        for sig in group_zone_antis[g]:
-            v_owner[g, zone_sigs[sig]] = True
-        for sig in group_zone_affs[g]:
-            v_owner[g, zone_sigs[sig]] = True
-            v_aff[g] = zone_sigs[sig]
+        axes = set()
+        for sig in g_tscs[g]:
+            v_owner[g, vsigs[sig]] = True
+            v_primary[g] = vsigs[sig]
+            axes.add(sig[0])
+        for sig in g_antis[g]:
+            v_owner[g, vsigs[sig]] = True
+            axes.add(sig[0])
+        for sig in g_affs[g]:
+            v_owner[g, vsigs[sig]] = True
+            v_aff[g] = vsigs[sig]
+            axes.add(sig[0])
+        # a membership in an anti sig blocks domains on that sig's axis —
+        # it binds the group to the axis just like ownership does
+        for v in range(V):
+            if v_member[g, v] and v_kind[v] == 1:
+                axes.add(int(sig_axis[v]))
+        if len(axes) > 1:
+            # genuinely two-axis pod (e.g. zone TSC + ct spread on ONE pod,
+            # or zone-constrained while a ct anti selects it): the engine
+            # drives one rotation state per group — oracle handles it
+            fallback[g] = True
+        elif axes:
+            group_daxis[g] = axes.pop()
     # kind-2 hostname affinity is implemented in the FAST branch only (the
     # one-claim bootstrap budget is not threaded through the zoned event
     # engine's open paths): a group owning one that is ALSO domain-
@@ -978,8 +1035,10 @@ def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
         has_topo=has_topo,
         has_aff=has_aff,
         hostname_sigs=hostname_sigs,
-        zone_sigs=zone_sigs,
+        zone_sigs=vsigs,
         v_axis=v_axis,
+        sig_axis=sig_axis,
+        group_daxis=group_daxis,
         q_member=q_member,
         q_owner=q_owner,
         q_kind=q_kind,
@@ -1047,20 +1106,30 @@ def _encode_with_nodes(core: _EncodeCore, inp: SolverInput) -> EncodedInput:
         hostnames = [node_hostname(n) for n in inp.nodes]
         if len(set(hostnames)) < len(hostnames):
             has_topo = True
-    # domain axis for the V sigs: zone (default) or capacity-type, in LEX
-    # order — the engine's index-order tiebreaks must match the oracle's
-    # string-lex domain tiebreaks (scheduler._affinity_admits / commit rules)
+    # domain axis for the V sigs: zone (default), capacity-type, or BOTH
+    # concatenated ("mixed": zone columns then lex-ordered ct columns) — the
+    # engine's index-order tiebreaks must match the oracle's string-lex
+    # domain tiebreaks (scheduler._affinity_admits / commit rules)
+    ct_lex = sorted(cts)
+    ct_rank = {c: i for i, c in enumerate(ct_lex)}
+    Zc = len(zones)
     if core.v_axis == "ct":
-        v_domains = sorted(cts)
-        dom_rank = {c: i for i, c in enumerate(v_domains)}
+        v_domains = ct_lex
+        dom_rank = dict(ct_rank)
         node_domain_of = lambda n: dom_rank.get(
             n.labels.get(wk.CAPACITY_TYPE_LABEL, ""), -1
         )
+    elif core.v_axis == "mixed":
+        v_domains = list(zones) + ct_lex
+        dom_rank = {z: i for i, z in enumerate(zones)}
+        node_domain_of = lambda n: dom_rank.get(n.labels.get(wk.ZONE_LABEL, ""), -1)
     else:
         v_domains = list(zones)
         dom_rank = {z: i for i, z in enumerate(v_domains)}
         node_domain_of = lambda n: dom_rank.get(n.labels.get(wk.ZONE_LABEL, ""), -1)
     v_node_domain = np.full(E, -1, dtype=np.int32)
+    # second-axis column per node (mixed only): Z + lex rank of its ct
+    node_dom2 = np.full(E, -1, dtype=np.int32)
     v_count0 = np.zeros((V, len(v_domains)), dtype=np.int32)
     node_v_member = np.zeros((E, V), dtype=np.int32)
     zsig_list = sorted(zone_sigs.items(), key=lambda kv: kv[1])
@@ -1071,19 +1140,28 @@ def _encode_with_nodes(core: _EncodeCore, inp: SolverInput) -> EncodedInput:
         node_zone[e] = zid.get(n.labels.get(wk.ZONE_LABEL, ""), -1)
         node_ct[e] = cid.get(n.labels.get(wk.CAPACITY_TYPE_LABEL, ""), -1)
         v_node_domain[e] = node_domain_of(n)
+        if core.v_axis == "mixed":
+            cr = ct_rank.get(n.labels.get(wk.CAPACITY_TYPE_LABEL, ""), -1)
+            node_dom2[e] = Zc + cr if cr >= 0 else -1
         for (kind, sel_sig, cap), q in sig_list:
             sel = dict(sel_sig)
             node_q_member[e, q] = sum(
                 1 for pl in n.pod_labels if all(pl.get(k) == v for k, v in sel.items())
             )
-        if v_node_domain[e] >= 0:
-            for (kind, sel_sig, cap), v in zsig_list:
+        if v_node_domain[e] >= 0 or node_dom2[e] >= 0:
+            for (ax, kind, sel_sig, cap), v in zsig_list:
                 sel = dict(sel_sig)
                 cnt = sum(
                     1 for pl in n.pod_labels if all(pl.get(k) == vv for k, vv in sel.items())
                 )
                 node_v_member[e, v] = cnt
-                v_count0[v, v_node_domain[e]] += cnt
+                # a node's domains are all determined, so its bound pods
+                # count on EVERY axis column it maps to (oracle: a node
+                # placement records every topology key)
+                if v_node_domain[e] >= 0:
+                    v_count0[v, v_node_domain[e]] += cnt
+                if node_dom2[e] >= 0:
+                    v_count0[v, node_dom2[e]] += cnt
         if not n.schedulable:
             continue
         # Node-profile dedupe: strictly_compatible only reads the labels at
@@ -1162,4 +1240,7 @@ def _encode_with_nodes(core: _EncodeCore, inp: SolverInput) -> EncodedInput:
         v_axis=core.v_axis,
         v_domains=v_domains,
         v_node_domain=v_node_domain,
+        sig_axis=core.sig_axis,
+        group_daxis=core.group_daxis,
+        node_dom2=node_dom2,
     )

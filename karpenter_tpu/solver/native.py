@@ -158,7 +158,11 @@ class NativeSolver(Solver):
             or enc.has_topology
             or enc.has_affinity
             or enc.G == 0
+            or enc.v_axis == "mixed"
         ):
+            # (mixed zone+ct domain sigs run on the DEVICE kernel's
+            # concatenated-axis path; the C++ core still drives a single
+            # domain axis, so those solves replay on the oracle here)
             # hostname (Q, incl. kind-2 positive affinity), zone/ct-domain
             # (V) constraints all run in the native core; what still routes
             # to the oracle is the same set the device kernel can't express

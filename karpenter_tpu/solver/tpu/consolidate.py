@@ -50,6 +50,8 @@ from .ffd import ARG_INDEX, ffd_solve
 _RUN_COUNT = ARG_INDEX["run_count"]
 _NODE_COMPAT = ARG_INDEX["node_compat"]
 _V_COUNT0 = ARG_INDEX["v_count0"]
+_NODE_QM = ARG_INDEX["node_q_member"]
+_NODE_QO = ARG_INDEX["node_q_owner"]
 
 
 def _batched_ffd_core(
@@ -72,6 +74,16 @@ def _batched_ffd_core(
         args[_RUN_COUNT] = rc
         args[_NODE_COMPAT] = node_compat & ~removed[None, :]
         args[_V_COUNT0] = vc0
+        # Q-axis analog of the v_count0 subtraction: kind-2 (positive
+        # hostname affinity) reads GLOBAL member sums (tot_m_q = Σ e_cm) for
+        # its bootstrap check, so a removed node's members must vanish from
+        # the counts exactly as the sequential simulate deletes the node
+        # object. Zeroing the removed ROWS is sufficient — every other Q
+        # read is per-row and removed rows are already compat-masked out of
+        # targeting.
+        keep = (~removed)[:, None]
+        args[_NODE_QM] = shared_args[_NODE_QM] * keep
+        args[_NODE_QO] = shared_args[_NODE_QO] * keep
         return ffd_solve.__wrapped__(
             *args,
             max_claims=max_claims,

@@ -128,6 +128,15 @@ ARG_SPEC = (
     "v_count0",
     "node_zone",
     "zone_col_mask",
+    # mixed-axis support: the domain columns may concatenate TWO axes
+    # (zones then capacity types). node_dom2 is each node's second-axis
+    # column (-1 on single-axis solves), col_axis labels every column with
+    # its axis id, and group_daxis picks which axis a constrained group's
+    # event engine runs over (its owned/anti sigs are single-axis by
+    # encode's fallback rules; genuinely two-axis pods stay on the oracle).
+    "node_dom2",
+    "col_axis",
+    "group_daxis",
 )
 
 ARG_INDEX = {name: i for i, name in enumerate(ARG_SPEC)}
@@ -289,6 +298,9 @@ def ffd_solve(
     v_count0,  # [V, Z] i32
     node_zone,  # [E] i32 — zone index per node (-1 unknown)
     zone_col_mask,  # [Z] u32 — joint-bit columns per zone
+    node_dom2,  # [E] i32 — second-axis domain column (-1 single-axis)
+    col_axis,  # [Z] i32 — axis id per domain column (0 zones, 1 cts)
+    group_daxis,  # [G] i32 — domain axis a constrained group's engine runs on
     *,
     max_claims: int,
     emit_takes: bool = True,
@@ -325,7 +337,13 @@ def ffd_solve(
         c_vo=jnp.zeros((M, V), bool),
     )
 
-    e_zone_1h = node_zone[:, None] == zidx[None, :]  # [E, Z]
+    # a node marks its column on EVERY axis (its zone and, under mixed-axis
+    # solves, its capacity type) — matching the oracle, which records every
+    # determined topology key of a placement target
+    e_zone_1h = (node_zone[:, None] == zidx[None, :]) | (
+        node_dom2[:, None] == zidx[None, :]
+    )  # [E, Z]
+    axis_cols = col_axis[None, :] == jnp.arange(2, dtype=jnp.int32)[:, None]  # [2, Z]
 
     def zone_sets(bits):
         """[...] u32 joint bits -> [..., Z] bool zone marginals."""
@@ -364,14 +382,18 @@ def ffd_solve(
         boot_ok = jnp.all(~owned2 | (member_g & (tot_m_q == 0)))
 
         def count_contrib(take_e, take_c, c_zc_after):
-            """[Z] recorded-pod count deltas: node zones + single-zone claims
-            (multi-zone claims record no zone domain — SPEC.md)."""
+            """[Z] recorded-pod count deltas: node domains + claims whose
+            domain is determined PER AXIS (a claim multi-valued on an axis
+            records no count on that axis — SPEC.md / the oracle's
+            domains.get(key) is None rule)."""
             contrib = jnp.sum(take_e[:, None] * e_zone_1h, axis=0)  # [Z]
             cz = zone_sets(c_zc_after)  # [M, Z]
-            single = jnp.sum(cz, axis=1) == 1
-            contrib = contrib + jnp.sum(
-                take_c[:, None] * (cz & single[:, None]), axis=0
-            )
+            rec = jnp.zeros_like(cz)
+            for a in range(2):
+                axm = axis_cols[a]  # [Z]
+                single_a = jnp.sum(cz & axm[None, :], axis=1) == 1
+                rec = rec | (cz & axm[None, :] & single_a[:, None])
+            contrib = contrib + jnp.sum(take_c[:, None] * rec, axis=0)
             return contrib.astype(jnp.int32)
 
         # =================================================================
@@ -611,7 +633,14 @@ def ffd_solve(
         # ZONE branch: the event engine (SPEC.md topology/affinity rules)
         # =================================================================
         def zoned(st: FFDState):
-            gz_zones = zone_sets(g_zc[None])[0]  # [Z] group's own zone admission
+            # the group's event engine runs over ONE axis's columns; its
+            # admission marginals and node domains restrict to that axis
+            # (encode guarantees owned/anti sigs of a device group are
+            # single-axis — two-axis pods are fallback groups)
+            g_ax = group_daxis[g]
+            gax_cols = col_axis == g_ax  # [Z]
+            nd = jnp.where(g_ax == 0, node_zone, node_dom2)  # [E]
+            gz_zones = zone_sets(g_zc[None])[0] & gax_cols  # [Z] group's own zone admission
             psig_g = v_primary[g]
             has_tsc = psig_g >= 0
             psig = jnp.clip(psig_g, 0, V - 1)
@@ -675,13 +704,13 @@ def ffd_solve(
                 e_fit = _fit_count(node_free, e_cum, req)
                 e_host = _hostname_allowance(e_cm, e_co, q_kind, q_cap, member_g, owner_g)
                 nz_ok = jnp.where(
-                    node_zone >= 0, A[jnp.clip(node_zone, 0, Z - 1)], ~has_owned
+                    nd >= 0, A[jnp.clip(nd, 0, Z - 1)], ~has_owned
                 )
                 elig_e_base = node_compat[g] & (e_fit > 0) & (e_host > 0)
                 elig_e = elig_e_base & nz_ok
                 found_e = jnp.any(elig_e)
                 e_star = jnp.argmax(elig_e)
-                z_e = node_zone[e_star]
+                z_e = nd[e_star]
 
                 # ---- open-claim candidates --------------------------------
                 # claim-local affinity: a co-located matching pod satisfies a
@@ -693,7 +722,7 @@ def ffd_solve(
                 ) & jnp.all(~member_anti[None, :] | ~c_vo_st, axis=1)  # [M]
 
                 cz = zone_sets(c_zc_bits)  # [M, Z]
-                zcount_m = jnp.sum(cz, axis=1)
+                zcount_m = jnp.sum(cz & gax_cols[None, :], axis=1)
                 A_m = jnp.where(local_aff[:, None], A_base[None, :], A[None, :])
                 inter = cz & A_m  # [M, Z]
                 has_inter = jnp.any(inter, axis=1)
@@ -741,7 +770,7 @@ def ffd_solve(
                 elig_m = (k_m > 0) & (c_host > 0)
                 found_c = jnp.any(elig_m)
                 m_star = jnp.argmax(elig_m)
-                fin_z = zone_sets(bits_eff[m_star][None])[0]  # [Z]
+                fin_z = zone_sets(bits_eff[m_star][None])[0] & gax_cols  # [Z]
                 nz_fin = jnp.sum(fin_z)
                 z_c = jnp.argmax(fin_z).astype(jnp.int32)
 
@@ -870,7 +899,7 @@ def ffd_solve(
                 )
                 found_p = jnp.any(elig_p)
                 p_star = jnp.argmax(elig_p)
-                fin_zp = zone_sets(nbits_p[p_star][None])[0]
+                fin_zp = zone_sets(nbits_p[p_star][None])[0] & gax_cols
                 nz_fin_p = jnp.sum(fin_zp)
                 z_p = jnp.argmax(fin_zp).astype(jnp.int32)
                 Bz_p = jnp.where(
@@ -905,7 +934,7 @@ def ffd_solve(
                 # MULTI-zone, no pour records a zone count (count_contrib
                 # single-zone rule) — any_present stays false throughout, so
                 # the whole drain is mode-stable
-                ze_cnt = jnp.sum(zone_sets(bits_eff), axis=1)  # [M]
+                ze_cnt = jnp.sum(zone_sets(bits_eff) & gax_cols[None, :], axis=1)  # [M]
                 aff_zonefree = (
                     ~any_present & is_member_a
                     & jnp.all(~elig_m | (ze_cnt > 1)) & (nz_fin_p > 1)
@@ -950,7 +979,7 @@ def ffd_solve(
                 tgt_e_1h = jnp.zeros((E,), bool)
                 tgt_c_1h = jnp.zeros((M,), bool)
                 for z in range(Z):
-                    elig_ez = elig_e & (node_zone == z)
+                    elig_ez = elig_e & (nd == z)
                     found_ez = jnp.any(elig_ez)
                     e_z = jnp.argmax(elig_ez)
                     cap_ez = jnp.minimum(e_fit[e_z], e_host[e_z])
@@ -1330,13 +1359,16 @@ def ffd_solve(
                 )
                 used = used + jnp.where(mega_ok, n_mega, 0)
 
-                # zone-count recording (take_c_add excludes new claims —
-                # their recorded zones add separately)
-                contrib = count_contrib(take_e_add, take_c_add, c_zc_bits)
-                contrib = contrib + jnp.where(
-                    use_p & (nz_fin_p == 1), jnp.where(zidx == z_p, jnp.sum(tq), 0), 0
-                ).astype(jnp.int32)
-                contrib = contrib + jnp.where(mega_ok, T_zv, 0).astype(jnp.int32)
+                # domain-count recording: one unified pass over the POST-
+                # update claim bits — open-claim pours, water-fill drains,
+                # fresh opens, and mega slots all record exactly where their
+                # final bits are single per axis (count_contrib's rule), which
+                # reproduces the old z_p/T_zv special cases on the group's
+                # axis and additionally records other-axis counts for claims
+                # that happen to be determined there (mixed-axis solves)
+                contrib = count_contrib(
+                    take_e_add, take_c_add + drain_m + tq + take_mega, c_zc_bits
+                )
                 v_count = v_count + member_v.astype(jnp.int32)[:, None] * contrib[None, :]
                 # anti-owner registration keys on the target's recorded zone,
                 # member or not (the oracle registers owned terms' domains)
