@@ -1,0 +1,119 @@
+"""Cross-process leader-lease transport: flock'd JSON file.
+
+The reference's standbys are separate PODS contending a kube Lease
+(charts/karpenter/values.yaml `replicas: 2`, Makefile:56
+DISABLE_LEADER_ELECTION); this framework's in-process store cannot span OS
+processes, so the lease gets its own minimal transport: one JSON file whose
+every read-modify-write happens under an exclusive POSIX flock on a sidecar
+lock file. The backend implements exactly the store surface LeaderElector
+touches (try_get / create / update_if raising Conflict) — resource_version
+increments under the file lock, so two processes CASing the lease serialize
+the same way two threads do on the in-process store, and kill -9 of the
+holder releases nothing (the standby waits out lease_duration_s, exactly
+like kube leases).
+
+Timebase: renew_time in the file must be comparable ACROSS processes, so
+file-backed electors run on time.time() (wall), not time.monotonic() —
+new_kwok_operator wires that when lease_path is set.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import tempfile
+from contextlib import contextmanager
+from typing import Optional
+
+from ..api.objects import ObjectMeta
+from . import store as st
+from .leaderelection import LEADER_LEASE_NAME, Lease
+
+
+class FileLeaseBackend:
+    def __init__(self, path: str):
+        self.path = path
+        self.lock_path = path + ".lock"
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        os.makedirs(d, exist_ok=True)
+
+    @contextmanager
+    def _locked(self):
+        with open(self.lock_path, "a+") as lf:
+            fcntl.flock(lf.fileno(), fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(lf.fileno(), fcntl.LOCK_UN)
+
+    def _read(self) -> Optional[Lease]:
+        try:
+            with open(self.path) as f:
+                d = json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            # a torn file cannot happen (atomic rename); missing = no lease
+            return None
+        lease = Lease(
+            meta=ObjectMeta(
+                name=d.get("name", LEADER_LEASE_NAME),
+                resource_version=int(d.get("rv", 0)),
+                creation_timestamp=d.get("created", 0.0),
+            ),
+            holder=d.get("holder", ""),
+            renew_time=float(d.get("renew_time", 0.0)),
+            lease_duration_s=float(d.get("lease_duration_s", 15.0)),
+        )
+        return lease
+
+    def _write(self, lease: Lease) -> None:
+        d = os.path.dirname(os.path.abspath(self.path)) or "."
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".lease-")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(
+                    {
+                        "name": lease.meta.name,
+                        "rv": lease.meta.resource_version,
+                        "created": lease.meta.creation_timestamp,
+                        "holder": lease.holder,
+                        "renew_time": lease.renew_time,
+                        "lease_duration_s": lease.lease_duration_s,
+                    },
+                    f,
+                )
+            os.replace(tmp, self.path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    # -- the LeaderElector store surface ------------------------------------
+
+    def try_get(self, kind: str, name: str):
+        with self._locked():
+            return self._read()
+
+    def create(self, kind: str, obj: Lease):
+        with self._locked():
+            if self._read() is not None:
+                raise st.Conflict(f"{kind} {obj.meta.name} already exists")
+            obj.meta.resource_version = 1
+            if obj.meta.creation_timestamp is None:
+                obj.meta.creation_timestamp = obj.renew_time
+            self._write(obj)
+            return obj
+
+    def update_if(self, kind: str, obj: Lease, expected_rv: int):
+        with self._locked():
+            cur = self._read()
+            if cur is None:
+                raise st.NotFound(f"{kind} {obj.meta.name}")
+            if cur.meta.resource_version != expected_rv:
+                raise st.Conflict(
+                    f"{kind} {obj.meta.name}: rv {cur.meta.resource_version} != {expected_rv}"
+                )
+            obj.meta.resource_version = expected_rv + 1
+            if obj.meta.creation_timestamp is None:
+                obj.meta.creation_timestamp = cur.meta.creation_timestamp
+            self._write(obj)
+            return obj

@@ -64,6 +64,17 @@ class LeaderElector:
         self.renew_s = renew_s
         self.clock = clock
         self._leading = False
+        # True when the CURRENT leadership was seized from ANOTHER holder
+        # (expired or resigned lease) — a real failover. False on fresh
+        # creation and identity-match reclaim. The manager's on_elected hook
+        # (snapshot re-hydration) keys on this so an initial acquisition
+        # never clear-restores over freshly injected objects.
+        self.takeover = False
+        # fencing token for shared-state writers (snapshot): the lease
+        # resource version observed at our last successful acquire/renew.
+        # Strictly increases across acquisitions, so a deposed leader's
+        # stale token loses against the new leader's writes.
+        self.fence_token = 0
 
     def is_leader(self) -> bool:
         return self._leading
@@ -80,6 +91,7 @@ class LeaderElector:
         )
         try:
             self.store.update_if(LEASES, fresh, lease.meta.resource_version)
+            self.fence_token = fresh.meta.resource_version
             return True
         except (st.Conflict, st.NotFound):
             return False
@@ -91,7 +103,7 @@ class LeaderElector:
         was = self._leading
         if lease is None:
             try:
-                self.store.create(
+                created = self.store.create(
                     LEASES,
                     Lease(
                         meta=ObjectMeta(name=LEADER_LEASE_NAME),
@@ -101,6 +113,9 @@ class LeaderElector:
                     ),
                 )
                 self._leading = True
+                self.takeover = False  # fresh lease: nobody to take from
+                if created is not None:
+                    self.fence_token = created.meta.resource_version
             except st.Conflict:
                 self._leading = False  # lost the creation race
         elif lease.holder == self.identity:
@@ -114,9 +129,14 @@ class LeaderElector:
                 self._leading = self._cas(lease, self.identity, now)
             else:
                 self._leading = True
+            if self._leading and was != self._leading:
+                self.takeover = False  # our own lease — reclaim, not failover
         elif now - lease.renew_time > lease.lease_duration_s:
-            # expired: take over; CAS loser stays standby
+            # expired (or resigned): seize from the previous holder; CAS
+            # loser stays standby
             self._leading = self._cas(lease, self.identity, now)
+            if self._leading:
+                self.takeover = True
         else:
             self._leading = False
         LEADER.set(1.0 if self._leading else 0.0, identity=self.identity)

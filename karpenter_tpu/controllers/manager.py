@@ -25,13 +25,17 @@ class Controller(Protocol):
 
 
 class Manager:
-    def __init__(self, elector=None):
+    def __init__(self, elector=None, on_elected: Callable[[], None] = None):
         self.controllers: List[Controller] = []
         self._stop = threading.Event()
         # lease-based leader election (controllers/leaderelection.py):
         # standbys tick the elector but run nothing until they take over —
         # the reference's singleton-controller HA model (settings.md:21)
         self.elector = elector
+        # fires on every standby->leader transition BEFORE controllers run
+        # (the operator wires snapshot re-hydration here so a takeover
+        # resumes the dead leader's claims instead of duplicating them)
+        self.on_elected = on_elected
 
     def register(self, *controllers: Controller) -> None:
         self.controllers.extend(controllers)
@@ -39,9 +43,25 @@ class Manager:
     def tick(self) -> bool:
         did = False
         if self.elector is not None:
-            self.elector.tick()
+            changed = self.elector.tick()
             if not self.elector.is_leader():
                 return False
+            if (
+                changed
+                and self.on_elected is not None
+                and getattr(self.elector, "takeover", True)
+            ):
+                # takeover=False (fresh lease / own-lease reclaim) skips the
+                # hook: an initial acquisition must not clear-restore over
+                # objects injected between construction and the first tick
+                try:
+                    self.on_elected()
+                except Exception as e:  # noqa: BLE001 — lead anyway
+                    import logging
+
+                    logging.getLogger("karpenter_tpu").exception(
+                        "on_elected hook: %s", e
+                    )
         for c in self.controllers:
             try:
                 did = bool(c.reconcile()) or did

@@ -37,7 +37,10 @@ SNAPSHOT_KINDS = (
 )
 
 
-def save_snapshot(store: st.Store, cloud, path: str, now: Optional[float] = None) -> None:
+def save_snapshot(
+    store: st.Store, cloud, path: str, now: Optional[float] = None,
+    fence_token: Optional[int] = None,
+) -> bool:
     """Atomic snapshot (tmp + rename): store kinds + cloud instances.
 
     Serialization happens WHILE both locks are held — the collected lists
@@ -71,14 +74,46 @@ def save_snapshot(store: st.Store, cloud, path: str, now: Optional[float] = None
     try:
         with os.fdopen(fd, "wb") as f:
             f.write(payload)
-        os.replace(tmp, path)
+        if fence_token is None:
+            os.replace(tmp, path)
+            return True
+        # Fenced write (HA shared state): a deposed leader's in-flight save
+        # must not clobber the new leader's snapshots. The fence token is
+        # the writer's lease resource version — strictly higher for every
+        # later acquisition — compared and advanced under a flock, so
+        # compare + rename are one atomic step (r5 review finding).
+        import fcntl
+
+        with open(path + ".fence", "a+") as ff:
+            fcntl.flock(ff.fileno(), fcntl.LOCK_EX)
+            try:
+                ff.seek(0)
+                raw = ff.read().strip()
+                cur = int(raw) if raw else -1
+                if cur > fence_token:
+                    return False  # we were deposed; drop the stale snapshot
+                os.replace(tmp, path)
+                ff.seek(0)
+                ff.truncate()
+                ff.write(str(fence_token))
+                ff.flush()
+            finally:
+                fcntl.flock(ff.fileno(), fcntl.LOCK_UN)
+        return True
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
 
 
-def restore_snapshot(store: st.Store, cloud, path: str, now: Optional[float] = None) -> bool:
+def restore_snapshot(
+    store: st.Store, cloud, path: str, now: Optional[float] = None,
+    clear: bool = False,
+) -> bool:
     """Hydrate an EMPTY store + cloud from a snapshot file; True on restore.
+    `clear=True` replaces the snapshot kinds (and the instance map)
+    wholesale instead of merging by key — the HA-takeover mode, where the
+    restoring standby may hold a stale boot-time restore whose deletions
+    must not linger.
 
     Persisted timestamps are CLOCK_MONOTONIC values from the dead process —
     meaningless on a rebooted machine. Every known timestamp field is rebased
@@ -108,6 +143,8 @@ def restore_snapshot(store: st.Store, cloud, path: str, now: Optional[float] = N
 
     with store._lock:
         for kind, objs in payload["objects"].items():
+            if clear:
+                store._objects[kind] = {}
             for obj in objs:
                 rebase(obj)
                 store._objects[kind][store._key(obj)] = obj
@@ -115,6 +152,8 @@ def restore_snapshot(store: st.Store, cloud, path: str, now: Optional[float] = N
     with cloud._lock:
         for inst in payload["instances"].values():
             inst.launch_time += delta
+        if clear:
+            cloud._instances = {}
         cloud._instances.update(payload["instances"])
         import itertools
 
@@ -129,12 +168,13 @@ class SnapshotController:
     name = "snapshot"
 
     def __init__(self, store: st.Store, cloud, path: str, interval_s: float = 5.0,
-                 clock=time.monotonic):
+                 clock=time.monotonic, fence=None):
         self.store = store
         self.cloud = cloud
         self.path = path
         self.interval_s = interval_s
         self.clock = clock
+        self.fence = fence  # callable -> current lease fence token (HA)
         self._last: Optional[float] = None
         self._last_rv: int = -1
 
@@ -148,7 +188,10 @@ class SnapshotController:
         if rv == self._last_rv:
             self._last = now
             return False
-        save_snapshot(self.store, self.cloud, self.path, now=now)
+        save_snapshot(
+            self.store, self.cloud, self.path, now=now,
+            fence_token=self.fence() if self.fence is not None else None,
+        )
         self._last = now
         self._last_rv = rv
         return False  # snapshots are not cluster progress

@@ -55,7 +55,20 @@ DEFAULT_VALUES: Dict[str, Any] = {
         "leaderElect": True,
         "solverBackend": "tpu",
         "warmStart": True,
+        # HA shared state: replicas contend the flock'd lease and the
+        # takeover re-hydrates from the snapshot — both live on the shared
+        # state volume mounted below (controllers/filelease.py)
+        "snapshotPath": "/var/lib/karpenter/state.snap",
+        "leasePath": "/var/lib/karpenter/leader.lease",
     },
+    # Both replicas (spread across hosts) mount this ReadWriteMany volume.
+    # The storage class MUST be named and RWX-capable — the render refuses
+    # an empty name rather than silently falling back to the cluster default
+    # StorageClass, which is commonly RWO-only (EBS/PD) and would leave both
+    # replicas Pending. Set it to your cluster's RWX class (NFS/Filestore/
+    # EFS/CephFS). To run without HA state, set stateVolume to null AND
+    # clear settings.snapshotPath/leasePath (render enforces consistency).
+    "stateVolume": {"storageClassName": "shared-rwx", "size": "1Gi"},
 }
 
 _OPTION_FIELDS = {f.name: f for f in fields(Options)}
@@ -157,6 +170,41 @@ def render(overrides: Optional[Dict[str, Any]] = None) -> List[Dict[str, Any]]:
             },
         }
     )
+    state_vol = v.get("stateVolume")
+    if not state_vol and (
+        v["settings"].get("leasePath") or v["settings"].get("snapshotPath")
+    ):
+        # each replica would get a container-LOCAL lease file -> both lead ->
+        # duplicate capacity. Fail the render instead of shipping split-brain.
+        raise ValueError(
+            "stateVolume is disabled but settings.leasePath/snapshotPath are "
+            "set: without the shared volume every replica leases against its "
+            "own filesystem. Clear both settings or keep stateVolume."
+        )
+    if state_vol and not state_vol.get("storageClassName"):
+        raise ValueError(
+            "stateVolume.storageClassName must name an RWX-capable class: "
+            "falling back to the cluster default StorageClass (commonly "
+            "RWO-only) would leave every replica Pending. Name your NFS/"
+            "Filestore/EFS/CephFS class, or disable stateVolume (and clear "
+            "settings.leasePath/snapshotPath) to run without HA state."
+        )
+    if state_vol:
+        # shared HA state: lease file + snapshot on one RWX volume — two
+        # replicas on different hosts (the topology spread below) contend
+        # the same flock'd lease and the takeover restores the same snapshot
+        pvc = {
+            "apiVersion": "v1",
+            "kind": "PersistentVolumeClaim",
+            "metadata": _meta(f"{name}-state", v),
+            "spec": {
+                "accessModes": ["ReadWriteMany"],
+                "resources": {"requests": {"storage": state_vol["size"]}},
+            },
+        }
+        if state_vol.get("storageClassName"):
+            pvc["spec"]["storageClassName"] = state_vol["storageClassName"]
+        out.append(pvc)
     env = settings_env(v["settings"]) + list(v["controller"]["env"])
     probe_port = opts.health_probe_port
     out.append(
@@ -217,8 +265,34 @@ def render(overrides: Optional[Dict[str, Any]] = None) -> List[Dict[str, Any]]:
                                     "timeoutSeconds": 30,
                                 },
                                 "resources": v["controller"]["resources"],
+                                **(
+                                    {
+                                        "volumeMounts": [
+                                            {
+                                                "name": "state",
+                                                "mountPath": "/var/lib/karpenter",
+                                            }
+                                        ]
+                                    }
+                                    if state_vol
+                                    else {}
+                                ),
                             }
                         ],
+                        **(
+                            {
+                                "volumes": [
+                                    {
+                                        "name": "state",
+                                        "persistentVolumeClaim": {
+                                            "claimName": f"{name}-state"
+                                        },
+                                    }
+                                ]
+                            }
+                            if state_vol
+                            else {}
+                        ),
                     },
                 },
             },

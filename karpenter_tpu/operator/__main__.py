@@ -82,6 +82,7 @@ def main(argv=None) -> int:
         snapshot_interval_s=o.snapshot_interval_s,
         warm_start=o.warm_start and o.solver_backend == "tpu",
         leader_elect=o.leader_elect,
+        lease_path=o.lease_path or None,
     )
     serve_endpoints(o.metrics_port, o.health_probe_port,
                     enable_profiling=o.enable_profiling)

@@ -66,6 +66,9 @@ def new_kwok_operator(
     warm_start: bool = False,
     leader_elect: bool = False,
     identity: str = "",
+    lease_path: Optional[str] = None,
+    lease_s: float = 15.0,
+    renew_s: float = 10.0,
     shared_store: Optional[st.Store] = None,
     shared_cloud: Optional[KwokCloud] = None,
 ) -> Operator:
@@ -132,8 +135,34 @@ def new_kwok_operator(
             import uuid as _uuid
 
             identity = f"karpenter-tpu-{_os.getpid()}-{_uuid.uuid4().hex[:8]}"
-        elector = LeaderElector(store, identity=identity, clock=clock)
-    manager = Manager(elector=elector)
+        if lease_path:
+            # cross-process HA: the lease lives in a flock'd file shared by
+            # the replicas (deploy/render.py mounts it); renew_time must be
+            # comparable across processes, so the elector runs on WALL time
+            # regardless of the control-plane clock
+            from ..controllers.filelease import FileLeaseBackend
+
+            elector = LeaderElector(
+                FileLeaseBackend(lease_path), identity=identity,
+                lease_s=lease_s, renew_s=renew_s, clock=time.time,
+            )
+        else:
+            elector = LeaderElector(
+                store, identity=identity, lease_s=lease_s, renew_s=renew_s,
+                clock=clock,
+            )
+    on_elected = None
+    if snapshot_path is not None and lease_path:
+        # cross-process mode ONLY: the standby's store is a cold boot-time
+        # restore, so takeover re-hydrates from the dead leader's latest
+        # snapshot. In-process shared-store HA must NOT run this — the
+        # standby already shares the live store, and a clear-restore would
+        # roll it back to the last snapshot cadence (r5 review finding).
+        def on_elected():
+            from ..controllers.snapshot import restore_snapshot
+
+            restore_snapshot(store, cloud, snapshot_path, now=clock(), clear=True)
+    manager = Manager(elector=elector, on_elected=on_elected)
     manager.register(
         VolumeTopologyController(store),
         provisioner,
@@ -173,8 +202,13 @@ def new_kwok_operator(
         from ..controllers.snapshot import SnapshotController
 
         manager.register(
-            SnapshotController(store, cloud, snapshot_path,
-                               interval_s=snapshot_interval_s, clock=clock)
+            SnapshotController(
+                store, cloud, snapshot_path,
+                interval_s=snapshot_interval_s, clock=clock,
+                # fenced writes under HA: a deposed leader's in-flight save
+                # loses against the new leader's higher lease rv
+                fence=(lambda: elector.fence_token) if elector is not None else None,
+            )
         )
     if warm_start and hasattr(solver, "warmup"):
         # pre-compile standard shape buckets off the boot path: first

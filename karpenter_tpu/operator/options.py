@@ -51,6 +51,9 @@ class Options:
     # (kwok ConfigMap-backup analog, kwok/ec2/ec2.go:112-232); empty = off
     snapshot_path: str = ""
     snapshot_interval_s: float = 5.0
+    # cross-process HA: flock'd lease file shared by replicas (empty = the
+    # in-process lease, single-process HA only)
+    lease_path: str = ""
     # self-contained smoke run (inject a demo nodepool + pods)
     demo: bool = False
 

@@ -1,0 +1,68 @@
+"""Subprocess driver for the cross-process HA handoff test.
+
+Runs a full operator as ONE OS process contending the flock'd file lease
+(controllers/filelease.py) and snapshotting to the shared state dir —
+the two-replica deployment shape deploy/render.py emits. Role "a" injects
+the workload; role "b" is a pure standby. Each loop iteration writes a
+status JSON the orchestrating test polls.
+
+Usage: python -m tests.ha_driver <role> <shared-dir>
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+
+def main() -> None:
+    role, dirpath = sys.argv[1], sys.argv[2]
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # never touch the axon tunnel
+
+    import karpenter_tpu.controllers.store as st
+    from karpenter_tpu.api.nodeclass import KwokNodeClass
+    from karpenter_tpu.api.objects import NodePool, ObjectMeta, Pod
+    from karpenter_tpu.operator.operator import new_kwok_operator
+    from karpenter_tpu.utils.resources import Resources
+
+    op = new_kwok_operator(
+        leader_elect=True,
+        identity=f"proc-{role}",
+        lease_path=os.path.join(dirpath, "leader.lease"),
+        lease_s=1.5,
+        renew_s=0.5,
+        snapshot_path=os.path.join(dirpath, "state.snap"),
+        snapshot_interval_s=0.2,
+    )
+    if role == "a":
+        op.store.create(st.NODEPOOLS, NodePool(meta=ObjectMeta(name="default")))
+        op.store.create(st.NODECLASSES, KwokNodeClass(meta=ObjectMeta(name="default")))
+        for i in range(5):
+            op.store.create(
+                st.PODS,
+                Pod(meta=ObjectMeta(name=f"w{i}", uid=f"w{i}"),
+                    requests=Resources.parse({"cpu": "1", "memory": "2Gi"})),
+            )
+
+    status_path = os.path.join(dirpath, f"status-{role}.json")
+    while True:
+        op.manager.tick()
+        status = {
+            "pid": os.getpid(),
+            "leader": op.manager.elector.is_leader(),
+            "bound": sum(1 for p in op.store.list(st.PODS) if p.node_name),
+            "claims": sorted(c.name for c in op.store.list(st.NODECLAIMS)),
+            "instances": sorted(i.id for i in op.cloud.describe_instances()),
+        }
+        fd, tmp = tempfile.mkstemp(dir=dirpath, prefix=f".st-{role}-")
+        with os.fdopen(fd, "w") as f:
+            json.dump(status, f)
+        os.replace(tmp, status_path)
+        time.sleep(0.05)
+
+
+if __name__ == "__main__":
+    main()

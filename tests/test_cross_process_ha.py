@@ -1,0 +1,228 @@
+"""Cross-process HA: two OS processes, flock'd file lease, kill -9 handoff.
+
+VERDICT r4 missing #4: the lease previously lived in one process's memory,
+so the deploy renderer's `replicas: 2` could never actually fail over.
+This test runs the REAL two-replica shape: two operator processes sharing a
+state dir (lease file + snapshot), the leader provisioning a workload, then
+SIGKILL — the standby must acquire the lease within the lease duration,
+re-hydrate from the snapshot, and resume the SAME claims (no duplicates).
+Ref: /root/reference/Makefile:56 (DISABLE_LEADER_ELECTION),
+charts/karpenter/values.yaml replicas: 2.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+def _read_status(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
+def _wait_for(path, pred, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    last = None
+    while time.monotonic() < deadline:
+        last = _read_status(path)
+        if last is not None and pred(last):
+            return last
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}; last status: {last}")
+
+
+def _spawn(role, dirpath):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the tunnel
+    return subprocess.Popen(
+        [sys.executable, "-m", "tests.ha_driver", role, dirpath],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+    )
+
+
+def test_kill9_leader_standby_resumes(tmp_path):
+    d = str(tmp_path)
+    sa, sb = os.path.join(d, "status-a.json"), os.path.join(d, "status-b.json")
+    a = _spawn("a", d)
+    b = None
+    try:
+        st_a = _wait_for(
+            sa, lambda s: s["leader"] and s["bound"] == 5, 90,
+            "process A to lead and bind the workload",
+        )
+        claims_a, instances_a = st_a["claims"], st_a["instances"]
+        assert claims_a and instances_a
+
+        b = _spawn("b", d)
+        _wait_for(sb, lambda s: not s["leader"], 60, "B to run as standby")
+        # B must NOT steal the lease while A renews
+        time.sleep(1.0)
+        st_b = _read_status(sb)
+        assert st_b is not None and not st_b["leader"], "standby stole the lease"
+
+        time.sleep(0.5)  # one snapshot cadence: converged state on disk
+        a.kill()  # SIGKILL: no resign, no cleanup — the crash case
+        a.wait(timeout=10)
+
+        st_b = _wait_for(
+            sb,
+            lambda s: s["leader"] and s["bound"] == 5,
+            30,
+            "standby takeover with restored workload",
+        )
+        # the dead leader's claims resumed — not re-provisioned duplicates
+        assert st_b["claims"] == claims_a, (
+            f"claims diverged after takeover: {st_b['claims']} != {claims_a}"
+        )
+        assert st_b["instances"] == instances_a
+    finally:
+        for p in (a, b):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+        if b is not None and b.stderr:
+            err = b.stderr.read().decode(errors="replace")[-2000:]
+            if err.strip():
+                print("B stderr tail:", err, file=sys.stderr)
+
+
+def test_filelease_cas_serializes_two_backends(tmp_path):
+    """Unit-level: two FileLeaseBackend handles on one path behave like the
+    in-process store's optimistic concurrency — one CAS wins, one conflicts."""
+    from karpenter_tpu.api.objects import ObjectMeta
+    from karpenter_tpu.controllers import store as st
+    from karpenter_tpu.controllers.filelease import FileLeaseBackend
+    from karpenter_tpu.controllers.leaderelection import (
+        LEADER_LEASE_NAME,
+        LEASES,
+        Lease,
+    )
+
+    path = str(tmp_path / "leader.lease")
+    b1, b2 = FileLeaseBackend(path), FileLeaseBackend(path)
+    assert b1.try_get(LEASES, LEADER_LEASE_NAME) is None
+    b1.create(LEASES, Lease(meta=ObjectMeta(name=LEADER_LEASE_NAME),
+                            holder="p1", renew_time=100.0))
+    with pytest.raises(st.Conflict):
+        b2.create(LEASES, Lease(meta=ObjectMeta(name=LEADER_LEASE_NAME),
+                                holder="p2", renew_time=100.0))
+    cur = b2.try_get(LEASES, LEADER_LEASE_NAME)
+    assert cur.holder == "p1" and cur.meta.resource_version == 1
+    # both observe rv=1; the second CAS must conflict
+    b2.update_if(LEASES, Lease(meta=ObjectMeta(name=LEADER_LEASE_NAME),
+                               holder="p2", renew_time=200.0), 1)
+    with pytest.raises(st.Conflict):
+        b1.update_if(LEASES, Lease(meta=ObjectMeta(name=LEADER_LEASE_NAME),
+                                   holder="p1", renew_time=200.0), 1)
+    cur = b1.try_get(LEASES, LEADER_LEASE_NAME)
+    assert cur.holder == "p2" and cur.meta.resource_version == 2
+
+
+def test_initial_acquisition_does_not_clear_restore(tmp_path):
+    """r5 review: on_elected must fire only on REAL failovers. A fresh
+    process acquiring a brand-new lease (takeover=False) must not
+    clear-restore the snapshot over objects injected before the first tick."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import karpenter_tpu.controllers.store as st
+    from karpenter_tpu.api.nodeclass import KwokNodeClass
+    from karpenter_tpu.api.objects import NodePool, ObjectMeta, Pod
+    from karpenter_tpu.controllers.snapshot import save_snapshot
+    from karpenter_tpu.operator.operator import new_kwok_operator
+    from karpenter_tpu.utils.resources import Resources
+
+    snap = str(tmp_path / "state.snap")
+    # a STALE snapshot missing the objects about to be injected
+    seed = new_kwok_operator()
+    save_snapshot(seed.store, seed.cloud, snap)
+
+    op = new_kwok_operator(
+        leader_elect=True,
+        lease_path=str(tmp_path / "leader.lease"),
+        lease_s=1.0, renew_s=0.3,
+        snapshot_path=snap, snapshot_interval_s=999,
+    )
+    op.store.create(st.NODEPOOLS, NodePool(meta=ObjectMeta(name="default")))
+    op.store.create(st.NODECLASSES, KwokNodeClass(meta=ObjectMeta(name="default")))
+    op.store.create(
+        st.PODS,
+        Pod(meta=ObjectMeta(name="w0", uid="w0"),
+            requests=Resources.parse({"cpu": "1", "memory": "2Gi"})),
+    )
+    op.manager.tick()  # first tick: creates the lease (takeover=False)
+    assert op.manager.elector.is_leader()
+    assert not op.manager.elector.takeover
+    assert op.store.get(st.PODS, "w0") is not None, (
+        "initial acquisition clear-restored over injected objects"
+    )
+
+
+def test_fenced_snapshot_rejects_deposed_writer(tmp_path):
+    """r5 review: a deposed leader's in-flight snapshot write must lose
+    against the new leader's (higher-fence) snapshots — last-writer-wins
+    would roll the shared state file back."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import karpenter_tpu.controllers.store as st
+    from karpenter_tpu.api.objects import ObjectMeta, Pod
+    from karpenter_tpu.controllers.snapshot import restore_snapshot, save_snapshot
+    from karpenter_tpu.operator.operator import new_kwok_operator
+    from karpenter_tpu.utils.resources import Resources
+
+    snap = str(tmp_path / "state.snap")
+    op_old = new_kwok_operator()
+    op_new = new_kwok_operator()
+    op_new.store.create(
+        st.PODS,
+        Pod(meta=ObjectMeta(name="fresh", uid="fresh"),
+            requests=Resources.parse({"cpu": "1", "memory": "1Gi"})),
+    )
+    # new leader (fence 7) writes; old deposed leader (fence 3) then lands
+    assert save_snapshot(op_new.store, op_new.cloud, snap, fence_token=7)
+    assert not save_snapshot(op_old.store, op_old.cloud, snap, fence_token=3)
+
+    probe = new_kwok_operator()
+    assert restore_snapshot(probe.store, probe.cloud, snap)
+    assert probe.store.get(st.PODS, "fresh") is not None, (
+        "stale snapshot clobbered the new leader's state"
+    )
+
+
+def test_elector_over_file_backend_handoff(tmp_path):
+    """In-process pair of electors over the FILE backend (fast determinism
+    check of expiry/takeover math on the wall-clock timebase)."""
+    from karpenter_tpu.controllers.filelease import FileLeaseBackend
+    from karpenter_tpu.controllers.leaderelection import LeaderElector
+
+    path = str(tmp_path / "leader.lease")
+    t = {"now": 1000.0}
+    clock = lambda: t["now"]
+    e1 = LeaderElector(FileLeaseBackend(path), "p1", lease_s=15, renew_s=10, clock=clock)
+    e2 = LeaderElector(FileLeaseBackend(path), "p2", lease_s=15, renew_s=10, clock=clock)
+    e1.tick()
+    e2.tick()
+    assert e1.is_leader() and not e2.is_leader()
+    # renewal keeps the standby out
+    t["now"] += 10
+    e1.tick()
+    t["now"] += 10
+    e2.tick()
+    assert not e2.is_leader(), "lease was renewed 10s ago"
+    # silent death of p1: after expiry p2 takes over
+    t["now"] += 20
+    e2.tick()
+    assert e2.is_leader()

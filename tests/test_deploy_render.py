@@ -33,6 +33,7 @@ def test_default_render_shapes():
         "ServiceAccount",
         "Service",
         "PodDisruptionBudget",
+        "PersistentVolumeClaim",
         "Deployment",
     ]
     dep = _by_kind(ms, "Deployment")[0]
@@ -95,7 +96,7 @@ def test_unknown_settings_key_rejected():
 def test_yaml_output_parses_and_merge_is_deep():
     out = render_yaml({"controller": {"resources": {"requests": {"cpu": "2"}}}})
     docs = list(yaml.safe_load_all(out))
-    assert len(docs) == 4
+    assert len(docs) == 5
     dep = [d for d in docs if d["kind"] == "Deployment"][0]
     res = dep["spec"]["template"]["spec"]["containers"][0]["resources"]
     assert res["requests"]["cpu"] == "2"
@@ -151,3 +152,17 @@ open('tests/testdata/crds.golden.yaml','w').write(crds_yaml())"
     here = os.path.dirname(__file__)
     golden = open(os.path.join(here, "testdata", "crds.golden.yaml")).read()
     assert crds_yaml() == golden
+
+
+def test_render_rejects_lease_without_state_volume():
+    """stateVolume off + leasePath set = container-local leases on both
+    replicas = split brain; the render must refuse (r5 review finding)."""
+    with pytest.raises(ValueError, match="stateVolume"):
+        render({"stateVolume": None})
+
+
+def test_render_rejects_unnamed_state_storage_class():
+    """The RWX requirement must be explicit: empty storageClassName would
+    silently bind the commonly-RWO default SC and strand both replicas."""
+    with pytest.raises(ValueError, match="storageClassName"):
+        render({"stateVolume": {"storageClassName": "", "size": "1Gi"}})
