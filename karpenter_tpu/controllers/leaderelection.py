@@ -24,7 +24,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
-from ..api.objects import ObjectMeta
+from ..api.objects import CLOCK, ObjectMeta
 from ..metrics.registry import LEADER
 from . import store as st
 
@@ -36,7 +36,11 @@ LEADER_LEASE_NAME = "karpenter-tpu-leader"
 class Lease:
     meta: ObjectMeta
     holder: str = ""
-    renew_time: float = 0.0
+    # in-process leases run on the control-plane clock; snapshot restore
+    # rebases this (CLOCK marker) so a restored lease's remaining duration
+    # is preserved instead of skewing by the downtime delta. (File-backed
+    # leases run on wall time and never pass through snapshots.)
+    renew_time: float = field(default=0.0, metadata=CLOCK)
     lease_duration_s: float = 15.0
 
 

@@ -61,6 +61,11 @@ class SolverInput:
     # required and relaxes them by ascending weight on failure; Ignore drops
     # every preference up front.
     preference_policy: str = "Respect"
+    # pods are ALREADY in canonical FFD order — skip the sort. Set only by
+    # the device relaxation loop (solver/relax.py), which must keep the
+    # ORIGINAL pods' processing order while pods' materialized signatures
+    # change between redispatches.
+    presorted: bool = False
 
 
 @dataclass
@@ -112,18 +117,21 @@ def ffd_sort(pods: Sequence[Pod]) -> List[Pod]:
     return ffd_sort_with_sigs(pods)[0]
 
 
-def ffd_sort_with_sigs(pods: Sequence[Pod]):
+def ffd_sort_with_sigs(pods: Sequence[Pod], presorted: bool = False):
     """ffd_sort plus the interned signature id and uid per sorted pod — the
     encoder consumes these directly so the batch pays one key-gathering pass.
 
     Returns (sorted_pods, sigs_sorted[int64], uids_sorted[str], interned) —
-    see encode.sig_nums for the `interned` contract."""
+    see encode.sig_nums for the `interned` contract. `presorted` trusts the
+    caller's order (the relaxation loop re-encodes materialized pods in the
+    ORIGINAL pods' canonical order — their mutated signatures would regroup
+    differently within equal-size blocks and diverge from the oracle)."""
     import numpy as np
 
     from ..solver.encode import sig_nums  # lazy: avoid import cycle
 
     n = len(pods)
-    if n <= 1:
+    if presorted or n <= 1:
         sigs, interned = sig_nums(pods)
         uids = np.array([p.meta.uid for p in pods], dtype=object)
         return list(pods), sigs, uids, interned

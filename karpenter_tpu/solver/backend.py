@@ -541,6 +541,23 @@ class TPUSolver(Solver):
     def solve_async(self, inp: SolverInput) -> AsyncSolve:
         """Encode + dispatch now; fetch + decode when result() is called."""
         qinp = quantize_input(inp)
+        from . import relax as rx
+
+        relax_plan = rx.plan(qinp)
+        if relax_plan is not None:
+            # Respect-mode preferences with only device-expressible kinds:
+            # host-driven relax-and-redispatch, every iteration on device
+            # (solver/relax.py). The common satisfiable case is ONE dispatch
+            # — dispatched EAGERLY here so the async pipelining the
+            # provisioner seam relies on still overlaps host and device work.
+            from ..provisioning.scheduler import ffd_sort
+
+            order = ffd_sort(qinp.pods)
+            dropped = {u: 0 for u in relax_plan}
+            first = self._relax_dispatch(qinp, relax_plan, order, dropped)
+            return AsyncSolve(
+                lambda: self._relax_solve(qinp, relax_plan, order, dropped, first)
+            )
         enc = encode(qinp)
         if (
             enc.group_fallback.any()
@@ -574,6 +591,69 @@ class TPUSolver(Solver):
             return out
 
         return AsyncSolve(finish)
+
+    def _relax_dispatch(self, qinp, items_map, order, dropped):
+        """Materialize + encode + dispatch one relax iteration. Returns
+        (minp, enc, handle) or None when this iteration cannot run on
+        device (non-preference fallback class present / dispatch declined)."""
+        import dataclasses
+
+        from . import relax as rx
+
+        pods2 = [
+            rx.materialize_pod(p, items_map[p.meta.uid], dropped[p.meta.uid])
+            if p.meta.uid in items_map
+            else p
+            for p in order
+        ]
+        minp = dataclasses.replace(qinp, pods=pods2, presorted=True)
+        enc = encode(minp)
+        if (
+            enc.group_fallback.any()
+            or enc.has_topology
+            or enc.has_affinity
+            or enc.G == 0
+        ):
+            return None
+        handle = self._device_solve_async(enc)
+        if handle is None:
+            return None
+        return minp, enc, handle
+
+    def _relax_solve(self, qinp: SolverInput, items_map, order, dropped,
+                     first=None) -> SolverResult:
+        """Drive the oracle's per-pod relaxation by whole-solve redispatch:
+        each iteration materializes the current per-pod active preference
+        sets (in the ORIGINAL pods' FFD order — see relax.py on why) and
+        solves on device; the FIRST failing pod with droppable preferences
+        left drops its lowest-weight one. Equivalence to the sequential
+        oracle is by induction: pods before the relaxed one replay
+        identically, the relaxed pod retries under the same state."""
+        budget = 1 + sum(len(v) for v in items_map.values())
+        for it in range(budget):
+            disp = first if (it == 0 and first is not None) else (
+                self._relax_dispatch(qinp, items_map, order, dropped)
+            )
+            if disp is None:
+                break
+            minp, enc, handle = disp
+            out = handle()
+            if out is None or not min_values_post_check(minp, out):
+                break
+            cand = None
+            for uid in enc.sorted_uids.tolist():
+                if uid in out.errors and dropped.get(uid, 0) < len(
+                    items_map.get(uid, ())
+                ):
+                    cand = uid
+                    break
+            if cand is None:
+                self.stats["device_solves"] += 1
+                SOLVER_SOLVES.inc(backend="device")
+                return out
+            dropped[cand] += 1
+        self.stats["fallback_solves"] += 1
+        return self.fallback.solve(qinp)
 
     def warmup(self, instance_types, zones, capacity_types=("on-demand", "spot"),
                pod_presets=(12, 600), with_zone_spread=True) -> int:

@@ -545,6 +545,7 @@ def _core_key(pods_f: List[Pod], inp: SolverInput) -> Tuple[tuple, np.ndarray]:
             tuple(inp.zones),
             tuple(inp.capacity_types),
             inp.preference_policy,
+            getattr(inp, "presorted", False),
         ),
         ids,
     )
@@ -552,6 +553,11 @@ def _core_key(pods_f: List[Pod], inp: SolverInput) -> Tuple[tuple, np.ndarray]:
 
 def encode(inp: SolverInput) -> EncodedInput:
     pods_f = [p for p in inp.pods if not p.scheduling_gated and p.node_name is None]
+    if getattr(inp, "presorted", False):
+        # relax-loop encodes materialize FRESH pod objects every iteration:
+        # caching them would only evict hot production cores and pin dead
+        # pod lists (r5 review) — build uncached
+        return _encode_with_nodes(_build_core(inp, pods_f), inp)
     key, ids = _core_key(pods_f, inp)
     ent = _CORE_CACHE.get(key)
     if ent is not None and np.array_equal(ids, ent[0]):
@@ -586,7 +592,9 @@ def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
     T = len(type_names)
 
     # ---- groups (vectorized: the only O(pods) work is cached-key gathering)
-    pods_sorted, sigs, sorted_uids, sigs_interned = ffd_sort_with_sigs(pods_f)
+    pods_sorted, sigs, sorted_uids, sigs_interned = ffd_sort_with_sigs(
+        pods_f, presorted=getattr(inp, "presorted", False)
+    )
     n_pods = len(pods_sorted)
     if n_pods:
         # group ids in first-appearance order over the sorted sequence

@@ -1,0 +1,93 @@
+"""Respect-mode preferences on the DEVICE path: relax-and-redispatch.
+
+The oracle treats preferences as required, then relaxes a failing pod's
+lowest-weight preference and retries that pod in place
+(scheduler._schedule_with_relaxation; scheduling.md:212-219). Re-dispatching
+the WHOLE solve from scratch with one more preference dropped replays the
+oracle's decision sequence exactly — pods before the relaxed one place
+identically, the relaxed pod retries under the same state — so the host
+drives the relaxation loop while every iteration runs on device
+(VERDICT r4 next #9). In the common production case (kube's default-on
+ScheduleAnyway spreads that are satisfiable), zero pods fail and ONE
+dispatch serves the solve — the class that previously forced every such
+surge onto the interpreter-speed oracle.
+
+Supported preference kinds (the others return None -> whole-solve oracle):
+  - ScheduleAnyway topology spread (weight 0, relaxed first) — materializes
+    to DoNotSchedule;
+  - weighted POSITIVE pod affinity — materializes to a required term.
+Preferred node affinity and weighted ANTI terms stay on the oracle: a
+materialized anti term would register as an owned anti at placement (the
+kernel keys registration on the pod's terms), but the oracle's bookkeeping
+records only the ORIGINAL pod — satisfied preferences never constrain later
+pods (scheduler._effective_pod docstring) — and the two would diverge.
+
+Ordering: the materialized pods are re-encoded in the ORIGINAL pods'
+canonical FFD order (SolverInput.presorted) — their mutated signatures
+would otherwise regroup within equal-size blocks and diverge from the
+oracle's fixed processing order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from ..api.objects import Pod
+
+
+def relax_items(pod: Pod) -> Optional[List[Tuple[int, int, str, int]]]:
+    """Droppable preferences in the oracle's exact relaxation order
+    ((weight, kind, idx) ascending — scheduler._schedule_with_relaxation).
+    Returns None when the pod carries a preference kind the device loop
+    cannot express."""
+    if pod.preferred_node_affinity:
+        return None
+    items: List[Tuple[int, int, str, int]] = []
+    for i, t in enumerate(pod.topology_spread):
+        if t.when_unsatisfiable == "ScheduleAnyway":
+            items.append((0, 1, "tsc", i))
+    for i, t in enumerate(pod.affinity_terms):
+        if t.weight is not None:
+            if t.anti:
+                return None
+            items.append((t.weight, 2, "aff", i))
+    items.sort(key=lambda it: (it[0], it[1], it[3]))
+    return items
+
+
+def materialize_pod(pod: Pod, items, n_dropped: int) -> Pod:
+    """Pod view with the still-active preferences REQUIRED and the dropped
+    ones gone — mirrors scheduler._effective_pod."""
+    active = items[n_dropped:]
+    act_tsc = {i for (_w, _k, tag, i) in active if tag == "tsc"}
+    act_aff = {i for (_w, _k, tag, i) in active if tag == "aff"}
+    tscs = []
+    for i, t in enumerate(pod.topology_spread):
+        if t.when_unsatisfiable == "DoNotSchedule":
+            tscs.append(t)
+        elif i in act_tsc:
+            tscs.append(dataclasses.replace(t, when_unsatisfiable="DoNotSchedule"))
+    affs = []
+    for i, t in enumerate(pod.affinity_terms):
+        if t.weight is None:
+            affs.append(t)
+        elif i in act_aff:
+            affs.append(dataclasses.replace(t, weight=None))
+    return dataclasses.replace(pod, topology_spread=tscs, affinity_terms=affs)
+
+
+def plan(qinp) -> Optional[Dict[str, list]]:
+    """uid -> relax item list for every preference-carrying pod, or None
+    when any pod carries an unsupported kind (or there is nothing to relax).
+    An empty dict is never returned — callers take the plain path then."""
+    if qinp.preference_policy == "Ignore":
+        return None
+    items_map: Dict[str, list] = {}
+    for pod in qinp.pods:
+        items = relax_items(pod)
+        if items is None:
+            return None
+        if items:
+            items_map[pod.meta.uid] = items
+    return items_map or None
