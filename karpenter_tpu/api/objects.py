@@ -226,7 +226,9 @@ class Node:
     # node conditions: type -> status ("True"/"False"/"Unknown"), with the
     # last transition time per type (drives the repair controller)
     conditions: Dict[str, str] = field(default_factory=dict)
-    condition_since: Dict[str, float] = field(default_factory=dict)
+    # CLOCK marker on a DICT field: every value is a control-plane stamp;
+    # snapshot rebase shifts each one (repair tolerations read these ages)
+    condition_since: Dict[str, float] = field(default_factory=dict, metadata=CLOCK)
 
     def set_condition(self, ctype: str, status: str, now: float) -> None:
         if self.conditions.get(ctype) != status:

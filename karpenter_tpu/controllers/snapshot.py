@@ -225,6 +225,12 @@ def restore_snapshot(
                 # means "never set" (r5 review: `and v` skipped t=0 stamps)
                 if isinstance(v, (int, float)):
                     setattr(target, name, v + delta)
+                elif isinstance(v, dict):
+                    # dict-valued clock stamps (Node.condition_since):
+                    # every value shifts (r5 review finding)
+                    for k, t in v.items():
+                        if isinstance(t, (int, float)):
+                            v[k] = t + delta
 
     with store._lock:
         for kind, objs in payload["objects"].items():

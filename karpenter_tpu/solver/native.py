@@ -50,14 +50,15 @@ def load() -> ctypes.CDLL:
             u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
             lib.ffd_solve_native.restype = ctypes.c_int
             lib.ffd_solve_native.argtypes = (
-                [ctypes.c_int32] * 11
+                [ctypes.c_int32] * 12  # dims (incl. DD domain columns)
                 + [i32p, i32p]  # runs
                 + [i32p, u8p, u8p, u8p, u8p, u8p, u8p]  # groups
                 + [i32p, i32p, u8p]  # types
                 + [u8p, u8p, u8p, i32p, i32p, i32p]  # pools
                 + [i32p, u8p, i32p]  # nodes (free, compat, zone)
                 + [u8p, u8p, i32p, i32p, i32p, i32p]  # hostname sigs (Q)
-                + [u8p, u8p, i32p, i32p, i32p, i32p, i32p]  # zone sigs (V)
+                + [u8p, u8p, i32p, i32p, i32p, i32p, i32p]  # domain sigs (V)
+                + [i32p, i32p, i32p]  # mixed-axis: sig_axis, group_daxis, node_ct
                 + [i32p, i32p, i32p, u8p, u8p, u8p, u8p, i32p, i32p, i32p]  # outputs
             )
             _lib = lib
@@ -86,6 +87,8 @@ def solve_encoded(enc: EncodedInput, max_claims: int = 1024):
     # oracle's string-lex domain tiebreaks). Zero C++ changes; outputs swap
     # back below.
     swap = enc.v_axis == "ct" and V > 0
+    mixed = enc.v_axis == "mixed"
+    ct_perm = None  # lex permutation of the C axis under mixed mode
     if swap:
         # canonical domain order (enc.v_domain_perm — shared with backend's
         # device column masks)
@@ -97,13 +100,33 @@ def solve_encoded(enc: EncodedInput, max_claims: int = 1024):
         p_ct = enc.pool_zone
         offer = enc.offer_avail.transpose(0, 2, 1)[:, perm, :]
         n_zone = enc.v_node_domain
+        n_ct = np.full(enc.E, -1, np.int32)
         Zn, Cn = C, Z
+    elif mixed:
+        # BOTH axes drive domain columns (core arg DD = Z + C): the C axis
+        # is permuted to LEX order so ct index == ct domain rank, matching
+        # v_count0's column layout (zones, then lex cts) and the core's
+        # index-order tiebreaks. Outputs un-permute below.
+        ct_perm = sorted(range(C), key=lambda i: enc.capacity_types[i])
+        ct_inv = np.argsort(ct_perm)
+        g_zone, g_ct = enc.group_zone, enc.group_ct[:, ct_perm]
+        p_zone, p_ct = enc.pool_zone, enc.pool_ct[:, ct_perm]
+        offer = enc.offer_avail[:, :, ct_perm]
+        n_zone = enc.node_zone
+        # node's ct DOMAIN rank (lex) — node_dom2 already carries Z + rank
+        n_ct = np.where(enc.node_dom2 >= 0, enc.node_dom2 - Z, -1).astype(np.int32)
+        Zn, Cn = Z, C
     else:
         g_zone, g_ct = enc.group_zone, enc.group_ct
         p_zone, p_ct = enc.pool_zone, enc.pool_ct
         offer = enc.offer_avail
         n_zone = enc.node_zone
+        n_ct = np.full(enc.E, -1, np.int32)
         Zn, Cn = Z, C
+    DD = Zn + Cn if mixed else Zn
+    # encode always populates these; a silent zeros-default here would
+    # misclassify every sig as zone-axis on a mixed solve — fail loudly
+    sig_axis, group_daxis = enc.sig_axis, enc.group_daxis
 
     take_e = np.zeros((S, E), np.int32)
     take_c = np.zeros((S, M), np.int32)
@@ -117,7 +140,7 @@ def solve_encoded(enc: EncodedInput, max_claims: int = 1024):
     used = np.zeros(1, np.int32)
 
     rc = lib.ffd_solve_native(
-        S, G, T, E, P, R, Zn, Cn, M, Q, V,
+        S, G, T, E, P, R, Zn, Cn, M, Q, V, DD,
         i32(enc.run_group), i32(enc.run_count),
         i32(enc.group_req), u8(enc.group_compat_t), u8(g_zone), u8(g_ct),
         u8(enc.group_pool), u8(enc.group_pair), u8(~enc.group_fallback),
@@ -131,12 +154,15 @@ def solve_encoded(enc: EncodedInput, max_claims: int = 1024):
         i32(enc.node_q_member), i32(enc.node_q_owner),
         u8(enc.v_member), u8(enc.v_owner), i32(enc.v_kind), i32(enc.v_cap),
         i32(enc.v_primary), i32(enc.v_aff), i32(enc.v_count0),
+        i32(sig_axis), i32(group_daxis), i32(n_ct),
         take_e, take_c, leftover, c_mask, c_zone, c_ct, c_gmask, c_pool, c_cum, used,
     )
     if rc != 0:
         return None
     if swap:
         c_zone, c_ct = c_ct, c_zone[:, inv]
+    elif mixed:
+        c_ct = c_ct[:, ct_inv]  # un-permute the lex C axis back to cid order
     # decode() argument order: ..., c_pool, c_gmask, c_cum, used
     return take_e, take_c, leftover, c_mask.astype(bool), c_zone.astype(bool), \
         c_ct.astype(bool), c_pool, c_gmask.astype(bool), c_cum, int(used[0])
@@ -158,11 +184,7 @@ class NativeSolver(Solver):
             or enc.has_topology
             or enc.has_affinity
             or enc.G == 0
-            or enc.v_axis == "mixed"
         ):
-            # (mixed zone+ct domain sigs run on the DEVICE kernel's
-            # concatenated-axis path; the C++ core still drives a single
-            # domain axis, so those solves replay on the oracle here)
             # hostname (Q, incl. kind-2 positive affinity), zone/ct-domain
             # (V) constraints all run in the native core; what still routes
             # to the oracle is the same set the device kernel can't express

@@ -84,9 +84,13 @@ extern "C" {
 //          c_zone [M,Z] u8, c_ct [M,C] u8, c_gmask [M,G] u8, c_pool [M],
 //          c_cum [M,R], used [1].
 int ffd_solve_native(
-    // dims
+    // dims. DD = total V-domain columns: Z for single-axis solves (the
+    // historical layout; the ct-granular case arrives pre-swapped by the
+    // marshaler), Z + C for MIXED solves — zone columns first, then
+    // capacity-type columns in the marshaler's lex order (the C axis itself
+    // is permuted to lex order in that mode, so ct index == domain rank).
     int32_t S, int32_t G, int32_t T, int32_t E, int32_t P, int32_t R,
-    int32_t Z, int32_t C, int32_t M, int32_t Q, int32_t V,
+    int32_t Z, int32_t C, int32_t M, int32_t Q, int32_t V, int32_t DD,
     // runs
     const int32_t* run_group, const int32_t* run_count,
     // groups
@@ -126,7 +130,10 @@ int ffd_solve_native(
     const int32_t* v_cap,           // [V]
     const int32_t* v_primary,       // [G] owned zone-TSC sig (-1)
     const int32_t* v_aff,           // [G] owned positive-affinity sig (-1)
-    const int32_t* v_count0,        // [V,Z]
+    const int32_t* v_count0,        // [V,DD]
+    const int32_t* sig_axis,        // [V] 0 = zone axis, 1 = ct axis
+    const int32_t* group_daxis,     // [G] axis a constrained group binds to
+    const int32_t* node_ct,         // [E] ct domain column (-1 unknown)
     // outputs
     int32_t* take_e, int32_t* take_c, int32_t* leftover,
     uint8_t* c_mask, uint8_t* c_zone, uint8_t* c_ct, uint8_t* c_gmask,
@@ -150,21 +157,48 @@ int ffd_solve_native(
   std::vector<int32_t> e_co(node_q_owner, node_q_owner + static_cast<size_t>(E) * Q);
   std::vector<int32_t> c_cm(static_cast<size_t>(M) * Q, 0);
   std::vector<int32_t> c_co(static_cast<size_t>(M) * Q, 0);
-  // zone (V) state
-  std::vector<int32_t> v_count(v_count0, v_count0 + static_cast<size_t>(V) * Z);
-  std::vector<uint8_t> v_owner_z(static_cast<size_t>(V) * Z, 0);
+  // domain (V) state — stride DD (zone cols, then ct cols under mixed)
+  const bool mixed = DD > Z;
+  std::vector<int32_t> v_count(v_count0, v_count0 + static_cast<size_t>(V) * DD);
+  std::vector<uint8_t> v_owner_z(static_cast<size_t>(V) * DD, 0);
   std::vector<int32_t> c_vm(static_cast<size_t>(M) * V, 0);
   std::vector<uint8_t> c_vo(static_cast<size_t>(M) * V, 0);
 
   std::vector<int32_t> k_t(T);  // per-type capacity scratch
   std::vector<uint8_t> fit_t(T);
-  std::vector<uint8_t> A(Z), A_base(Z), inter(Z);
+  const int32_t NDmax = std::max(Z, C);
+  std::vector<uint8_t> A(NDmax), A_base(NDmax), inter(NDmax);
   std::vector<int32_t> charge_one(R);
 
   auto claim_zone_count = [&](int32_t m) {
     int32_t n = 0;
     for (int32_t z = 0; z < Z; ++z) n += c_zone[static_cast<size_t>(m) * Z + z] ? 1 : 0;
     return n;
+  };
+  auto claim_ct_count = [&](int32_t m) {
+    int32_t n = 0;
+    for (int32_t c = 0; c < C; ++c) n += c_ct[static_cast<size_t>(m) * C + c] ? 1 : 0;
+    return n;
+  };
+  // record one placed pod (or `take` pods) of group g onto a target whose
+  // determined domains are zone_col (or -1) / ct_col (or -1): member counts
+  // accrue on EVERY determined axis (the oracle records every determined
+  // topology key); owned-anti registration keys on the TERM's axis.
+  auto record_target = [&](const uint8_t* member_v_g, const uint8_t* owner_v_g,
+                           int32_t zone_col, int32_t ct_col, int32_t take) {
+    for (int32_t v = 0; v < V; ++v) {
+      if (member_v_g[v]) {
+        if (zone_col >= 0)
+          v_count[static_cast<size_t>(v) * DD + zone_col] += take;
+        if (mixed && ct_col >= 0)
+          v_count[static_cast<size_t>(v) * DD + Z + ct_col] += take;
+      }
+      if (owner_v_g[v] && v_kind[v] == 1 && take > 0) {
+        const int32_t col = (mixed && sig_axis[v] == 1) ? (ct_col >= 0 ? Z + ct_col : -1)
+                                                        : zone_col;
+        if (col >= 0) v_owner_z[static_cast<size_t>(v) * DD + col] = 1;
+      }
+    }
   };
 
   for (int32_t s = 0; s < S; ++s) {
@@ -213,25 +247,33 @@ int ffd_solve_native(
         std::vector<int32_t>(Q, 0).data(), std::vector<int32_t>(Q, 0).data(),
         q_kind, q_cap, member_q, owner_nb.data(), Q);
 
-    // run-level zone-count contribution bookkeeping (fast path): which
-    // claims received pods this run, and per-zone node takes
+    // run-level domain-count contribution bookkeeping (fast path): which
+    // claims received pods this run, and per-domain node takes PER AXIS
     std::vector<int32_t> node_take_z(Z, 0);
+    std::vector<int32_t> node_take_c(C, 0);
     std::vector<int32_t> claim_take(M, 0);
 
     auto record_v_counts_fast = [&]() {
       if (V == 0) return;
-      std::vector<int32_t> contrib(Z, 0);
+      std::vector<int32_t> contrib(DD, 0);
       for (int32_t z = 0; z < Z; ++z) contrib[z] = node_take_z[z];
+      if (mixed)
+        for (int32_t c = 0; c < C; ++c) contrib[Z + c] = node_take_c[c];
       for (int32_t m = 0; m < used; ++m) {
         if (claim_take[m] <= 0) continue;
-        if (claim_zone_count(m) != 1) continue;  // multi-zone: no domain
-        for (int32_t z = 0; z < Z; ++z)
-          if (c_zone[static_cast<size_t>(m) * Z + z]) contrib[z] += claim_take[m];
+        // per-axis singleness: a claim records on every axis where its
+        // domain is determined (multi-valued on an axis: no count there)
+        if (claim_zone_count(m) == 1)
+          for (int32_t z = 0; z < Z; ++z)
+            if (c_zone[static_cast<size_t>(m) * Z + z]) contrib[z] += claim_take[m];
+        if (mixed && claim_ct_count(m) == 1)
+          for (int32_t c = 0; c < C; ++c)
+            if (c_ct[static_cast<size_t>(m) * C + c]) contrib[Z + c] += claim_take[m];
       }
       for (int32_t v = 0; v < V; ++v) {
         if (!member_v_g[v]) continue;
-        for (int32_t z = 0; z < Z; ++z)
-          v_count[static_cast<size_t>(v) * Z + z] += contrib[z];
+        for (int32_t d = 0; d < DD; ++d)
+          v_count[static_cast<size_t>(v) * DD + d] += contrib[d];
       }
     };
 
@@ -256,6 +298,7 @@ int ffd_solve_native(
             if (owner_q[q] && q_kind[q] == 1) e_co[static_cast<size_t>(e) * Q + q] += 1;
           }
           if (node_zone[e] >= 0) node_take_z[node_zone[e]] += take;
+          if (mixed && node_ct[e] >= 0) node_take_c[node_ct[e]] += take;
           remaining -= take;
           if (boot2) { boot_done = true; break; }  // single bootstrap target
         }
@@ -415,7 +458,34 @@ int ffd_solve_native(
     }
 
     // ================= ZONE path: per-pod placement =======================
-    // (solver/tpu/ffd.py zoned branch semantics, walked one pod at a time)
+    // (solver/tpu/ffd.py zoned branch semantics, walked one pod at a time.
+    // Under mixed-axis solves the group's engine runs over ITS axis's
+    // domain columns — ax=1 swaps the zone-role arrays for the ct ones;
+    // encode guarantees a device group's owned/anti sigs are single-axis.)
+    const int32_t ax = mixed ? group_daxis[g] : 0;
+    const int32_t ND = ax ? C : Z;   // domains on the group's axis
+    const int32_t D0 = ax ? Z : 0;   // column offset into the v tables
+    const uint8_t* g_dom = ax ? gc : gz;
+    auto node_dom = [&](int32_t e) { return ax ? node_ct[e] : node_zone[e]; };
+    auto c_dom = [&](int32_t m, int32_t d) -> bool {
+      return ax ? (c_ct[static_cast<size_t>(m) * C + d] != 0)
+                : (c_zone[static_cast<size_t>(m) * Z + d] != 0);
+    };
+    auto pool_dom = [&](int32_t p, int32_t d) -> bool {
+      return ax ? (pool_ct[static_cast<size_t>(p) * C + d] != 0)
+                : (pool_zone[static_cast<size_t>(p) * Z + d] != 0);
+    };
+    // claim recording: determined-domain column per axis (-1 when multi)
+    auto record_claim = [&](int32_t m, int32_t take) {
+      int32_t zcol = -1, ccol = -1;
+      if (claim_zone_count(m) == 1)
+        for (int32_t z = 0; z < Z; ++z)
+          if (c_zone[static_cast<size_t>(m) * Z + z]) zcol = z;
+      if (mixed && claim_ct_count(m) == 1)
+        for (int32_t c = 0; c < C; ++c)
+          if (c_ct[static_cast<size_t>(m) * C + c]) ccol = c;
+      record_target(member_v_g, owner_v_g, zcol, ccol, take);
+    };
     const int32_t psig = v_primary[g];
     const bool has_tsc = psig >= 0;
     const int32_t cap_p = has_tsc ? v_cap[psig] : 0;
@@ -427,32 +497,36 @@ int ffd_solve_native(
       if (owner_v_g[v] && v_kind[v] == 1) has_anti = true;
 
     while (remaining > 0) {
-      // ---- allowed zone set A ------------------------------------------
+      // ---- allowed domain set A (group's axis columns) -----------------
       int32_t m1 = BIG;
-      const int32_t* cnt_p = has_tsc ? v_count.data() + static_cast<size_t>(psig) * Z : nullptr;
+      const int32_t* cnt_p =
+          has_tsc ? v_count.data() + static_cast<size_t>(psig) * DD + D0 : nullptr;
       if (has_tsc)
-        for (int32_t z = 0; z < Z; ++z)
-          if (gz[z]) m1 = std::min(m1, cnt_p[z]);
+        for (int32_t d = 0; d < ND; ++d)
+          if (g_dom[d]) m1 = std::min(m1, cnt_p[d]);
       bool any_present = false;
-      const int32_t* cnt_a = has_affs ? v_count.data() + static_cast<size_t>(asig) * Z : nullptr;
+      const int32_t* cnt_a =
+          has_affs ? v_count.data() + static_cast<size_t>(asig) * DD + D0 : nullptr;
       if (has_affs)
-        for (int32_t z = 0; z < Z; ++z)
-          if (cnt_a[z] > 0) any_present = true;
-      for (int32_t z = 0; z < Z; ++z) {
-        bool a = gz[z];
-        if (a && has_tsc) a = (cnt_p[z] + 1 - m1 <= cap_p);
+        for (int32_t d = 0; d < ND; ++d)
+          if (cnt_a[d] > 0) any_present = true;
+      for (int32_t d = 0; d < ND; ++d) {
+        bool a = g_dom[d];
+        if (a && has_tsc) a = (cnt_p[d] + 1 - m1 <= cap_p);
         if (a)
           for (int32_t v = 0; v < V && a; ++v) {
             if (v_kind[v] != 1) continue;
-            if (owner_v_g[v] && v_count[static_cast<size_t>(v) * Z + z] > 0) a = false;
-            if (member_v_g[v] && v_owner_z[static_cast<size_t>(v) * Z + z]) a = false;
+            if (owner_v_g[v] && v_count[static_cast<size_t>(v) * DD + D0 + d] > 0)
+              a = false;
+            if (member_v_g[v] && v_owner_z[static_cast<size_t>(v) * DD + D0 + d])
+              a = false;
           }
-        A_base[z] = a ? 1 : 0;
+        A_base[d] = a ? 1 : 0;
         if (has_affs) {
-          if (any_present) a = a && (cnt_a[z] > 0);
+          if (any_present) a = a && (cnt_a[d] > 0);
           else if (!is_member_a) a = false;  // bootstrap only for members
         }
-        A[z] = a ? 1 : 0;
+        A[d] = a ? 1 : 0;
       }
 
       bool placed = false;
@@ -460,8 +534,8 @@ int ffd_solve_native(
       // ---- 1. existing nodes, in order ---------------------------------
       for (int32_t e = 0; e < E && !placed; ++e) {
         if (!node_compat[static_cast<size_t>(g) * E + e]) continue;
-        const int32_t zn = node_zone[e];
-        const bool nz_ok = (zn >= 0) ? (A[zn] != 0) : !has_owned;
+        const int32_t dn = node_dom(e);
+        const bool nz_ok = (dn >= 0) ? (A[dn] != 0) : !has_owned;
         if (!nz_ok) continue;
         if (fit_count_row(node_free + static_cast<size_t>(e) * R,
                           e_cum.data() + static_cast<size_t>(e) * R, req, R) < 1)
@@ -478,13 +552,8 @@ int ffd_solve_native(
           if (member_q[q]) e_cm[static_cast<size_t>(e) * Q + q] += 1;
           if (owner_q[q] && q_kind[q] == 1) e_co[static_cast<size_t>(e) * Q + q] += 1;
         }
-        if (zn >= 0) {
-          for (int32_t v = 0; v < V; ++v) {
-            if (member_v_g[v]) v_count[static_cast<size_t>(v) * Z + zn] += 1;
-            if (owner_v_g[v] && v_kind[v] == 1)
-              v_owner_z[static_cast<size_t>(v) * Z + zn] = 1;
-          }
-        }
+        record_target(member_v_g, owner_v_g, node_zone[e],
+                      mixed ? node_ct[e] : -1, 1);
         placed = true;
       }
 
@@ -516,9 +585,9 @@ int ffd_solve_native(
             has_affs && c_vm[static_cast<size_t>(m) * V + asig] > 0;
         const uint8_t* Am = local_aff ? A_base.data() : A.data();
         int32_t n_inter = 0;
-        for (int32_t z = 0; z < Z; ++z) {
-          inter[z] = (c_zone[static_cast<size_t>(m) * Z + z] && Am[z] && gz[z]) ? 1 : 0;
-          n_inter += inter[z];
+        for (int32_t d = 0; d < ND; ++d) {
+          inter[d] = (c_dom(m, d) && Am[d] && g_dom[d]) ? 1 : 0;
+          n_inter += inter[d];
         }
         if (n_inter == 0) continue;
         // commit rule (SPEC.md joint narrowing)
@@ -527,16 +596,18 @@ int ffd_solve_native(
         int32_t d_star = -1;
         if (commit) {
           int32_t best = BIG + 1;
-          for (int32_t z = 0; z < Z; ++z) {
-            if (!inter[z]) continue;
+          for (int32_t d = 0; d < ND; ++d) {
+            if (!inter[d]) continue;
             int32_t score;
-            if (has_tsc) score = cnt_p[z] * 64 + z;
-            else if (has_affs && any_present && !local_aff) score = -cnt_a[z] * 64 + z;
-            else score = z;
-            if (score < best) { best = score; d_star = z; }
+            if (has_tsc) score = cnt_p[d] * 64 + d;
+            else if (has_affs && any_present && !local_aff) score = -cnt_a[d] * 64 + d;
+            else score = d;
+            if (score < best) { best = score; d_star = d; }
           }
         }
-        // surviving types under the effective zone bits
+        // surviving types under the effective domain bits: the group's
+        // axis restricts to the committed/allowed columns, the OTHER axis
+        // keeps the claim's bits ∧ the group's admission
         int32_t kmax = 0;
         for (int32_t t = 0; t < T; ++t) {
           fit_t[t] = 0;
@@ -544,14 +615,16 @@ int ffd_solve_native(
           if (!group_compat_t[static_cast<size_t>(g) * T + t]) continue;
           bool off_ok = false;
           for (int32_t z = 0; z < Z && !off_ok; ++z) {
-            const bool zin = commit ? (z == d_star) : (inter[z] != 0);
-            if (!zin) continue;
-            for (int32_t c = 0; c < C; ++c)
-              if (c_ct[static_cast<size_t>(m) * C + c] && gc[c] &&
-                  offer_avail[(static_cast<size_t>(t) * Z + z) * C + c]) {
+            if (!(c_zone[static_cast<size_t>(m) * Z + z] && gz[z])) continue;
+            if (ax == 0 && !(commit ? (z == d_star) : (inter[z] != 0))) continue;
+            for (int32_t c = 0; c < C; ++c) {
+              if (!(c_ct[static_cast<size_t>(m) * C + c] && gc[c])) continue;
+              if (ax == 1 && !(commit ? (c == d_star) : (inter[c] != 0))) continue;
+              if (offer_avail[(static_cast<size_t>(t) * Z + z) * C + c]) {
                 off_ok = true;
                 break;
               }
+            }
           }
           if (!off_ok) continue;
           int32_t kt = fit_count_row(type_alloc + static_cast<size_t>(t) * R,
@@ -568,11 +641,19 @@ int ffd_solve_native(
           c_cum[static_cast<size_t>(m) * R + r] += req[r];
         for (int32_t t = 0; t < T; ++t)
           c_mask[static_cast<size_t>(m) * T + t] = (fit_t[t] && k_t[t] >= 1) ? 1 : 0;
-        for (int32_t z = 0; z < Z; ++z)
-          c_zone[static_cast<size_t>(m) * Z + z] =
-              (commit ? (z == d_star) : (inter[z] != 0)) ? 1 : 0;
-        for (int32_t c = 0; c < C; ++c)
-          c_ct[static_cast<size_t>(m) * C + c] &= gc[c];
+        if (ax == 0) {
+          for (int32_t z = 0; z < Z; ++z)
+            c_zone[static_cast<size_t>(m) * Z + z] =
+                (commit ? (z == d_star) : (inter[z] != 0)) ? 1 : 0;
+          for (int32_t c = 0; c < C; ++c)
+            c_ct[static_cast<size_t>(m) * C + c] &= gc[c];
+        } else {
+          for (int32_t c = 0; c < C; ++c)
+            c_ct[static_cast<size_t>(m) * C + c] =
+                (commit ? (c == d_star) : (inter[c] != 0)) ? 1 : 0;
+          for (int32_t z = 0; z < Z; ++z)
+            c_zone[static_cast<size_t>(m) * Z + z] &= gz[z];
+        }
         c_gmask[static_cast<size_t>(m) * G + g] = 1;
         for (int32_t q = 0; q < Q; ++q) {
           if (member_q[q]) c_cm[static_cast<size_t>(m) * Q + q] += 1;
@@ -582,17 +663,8 @@ int ffd_solve_native(
           if (member_v_g[v]) c_vm[static_cast<size_t>(m) * V + v] += 1;
           if (owner_v_g[v] && v_kind[v] == 1) c_vo[static_cast<size_t>(m) * V + v] = 1;
         }
-        // zone-count recording: single-zone claims only (SPEC.md)
-        if (claim_zone_count(m) == 1) {
-          int32_t zc = -1;
-          for (int32_t z = 0; z < Z; ++z)
-            if (c_zone[static_cast<size_t>(m) * Z + z]) zc = z;
-          for (int32_t v = 0; v < V; ++v) {
-            if (member_v_g[v]) v_count[static_cast<size_t>(v) * Z + zc] += 1;
-            if (owner_v_g[v] && v_kind[v] == 1)
-              v_owner_z[static_cast<size_t>(v) * Z + zc] = 1;
-          }
-        }
+        // domain-count recording: per-axis determined columns (SPEC.md)
+        record_claim(m, 1);
         placed = true;
       }
 
@@ -607,24 +679,24 @@ int ffd_solve_native(
         if (fresh_allow < 1) continue;
         if (used >= M) { overflow = true; break; }
         const int32_t* daemon = pool_daemon + static_cast<size_t>(p) * R;
-        // pool's admissible zones intersect A; commit like open claims
+        // pool's admissible domains intersect A; commit like open claims
         int32_t n_inter = 0;
-        for (int32_t z = 0; z < Z; ++z) {
-          inter[z] = (pool_zone[static_cast<size_t>(p) * Z + z] && gz[z] && A[z]) ? 1 : 0;
-          n_inter += inter[z];
+        for (int32_t d = 0; d < ND; ++d) {
+          inter[d] = (pool_dom(p, d) && g_dom[d] && A[d]) ? 1 : 0;
+          n_inter += inter[d];
         }
         if (n_inter == 0) continue;
         const bool commit = has_tsc || (has_affs && any_present) || has_anti;
         int32_t d_star = -1;
         if (commit) {
           int32_t best = BIG + 1;
-          for (int32_t z = 0; z < Z; ++z) {
-            if (!inter[z]) continue;
+          for (int32_t d = 0; d < ND; ++d) {
+            if (!inter[d]) continue;
             int32_t score;
-            if (has_tsc) score = cnt_p[z] * 64 + z;
-            else if (has_affs && any_present) score = -cnt_a[z] * 64 + z;
-            else score = z;
-            if (score < best) { best = score; d_star = z; }
+            if (has_tsc) score = cnt_p[d] * 64 + d;
+            else if (has_affs && any_present) score = -cnt_a[d] * 64 + d;
+            else score = d;
+            if (score < best) { best = score; d_star = d; }
           }
         }
         int32_t kmax = 0;
@@ -634,14 +706,16 @@ int ffd_solve_native(
           if (!pool_type[static_cast<size_t>(p) * T + t]) continue;
           bool off_ok = false;
           for (int32_t z = 0; z < Z && !off_ok; ++z) {
-            const bool zin = commit ? (z == d_star) : (inter[z] != 0);
-            if (!zin) continue;
-            for (int32_t c = 0; c < C; ++c)
-              if (pool_ct[static_cast<size_t>(p) * C + c] && gc[c] &&
-                  offer_avail[(static_cast<size_t>(t) * Z + z) * C + c]) {
+            if (!(pool_zone[static_cast<size_t>(p) * Z + z] && gz[z])) continue;
+            if (ax == 0 && !(commit ? (z == d_star) : (inter[z] != 0))) continue;
+            for (int32_t c = 0; c < C; ++c) {
+              if (!(pool_ct[static_cast<size_t>(p) * C + c] && gc[c])) continue;
+              if (ax == 1 && !(commit ? (c == d_star) : (inter[c] != 0))) continue;
+              if (offer_avail[(static_cast<size_t>(t) * Z + z) * C + c]) {
                 off_ok = true;
                 break;
               }
+            }
           }
           if (!off_ok) continue;
           int32_t k = BIG;
@@ -663,12 +737,21 @@ int ffd_solve_native(
           c_cum[static_cast<size_t>(m) * R + r] = daemon[r] + req[r];
         for (int32_t t = 0; t < T; ++t)
           c_mask[static_cast<size_t>(m) * T + t] = fit_t[t];
-        for (int32_t z = 0; z < Z; ++z)
-          c_zone[static_cast<size_t>(m) * Z + z] =
-              (commit ? (z == d_star) : (inter[z] != 0)) ? 1 : 0;
-        for (int32_t c = 0; c < C; ++c)
-          c_ct[static_cast<size_t>(m) * C + c] =
-              pool_ct[static_cast<size_t>(p) * C + c] && gc[c];
+        if (ax == 0) {
+          for (int32_t z = 0; z < Z; ++z)
+            c_zone[static_cast<size_t>(m) * Z + z] =
+                (commit ? (z == d_star) : (inter[z] != 0)) ? 1 : 0;
+          for (int32_t c = 0; c < C; ++c)
+            c_ct[static_cast<size_t>(m) * C + c] =
+                pool_ct[static_cast<size_t>(p) * C + c] && gc[c];
+        } else {
+          for (int32_t c = 0; c < C; ++c)
+            c_ct[static_cast<size_t>(m) * C + c] =
+                (commit ? (c == d_star) : (inter[c] != 0)) ? 1 : 0;
+          for (int32_t z = 0; z < Z; ++z)
+            c_zone[static_cast<size_t>(m) * Z + z] =
+                pool_zone[static_cast<size_t>(p) * Z + z] && gz[z];
+        }
         c_gmask[static_cast<size_t>(m) * G + g] = 1;
         for (int32_t q = 0; q < Q; ++q) {
           if (member_q[q]) c_cm[static_cast<size_t>(m) * Q + q] = 1;
@@ -685,16 +768,7 @@ int ffd_solve_native(
               mn = std::min(mn, type_charge[static_cast<size_t>(t) * R + r]);
           p_usage[static_cast<size_t>(p) * R + r] += (mn == BIG) ? 0 : mn;
         }
-        if (claim_zone_count(m) == 1) {
-          int32_t zc = -1;
-          for (int32_t z = 0; z < Z; ++z)
-            if (c_zone[static_cast<size_t>(m) * Z + z]) zc = z;
-          for (int32_t v = 0; v < V; ++v) {
-            if (member_v_g[v]) v_count[static_cast<size_t>(v) * Z + zc] += 1;
-            if (owner_v_g[v] && v_kind[v] == 1)
-              v_owner_z[static_cast<size_t>(v) * Z + zc] = 1;
-          }
-        }
+        record_claim(m, 1);
         placed = true;
       }
 

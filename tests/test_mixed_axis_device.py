@@ -195,3 +195,106 @@ def test_mixed_axis_fuzz(seed):
             sel=rng.choice([{"app": "w"}, {"tier": "ct"}]),
         ))
     assert_zone_parity(mkinp(pods, nodes))
+
+
+class TestMixedAxisNative:
+    """The C++ core drives BOTH domain axes too (round 5): DD = Z + C
+    concatenated columns, per-group axis binding, per-axis count recording
+    — 3-way parity (native vs oracle) over the same mixed families the
+    device tests pin."""
+
+    @staticmethod
+    def _native_parity(inp):
+        from karpenter_tpu.solver.backend import ReferenceSolver, quantize_input
+        from karpenter_tpu.solver.native import NativeSolver
+
+        ns = NativeSolver()
+        out = ns.solve(inp)
+        ref = ReferenceSolver().solve(quantize_input(inp))
+        assert set(out.errors) == set(ref.errors)
+        assert out.placements == ref.placements, {
+            k: (out.placements.get(k), ref.placements.get(k))
+            for k in set(out.placements) | set(ref.placements)
+            if out.placements.get(k) != ref.placements.get(k)
+        }
+        assert len(out.claims) == len(ref.claims)
+        for rc, tc in zip(ref.claims, out.claims):
+            assert sorted(rc.instance_type_names) == sorted(tc.instance_type_names)
+            assert rc.pod_uids == tc.pod_uids
+        assert ns.stats["native_solves"] == 1, ns.stats
+        return out
+
+    def test_zone_tsc_plus_ct_tsc(self):
+        pods = [
+            mkpod(f"z{i}", cpu="2", mem="4Gi", labels={"app": "w"},
+                  topology_spread=[ztsc({"app": "w"})])
+            for i in range(6)
+        ] + [
+            mkpod(f"c{i}", cpu="1", mem="2Gi", labels={"tier": "ct"},
+                  topology_spread=[ctsc({"tier": "ct"})])
+            for i in range(4)
+        ]
+        self._native_parity(mkinp(pods))
+
+    def test_ct_anti_plus_zone_affinity(self):
+        pods = [
+            mkpod(f"a{i}", labels={"svc": "db"},
+                  affinity_terms=[PodAffinityTerm(
+                      label_selector={"svc": "db"}, topology_key=wk.ZONE_LABEL,
+                      anti=False)])
+            for i in range(4)
+        ] + [
+            mkpod(f"l{i}", labels={"lock": f"k{i}"},
+                  affinity_terms=[PodAffinityTerm(
+                      label_selector={"lock": f"k{i}"},
+                      topology_key=wk.CAPACITY_TYPE_LABEL, anti=True)])
+            for i in range(2)
+        ]
+        self._native_parity(mkinp(pods))
+
+    def test_mixed_with_existing_nodes_cross_membership(self):
+        nodes = [
+            ct_node("n-a", "zone-1a", "on-demand", matching=2, sel={"app": "w"}),
+            ct_node("n-b", "zone-1b", "spot", matching=1, sel={"app": "w"}),
+            ct_node("n-c", "zone-1c", "on-demand"),
+        ]
+        pods = [
+            mkpod(f"z{i}", labels={"app": "w"}, topology_spread=[ztsc({"app": "w"})])
+            for i in range(7)
+        ] + [
+            mkpod(f"c{i}", labels={"app": "w"},
+                  topology_spread=[ctsc({"app": "w"}, skew=2)])
+            for i in range(4)
+        ]
+        self._native_parity(mkinp(pods, nodes))
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_native_mixed_fuzz(self, seed):
+        rng = random.Random(5000 + seed)
+        pods = []
+        for i in range(rng.randrange(6, 20)):
+            kind = rng.random()
+            name = f"p{i:03d}"
+            if kind < 0.35:
+                pods.append(mkpod(name, labels={"app": "w"},
+                                  topology_spread=[ztsc({"app": "w"})]))
+            elif kind < 0.6:
+                pods.append(mkpod(name, labels={"tier": "ct"},
+                                  topology_spread=[ctsc({"tier": "ct"},
+                                                        skew=rng.choice([1, 2]))]))
+            elif kind < 0.75:
+                pods.append(mkpod(name, labels={"svc": "db"},
+                                  affinity_terms=[PodAffinityTerm(
+                                      label_selector={"svc": "db"},
+                                      topology_key=wk.CAPACITY_TYPE_LABEL,
+                                      anti=False)]))
+            else:
+                pods.append(mkpod(name, cpu=rng.choice(["500m", "1", "2"])))
+        nodes = []
+        for j in range(rng.randrange(0, 4)):
+            nodes.append(ct_node(
+                f"n{j}", rng.choice(ZONES), rng.choice(CTS),
+                matching=rng.randrange(0, 3),
+                sel=rng.choice([{"app": "w"}, {"tier": "ct"}]),
+            ))
+        self._native_parity(mkinp(pods, nodes))

@@ -152,3 +152,59 @@ class TestTwoLevelMesh:
             cons._MESH, cons._MESH_INIT = old_mesh, old_init
             cons._sharded_ffd.cache_clear()
         assert n1 == n2
+
+
+def test_sharded_mixed_axis_scenario_matches_sequential():
+    """Round-5 mixed zone+ct solves under the SHARDED dispatch: the
+    concatenated-domain kernel (extra D=Z+C columns, col_axis/group_daxis/
+    node_dom2 args) must shard over the candidate mesh bit-identically to
+    the unsharded kernel — the consolidation evaluator batches mixed-axis
+    universes through this exact program."""
+    from karpenter_tpu.api.objects import TopologySpreadConstraint
+
+    pool = NodePoolSpec(
+        name="default",
+        weight=0,
+        requirements=Requirements.of(
+            Requirement.create(wk.NODEPOOL_LABEL, IN, ["default"])
+        ),
+        taints=[],
+        instance_types=CATALOG,
+    )
+    pods = []
+    for i in range(24):
+        p = Pod(
+            meta=ObjectMeta(name=f"z{i:03d}", uid=f"z{i:03d}",
+                            labels={"app": "w"}),
+            requests=Resources.parse({"cpu": "1", "memory": "2Gi"}),
+        )
+        p.topology_spread = [TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.ZONE_LABEL, label_selector={"app": "w"})]
+        pods.append(p)
+    for i in range(8):
+        p = Pod(
+            meta=ObjectMeta(name=f"c{i:03d}", uid=f"c{i:03d}",
+                            labels={"tier": "ct"}),
+            requests=Resources.parse({"cpu": "500m", "memory": "1Gi"}),
+        )
+        p.topology_spread = [TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.CAPACITY_TYPE_LABEL,
+            label_selector={"tier": "ct"})]
+        pods.append(p)
+    inp = SolverInput(pods=pods, nodes=[], nodepools=[pool], zones=ZONES)
+    enc = encode(quantize_input(inp))
+    assert enc.v_axis == "mixed"
+    solver = TPUSolver(max_claims=64)
+    args, _dims = kernel_args(enc, solver._bucket)
+
+    seq = ffd_solve(*args, max_claims=64)
+    mesh = make_mesh(N_DEV)
+    out = batched_solve(mesh, replicate_args(args, N_DEV), max_claims=64)
+    used = np.asarray(out.state.used)
+    assert (used == int(seq.state.used)).all()
+    assert int(np.asarray(seq.leftover).sum()) == 0
+    for b in range(N_DEV):
+        np.testing.assert_array_equal(
+            np.asarray(out.take_c)[b], np.asarray(seq.take_c))
+        np.testing.assert_array_equal(
+            np.asarray(out.state.c_zc_bits)[b], np.asarray(seq.state.c_zc_bits))

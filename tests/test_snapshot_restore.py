@@ -260,3 +260,29 @@ def test_snapshot_stall_bounded_at_10k_nodes(tmp_path):
     assert restore_snapshot(op2.store, op2.cloud, path, now=clock())
     assert len(op2.store.list(st.NODECLAIMS)) == 10_000
     assert len(op2.cloud.describe_instances()) == 10_000
+
+
+def test_condition_since_rebases_across_restore(tmp_path):
+    """Dict-valued clock stamps (Node.condition_since) must rebase too, or
+    the repair controller sees conditions aged by the downtime delta and
+    force-deletes healthy-until-recently nodes (r5 review finding)."""
+    from karpenter_tpu.api.objects import Node, ObjectMeta
+    from karpenter_tpu.controllers.snapshot import restore_snapshot
+
+    snap = str(tmp_path / "snap.bin")
+    clock_hi = FakeClock()
+    clock_hi.t = 500_000.0
+    op = new_kwok_operator(clock=clock_hi)
+    n = Node(meta=ObjectMeta(name="sick"))
+    n.set_condition("Unhealthy", "True", clock_hi())  # stamped NOW
+    op.store.create(st.NODES, n)
+    clock_hi.advance(10)  # condition is 10s old at snapshot time
+    save_snapshot(op.store, op.cloud, snap, now=clock_hi())
+
+    clock_lo = FakeClock()
+    clock_lo.t = 100.0
+    op2 = new_kwok_operator(clock=clock_lo)
+    assert restore_snapshot(op2.store, op2.cloud, snap, now=clock_lo())
+    n2 = op2.store.get(st.NODES, "sick")
+    age = clock_lo() - n2.condition_since["Unhealthy"]
+    assert 9 <= age <= 12, f"condition age skewed after restore: {age}"
