@@ -164,6 +164,22 @@ class Requirement:
         return Requirement(self.key, False, self.values & other.values, gt, lt, mv, rp)
 
     def intersects(self, other: "Requirement") -> bool:
+        # allocation-free fast path for the overwhelmingly common bounds-free
+        # case (the oracle's compatible() calls this millions of times per
+        # large solve): without Gt/Lt, emptiness reduces to set algebra.
+        if (
+            self.greater_than is None
+            and self.less_than is None
+            and other.greater_than is None
+            and other.less_than is None
+        ):
+            if self.complement:
+                if other.complement:
+                    return True  # co-finite ∩ co-finite is co-finite
+                return any(v not in self.values for v in other.values)
+            if other.complement:
+                return any(v not in other.values for v in self.values)
+            return not self.values.isdisjoint(other.values)
         return not self.intersect(other).is_empty()
 
     def values_list(self) -> list:
