@@ -53,10 +53,17 @@ class InstanceType:
     offerings: List[Offering] = field(default_factory=list)
 
     def allocatable(self) -> Resources:
-        # memoized per (capacity, overhead) OBJECT identity — the memo pins
-        # both objects so a swapped-in replacement can never alias a freed
-        # id (the _QUANTIZED_TYPE_CACHE `is`-check discipline); the oracle
-        # calls this per (claim, type) probe, ~1M times in a large solve
+        # fresh copy: callers assign the result onto claims and must never
+        # share (and risk mutating) the memoized instance
+        return Resources(self.allocatable_view())
+
+    def allocatable_view(self) -> Resources:
+        """READ-ONLY view of allocatable() (no defensive copy) — for hot
+        fit checks that never mutate (the oracle probes this per
+        (claim, type); copying dominated the memo win). Memoized per
+        (capacity, overhead) OBJECT identity — the memo pins both objects so
+        a swapped-in replacement can never alias a freed id (the
+        _QUANTIZED_TYPE_CACHE `is`-check discipline)."""
         cached = getattr(self, "_alloc_memo", None)
         if (
             cached is None
@@ -70,22 +77,6 @@ class InstanceType:
                 Resources({k: max(0, v) for k, v in out.items()}),
             )
             self._alloc_memo = cached
-        # fresh copy: callers assign the result onto claims and must never
-        # share (and risk mutating) the memoized instance
-        return Resources(cached[2])
-
-    def allocatable_view(self) -> Resources:
-        """READ-ONLY view of allocatable() (no defensive copy) — for hot
-        fit checks that never mutate (the oracle probes this per
-        (claim, type); copying dominated the memo win)."""
-        cached = getattr(self, "_alloc_memo", None)
-        if (
-            cached is None
-            or cached[0] is not self.capacity
-            or cached[1] is not self.overhead
-        ):
-            self.allocatable()
-            cached = self._alloc_memo
         return cached[2]
 
     def cheapest_available(self, reqs: Optional[Requirements] = None) -> Optional[Offering]:

@@ -569,9 +569,9 @@ class TPUSolver(Solver):
             # on device (Q/V axes, tpu/ffd.py; ct via the domain-axis swap;
             # zone+ct MIXES via the concatenated-axis layout); what still
             # routes the whole solve to the fallback chain: flagged fallback
-            # groups (OR'd node affinity, preferred terms, stacked domain
-            # constraints, single pods constrained on BOTH domain axes,
-            # ≥3-way custom-label conflicts), custom-key spread, and
+            # groups (OR'd node affinity, preferred terms, multiple SAME-kind
+            # domain terms per pod, single pods constrained on BOTH domain
+            # axes, ≥3-way custom-label conflicts), custom-key spread, and
             # duplicate node hostnames. Whole-solve fallback keeps semantics
             # unforked.
             self.stats["fallback_solves"] += 1

@@ -725,12 +725,15 @@ def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
                 (cantis if t.anti else caffs).append(sig)
             else:
                 has_aff = True  # custom-key affinity: fallback
-        # the domain event engine supports ONE owned TSC and ONE positive
-        # affinity per pod, not combined (the oracle's sequential narrowing
-        # order for stacked terms isn't expressed on device yet)
-        if len(ztscs) > 1 or len(zaffs) > 1 or (ztscs and zaffs):
+        # the domain event engine drives ONE owned TSC and ONE positive
+        # affinity per pod — including BOTH on the same pod (round 5: the
+        # engine's allowed set already intersects the TSC budget with the
+        # affinity present-set exactly as the oracle's sequential narrowing
+        # does; parity pinned by tests/test_stacked_device.py). Multiple
+        # terms of the SAME kind still fall back.
+        if len(ztscs) > 1 or len(zaffs) > 1:
             fallback[g] = True
-        if len(ctscs) > 1 or len(caffs) > 1 or (ctscs and caffs):
+        if len(ctscs) > 1 or len(caffs) > 1:
             fallback[g] = True
         if n_h2 > 1:
             # stacked positive hostname terms: the single-target bootstrap

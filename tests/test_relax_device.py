@@ -116,12 +116,13 @@ class TestWeightedAffinityOnDevice:
         inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
         assert_relax_parity(inp)
 
-    def test_stacked_soft_constraints_fall_back_with_parity(self):
-        # SA spread + weighted affinity on ONE pod materializes to a stacked
-        # TSC+affinity — a per-pod class the device engine doesn't express,
-        # so the relax loop hands the whole solve to the oracle. Parity (and
-        # the oracle's ascending-weight relax order: the weight-0 spread
-        # drops before the weight-50 affinity) must still hold.
+    def test_stacked_soft_constraints_relax_on_device(self):
+        # SA spread + weighted affinity on ONE pod materializes to a
+        # TSC+affinity stack — ON DEVICE since the late-round-5 joint
+        # narrowing (test_stacked_device.py); the relax loop keeps every
+        # iteration on the kernel, and the oracle's ascending-weight order
+        # (weight-0 spread drops before the weight-50 affinity) is
+        # reproduced by the redispatch sequence.
         nodes = [mknode("n-a", "zone-1a", matching=3, sel={"svc": "db"})]
         nodes[0].free["cpu"] = 2000  # room for little
         pods = [
@@ -131,7 +132,7 @@ class TestWeightedAffinityOnDevice:
             for i in range(4)
         ]
         inp = SolverInput(pods=pods, nodes=nodes, nodepools=[pool()], zones=ZONES)
-        ref, tpu = assert_relax_parity(inp, expect_device=False)
+        ref, tpu = assert_relax_parity(inp)
 
     def test_weighted_anti_stays_on_oracle(self):
         pods = [

@@ -1,0 +1,150 @@
+"""Stacked same-axis TSC + positive affinity on ONE pod, ON DEVICE.
+
+Round 5 (late): the zoned engine's allowed set already intersects the
+spread budget with the affinity present-set — exactly the oracle's
+sequential per-term narrowing — so a pod owning one TSC AND one positive
+affinity on the same axis no longer falls back. That also unlocks the
+Respect-mode relax loop for pods carrying a ScheduleAnyway spread plus a
+weighted affinity (they materialize to this shape). Multiple terms of the
+SAME kind still route to the oracle. Parity is the contract, fuzz +
+corner-pinned; native (C++) covered too.
+"""
+
+import random
+
+import pytest
+
+from karpenter_tpu.api import wellknown as wk
+from karpenter_tpu.api.objects import PodAffinityTerm, TopologySpreadConstraint
+from karpenter_tpu.provisioning.scheduler import SolverInput
+
+from tests.test_mixed_axis_device import CTS, ct_node, ctsc, mkinp
+from tests.test_zone_device import (
+    TSC1,
+    TSC2,
+    ZONES,
+    assert_zone_parity,
+    mknode,
+    mkpod,
+    pool,
+)
+
+
+def zaff(sel):
+    return PodAffinityTerm(label_selector=sel, topology_key=wk.ZONE_LABEL, anti=False)
+
+
+def caff(sel):
+    return PodAffinityTerm(
+        label_selector=sel, topology_key=wk.CAPACITY_TYPE_LABEL, anti=False
+    )
+
+
+class TestStackedOnDevice:
+    def test_nonmember_affinity_never_bootstraps(self):
+        # stacked pod whose affinity matches nobody (not even itself):
+        # unschedulable on both paths
+        pods = [mkpod("g0", labels={"app": "w"}, topology_spread=[TSC1],
+                      affinity_terms=[zaff({"ghost": "x"})])]
+        ref, tpu = assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+        assert tpu.errors
+
+    def test_owner_not_member_tsc_with_member_affinity(self):
+        tsc_other = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.ZONE_LABEL, label_selector={"other": "y"})
+        pods = [mkpod(f"o{i}", labels={"svc": "db"}, topology_spread=[tsc_other],
+                      affinity_terms=[zaff({"svc": "db"})]) for i in range(5)]
+        nodes = [mknode("na", "zone-1a", matching=2, sel={"other": "y"}),
+                 mknode("nb", "zone-1b")]
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=nodes, nodepools=[pool()], zones=ZONES)
+        )
+
+    def test_tsc_vs_affinity_zone_conflict(self):
+        # members pinned on a count-skewed zone: the affinity restricts to
+        # the member zone while the spread wants the min-count zone — the
+        # joint set must match the oracle's narrowing
+        nodes = [mknode("na", "zone-1a", matching=3, sel={"svc": "db"}),
+                 mknode("nb", "zone-1b")]
+        pods = [mkpod(f"m{i}", labels={"svc": "db", "app": "w"},
+                      topology_spread=[TSC1], affinity_terms=[zaff({"svc": "db"})])
+                for i in range(6)]
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=nodes, nodepools=[pool()], zones=ZONES)
+        )
+
+    def test_stacked_amid_mega_spread_run(self):
+        pods = [mkpod(f"w{i:03d}", labels={"app": "w"}, topology_spread=[TSC1])
+                for i in range(60)]
+        pods += [mkpod("st", labels={"app": "w", "svc": "db"},
+                       topology_spread=[TSC1], affinity_terms=[zaff({"svc": "db"})])]
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        )
+
+    def test_stacked_ct_axis(self):
+        pods = [mkpod(f"c{i}", labels={"tier": "ct"},
+                      topology_spread=[ctsc({"tier": "ct"})],
+                      affinity_terms=[caff({"tier": "ct"})]) for i in range(4)]
+        assert_zone_parity(mkinp(pods))
+
+    def test_double_affinity_still_falls_back(self):
+        pods = [mkpod("d0", labels={"a": "1", "b": "2"},
+                      affinity_terms=[zaff({"a": "1"}), zaff({"b": "2"})])]
+        assert_zone_parity(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES),
+            expect_device=False,
+        )
+
+    def test_native_stacked_parity(self):
+        from karpenter_tpu.solver.backend import ReferenceSolver, quantize_input
+        from karpenter_tpu.solver.native import NativeSolver
+
+        nodes = [mknode("na", "zone-1a", matching=3, sel={"svc": "db"}),
+                 mknode("nb", "zone-1b")]
+        pods = [mkpod(f"m{i}", labels={"svc": "db", "app": "w"},
+                      topology_spread=[TSC1], affinity_terms=[zaff({"svc": "db"})])
+                for i in range(6)]
+        inp = SolverInput(pods=pods, nodes=nodes, nodepools=[pool()], zones=ZONES)
+        ns = NativeSolver()
+        out = ns.solve(inp)
+        ref = ReferenceSolver().solve(quantize_input(inp))
+        assert out.placements == ref.placements
+        assert ns.stats["native_solves"] == 1, ns.stats
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_stacked_fuzz(seed):
+    """Stacked pods on both axes beside plain spreads, antis, and existing
+    nodes; device parity for every seed (no stacked-kind duplicates)."""
+    rng = random.Random(11000 + seed)
+    pods = []
+    for i in range(rng.randrange(4, 18)):
+        r = rng.random()
+        if r < 0.3:
+            pods.append(mkpod(f"s{i}", labels={"app": "w", "svc": "db"},
+                              topology_spread=[rng.choice([TSC1, TSC2])],
+                              affinity_terms=[zaff(rng.choice(
+                                  [{"svc": "db"}, {"app": "w"}]))]))
+        elif r < 0.45:
+            pods.append(mkpod(f"c{i}", labels={"tier": "ct"},
+                              topology_spread=[ctsc({"tier": "ct"},
+                                                    skew=rng.choice([1, 2]))],
+                              affinity_terms=[caff({"tier": "ct"})]))
+        elif r < 0.6:
+            pods.append(mkpod(f"t{i}", labels={"app": "w"}, topology_spread=[TSC1]))
+        elif r < 0.7:
+            pods.append(mkpod(f"a{i}", labels={"lock": f"l{i % 3}"},
+                              affinity_terms=[PodAffinityTerm(
+                                  label_selector={"lock": f"l{i % 3}"},
+                                  topology_key=wk.ZONE_LABEL, anti=True)]))
+        else:
+            pods.append(mkpod(f"x{i}", labels=rng.choice(
+                [{"svc": "db"}, {"app": "w"}, {}])))
+    nodes = [ct_node(f"n{j}", rng.choice(ZONES), rng.choice(CTS),
+                     matching=rng.randrange(0, 3),
+                     sel=rng.choice([{"app": "w"}, {"svc": "db"}, {"tier": "ct"}]))
+             for j in range(rng.randrange(0, 4))]
+    assert_zone_parity(mkinp(pods, nodes), expect_device=None)

@@ -284,9 +284,10 @@ class TestZoneAffinityOnDevice:
 
 
 class TestJointNarrowingFallbackPath:
-    """TSC+affinity and stacked-affinity pods route to the oracle (encode
-    marks them fallback); the oracle must narrow claims over the JOINT
-    allowed set (SPEC.md) instead of committing per-constraint and failing."""
+    """Joint narrowing over combined constraints (SPEC.md): TSC+affinity on
+    one pod runs ON DEVICE since round 5 (the engine's allowed set is the
+    joint intersection); stacked SAME-kind terms (two positive affinities)
+    still route to the oracle, which must narrow over the joint set too."""
 
     def _bignode(self, name, zone, pls):
         n = mknode(name, zone, 0)
@@ -306,8 +307,7 @@ class TestJointNarrowingFallbackPath:
         pod = mkpod("p", cpu="12", mem="24Gi", labels={"app": "x"},
                     topology_spread=[TSC1], affinity_terms=[aff])
         ref, tpu = assert_zone_parity(
-            SolverInput(pods=[pod], nodes=nodes, nodepools=[pool()], zones=ZONES),
-            expect_device=False,
+            SolverInput(pods=[pod], nodes=nodes, nodepools=[pool()], zones=ZONES)
         )
         assert not tpu.errors
         zr = tpu.claims[0].requirements.get(wk.ZONE_LABEL)
