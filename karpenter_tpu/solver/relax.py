@@ -15,12 +15,17 @@ surge onto the interpreter-speed oracle.
 Supported preference kinds (the others return None -> whole-solve oracle):
   - ScheduleAnyway topology spread (weight 0, relaxed first) — materializes
     to DoNotSchedule;
-  - weighted POSITIVE pod affinity — materializes to a required term.
-Preferred node affinity and weighted ANTI terms stay on the oracle: a
-materialized anti term would register as an owned anti at placement (the
-kernel keys registration on the pod's terms), but the oracle's bookkeeping
-records only the ORIGINAL pod — satisfied preferences never constrain later
-pods (scheduler._effective_pod docstring) — and the two would diverge.
+  - weighted POSITIVE pod affinity — materializes to a required term;
+  - preferred NODE affinity — active terms union into the pod's required
+    node-affinity term (exactly the oracle's
+    _pod_requirement_alternatives base ∪ prefs), so they narrow the device
+    solve like any node selector. Pods with OR'd alternatives are already
+    fallback groups, so the union targets at most one term.
+Weighted ANTI terms stay on the oracle: a materialized anti term would
+register as an owned anti at placement (the kernel keys registration on
+the pod's terms), but the oracle's bookkeeping records only the ORIGINAL
+pod — satisfied preferences never constrain later pods
+(scheduler._effective_pod docstring) — and the two would diverge.
 
 Ordering: the materialized pods are re-encoded in the ORIGINAL pods'
 canonical FFD order (SolverInput.presorted) — their mutated signatures
@@ -41,9 +46,9 @@ def relax_items(pod: Pod) -> Optional[List[Tuple[int, int, str, int]]]:
     ((weight, kind, idx) ascending — scheduler._schedule_with_relaxation).
     Returns None when the pod carries a preference kind the device loop
     cannot express."""
-    if pod.preferred_node_affinity:
-        return None
     items: List[Tuple[int, int, str, int]] = []
+    for i, (w, _r) in enumerate(pod.preferred_node_affinity):
+        items.append((w, 0, "na", i))
     for i, t in enumerate(pod.topology_spread):
         if t.when_unsatisfiable == "ScheduleAnyway":
             items.append((0, 1, "tsc", i))
@@ -62,6 +67,7 @@ def materialize_pod(pod: Pod, items, n_dropped: int) -> Pod:
     active = items[n_dropped:]
     act_tsc = {i for (_w, _k, tag, i) in active if tag == "tsc"}
     act_aff = {i for (_w, _k, tag, i) in active if tag == "aff"}
+    act_na = [i for (_w, _k, tag, i) in active if tag == "na"]
     tscs = []
     for i, t in enumerate(pod.topology_spread):
         if t.when_unsatisfiable == "DoNotSchedule":
@@ -74,7 +80,28 @@ def materialize_pod(pod: Pod, items, n_dropped: int) -> Pod:
             affs.append(t)
         elif i in act_aff:
             affs.append(dataclasses.replace(t, weight=None))
-    return dataclasses.replace(pod, topology_spread=tscs, affinity_terms=affs)
+    node_aff = pod.node_affinity
+    prefs = []
+    if act_na:
+        # active preferred node affinity unions into the required term —
+        # the oracle's base ∪ prefs (dropped prefs vanish, preserving its
+        # ascending-weight relaxation); the materialized pod carries NO
+        # preferred terms so encode keeps it on device
+        base = pod.preferred_node_affinity[act_na[0]][1]
+        for i in act_na[1:]:
+            base = base.union(pod.preferred_node_affinity[i][1])
+        node_aff = (
+            [term.union(base) for term in pod.node_affinity]
+            if pod.node_affinity
+            else [base]
+        )
+    return dataclasses.replace(
+        pod,
+        topology_spread=tscs,
+        affinity_terms=affs,
+        node_affinity=node_aff,
+        preferred_node_affinity=prefs,
+    )
 
 
 def plan(qinp) -> Optional[Dict[str, list]]:

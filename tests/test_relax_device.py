@@ -191,3 +191,55 @@ def test_relax_fuzz(seed):
             Requirement.create(wk.ZONE_LABEL, IN, ["zone-1a"])))]
     inp = SolverInput(pods=pods, nodes=nodes, nodepools=pools, zones=ZONES)
     assert_relax_parity(inp)
+
+
+class TestPreferredNodeAffinityOnDevice:
+    """Preferred node affinity under Respect (round 5, late): active terms
+    union into the required node-affinity term per relax iteration — honored
+    when satisfiable, dropped ascending-weight when not, all on device."""
+
+    def _prefs(self, *pairs):
+        return [
+            (w, Requirements.of(Requirement.create(k, IN, vals)))
+            for (w, k, vals) in pairs
+        ]
+
+    def test_honored_when_satisfiable(self):
+        pods = [mkpod("p0", preferred_node_affinity=self._prefs(
+            (50, wk.ARCH_LABEL, ["arm64"])))]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        ref, tpu = assert_relax_parity(inp)
+        arch = tpu.claims[0].requirements.get(wk.ARCH_LABEL)
+        assert arch is not None and arch.values_list() == ["arm64"]
+
+    def test_relaxed_when_impossible(self):
+        # amd64-only pool: the arm64 preference must drop, pod still places
+        amd_pool = pool(extra=Requirements.of(
+            Requirement.create(wk.ARCH_LABEL, IN, ["amd64"])))
+        pods = [mkpod("p0", preferred_node_affinity=self._prefs(
+            (50, wk.ARCH_LABEL, ["arm64"])))]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[amd_pool], zones=ZONES)
+        ref, tpu = assert_relax_parity(inp)
+        assert not tpu.errors
+
+    def test_ascending_weight_drop_order(self):
+        # two prefs against an amd64-only pool: the oracle drops the LOWEST
+        # weight first (zone-1b, w=10), then the impossible arm64 (w=50),
+        # then places — parity pins the exact drop sequence.
+        amd_pool = pool(extra=Requirements.of(
+            Requirement.create(wk.ARCH_LABEL, IN, ["amd64"])))
+        pods = [mkpod("p0", preferred_node_affinity=self._prefs(
+            (10, wk.ZONE_LABEL, ["zone-1b"]), (50, wk.ARCH_LABEL, ["arm64"])))]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[amd_pool], zones=ZONES)
+        ref, tpu = assert_relax_parity(inp)
+        assert not tpu.errors
+
+    def test_combined_with_sa_spread(self):
+        sel = {"app": "soft"}
+        pods = [
+            mkpod(f"s{i}", labels=dict(sel), topology_spread=[sa_tsc(sel)],
+                  preferred_node_affinity=self._prefs((30, wk.ZONE_LABEL, ["zone-1c"])))
+            for i in range(3)
+        ]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        assert_relax_parity(inp)
