@@ -111,6 +111,13 @@ class PodAffinityTerm:
     # weight != None => preferred (soft); reference treats preferred terms via
     # relaxation (website/.../scheduling.md:212-219)
     weight: Optional[int] = None
+    # Internal marker set ONLY by the relax loop (solver/relax.py) when it
+    # materializes an ACTIVE weighted anti term: the term blocks this pod's
+    # own admission like a required anti, but must NOT register as an owned
+    # anti at placement — the oracle's bookkeeping records only the original
+    # pod's required terms, so satisfied preferences never constrain later
+    # pods. Encodes as a kind-3 (blocking-only) domain sig.
+    admission_only: bool = False
 
 
 # Pod fields that feed the solver's cached signature / FFD sort key; assigning

@@ -650,7 +650,11 @@ class TPUSolver(Solver):
             if cand is None:
                 self.stats["device_solves"] += 1
                 SOLVER_SOLVES.inc(backend="device")
-                return out
+                # per-pod relaxation SPLITS original runs (a relaxed pod's
+                # materialized signature differs from its unrelaxed twins),
+                # so canonicalize fungible-pod assignments over the ORIGINAL
+                # pods — the same post-pass ReferenceSolver applies
+                return canonicalize_placements(qinp, out)
             dropped[cand] += 1
         self.stats["fallback_solves"] += 1
         return self.fallback.solve(qinp)

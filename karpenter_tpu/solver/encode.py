@@ -161,7 +161,8 @@ def _pod_signature_uncached(pod: Pod) -> tuple:
             for t in pod.topology_spread
         ),
         tuple(
-            (tuple(sorted(t.label_selector.items())), t.topology_key, t.anti, t.weight)
+            (tuple(sorted(t.label_selector.items())), t.topology_key, t.anti,
+             t.weight, t.admission_only)
             for t in pod.affinity_terms
         ),
         tuple(
@@ -717,12 +718,16 @@ def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
                 has_h2 = True
                 n_h2 += 1
             elif t.topology_key == wk.ZONE_LABEL:
-                kind = 1 if t.anti else 2
+                # kind 3 = admission-only anti (relax-materialized weighted
+                # anti): blocks THIS pod's placement like a required anti but
+                # never registers as an owned anti — the oracle's bookkeeping
+                # records only original required terms
+                kind = (3 if t.admission_only else 1) if t.anti else 2
                 sig = (kind, tuple(sorted(t.label_selector.items())), 1 if t.anti else 0)
                 zone_sigs.setdefault(sig, len(zone_sigs))
                 (zantis if t.anti else zaffs).append(sig)
             elif t.topology_key == wk.CAPACITY_TYPE_LABEL:
-                kind = 1 if t.anti else 2
+                kind = (3 if t.admission_only else 1) if t.anti else 2
                 sig = (kind, tuple(sorted(t.label_selector.items())), 1 if t.anti else 0)
                 ct_sigs.setdefault(sig, len(ct_sigs))
                 (cantis if t.anti else caffs).append(sig)

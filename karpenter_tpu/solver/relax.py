@@ -21,11 +21,12 @@ Supported preference kinds (the others return None -> whole-solve oracle):
     _pod_requirement_alternatives base ∪ prefs), so they narrow the device
     solve like any node selector. Pods with OR'd alternatives are already
     fallback groups, so the union targets at most one term.
-Weighted ANTI terms stay on the oracle: a materialized anti term would
-register as an owned anti at placement (the kernel keys registration on
-the pod's terms), but the oracle's bookkeeping records only the ORIGINAL
-pod — satisfied preferences never constrain later pods
-(scheduler._effective_pod docstring) — and the two would diverge.
+Weighted ANTI terms on the zone/ct axes materialize ADMISSION-ONLY
+(encode kind 3): they block and commit like a required anti for the owning
+pod, but never register as owned antis — the oracle's bookkeeping records
+only the ORIGINAL pod, so satisfied preferences never constrain later
+members. Hostname-key weighted antis (no Q-axis kind-3 analog yet) stay on
+the oracle.
 
 Ordering: the materialized pods are re-encoded in the ORIGINAL pods'
 canonical FFD order (SolverInput.presorted) — their mutated signatures
@@ -38,6 +39,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional, Tuple
 
+from ..api import wellknown as wk
 from ..api.objects import Pod
 
 
@@ -54,7 +56,11 @@ def relax_items(pod: Pod) -> Optional[List[Tuple[int, int, str, int]]]:
             items.append((0, 1, "tsc", i))
     for i, t in enumerate(pod.affinity_terms):
         if t.weight is not None:
-            if t.anti:
+            if t.anti and t.topology_key not in (
+                wk.ZONE_LABEL, wk.CAPACITY_TYPE_LABEL
+            ):
+                # weighted HOSTNAME/custom-key antis: no admission-only (Q
+                # kind-3) analog yet — oracle
                 return None
             items.append((t.weight, 2, "aff", i))
     items.sort(key=lambda it: (it[0], it[1], it[3]))
@@ -79,7 +85,12 @@ def materialize_pod(pod: Pod, items, n_dropped: int) -> Pod:
         if t.weight is None:
             affs.append(t)
         elif i in act_aff:
-            affs.append(dataclasses.replace(t, weight=None))
+            # active weighted ANTI terms materialize ADMISSION-ONLY (encode
+            # kind 3): they block this pod like a required anti but never
+            # register — matching the oracle's original-pod bookkeeping
+            affs.append(
+                dataclasses.replace(t, weight=None, admission_only=t.anti)
+            )
     node_aff = pod.node_affinity
     prefs = []
     if act_na:

@@ -652,9 +652,14 @@ def ffd_solve(
             asig_g = v_aff[g]
             has_affs = asig_g >= 0
             asig = jnp.clip(asig_g, 0, V - 1)
-            owned_anti = owner_v & (v_kind == 1)  # [V]
+            owned_anti = owner_v & (v_kind == 1)  # [V] — registering antis
+            # kind 3 = admission-only anti (relax-materialized weighted
+            # anti): blocks this pod's own placement exactly like kind 1
+            # but never registers (no v_owner_z / c_vo writes, no commit) —
+            # the oracle records only original required terms
+            owned_blk = owner_v & ((v_kind == 1) | (v_kind == 3))  # [V]
             member_anti = member_v & (v_kind == 1)
-            self_anti = jnp.any(owned_anti & member_v)
+            self_anti = jnp.any(owned_blk & member_v)
             is_member_a = member_v[asig]
             has_owned = jnp.any(owner_v)
 
@@ -681,7 +686,7 @@ def ffd_solve(
                 A = jnp.where(has_tsc, allowed_tsc, elig)
                 B = jnp.where(has_tsc, budget_tsc, BIG)
 
-                blocked_m = jnp.any(owned_anti[:, None] & (v_count > 0), axis=0)
+                blocked_m = jnp.any(owned_blk[:, None] & (v_count > 0), axis=0)
                 blocked_o = jnp.any(member_anti[:, None] & v_owner_z, axis=0)
                 A = A & ~blocked_m & ~blocked_o
                 B = jnp.where(self_anti, jnp.minimum(B, 1), B)
@@ -717,8 +722,12 @@ def ffd_solve(
                 # positive term (and blocks anti terms) regardless of the
                 # claim's still-multi-valued zone — same claim, same domain
                 local_aff = has_affs & (c_vm_st[:, asig] > 0)  # [M]
+                # owner side uses owned_blk (kind 3 blocks and commits like
+                # a required anti); ONLY the registration writes (v_owner_z /
+                # c_vo) stay kind-1, so satisfied weighted antis never block
+                # FUTURE members — the oracle records only original terms
                 anti_claim_ok = jnp.all(
-                    ~owned_anti[None, :] | (c_vm_st == 0), axis=1
+                    ~owned_blk[None, :] | (c_vm_st == 0), axis=1
                 ) & jnp.all(~member_anti[None, :] | ~c_vo_st, axis=1)  # [M]
 
                 cz = zone_sets(c_zc_bits)  # [M, Z]
@@ -729,7 +738,7 @@ def ffd_solve(
                 # an owned anti term commits the claim to one zone too —
                 # multi-valued claims could later materialize in the same
                 # zone and violate the term (SPEC.md anti commit, lex-first)
-                has_anti = jnp.any(owned_anti)
+                has_anti = jnp.any(owned_blk)
                 commit_m = has_tsc | (has_affs & any_present & ~local_aff) | has_anti
                 score_tsc = jnp.where(inter, cnt_p[None, :] * 64 + zidx[None, :], BIG)
                 score_aff = jnp.where(inter, -cnt_a[None, :] * 64 + zidx[None, :], BIG)
@@ -941,7 +950,7 @@ def ffd_solve(
                 )
                 aff_bulk = (
                     has_affs & ~has_tsc & ~self_anti
-                    & ~jnp.any(owned_anti) & ~jnp.any(member_anti)
+                    & ~jnp.any(owned_blk) & ~jnp.any(member_anti)
                     & ~found_e & found_c & found_p
                     & (aff_committed | aff_zonefree)
                 )
@@ -963,7 +972,7 @@ def ffd_solve(
                     & ~self_anti
                     & ~has_affs
                     & ~jnp.any(member_anti)
-                    & ~jnp.any(owned_anti)
+                    & ~jnp.any(owned_blk)
                 )
                 # is_self: like the water-fill form, the cycle assumes pours
                 # advance the rotation counts — an owner-not-member spread

@@ -134,12 +134,47 @@ class TestWeightedAffinityOnDevice:
         inp = SolverInput(pods=pods, nodes=nodes, nodepools=[pool()], zones=ZONES)
         ref, tpu = assert_relax_parity(inp)
 
-    def test_weighted_anti_stays_on_oracle(self):
+    def test_weighted_anti_on_device_admission_only(self):
+        # round 5 (late): weighted ANTI terms materialize ADMISSION-ONLY
+        # (encode kind 3) — they block and commit like a required anti for
+        # the owning pod but never register, so satisfied preferences never
+        # constrain later members (the oracle's original-pod bookkeeping)
+        nodes = [mknode("n-a", "zone-1a"), mknode("n-b", "zone-1b")]
         pods = [
             mkpod("w0", labels={"svc": "x"},
                   affinity_terms=[PodAffinityTerm(
                       label_selector={"svc": "x"}, topology_key=wk.ZONE_LABEL,
-                      anti=True, weight=5)])
+                      anti=True, weight=5)]),
+            mkpod("m1", labels={"svc": "x"}),
+            mkpod("m2", labels={"svc": "x"}),
+        ]
+        inp = SolverInput(pods=pods, nodes=nodes, nodepools=[pool()], zones=ZONES)
+        ref, tpu = assert_relax_parity(inp)
+        # m1/m2 are NOT blocked by w0's satisfied preference
+        assert not tpu.errors
+
+    def test_weighted_anti_relaxes_past_capacity(self):
+        # five singleton locks over three zones: two pods must drop their
+        # preference — per-pod ascending-weight relaxation, all on device
+        pods = [
+            mkpod(f"l{i}", labels={"lock": "k"},
+                  affinity_terms=[PodAffinityTerm(
+                      label_selector={"lock": "k"}, topology_key=wk.ZONE_LABEL,
+                      anti=True, weight=7)])
+            for i in range(5)
+        ]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        ref, tpu = assert_relax_parity(inp)
+        assert not tpu.errors
+
+    def test_weighted_hostname_anti_stays_on_oracle(self):
+        # no Q-axis admission-only analog yet: hostname-key weighted antis
+        # keep the whole solve on the oracle
+        pods = [
+            mkpod("w0", labels={"svc": "x"},
+                  affinity_terms=[PodAffinityTerm(
+                      label_selector={"svc": "x"},
+                      topology_key=wk.HOSTNAME_LABEL, anti=True, weight=5)])
         ]
         inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
         ref = ReferenceSolver().solve(quantize_input(inp))
@@ -243,3 +278,82 @@ class TestPreferredNodeAffinityOnDevice:
         ]
         inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
         assert_relax_parity(inp)
+
+
+class TestWeightedAntiCtAxis:
+    """CT-axis and cross-axis coverage for admission-only (kind-3) antis —
+    review finding: the zone tests alone left the ct path unpinned."""
+
+    def test_ct_weighted_anti_singletons(self):
+        # singleton locks across {on-demand, spot}: third pod relaxes
+        pods = [
+            mkpod(f"c{i}", labels={"lock": "k"},
+                  affinity_terms=[PodAffinityTerm(
+                      label_selector={"lock": "k"},
+                      topology_key=wk.CAPACITY_TYPE_LABEL, anti=True, weight=4)])
+            for i in range(3)
+        ]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        ref, tpu = assert_relax_parity(inp)
+        assert not tpu.errors
+
+    def test_zone_member_of_ct_kind3_sig_stays_on_device(self):
+        # a zone-TSC pod whose labels match a CT-axis kind-3 selector:
+        # kind-3 membership binds no axis (it never registers, so members
+        # are never blocked) — the mixed solve must stay kernel-served and
+        # oracle-exact
+        from karpenter_tpu.api.objects import TopologySpreadConstraint
+
+        pods = [
+            mkpod(f"z{i}", labels={"app": "w"},
+                  topology_spread=[TopologySpreadConstraint(
+                      max_skew=1, topology_key=wk.ZONE_LABEL,
+                      label_selector={"app": "w"})])
+            for i in range(4)
+        ] + [
+            mkpod("wa", labels={"pick": "1"},
+                  affinity_terms=[PodAffinityTerm(
+                      label_selector={"app": "w"},  # selects the zone pods
+                      topology_key=wk.CAPACITY_TYPE_LABEL, anti=True,
+                      weight=9)])
+        ]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        ref, tpu = assert_relax_parity(inp)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_weighted_anti_fuzz(seed):
+    """Weighted antis on BOTH axes beside required antis, hard spreads, and
+    existing nodes — parity per seed (the kind-3 validation fuzz, checked
+    in per review)."""
+    from tests.test_mixed_axis_device import CTS, ct_node, mkinp
+    from karpenter_tpu.api.objects import TopologySpreadConstraint
+
+    TSC1 = TopologySpreadConstraint(
+        max_skew=1, topology_key=wk.ZONE_LABEL, label_selector={"app": "w"})
+    rng = random.Random(13000 + seed)
+    pods = []
+    for i in range(rng.randrange(4, 16)):
+        r = rng.random()
+        if r < 0.3:
+            pods.append(mkpod(f"w{i}", labels={"lock": f"k{i % 3}"},
+                              affinity_terms=[PodAffinityTerm(
+                                  label_selector={"lock": f"k{i % 3}"},
+                                  topology_key=rng.choice(
+                                      [wk.ZONE_LABEL, wk.CAPACITY_TYPE_LABEL]),
+                                  anti=True, weight=rng.choice([1, 10]))]))
+        elif r < 0.5:
+            pods.append(mkpod(f"t{i}", labels={"app": "w"}, topology_spread=[TSC1]))
+        elif r < 0.65:
+            pods.append(mkpod(f"r{i}", labels={"lock": f"k{i % 3}"},
+                              affinity_terms=[PodAffinityTerm(
+                                  label_selector={"lock": f"k{i % 3}"},
+                                  topology_key=wk.ZONE_LABEL, anti=True)]))
+        else:
+            pods.append(mkpod(f"x{i}", labels=rng.choice(
+                [{"lock": "k0"}, {"app": "w"}, {}])))
+    nodes = [ct_node(f"n{j}", rng.choice(ZONES), rng.choice(CTS),
+                     matching=rng.randrange(0, 2),
+                     sel=rng.choice([{"lock": "k0"}, {"app": "w"}]))
+             for j in range(rng.randrange(0, 4))]
+    assert_relax_parity(mkinp(pods, nodes), expect_device=None)
