@@ -707,7 +707,11 @@ def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
             if t.weight is not None:
                 continue
             if t.anti and t.topology_key == wk.HOSTNAME_LABEL:
-                sig = (1, tuple(sorted(t.label_selector.items())), 1)
+                # kind 3 = admission-only (relax-materialized weighted anti):
+                # same blocking allowance as kind 1, but the e_co/c_co owner
+                # registrations stay kind-1-only — future members unblocked
+                sig = (3 if t.admission_only else 1,
+                       tuple(sorted(t.label_selector.items())), 1)
                 hostname_sigs.setdefault(sig, len(hostname_sigs))
             elif t.topology_key == wk.HOSTNAME_LABEL:
                 # positive hostname affinity (kind 2): per-target allowance
@@ -883,9 +887,10 @@ def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
                     q_owner[g, q] = True
             for t in pod.affinity_terms:
                 if (
-                    kind == 1
+                    kind in (1, 3)
                     and t.weight is None
                     and t.anti
+                    and t.admission_only == (kind == 3)
                     and t.topology_key == wk.HOSTNAME_LABEL
                     and tuple(sorted(t.label_selector.items())) == sel_sig
                 ):

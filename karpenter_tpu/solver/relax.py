@@ -25,8 +25,9 @@ Weighted ANTI terms on the zone/ct axes materialize ADMISSION-ONLY
 (encode kind 3): they block and commit like a required anti for the owning
 pod, but never register as owned antis — the oracle's bookkeeping records
 only the ORIGINAL pod, so satisfied preferences never constrain later
-members. Hostname-key weighted antis (no Q-axis kind-3 analog yet) stay on
-the oracle.
+members — on every topology key (zone/ct via V kind 3, hostname via Q
+kind 3: the allowance treats it as an anti while the e_co/c_co owner
+registrations stay kind-1-gated).
 
 Ordering: the materialized pods are re-encoded in the ORIGINAL pods'
 canonical FFD order (SolverInput.presorted) — their mutated signatures
@@ -57,11 +58,9 @@ def relax_items(pod: Pod) -> Optional[List[Tuple[int, int, str, int]]]:
     for i, t in enumerate(pod.affinity_terms):
         if t.weight is not None:
             if t.anti and t.topology_key not in (
-                wk.ZONE_LABEL, wk.CAPACITY_TYPE_LABEL
+                wk.ZONE_LABEL, wk.CAPACITY_TYPE_LABEL, wk.HOSTNAME_LABEL
             ):
-                # weighted HOSTNAME/custom-key antis: no admission-only (Q
-                # kind-3) analog yet — oracle
-                return None
+                return None  # custom-key weighted antis: oracle
             items.append((t.weight, 2, "aff", i))
     items.sort(key=lambda it: (it[0], it[1], it[3]))
     return items

@@ -654,9 +654,10 @@ def ffd_solve(
             asig = jnp.clip(asig_g, 0, V - 1)
             owned_anti = owner_v & (v_kind == 1)  # [V] — registering antis
             # kind 3 = admission-only anti (relax-materialized weighted
-            # anti): blocks this pod's own placement exactly like kind 1
-            # but never registers (no v_owner_z / c_vo writes, no commit) —
-            # the oracle records only original required terms
+            # anti): blocks AND commits for the owning pod exactly like
+            # kind 1, but never REGISTERS (no v_owner_z / c_vo writes) —
+            # the oracle records only original required terms, so satisfied
+            # preferences cannot block future members
             owned_blk = owner_v & ((v_kind == 1) | (v_kind == 3))  # [V]
             member_anti = member_v & (v_kind == 1)
             self_anti = jnp.any(owned_blk & member_v)

@@ -167,21 +167,38 @@ class TestWeightedAffinityOnDevice:
         ref, tpu = assert_relax_parity(inp)
         assert not tpu.errors
 
-    def test_weighted_hostname_anti_stays_on_oracle(self):
-        # no Q-axis admission-only analog yet: hostname-key weighted antis
-        # keep the whole solve on the oracle
+    def test_weighted_hostname_anti_on_device(self):
+        # Q-axis admission-only: the hostname allowance already treats
+        # kind 3 as an anti, and the e_co/c_co owner registrations are
+        # kind-1-gated — satisfied hostname preferences never block members
+        nodes = [mknode("n-a", "zone-1a", matching=1, sel={"svc": "x"}),
+                 mknode("n-b", "zone-1b")]
         pods = [
             mkpod("w0", labels={"svc": "x"},
                   affinity_terms=[PodAffinityTerm(
                       label_selector={"svc": "x"},
-                      topology_key=wk.HOSTNAME_LABEL, anti=True, weight=5)])
+                      topology_key=wk.HOSTNAME_LABEL, anti=True, weight=5)]),
+            mkpod("m1", labels={"svc": "x"}),
+        ]
+        inp = SolverInput(pods=pods, nodes=nodes, nodepools=[pool()], zones=ZONES)
+        ref, tpu = assert_relax_parity(inp)
+        assert not tpu.errors
+
+    def test_weighted_hostname_anti_relaxes(self):
+        # self-matching hostname singletons beyond node capacity: fresh
+        # claims are singletons too; oracle relaxation kicks in only when
+        # the pool itself cannot open more claims (it can), so every pod
+        # lands on its own target — parity pins the exact shape
+        pods = [
+            mkpod(f"h{i}", labels={"lock": "k"},
+                  affinity_terms=[PodAffinityTerm(
+                      label_selector={"lock": "k"},
+                      topology_key=wk.HOSTNAME_LABEL, anti=True, weight=3)])
+            for i in range(4)
         ]
         inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
-        ref = ReferenceSolver().solve(quantize_input(inp))
-        solver = TPUSolver()
-        tpu = solver.solve(inp)
-        assert ref.placements == tpu.placements
-        assert solver.stats["fallback_solves"] == 1, solver.stats
+        ref, tpu = assert_relax_parity(inp)
+        assert not tpu.errors
 
 
 @pytest.mark.parametrize("seed", range(8))
@@ -357,3 +374,21 @@ def test_weighted_anti_fuzz(seed):
                      sel=rng.choice([{"lock": "k0"}, {"app": "w"}]))
              for j in range(rng.randrange(0, 4))]
     assert_relax_parity(mkinp(pods, nodes), expect_device=None)
+
+
+def test_custom_key_weighted_anti_stays_on_oracle():
+    """Custom topology keys have no kind-3 encoding; the relax plan must
+    decline so the whole solve (preferences intact) replays on the oracle —
+    exact-path pinned per the repo's routing-test convention."""
+    pods = [
+        mkpod("c0", labels={"svc": "x"},
+              affinity_terms=[PodAffinityTerm(
+                  label_selector={"svc": "x"}, topology_key="rack",
+                  anti=True, weight=5)])
+    ]
+    inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+    ref = ReferenceSolver().solve(quantize_input(inp))
+    solver = TPUSolver()
+    tpu = solver.solve(inp)
+    assert ref.placements == tpu.placements
+    assert solver.stats["fallback_solves"] == 1, solver.stats
