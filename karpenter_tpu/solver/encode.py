@@ -19,15 +19,16 @@ tensors plus boolean constraint masks"). It performs:
     [T,Z,C] offering availability/price, [G,G] pairwise group compatibility.
 
 Pods the device kernel cannot express (OR'd node-affinity alternatives,
-weighted ANTI affinity under --preference-policy=Respect,
-custom-topology-key terms, stacked positive hostname terms, kind-2 groups
+custom-topology-key terms — including custom-key weighted antis,
+stacked positive hostname terms, kind-2 groups
 that are also domain-constrained, single pods domain-constrained on BOTH
 the zone and ct axes, or ≥3-way custom-label joint conflicts) are flagged
 `fallback` — the hybrid solver routes those to the reference path (see
-karpenter_tpu/solver/backend.py). Other Respect-mode preferences
-(ScheduleAnyway spreads, weighted positive affinity, preferred node
-affinity) are served on device by the relax loop (solver/relax.py), which
-materializes them as required constraints before this encoder runs. Zone-
+karpenter_tpu/solver/backend.py). Respect-mode preferences on the known
+keys (ScheduleAnyway spreads, weighted positive affinity, preferred node
+affinity, zone/ct/hostname weighted antis) are served on device by the
+relax loop (solver/relax.py), which materializes them as required — or,
+for antis, admission-only kind-3 — constraints before this encoder runs. Zone-
 and capacity-type-granular spread/affinity run ON DEVICE — including solves
 MIXING the two axes (concatenated domain columns, per-group axis binding) —
 as does positive hostname affinity (V domain axis / Q kind 2).
