@@ -482,7 +482,79 @@ def _emit_unavailable(reason: str) -> None:
     }))
 
 
+def bench_encode_only(num_pods: int = 50_000) -> None:
+    """CPU micro-bench of the HOST encode path alone (no device, no jax
+    backend init): fresh full encode vs exact-key hit vs steady-state
+    pod-delta patches through the incremental encode cache
+    (solver/encode_cache.py). Run with --encode-only or
+    KTPU_BENCH_ENCODE_ONLY=1; emits its own JSON line."""
+    import dataclasses as _dc
+
+    from karpenter_tpu.solver import encode as em
+    from karpenter_tpu.solver import encode_cache as ec
+    from karpenter_tpu.solver.encode import encode, quantize_input
+
+    t0 = time.perf_counter()
+    inp = build_input(num_pods)
+    print(f"[bench] built {num_pods} pods in {time.perf_counter()-t0:.1f}s",
+          file=sys.stderr)
+
+    em._CORE_CACHE.clear()
+    ec.reset_stats()
+    t0 = time.perf_counter()
+    enc = encode(quantize_input(inp))
+    fresh_ms = (time.perf_counter() - t0) * 1000
+
+    # exact-key hit: unchanged input, fully cached core
+    t0 = time.perf_counter()
+    encode(quantize_input(inp))
+    hit_ms = (time.perf_counter() - t0) * 1000
+
+    # steady state: each subset is a NEW pod set inside the known signature
+    # universe — an exact-key miss whose core patches off the cached donor
+    patched = []
+    for k in range(1, 7):
+        sub = _dc.replace(inp, pods=inp.pods[: num_pods - 10 * k])
+        t0 = time.perf_counter()
+        encode(quantize_input(sub))
+        patched.append((time.perf_counter() - t0) * 1000)
+    patched_ms = float(np.percentile(np.asarray(patched), 50))
+    stats = dict(ec.STATS)
+    print(
+        f"[bench] encode-only ({num_pods} pods, cpu): fresh={fresh_ms:.0f}ms "
+        f"hit={hit_ms:.1f}ms patched-p50={patched_ms:.0f}ms — G={enc.G} "
+        f"runs={len(enc.run_group)} cache={stats}",
+        file=sys.stderr,
+    )
+    assert stats["patches"] >= 6, f"delta encodes did not patch: {stats}"
+    print(json.dumps({
+        "metric": f"encode_p50_{num_pods // 1000}k_pods_cpu",
+        "value": round(patched_ms, 2),
+        "unit": "ms",
+        "encode_fresh_ms": round(fresh_ms, 2),
+        "encode_hit_ms": round(hit_ms, 2),
+        "encode_cache_speedup": round(fresh_ms / max(patched_ms, 1e-9), 1),
+        "encode_only": True,
+    }))
+
+
 def main() -> None:
+    if "--encode-only" in sys.argv[1:] or os.environ.get(
+        "KTPU_BENCH_ENCODE_ONLY", ""
+    ).lower() in ("1", "true", "yes"):
+        bench_encode_only()
+        return
+    # JAX_PLATFORMS pinned to host-only platforms means no accelerator can
+    # EVER appear — the 4-attempt probe/backoff loop (~13 min) would be pure
+    # waste. Fail fast with a reason distinct from a tunnel outage.
+    jp = os.environ.get("JAX_PLATFORMS", "")
+    if jp and all(p.strip().lower() in ("", "cpu") for p in jp.split(",")):
+        _emit_unavailable(
+            f"JAX_PLATFORMS={jp!r} is host-only: no accelerator can appear; "
+            "skipping probe retries (use --encode-only for the CPU "
+            "encode micro-bench)"
+        )
+        return
     plat = wait_for_backend()
     if plat is None:
         _emit_unavailable("accelerator backend never initialized "
@@ -545,9 +617,30 @@ def _run(plat: str) -> None:
 
     t0 = time.perf_counter()
     enc = encode(quantize_input(inp))
+    encode_fresh_s = time.perf_counter() - t0
     print(
-        f"[bench] encode: {time.perf_counter()-t0:.1f}s — G={enc.G} runs={len(enc.run_group)} "
+        f"[bench] encode: {encode_fresh_s:.1f}s — G={enc.G} runs={len(enc.run_group)} "
         f"T={enc.T} P={enc.P}",
+        file=sys.stderr,
+    )
+
+    # steady-state encode: pod-delta patches against the warm core cache —
+    # the control loop's per-tick host cost once the surge shape is known
+    import dataclasses as _dc
+
+    from karpenter_tpu.solver import encode_cache as ec
+
+    ec.reset_stats()
+    etimes = []
+    for k in range(1, 5):
+        sub = _dc.replace(inp, pods=inp.pods[: len(inp.pods) - 10 * k])
+        t0 = time.perf_counter()
+        encode(quantize_input(sub))
+        etimes.append((time.perf_counter() - t0) * 1000)
+    encode_ms = float(np.percentile(np.asarray(etimes), 50))
+    print(
+        f"[bench] encode steady-state (pod-delta): {encode_ms:.0f}ms "
+        f"(cache {dict(ec.STATS)})",
         file=sys.stderr,
     )
 
@@ -697,6 +790,9 @@ def _run(plat: str) -> None:
                 "config5_prefix_nodes": c5_k,
                 "config5_dispatches": c5_d,
                 "s_stress_e2e_p50_ms": round(ss_p50, 2),
+                "encode_ms": round(encode_ms, 2),
+                "encode_fresh_ms": round(encode_fresh_s * 1000, 2),
+                "first_solve_ms": round(compile_s * 1000, 1),
                 "first_call_s": round(compile_s, 2),
             }
         )

@@ -198,6 +198,14 @@ class CloudProvider(abc.ABC):
     def get_instance_types(self, nodepool_name: str) -> List[InstanceType]:
         ...
 
+    def catalog_token(self) -> Optional[tuple]:
+        """Change token for the instance-type catalog beyond store events
+        (ICE masking, reservations, discovered capacity). None means the
+        provider cannot prove catalog stability — the provisioner then skips
+        the encode-cache state_rev stamp and the encoder compares catalog
+        keys in full (always safe, just slower)."""
+        return None
+
     def is_drifted(self, node_claim: NodeClaim) -> Optional[str]:
         return None
 

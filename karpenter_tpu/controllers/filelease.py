@@ -12,9 +12,23 @@ the same way two threads do on the in-process store, and kill -9 of the
 holder releases nothing (the standby waits out lease_duration_s, exactly
 like kube leases).
 
-Timebase: renew_time in the file must be comparable ACROSS processes, so
-file-backed electors run on time.time() (wall), not time.monotonic() —
-new_kwok_operator wires that when lease_path is set.
+Timebase: renew_time in the file is the HOLDER's wall clock (time.time();
+new_kwok_operator wires that when lease_path is set) — but no other process
+ever compares against it. Expiry follows client-go semantics: each elector
+records (resource_version, holder, renew_time) with ITS OWN clock when it
+observes the record change, and seizes only after the record sits unchanged
+for a full lease_duration_s on that local clock (leaderelection.py). Renewal
+still writes renew_time so every CAS changes the record; cross-host clock
+skew can neither expire a live lease (dual leaders) nor immortalize a dead
+one.
+
+Storage requirement: the lease path must live on a filesystem whose
+advisory byte-range/flock locking is coherent ACROSS HOSTS — NFSv4+ (lock
+leases in-protocol), or a local disk when both replicas share a node. NFSv3
+(separate lockd), SMB/CIFS mounted with `nolock`/`nobrl`, and most FUSE
+overlays grant flock locally without cross-host coherence, which would let
+two CAS sections interleave. The deploy renderer's storageClassName
+validation (deploy/render.py) carries the same note next to the RWX check.
 """
 
 from __future__ import annotations

@@ -79,6 +79,16 @@ class LeaderElector:
         # Strictly increases across acquisitions, so a deposed leader's
         # stale token loses against the new leader's writes.
         self.fence_token = 0
+        # Local observation of the remote record (client-go semantics): a
+        # candidate judges expiry from ITS OWN clock at the moment it last
+        # saw the record CHANGE — (rv, holder, renew_time). The holder's
+        # renew_time may only SHORTEN the wait (restored leases carry their
+        # remaining duration), floored at a renew interval of observed
+        # silence — see tick(). Cross-host clock skew therefore cannot
+        # manufacture an "expired" lease while the holder is alive and
+        # renewing (dual-leader), nor keep a dead holder's lease alive.
+        self._obs_key: Optional[tuple] = None
+        self._obs_at: float = 0.0
 
     def is_leader(self) -> bool:
         return self._leading
@@ -105,6 +115,11 @@ class LeaderElector:
         now = self.clock()
         lease: Optional[Lease] = self.store.try_get(LEASES, LEADER_LEASE_NAME)
         was = self._leading
+        if lease is not None:
+            key = (lease.meta.resource_version, lease.holder, lease.renew_time)
+            if key != self._obs_key:
+                self._obs_key = key
+                self._obs_at = now
         if lease is None:
             try:
                 created = self.store.create(
@@ -135,14 +150,29 @@ class LeaderElector:
                 self._leading = True
             if self._leading and was != self._leading:
                 self.takeover = False  # our own lease — reclaim, not failover
-        elif now - lease.renew_time > lease.lease_duration_s:
-            # expired (or resigned): seize from the previous holder; CAS
-            # loser stays standby
-            self._leading = self._cas(lease, self.identity, now)
-            if self._leading:
-                self.takeover = True
         else:
-            self._leading = False
+            # Expiry deadline on OUR clock: a full lease duration of observed
+            # silence — or sooner, when the record's renew_time is meaningful
+            # on this clock (restored snapshot rebases it; same-clock peers
+            # share it) and implies less remaining. The renew_time shortcut
+            # is floored at a full renew interval of observed silence, so a
+            # live holder whose clock runs BEHIND ours always renews the
+            # record (resetting the observation) before we can seize —
+            # wall-clock skew still cannot manufacture an expired lease.
+            deadline = min(
+                self._obs_at + lease.lease_duration_s,
+                max(lease.renew_time + lease.lease_duration_s,
+                    self._obs_at + self.renew_s),
+            )
+            if lease.holder == "" or now > deadline:
+                # Resigned (empty holder: immediately acquirable, kube treats
+                # an unheld record as free) or expired. Seize from the
+                # previous holder; CAS loser stays standby.
+                self._leading = self._cas(lease, self.identity, now)
+                if self._leading:
+                    self.takeover = True
+            else:
+                self._leading = False
         LEADER.set(1.0 if self._leading else 0.0, identity=self.identity)
         return self._leading != was
 

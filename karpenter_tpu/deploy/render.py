@@ -182,11 +182,18 @@ def render(overrides: Optional[Dict[str, Any]] = None) -> List[Dict[str, Any]]:
             "own filesystem. Clear both settings or keep stateVolume."
         )
     if state_vol and not state_vol.get("storageClassName"):
+        # RWX alone is not sufficient: the lease transport is flock-based
+        # (controllers/filelease.py), so the class must also provide
+        # CROSS-HOST-coherent advisory locking — NFSv4+/Filestore/EFS/CephFS
+        # qualify; NFSv3 lockd setups and `nolock`/`nobrl` mounts grant
+        # flock locally and would let two replicas lead.
         raise ValueError(
-            "stateVolume.storageClassName must name an RWX-capable class: "
+            "stateVolume.storageClassName must name an RWX-capable class "
+            "with cross-host flock coherence (NFSv4+/Filestore/EFS/CephFS): "
             "falling back to the cluster default StorageClass (commonly "
-            "RWO-only) would leave every replica Pending. Name your NFS/"
-            "Filestore/EFS/CephFS class, or disable stateVolume (and clear "
+            "RWO-only) would leave every replica Pending, and a class "
+            "without coherent locking silently breaks the leader lease. "
+            "Name your class, or disable stateVolume (and clear "
             "settings.leasePath/snapshotPath) to run without HA state."
         )
     if state_vol:

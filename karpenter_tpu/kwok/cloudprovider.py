@@ -112,6 +112,18 @@ class KwokCloudProvider(CloudProvider):
     def _reservation_version(self) -> int:
         return sum((r.total + 1) * 1000 + r.available for r in self.reservations.list())
 
+    def catalog_token(self) -> tuple:
+        """Identity of the current masked catalog for the encode-cache stamp
+        (state/cluster.py:EncodeDeltas): the same SeqNum tuple that keys the
+        masked-catalog cache above, so equal tokens guarantee
+        get_instance_types returned the SAME list objects (pools_key ids)."""
+        with self._lock:
+            return (
+                self.unavailable.seq_num,
+                self._reservation_version(),
+                self.discovered.seq if self.discovered is not None else -1,
+            )
+
     # -- create -------------------------------------------------------------
 
     def create(self, claim: NodeClaim, instance_type_names: Optional[Sequence[str]] = None) -> NodeClaim:

@@ -81,6 +81,9 @@ def main(argv=None) -> int:
         snapshot_path=o.snapshot_path or None,
         snapshot_interval_s=o.snapshot_interval_s,
         warm_start=o.warm_start and o.solver_backend == "tpu",
+        aot_prewarm=o.aot_prewarm and o.solver_backend == "tpu",
+        prewarm_scale_pods=o.prewarm_scale_pods,
+        compile_cache_dir=o.compile_cache_dir or None,
         leader_elect=o.leader_elect,
         lease_path=o.lease_path or None,
     )

@@ -64,6 +64,9 @@ def new_kwok_operator(
     snapshot_path: Optional[str] = None,
     snapshot_interval_s: float = 5.0,
     warm_start: bool = False,
+    aot_prewarm: bool = False,
+    prewarm_scale_pods: int = 50_000,
+    compile_cache_dir: Optional[str] = None,
     leader_elect: bool = False,
     identity: str = "",
     lease_path: Optional[str] = None,
@@ -210,16 +213,37 @@ def new_kwok_operator(
                 fence=(lambda: elector.fence_token) if elector is not None else None,
             )
         )
-    if warm_start and hasattr(solver, "warmup"):
-        # pre-compile standard shape buckets off the boot path: first
-        # production solve hits a warm jit cache instead of a compile stall
+    if compile_cache_dir:
+        # persistent XLA compilation cache: compilations (jit AND the AOT
+        # prewarm's) are keyed by HLO hash on disk, so a restarted replica
+        # reuses them instead of recompiling (min cache-size/compile-time
+        # floors dropped to zero — control-loop kernels are small but their
+        # compiles are the entire first-solve stall)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", compile_cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    if (warm_start and hasattr(solver, "warmup")) or (
+        aot_prewarm and hasattr(solver, "prewarm_aot")
+    ):
+        # pre-compile off the boot path: the AOT pass lowers the whole
+        # claim-bucket lattice (incl. overflow-retry shapes) without touching
+        # the device, then warm-start solves fill the in-process jit cache
+        # for the standard pod buckets — first production solve hits a warm
+        # cache instead of a compile stall
         import threading
 
         zones = sorted({o.zone for it in types for o in it.offerings})
-        threading.Thread(
-            target=lambda: solver.warmup(types, zones), daemon=True,
-            name="solver-warmup",
-        ).start()
+
+        def _warm():
+            if aot_prewarm and hasattr(solver, "prewarm_aot"):
+                solver.prewarm_aot(types, zones,
+                                   expected_pods=prewarm_scale_pods)
+            if warm_start and hasattr(solver, "warmup"):
+                solver.warmup(types, zones)
+
+        threading.Thread(target=_warm, daemon=True, name="solver-warmup").start()
     return Operator(
         store=store,
         cloud=cloud,

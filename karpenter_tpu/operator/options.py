@@ -47,6 +47,16 @@ class Options:
     vm_memory_overhead_percent: float = 0.075  # options.go:36-56
     # pre-compile solver shape buckets at boot (background thread)
     warm_start: bool = True
+    # ahead-of-time compile the claim-bucket lattice at boot (no device
+    # execution; covers overflow-retry shapes warm_start's solves never hit)
+    aot_prewarm: bool = True
+    # claim-bucket lattice is sized for surges up to this many pods
+    prewarm_scale_pods: int = 50_000
+    # persistent XLA compilation cache directory (jax_compilation_cache_dir):
+    # compilations — including the AOT prewarm's — survive process restarts,
+    # so a fresh replica boots with zero compile stalls. Empty = in-process
+    # jit cache only.
+    compile_cache_dir: str = ""
     # durability: periodic store+cloud snapshot with boot-time restore
     # (kwok ConfigMap-backup analog, kwok/ec2/ec2.go:112-232); empty = off
     snapshot_path: str = ""

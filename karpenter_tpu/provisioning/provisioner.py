@@ -101,6 +101,19 @@ class Provisioner:
                 if cr:
                     cts.update(cr.values_list())
         daemonsets = [d for d in self.store.list(st.DAEMONSETS)]
+        # Encode-cache stamp: (tracker, (store catalog rev, provider catalog
+        # token), pods rev, nodes rev). Store events alone don't cover
+        # ICE/reservation masking (the provider re-masks with no store
+        # event), so without a provider token the stamp stays None and the
+        # encoder does the full catalog-key compare instead.
+        state_rev = None
+        deltas = getattr(self.cluster, "encode_deltas", None)
+        tok_fn = getattr(self.cloud_provider, "catalog_token", None)
+        if deltas is not None and callable(tok_fn):
+            tok = tok_fn()
+            if tok is not None:
+                tracker, crev, prev, nrev = deltas.snapshot()
+                state_rev = (tracker, (crev, tok), prev, nrev)
         return SolverInput(
             pods=pending,
             nodes=self.cluster.existing_nodes_for_scheduler(),
@@ -109,6 +122,7 @@ class Provisioner:
             zones=tuple(sorted(zones)),
             capacity_types=tuple(sorted(cts)) or ("on-demand", "spot"),
             preference_policy=self.preference_policy,
+            state_rev=state_rev,
         )
 
     def _next_claim_name(self, nodepool: str, suffix: str = "") -> str:

@@ -66,6 +66,12 @@ class SolverInput:
     # ORIGINAL pods' processing order while pods' materialized signatures
     # change between redispatches.
     presorted: bool = False
+    # Encode-cache delta stamp (state/cluster.py:EncodeDeltas.snapshot()):
+    # (tracker identity, catalog rev, pods rev, nodes rev). Optional hint —
+    # a matching tracker + catalog rev lets the incremental encoder skip the
+    # deep catalog-key compare when hunting a patch donor (solver/
+    # encode_cache.py); None is always safe (full compare).
+    state_rev: Optional[tuple] = None
 
 
 @dataclass

@@ -341,6 +341,34 @@ def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
     return args, dims
 
 
+# Bucketed shape of every ffd.ARG_SPEC positional, in dim symbols — the AOT
+# prewarm (TPUSolver.prewarm_aot) builds ShapeDtypeStructs from this without
+# materializing arrays, and self-checks the table against a concrete
+# kernel_args() result before compiling anything, so it can never drift
+# silently. W = (Gp+31)//32 pair-words; D = the domain-axis width
+# (Z / C / Z+C by v_axis).
+_AOT_SHAPES = {
+    "run_group": ("Sp",), "run_count": ("Sp",),
+    "group_req": ("Gp", "R"), "group_compat_t": ("Gp", "Tp"),
+    "group_zc_bits": ("Gp",), "group_pool": ("Gp", "Pp"),
+    "group_pair_nok": ("Gp", "W"), "group_device": ("Gp",),
+    "type_alloc": ("Tp", "R"), "type_charge": ("Tp", "R"),
+    "offer_zc_bits": ("Tp",), "pool_type": ("Pp", "Tp"),
+    "pool_zc_bits": ("Pp",), "pool_daemon": ("Pp", "R"),
+    "pool_limit": ("Pp", "R"), "pool_usage0": ("Pp", "R"),
+    "node_free": ("Ep", "R"), "node_compat": ("Gp", "Ep"),
+    "q_member": ("Gp", "Qp"), "q_owner": ("Gp", "Qp"),
+    "q_kind": ("Qp",), "q_cap": ("Qp",),
+    "node_q_member": ("Ep", "Qp"), "node_q_owner": ("Ep", "Qp"),
+    "v_member": ("Gp", "Vp"), "v_owner": ("Gp", "Vp"),
+    "v_kind": ("Vp",), "v_cap": ("Vp",),
+    "v_primary": ("Gp",), "v_aff": ("Gp",),
+    "v_count0": ("Vp", "D"), "node_zone": ("Ep",),
+    "zone_col_mask": ("D",), "node_dom2": ("Ep",),
+    "col_axis": ("D",), "group_daxis": ("Gp",),
+}
+
+
 def min_values_post_check(qinp: SolverInput, result: SolverResult) -> bool:
     """minValues floors for the tensor backends (nodepools.md:268-330): the
     kernels narrow type sets without counting distinct requirement values, so
@@ -552,7 +580,17 @@ class TPUSolver(Solver):
             # provisioner seam relies on still overlaps host and device work.
             from ..provisioning.scheduler import ffd_sort
 
-            order = ffd_sort(qinp.pods)
+            # Sort the FILTERED list (gated/bound pods dropped first): the
+            # oracle sorts only schedulable pods, and sorting the full list
+            # shifts signature first-appearance within equal-size blocks,
+            # diverging the relax path's processing order from the oracle's.
+            order = ffd_sort(
+                [
+                    p
+                    for p in qinp.pods
+                    if not p.scheduling_gated and p.node_name is None
+                ]
+            )
             dropped = {u: 0 for u in relax_plan}
             first = self._relax_dispatch(qinp, relax_plan, order, dropped)
             return AsyncSolve(
@@ -716,6 +754,89 @@ class TPUSolver(Solver):
                                    zones=tuple(zones), capacity_types=tuple(capacity_types)))
             n_warm += 1
         return n_warm
+
+    def prewarm_aot(self, instance_types, zones,
+                    capacity_types=("on-demand", "spot"),
+                    expected_pods: int = 50_000, with_zone_engine: bool = True,
+                    claim_buckets=None) -> int:
+        """Ahead-of-time compile the kernel's bucket lattice WITHOUT running
+        solves: lower `ffd_solve` on ShapeDtypeStructs for every claim bucket
+        the configured scale can reach (initial_claim_bucket ladder +
+        overflow doublings to max_claims) and compile. Unlike warmup() this
+        executes nothing on device and covers the M ladder in one pass; the
+        compilations land in the persistent compilation cache (operator
+        options `compile_cache_dir` wires jax_compilation_cache_dir), so
+        production dispatches — including overflow retries — skip XLA
+        compilation even in a fresh process.
+
+        Returns the number of lattice points compiled (0 when the shape
+        table drifted from kernel_args — the guard refuses to compile shapes
+        production would never request)."""
+        import jax
+
+        from ..api import wellknown as wk
+        from ..api.objects import ObjectMeta, Pod
+        from ..provisioning.scheduler import NodePoolSpec, SolverInput
+        from ..scheduling.requirements import IN, Requirement, Requirements
+        from ..utils.resources import Resources
+        from .encode import encode, quantize_input
+        from .tpu.ffd import ARG_SPEC, ffd_solve
+
+        # one tiny encode against the REAL catalog fixes every
+        # catalog-derived bucket (Tp/Pp/R/Z/C) and all arg dtypes
+        pool = NodePoolSpec(
+            name="prewarm", weight=0,
+            requirements=Requirements.of(
+                Requirement.create(wk.NODEPOOL_LABEL, IN, ["prewarm"])
+            ),
+            taints=[], instance_types=list(instance_types),
+        )
+        pods = [
+            Pod(meta=ObjectMeta(name=f"pw{i:03d}", uid=f"pw{i:03d}"),
+                requests=Resources.parse({"cpu": "100m", "memory": "128Mi"}))
+            for i in range(4)
+        ]
+        enc = encode(quantize_input(SolverInput(
+            pods=pods, nodes=[], nodepools=[pool],
+            zones=tuple(zones), capacity_types=tuple(capacity_types),
+        )))
+        try:
+            args0, dims = kernel_args(enc, self._bucket)
+        except UnpackableInput:
+            return 0
+        dims = dict(dims)
+        dims["D"] = int(args0[ARG_SPEC.index("zone_col_mask")].shape[0])
+        for i, name in enumerate(ARG_SPEC):
+            if tuple(args0[i].shape) != tuple(dims[s] for s in _AOT_SHAPES[name]):
+                return 0  # table out of sync with kernel_args — never
+                # compile shapes production would not request
+        if claim_buckets is None:
+            mc = self.max_claims
+            # initial buckets for small/medium/configured surges, plus the
+            # overflow-retry ceiling (doubling always ends at max_claims)
+            claim_buckets = sorted({
+                initial_claim_bucket(64, mc),
+                initial_claim_bucket(600, mc),
+                initial_claim_bucket(int(expected_pods), mc),
+                max(mc, 64),
+            })
+        specs = tuple(
+            jax.ShapeDtypeStruct(
+                tuple(dims[s] for s in _AOT_SHAPES[name]), args0[i].dtype
+            )
+            for i, name in enumerate(ARG_SPEC)
+        )
+        n = 0
+        for M in claim_buckets:
+            for ze in (False, True) if with_zone_engine else (False,):
+                try:
+                    ffd_solve.lower(
+                        *specs, max_claims=int(M), zone_engine=ze
+                    ).compile()
+                except Exception:
+                    return n  # a compile failure would repeat at every point
+                n += 1
+        return n
 
     # -- device path --------------------------------------------------------
 

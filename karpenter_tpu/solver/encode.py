@@ -324,6 +324,50 @@ _TYPE_ROW_CACHE: dict = {}
 # pod-signature -> (catalog id-tuple, pinned types, [T] bool compat row)
 _GROUP_COMPAT_CACHE: dict = {}
 
+# Label-dict intern table + selector-match verdict cache: the Q/V member
+# tables (both the [G,*] group side and the [E,*] node side) reduce to
+# "does selector S match label-set L" — a pure function of content. Interning
+# every distinct label dict to a small id and caching the verdict per
+# (selector, label-id) turns the former per-(node, sig, bound-pod) Python
+# loops into one verdict per DISTINCT (selector, label-set) plus vectorized
+# gathers. Both tables clear together on overflow (verdict keys embed label
+# ids, so a stale verdict can never pair with a recycled id).
+_LAB_IDS: Dict[tuple, int] = {}
+_LAB_CAP = 200_000
+_SEL_MATCH: Dict[tuple, bool] = {}
+
+
+def _lab_id(labels: dict) -> int:
+    global _LAB_IDS, _SEL_MATCH
+    key = tuple(sorted(labels.items()))
+    n = _LAB_IDS.get(key)
+    if n is None:
+        if len(_LAB_IDS) >= _LAB_CAP:
+            _LAB_IDS = {}
+            _LAB_KEYS.clear()
+            _SEL_MATCH.clear()
+        n = len(_LAB_IDS)
+        _LAB_IDS[key] = n
+        _LAB_KEYS[n] = key
+    return n
+
+
+_LAB_KEYS: Dict[int, tuple] = {}  # reverse map (rebuilt lazily on clear)
+
+
+def _sel_verdicts(sel_sig: tuple, lids: np.ndarray) -> np.ndarray:
+    """[len(lids)] bool — does the selector match each interned label set."""
+    out = np.empty(len(lids), dtype=bool)
+    sel = dict(sel_sig)
+    for i, lid in enumerate(lids.tolist()):
+        v = _SEL_MATCH.get((sel_sig, lid))
+        if v is None:
+            lab = dict(_LAB_KEYS[lid])
+            v = all(lab.get(k) == val for k, val in sel.items())
+            _SEL_MATCH[(sel_sig, lid)] = v
+        out[i] = v
+    return out
+
 
 def _quantize_type(it):
     """Per-InstanceType quantization, cached by object identity (the catalog
@@ -432,6 +476,7 @@ def quantize_input(inp: SolverInput) -> SolverInput:
         zones=inp.zones,
         capacity_types=inp.capacity_types,
         preference_policy=inp.preference_policy,
+        state_rev=getattr(inp, "state_rev", None),
     )
 
 
@@ -492,10 +537,49 @@ class _EncodeCore:
     all_req_keys: List[str]
     zid: Dict[str, int]
     cid: Dict[str, int]
+    # patch-layer identity (solver/encode_cache.py): the ordered DISTINCT
+    # interned signature ids this core was built from, and the intern epoch
+    # they are valid in. Every [G]/[T]/[P]-indexed table above is a pure
+    # function of (this sequence, the catalog segment of the cache key), so
+    # a new pod set producing the same sequence under the same epoch can
+    # reuse them verbatim. () / -1 = not patchable (batch-local sig ids).
+    group_snums: tuple = ()
+    sig_epoch: int = -1
 
 
-_CORE_CACHE: Dict[tuple, _EncodeCore] = {}
+_CORE_CACHE: Dict[tuple, tuple] = {}
 _CORE_CACHE_MAX = 4
+
+
+def _group_structure(pods_sorted: List[Pod], sigs: np.ndarray):
+    """Group/run decomposition of an FFD-sorted pod list: per-group pod
+    lists (first-appearance order), the run split, and the ordered distinct
+    signature sequence. Pure NumPy except the run-slice extends."""
+    n_pods = len(pods_sorted)
+    if not n_pods:
+        return [], np.zeros(0, np.int32), np.zeros(0, np.int32), ()
+    # group ids in first-appearance order over the sorted sequence
+    _, first_idx, inv = np.unique(sigs, return_index=True, return_inverse=True)
+    rank = np.empty(len(first_idx), np.int64)
+    rank[np.argsort(first_idx, kind="stable")] = np.arange(len(first_idx))
+    gids = rank[inv]
+    G = len(first_idx)
+    # runs: consecutive same-group stretches of the sorted pod list
+    change = np.flatnonzero(np.diff(gids) != 0) + 1
+    starts = np.concatenate(([0], change))
+    run_group = gids[starts].astype(np.int32)
+    run_count = np.diff(np.concatenate((starts, [n_pods]))).astype(np.int32)
+    # per-group pod lists assembled run-by-run (S slices of the sorted
+    # list, C-speed extend) — NOT via an object ndarray: numpy's
+    # list→object-array fill probes every element and costs ~70ms at 50k
+    group_pods: List[List[Pod]] = [[] for _ in range(G)]
+    pos = 0
+    for s in range(len(run_group)):
+        c = int(run_count[s])
+        group_pods[int(run_group[s])].extend(pods_sorted[pos : pos + c])
+        pos += c
+    group_snums = tuple(int(s) for s in sigs[np.sort(first_idx)])
+    return group_pods, run_group, run_count, group_snums
 
 
 def _reqs_key(reqs: Requirements) -> tuple:
@@ -566,20 +650,42 @@ def encode(inp: SolverInput) -> EncodedInput:
     key, ids = _core_key(pods_f, inp)
     ent = _CORE_CACHE.get(key)
     if ent is not None and np.array_equal(ids, ent[0]):
+        from . import encode_cache as ec
+
+        ec.STATS["hits"] += 1
         core = ent[1]
     else:
-        core = _build_core(inp, pods_f)
+        from . import encode_cache as ec
+
+        # delta-patch path: same sig universe + same catalog as a cached
+        # core (pods added/removed within known groups) reuses every
+        # group/type/pool table and rebuilds only the run split — falls
+        # back to a full build for any other delta class
+        presort = ffd_sort_with_sigs(pods_f, presorted=False)
+        structure = _group_structure(presort[0], presort[1])
+        state_rev = getattr(inp, "state_rev", None)
+        core = ec.try_patch(key, presort, structure, _CORE_CACHE, state_rev)
+        if core is None:
+            core = _build_core(inp, pods_f, presort, structure)
+            ec.STATS["rebuilds"] += 1
+        else:
+            ec.STATS["patches"] += 1
         if len(_CORE_CACHE) >= _CORE_CACHE_MAX:
             _CORE_CACHE.pop(next(iter(_CORE_CACHE)))
         # entry pins the instance-type objects whose ids appear in the key
         # (pods are pinned via core.group_pods), so ids can't be recycled
         # while the entry lives
         type_pins = tuple(it for p in inp.nodepools for it in p.instance_types)
-        _CORE_CACHE[key] = (ids, core, type_pins)
+        _CORE_CACHE[key] = (ids, core, type_pins, state_rev)
     return _encode_with_nodes(core, inp)
 
 
-def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
+def _build_core(
+    inp: SolverInput,
+    pods_f: List[Pod],
+    presort: Optional[tuple] = None,
+    structure: Optional[tuple] = None,
+) -> _EncodeCore:
     # ---- axes -------------------------------------------------------------
     zones = list(inp.zones)
     cts = list(inp.capacity_types)
@@ -597,39 +703,15 @@ def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
     T = len(type_names)
 
     # ---- groups (vectorized: the only O(pods) work is cached-key gathering)
-    pods_sorted, sigs, sorted_uids, sigs_interned = ffd_sort_with_sigs(
-        pods_f, presorted=getattr(inp, "presorted", False)
-    )
-    n_pods = len(pods_sorted)
-    if n_pods:
-        # group ids in first-appearance order over the sorted sequence
-        _, first_idx, inv = np.unique(sigs, return_index=True, return_inverse=True)
-        rank = np.empty(len(first_idx), np.int64)
-        rank[np.argsort(first_idx, kind="stable")] = np.arange(len(first_idx))
-        gids = rank[inv]
-        G = len(first_idx)
-        # runs: consecutive same-group stretches of the sorted pod list
-        change = np.flatnonzero(np.diff(gids) != 0) + 1
-        starts = np.concatenate(([0], change))
-        run_group = gids[starts].astype(np.int32)
-        run_count = np.diff(np.concatenate((starts, [n_pods]))).astype(np.int32)
-        # per-group pod lists (sorted order preserved within each group)
-        # per-group pod lists assembled run-by-run (S slices of the sorted
-        # list, C-speed extend) — NOT via an object ndarray: numpy's
-        # list→object-array fill probes every element and costs ~70ms at 50k
-        group_pods = [[] for _ in range(G)]
-        pos = 0
-        for s in range(len(run_group)):
-            c = int(run_count[s])
-            group_pods[int(run_group[s])].extend(pods_sorted[pos : pos + c])
-            pos += c
-        group_snums = [int(s) for s in sigs[np.sort(first_idx)]]
-    else:
-        G = 0
-        group_pods = []
-        run_group = np.zeros(0, np.int32)
-        run_count = np.zeros(0, np.int32)
-        group_snums = []
+    if presort is None:
+        presort = ffd_sort_with_sigs(
+            pods_f, presorted=getattr(inp, "presorted", False)
+        )
+    pods_sorted, sigs, sorted_uids, sigs_interned = presort
+    if structure is None:
+        structure = _group_structure(pods_sorted, sigs)
+    group_pods, run_group, run_count, group_snums = structure
+    G = len(group_pods)
 
     # ---- resource axis (from group representatives — same-group pods have
     # identical requests, so the scan is O(groups), not O(pods)) -------------
@@ -664,9 +746,15 @@ def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
     group_ct_antis: List[List[tuple]] = []
     group_ct_affs: List[List[tuple]] = []
     group_h2: List[bool] = []  # owns a positive hostname-affinity term
+    # hostname sigs OWNED per group, collected during the term scan below —
+    # a term that constructs/merges a sig key is exactly what the former
+    # per-sig rescan matched, so collection is the same ownership relation
+    # without the O(G·Q) second pass
+    group_h_owned: List[List[tuple]] = []
     respect_prefs = inp.preference_policy != "Ignore"
     for g, pl in enumerate(group_pods):
         pod = pl[0]
+        h_owned: List[tuple] = []
         if len(pod.node_affinity) > 1:
             fallback[g] = True
         if respect_prefs and (
@@ -692,6 +780,7 @@ def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
                 # SPEC.md hostname floor-0 rule)
                 sig = (0, tuple(sorted(t.label_selector.items())), t.max_skew)
                 hostname_sigs.setdefault(sig, len(hostname_sigs))
+                h_owned.append(sig)
             elif t.topology_key == wk.ZONE_LABEL:
                 sig = (0, tuple(sorted(t.label_selector.items())), t.max_skew)
                 zone_sigs.setdefault(sig, len(zone_sigs))
@@ -714,12 +803,14 @@ def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
                 sig = (3 if t.admission_only else 1,
                        tuple(sorted(t.label_selector.items())), 1)
                 hostname_sigs.setdefault(sig, len(hostname_sigs))
+                h_owned.append(sig)
             elif t.topology_key == wk.HOSTNAME_LABEL:
                 # positive hostname affinity (kind 2): per-target allowance
                 # where members are present + a one-claim bootstrap budget
                 # (ffd._hostname_allowance / fast())
                 sig = (2, tuple(sorted(t.label_selector.items())), 0)
                 hostname_sigs.setdefault(sig, len(hostname_sigs))
+                h_owned.append(sig)
                 has_h2 = True
                 n_h2 += 1
             elif t.topology_key == wk.ZONE_LABEL:
@@ -759,6 +850,7 @@ def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
         group_ct_antis.append(cantis)
         group_ct_affs.append(caffs)
         group_h2.append(has_h2)
+        group_h_owned.append(h_owned)
         group_reqsets.append(pod.scheduling_requirements())
 
     # ---- domain-axis resolution -------------------------------------------
@@ -820,14 +912,20 @@ def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
     v_primary = np.full(G, -1, dtype=np.int32)
     v_aff = np.full(G, -1, dtype=np.int32)
     group_daxis = np.zeros(G, dtype=np.int32)
+    # member tables are selector-vs-representative-label verdicts: intern
+    # the label dicts, evaluate once per DISTINCT (selector, label set)
+    # (global cache), and gather — replaces the per-(sig, group) Python scan
+    if G and (vsigs or hostname_sigs):
+        rep_lids = np.fromiter(
+            (_lab_id(pl[0].meta.labels) for pl in group_pods), np.int64, G
+        )
+        uniq_l, inv_l = np.unique(rep_lids, return_inverse=True)
     for (ax, kind, sel_sig, cap), v in vsigs.items():
         v_kind[v] = kind
         v_cap[v] = cap
         sig_axis[v] = ax
-        sel = dict(sel_sig)
-        for g, pl in enumerate(group_pods):
-            if all(pl[0].meta.labels.get(k) == val for k, val in sel.items()):
-                v_member[g, v] = True
+        if G:
+            v_member[:, v] = _sel_verdicts(sel_sig, uniq_l)[inv_l]
     for g in range(G):
         axes = set()
         for sig in g_tscs[g]:
@@ -843,9 +941,9 @@ def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
             axes.add(sig[0])
         # a membership in an anti sig blocks domains on that sig's axis —
         # it binds the group to the axis just like ownership does
-        for v in range(V):
-            if v_member[g, v] and v_kind[v] == 1:
-                axes.add(int(sig_axis[v]))
+        manti = v_member[g] & (v_kind == 1)
+        if manti.any():
+            axes.update(int(a) for a in sig_axis[manti])
         if len(axes) > 1:
             # genuinely two-axis pod (e.g. zone TSC + ct spread on ONE pod,
             # or zone-constrained while a ct anti selects it): the engine
@@ -872,38 +970,14 @@ def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
     for (kind, sel_sig, cap), q in hostname_sigs.items():
         q_kind[q] = kind
         q_cap[q] = cap
-        sel = dict(sel_sig)
-        for g, pl in enumerate(group_pods):
-            pod = pl[0]
-            if all(pod.meta.labels.get(k) == v for k, v in sel.items()):
-                q_member[g, q] = True
-            for t in pod.topology_spread:
-                if (
-                    kind == 0
-                    and t.when_unsatisfiable == "DoNotSchedule"
-                    and t.topology_key == wk.HOSTNAME_LABEL
-                    and tuple(sorted(t.label_selector.items())) == sel_sig
-                    and t.max_skew == cap
-                ):
-                    q_owner[g, q] = True
-            for t in pod.affinity_terms:
-                if (
-                    kind in (1, 3)
-                    and t.weight is None
-                    and t.anti
-                    and t.admission_only == (kind == 3)
-                    and t.topology_key == wk.HOSTNAME_LABEL
-                    and tuple(sorted(t.label_selector.items())) == sel_sig
-                ):
-                    q_owner[g, q] = True
-                if (
-                    kind == 2
-                    and t.weight is None
-                    and not t.anti
-                    and t.topology_key == wk.HOSTNAME_LABEL
-                    and tuple(sorted(t.label_selector.items())) == sel_sig
-                ):
-                    q_owner[g, q] = True
+        if G:
+            q_member[:, q] = _sel_verdicts(sel_sig, uniq_l)[inv_l]
+    # ownership collected during the term scan: a group owns exactly the
+    # sigs its representative's terms constructed (the sig key encodes
+    # kind/selector/cap, so key identity IS the former rescan's match)
+    for g, owned in enumerate(group_h_owned):
+        for s in owned:
+            q_owner[g, hostname_sigs[s]] = True
 
     # ---- instance-type tensors ---------------------------------------------
     type_alloc = np.zeros((T, R), dtype=np.int32)
@@ -1018,11 +1092,30 @@ def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
             group_pool[g, p] = group_reqsets[g].compatible(pool.requirements)
 
     # ---- pairwise group compatibility --------------------------------------
-    group_pair = np.ones((G, G), dtype=bool)
-    for a in range(G):
-        for b in range(a + 1, G):
-            ok = group_reqsets[a].compatible(group_reqsets[b])
-            group_pair[a, b] = group_pair[b, a] = ok
+    # compatible() is pure requirement algebra, so dedupe by DISTINCT
+    # requirement-set content: D distinct sets cost D·(D+1)/2 calls instead
+    # of G·(G-1)/2 (the s-stress shape — thousands of groups, one distinct
+    # reqset — collapses to a single call), then gather to [G, G]. The
+    # diagonal is forced True afterwards exactly as the original never
+    # computed it (a self-incompatible reqset still pairs False off-diagonal).
+    uniq_req: Dict[tuple, int] = {}
+    req_rep_idx = np.fromiter(
+        (uniq_req.setdefault(_reqs_key(r), len(uniq_req)) for r in group_reqsets),
+        np.int64,
+        G,
+    )
+    Dreq = len(uniq_req)
+    rep_reqs: List[Optional[Requirements]] = [None] * Dreq
+    for g in range(G):
+        if rep_reqs[req_rep_idx[g]] is None:
+            rep_reqs[req_rep_idx[g]] = group_reqsets[g]
+    rep_pair = np.ones((Dreq, Dreq), dtype=bool)
+    for a in range(Dreq):
+        for b in range(a, Dreq):
+            ok = rep_reqs[a].compatible(rep_reqs[b])
+            rep_pair[a, b] = rep_pair[b, a] = ok
+    group_pair = rep_pair[np.ix_(req_rep_idx, req_rep_idx)]
+    np.fill_diagonal(group_pair, True)
     # ≥3-way custom-label joint conflicts the pairwise mask can't see:
     # detect custom keys with ≥3 distinct finite value-sets among groups.
     custom_sets: Dict[str, set] = {}
@@ -1085,6 +1178,8 @@ def _build_core(inp: SolverInput, pods_f: List[Pod]) -> _EncodeCore:
         all_req_keys=sorted({k for reqs in group_reqsets for k in reqs}),
         zid=zid,
         cid=cid,
+        group_snums=group_snums if sigs_interned else (),
+        sig_epoch=_SIG_EPOCH if sigs_interned else -1,
     )
 
 
@@ -1160,33 +1255,63 @@ def _encode_with_nodes(core: _EncodeCore, inp: SolverInput) -> EncodedInput:
     zsig_list = sorted(zone_sigs.items(), key=lambda kv: kv[1])
     all_req_keys = core.all_req_keys
     profile_cols: Dict[tuple, np.ndarray] = {}
+    if E:
+        # node_free in one pass: gather raw values, then vectorized MiB
+        # floor on memory-like columns / truncation elsewhere — identical
+        # to per-node _quantize(ceil=False)
+        raw = np.fromiter(
+            (n.free.get_(k) for n in inp.nodes for k in rkeys),
+            np.float64,
+            E * R,
+        ).reshape(E, R)
+        mib_cols = np.asarray([k in _MIB_KEYS for k in rkeys])
+        qv = np.where(mib_cols[None, :], np.floor_divide(raw, MIB), np.trunc(raw))
+        node_free = np.minimum(qv, float(INT32_MAX)).astype(np.int32)
     for e, n in enumerate(inp.nodes):
-        node_free[e] = _quantize(n.free, rkeys, ceil=False)
         node_zone[e] = zid.get(n.labels.get(wk.ZONE_LABEL, ""), -1)
         node_ct[e] = cid.get(n.labels.get(wk.CAPACITY_TYPE_LABEL, ""), -1)
         v_node_domain[e] = node_domain_of(n)
         if core.v_axis == "mixed":
             cr = ct_rank.get(n.labels.get(wk.CAPACITY_TYPE_LABEL, ""), -1)
             node_dom2[e] = Zc + cr if cr >= 0 else -1
-        for (kind, sel_sig, cap), q in sig_list:
-            sel = dict(sel_sig)
-            node_q_member[e, q] = sum(
-                1 for pl in n.pod_labels if all(pl.get(k) == v for k, v in sel.items())
+    # Q/V bound-pod counts: intern every bound pod's label dict, evaluate
+    # each selector once per DISTINCT label set (global verdict cache), and
+    # scatter per-node counts — replaces the former O(E · (Q+V) · pods)
+    # per-node Python scans with O(distinct labels · sigs) verdicts plus
+    # vectorized bincounts.
+    if (Q or V) and E:
+        pod_lids = [
+            np.fromiter(
+                (_lab_id(pl) for pl in n.pod_labels), np.int64, len(n.pod_labels)
             )
-        if v_node_domain[e] >= 0 or node_dom2[e] >= 0:
-            for (ax, kind, sel_sig, cap), v in zsig_list:
-                sel = dict(sel_sig)
-                cnt = sum(
-                    1 for pl in n.pod_labels if all(pl.get(k) == vv for k, vv in sel.items())
-                )
-                node_v_member[e, v] = cnt
-                # a node's domains are all determined, so its bound pods
-                # count on EVERY axis column it maps to (oracle: a node
-                # placement records every topology key)
-                if v_node_domain[e] >= 0:
-                    v_count0[v, v_node_domain[e]] += cnt
-                if node_dom2[e] >= 0:
-                    v_count0[v, node_dom2[e]] += cnt
+            for n in inp.nodes
+        ]
+        lens = np.fromiter((len(a) for a in pod_lids), np.int64, E)
+        if lens.sum():
+            lids_all = np.concatenate(pod_lids)
+            nidx = np.repeat(np.arange(E), lens)
+            uniq_n, inv_n = np.unique(lids_all, return_inverse=True)
+            for (kind, sel_sig, cap), q in sig_list:
+                hit = _sel_verdicts(sel_sig, uniq_n)[inv_n]
+                node_q_member[:, q] = np.bincount(nidx[hit], minlength=E)
+            if V:
+                # only nodes with a determined domain contribute (and
+                # record) member counts — undetermined rows stay zero,
+                # matching the oracle's "placement records every known
+                # topology key" rule
+                det = (v_node_domain >= 0) | (node_dom2 >= 0)
+                for (ax, kind, sel_sig, cap), v in zsig_list:
+                    hit = _sel_verdicts(sel_sig, uniq_n)[inv_n]
+                    cnts = np.bincount(nidx[hit], minlength=E)
+                    cnts[~det] = 0
+                    node_v_member[:, v] = cnts
+                m1 = v_node_domain >= 0
+                if m1.any():
+                    np.add.at(v_count0.T, v_node_domain[m1], node_v_member[m1])
+                m2 = node_dom2 >= 0
+                if m2.any():
+                    np.add.at(v_count0.T, node_dom2[m2], node_v_member[m2])
+    for e, n in enumerate(inp.nodes):
         if not n.schedulable:
             continue
         # Node-profile dedupe: strictly_compatible only reads the labels at

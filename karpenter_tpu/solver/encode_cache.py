@@ -1,0 +1,93 @@
+"""Incremental encode cache: delta-patch `_EncodeCore` instead of rebuilding.
+
+The control loop's dominant host cost at scale is re-deriving the encode
+tables every tick (solver/encode.py). The existing `_CORE_CACHE` already
+serves the *identical-input* case; this layer serves the next delta class
+out: the pod set CHANGED, but only within the known signature universe —
+pods added to / removed from existing groups, pods bound (they drop out of
+the filtered set), disruption simulations re-placing a subset that spans
+the same groups. For those, every [G]/[T]/[P]-indexed table in the cached
+core is reusable verbatim, because each is a pure function of
+
+    (ordered distinct signature sequence, catalog segment of the cache key)
+
+— the signature covers requests, selectors, affinities, tolerations,
+spreads, labels, priority, and volume zones, and the catalog segment covers
+pools (content + instance-type identity), daemonsets, axes, and the
+preference policy. Only the run split (`run_group`/`run_count`), the pod
+lists (`group_pods`), and `sorted_uids` depend on pod multiplicity, and
+those are rebuilt from the vectorized FFD sort in O(pods) NumPy.
+
+Invalidation rules (solver/SPEC.md "Encode cache"): any delta the patch
+cannot express — catalog/daemonset/axes/policy change, a signature entering
+or leaving the universe, a signature-order change, an intern-epoch reset —
+falls back to a full `_build_core`. The patch must be SEMANTICS-INVISIBLE:
+a patched core feeds `_encode_with_nodes` exactly the arrays a fresh build
+would (tests/test_encode_cache.py asserts field-by-field equality).
+
+The cluster store side of the channel is `state/cluster.py:EncodeDeltas`,
+which stamps `SolverInput.state_rev`; a matching catalog revision lets the
+donor scan skip the deep catalog-key compare.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+# Visible counters for bench/tests: exact-key hits, successful patches, and
+# full rebuilds (the encoder bumps these; reset freely between measurements).
+STATS: Dict[str, int] = {"hits": 0, "patches": 0, "rebuilds": 0}
+
+
+def reset_stats() -> None:
+    for k in STATS:
+        STATS[k] = 0
+
+
+def try_patch(key, presort, structure, core_cache, state_rev=None):
+    """Scan `core_cache` for a donor core with the same catalog segment and
+    the same ordered distinct-signature sequence as the new pod set; return
+    a patched copy (new run split / pod lists, every derived table shared)
+    or None when no delta-compatible donor exists.
+
+    `key` is the new `_core_key` tuple — [2:4] is the deep catalog segment
+    (pools, daemonsets) and [4:7] the cheap one (zones, capacity types,
+    preference policy; small tuples, always compared). `state_rev` is the
+    cluster delta-channel stamp (tracker identity + catalog element); an
+    equal stamp prefix proves the DEEP segment's identity without the tuple
+    compare — it says nothing about [4:7], which per-call options control.
+    """
+    from . import encode as enc
+
+    pods_sorted, sigs, sorted_uids, interned = presort
+    if not interned:
+        return None  # batch-local sig ids: not comparable across solves
+    group_pods, run_group, run_count, group_snums = structure
+    for k2, ent2 in core_cache.items():
+        core2 = ent2[1]
+        if core2.sig_epoch != enc._SIG_EPOCH:
+            continue  # intern table reset since the donor was built
+        if core2.group_snums != group_snums:
+            continue  # universe grew/shrank/reordered: not patchable
+        if k2[4:7] != key[4:7]:
+            continue  # zone/capacity-type universe or preference policy moved
+        rev2 = ent2[3] if len(ent2) > 3 else None
+        same_catalog = (
+            state_rev is not None
+            and rev2 is not None
+            # same tracker object + same (store catalog rev, provider
+            # catalog token) — proves pools_key/ds_key equality without
+            # the deep compare (state/cluster.py:EncodeDeltas)
+            and rev2[:2] == state_rev[:2]
+        ) or k2[2:4] == key[2:4]
+        if not same_catalog:
+            continue
+        return dataclasses.replace(
+            core2,
+            group_pods=group_pods,
+            run_group=run_group,
+            run_count=run_count,
+            sorted_uids=sorted_uids,
+        )
+    return None

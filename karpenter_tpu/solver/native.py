@@ -68,6 +68,13 @@ def load() -> ctypes.CDLL:
 def solve_encoded(enc: EncodedInput, max_claims: int = 1024):
     """Run the native core on an (unpadded) EncodedInput; returns the same
     tuple decode() consumes, or None on slot overflow."""
+    if enc.V and (np.asarray(enc.v_kind) == 3).any():
+        # Kind-3 (admission-only weighted antis, relax-materialized): the
+        # C++ core's `v_kind != 1` guards would silently DROP their
+        # admission semantics. Unreachable today (weighted antis route to
+        # fallback before native), but a future routing change must fall
+        # back loudly here, never mis-solve.
+        return None
     lib = load()
     S, G, T, E, P = len(enc.run_group), enc.G, enc.T, enc.E, enc.P
     R = enc.group_req.shape[1]

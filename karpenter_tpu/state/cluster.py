@@ -15,6 +15,7 @@ from consolidation for a window).
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -77,12 +78,75 @@ class StateNode:
         return Resources()
 
 
+class EncodeDeltas:
+    """Watch-driven revision stamps feeding the incremental encode cache
+    (solver/encode_cache.py).
+
+    The store is the message bus; this tracker folds its event stream into
+    three monotonic counters so a solve can prove "nothing the encoder's
+    catalog tables depend on changed since that cached core was built"
+    without re-hashing the catalog:
+
+      - catalog_rev: NodePools / NodeClasses / DaemonSets — any event here
+        can change pool contents, instance types, axes universes, or the
+        daemonset overhead, all of which live in the cached `_EncodeCore`'s
+        catalog-keyed tables;
+      - pods_rev:    Pods — the delta class the cache PATCHES through;
+      - nodes_rev:   Nodes / NodeClaims — nodes are encoded outside the
+        cached core (`_encode_with_nodes` runs every solve), so this rev is
+        informational (bench/debug), not an invalidation input.
+
+    `snapshot()` is the raw material for the `SolverInput.state_rev` stamp:
+    `(self, catalog_rev, pods_rev, nodes_rev)`. The leading element is the
+    tracker OBJECT, not `id(self)` — comparisons fall back to object
+    identity (no `__eq__` defined), and cache entries holding the stamp
+    keep the tracker alive, so a recycled address can never alias two
+    trackers' counters. The stamp is a pure OPTIMIZATION hint: equal
+    (identity, catalog element) lets the donor scan skip the deep
+    pools/daemonset key compare; the encoder still compares the small
+    zone/capacity-type/policy key segment, and an absent or mismatched
+    stamp just falls back to the full tuple compare. Because pool content
+    also depends on the cloud provider's ICE/reservation masking (no store
+    event fires for those), Provisioner.build_input folds the provider's
+    `catalog_token()` into the catalog element and stamps nothing when the
+    provider cannot produce one. Hand-rolled test inputs leave state_rev
+    None — always safe.
+    """
+
+    _CATALOG_KINDS = (st.NODEPOOLS, st.NODECLASSES, st.DAEMONSETS)
+    _NODE_KINDS = (st.NODES, st.NODECLAIMS)
+
+    def __init__(self, store: st.Store):
+        self._lock = threading.Lock()
+        self.catalog_rev = 0
+        self.pods_rev = 0
+        self.nodes_rev = 0
+        store.watch(None, self._on_event)
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        with self._lock:
+            if kind in self._CATALOG_KINDS:
+                self.catalog_rev += 1
+            elif kind == st.PODS:
+                self.pods_rev += 1
+            elif kind in self._NODE_KINDS:
+                self.nodes_rev += 1
+
+    def snapshot(self) -> tuple:
+        with self._lock:
+            return (self, self.catalog_rev, self.pods_rev, self.nodes_rev)
+
+
 class Cluster:
     def __init__(self, store: st.Store, clock=time.monotonic):
         self.store = store
         self.clock = clock
         self._nominations: Dict[str, float] = {}  # node name -> expiry
         self.nomination_window_s = 20.0
+        # delta channel for the incremental encode cache; shared by the
+        # provisioner and the disruption engine's simulation helper so
+        # their solves patch against each other's cached cores
+        self.encode_deltas = EncodeDeltas(store)
 
     # -- assembly -----------------------------------------------------------
 

@@ -138,6 +138,11 @@ int ffd_solve_native(
     int32_t* take_e, int32_t* take_c, int32_t* leftover,
     uint8_t* c_mask, uint8_t* c_zone, uint8_t* c_ct, uint8_t* c_gmask,
     int32_t* c_pool, int32_t* c_cum, int32_t* used_out) {
+  // Kind-3 (admission-only weighted-anti) sigs are not implemented here —
+  // the v_kind==1 guards below would silently drop their admission
+  // semantics. Refuse loudly so the caller falls back to the oracle.
+  for (int32_t v = 0; v < V; ++v)
+    if (v_kind[v] == 3) return 2;
   std::vector<int32_t> e_cum(static_cast<size_t>(E) * R, 0);
   std::vector<int32_t> p_usage(pool_usage0, pool_usage0 + static_cast<size_t>(P) * R);
   std::memset(take_e, 0, sizeof(int32_t) * S * E);

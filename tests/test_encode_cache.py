@@ -1,0 +1,269 @@
+"""Incremental encode cache (solver/encode_cache.py): bit-transparency,
+invalidation classes, delta-channel stamps, and solver parity with the
+cache hot.
+
+The patch path must be SEMANTICS-INVISIBLE: for any pod-set delta it
+accepts, the patched `EncodedInput` must equal a from-scratch build field
+by field (SPEC.md "Encode cache"). Deltas the patch cannot express must
+fall back to a full rebuild, never to a stale core.
+"""
+
+import dataclasses
+import random
+
+import numpy as np
+
+from karpenter_tpu.api import wellknown as wk
+from karpenter_tpu.controllers import store as st
+from karpenter_tpu.provisioning.scheduler import SolverInput, ffd_sort_with_sigs
+from karpenter_tpu.solver import encode as em
+from karpenter_tpu.solver import encode_cache as ec
+from karpenter_tpu.solver.encode import EncodedInput, quantize_input
+from karpenter_tpu.state.cluster import Cluster
+
+from tests.test_zone_device import (
+    TSC1,
+    ZONES,
+    assert_zone_parity,
+    mknode,
+    mkpod,
+    pool,
+)
+
+# Pod spec templates with DISTINCT (cpu, memory) sizes: the FFD block order
+# (and with it the distinct-signature sequence) is then independent of pod
+# uids, so any per-template multiplicity produces the same group universe.
+_TEMPLATES = (
+    dict(cpu="2", mem="4Gi", labels={"app": "w"}, topology_spread=[TSC1]),
+    dict(cpu="1500m", mem="3Gi", labels={"app": "w"}),
+    dict(cpu="1", mem="2Gi", labels={"app": "x"}),
+    dict(cpu="500m", mem="1Gi", labels={"tier": "batch"}),
+)
+
+
+def _pods(tag, counts):
+    out = []
+    for t, cnt in enumerate(counts):
+        for i in range(cnt):
+            out.append(mkpod(f"{tag}-t{t}-{i:03d}", **_TEMPLATES[t]))
+    return out
+
+
+def _nodes():
+    return [
+        mknode("na", "zone-1a", matching=2),
+        mknode("nb", "zone-1b", matching=0),
+        mknode("nc", "zone-1c", matching=1),
+    ]
+
+
+def _inp(pods, nodes=None, nodepools=None, zones=ZONES, **kw):
+    return quantize_input(
+        SolverInput(
+            pods=pods,
+            nodes=_nodes() if nodes is None else nodes,
+            nodepools=[pool()] if nodepools is None else nodepools,
+            zones=zones,
+            **kw,
+        )
+    )
+
+
+def assert_encoded_equal(a: EncodedInput, b: EncodedInput):
+    """Field-by-field equality over the full EncodedInput surface — arrays
+    compare by dtype + contents, pods by uid (fresh builds make new lists)."""
+    for f in dataclasses.fields(EncodedInput):
+        va, vb = getattr(a, f.name), getattr(b, f.name)
+        if f.name == "group_pods":
+            ua = [[p.meta.uid for p in g] for g in va]
+            ub = [[p.meta.uid for p in g] for g in vb]
+            assert ua == ub, f"group_pods: {ua} != {ub}"
+        elif isinstance(va, np.ndarray) or isinstance(vb, np.ndarray):
+            assert isinstance(va, np.ndarray) and isinstance(vb, np.ndarray), (
+                f"{f.name}: {type(va)} vs {type(vb)}"
+            )
+            assert va.dtype == vb.dtype, f"{f.name}: dtype {va.dtype} != {vb.dtype}"
+            assert va.shape == vb.shape, f"{f.name}: shape {va.shape} != {vb.shape}"
+            assert np.array_equal(va, vb), f.name
+        else:
+            assert va == vb, f"{f.name}: {va!r} != {vb!r}"
+
+
+def _fresh(inp):
+    """Force a from-scratch encode (empty donor cache), restoring nothing —
+    callers re-seed as needed."""
+    em._CORE_CACHE.clear()
+    return em.encode(inp)
+
+
+class TestPatchTransparency:
+    def test_exact_hit_returns_identical_encode(self):
+        em._CORE_CACHE.clear()
+        ec.reset_stats()
+        inp = _inp(_pods("hit", (4, 3, 2, 2)))
+        a = em.encode(inp)
+        b = em.encode(inp)
+        assert ec.STATS == {"hits": 1, "patches": 0, "rebuilds": 1}, ec.STATS
+        assert_encoded_equal(a, b)
+
+    def test_patched_equals_fresh_field_by_field(self):
+        """Property suite: random per-template multiplicities (all-new pod
+        objects, new uids — uids are NOT part of the signature) must patch,
+        and the patched encode must equal a from-scratch build exactly."""
+        rng = random.Random(7)
+        em._CORE_CACHE.clear()
+        ec.reset_stats()
+        em.encode(_inp(_pods("base", (5, 4, 3, 2))))
+        assert ec.STATS["rebuilds"] == 1
+        for trial in range(8):
+            counts = tuple(rng.randint(1, 9) for _ in _TEMPLATES)
+            inp2 = _inp(_pods(f"d{trial}", counts))
+            patched = em.encode(inp2)
+            assert ec.STATS["patches"] == trial + 1, (trial, ec.STATS)
+            fresh = _fresh(inp2)  # rebuild becomes the next trial's donor
+            assert_encoded_equal(patched, fresh)
+
+    def test_patch_after_removals_within_groups(self):
+        """Same pod OBJECTS minus a subset (every group keeps >=1 pod) — the
+        bound-pods / disruption-subset delta class."""
+        rng = random.Random(11)
+        em._CORE_CACHE.clear()
+        ec.reset_stats()
+        base = _pods("rm", (6, 5, 4, 3))
+        nodes = _nodes()
+        em.encode(_inp(base, nodes=nodes))
+        by_tpl = {}
+        for p in base:
+            by_tpl.setdefault(p.meta.name.split("-")[1], []).append(p)
+        kept = []
+        for grp in by_tpl.values():
+            k = rng.randint(1, len(grp))
+            kept.extend(rng.sample(grp, k))
+        inp2 = _inp(kept, nodes=nodes)
+        patched = em.encode(inp2)
+        assert ec.STATS["patches"] == 1, ec.STATS
+        assert_encoded_equal(patched, _fresh(inp2))
+
+
+class TestInvalidation:
+    """Delta classes the patch cannot express MUST take the rebuild path
+    (SPEC.md "Encode cache" invalidation rules)."""
+
+    def _seed(self, counts=(3, 3, 2, 2)):
+        em._CORE_CACHE.clear()
+        ec.reset_stats()
+        em.encode(_inp(_pods("seed", counts)))
+        assert ec.STATS == {"hits": 0, "patches": 0, "rebuilds": 1}
+
+    def test_new_signature_rebuilds(self):
+        self._seed()
+        extra = _pods("ns", (3, 3, 2, 2))
+        extra.append(mkpod("ns-novel", cpu="250m", mem="512Mi",
+                           labels={"brand": "new"}))
+        em.encode(_inp(extra))
+        assert ec.STATS["patches"] == 0 and ec.STATS["rebuilds"] == 2, ec.STATS
+
+    def test_vanished_group_rebuilds(self):
+        self._seed()
+        em.encode(_inp(_pods("vg", (3, 3, 2, 0))))  # template 3 gone
+        assert ec.STATS["patches"] == 0 and ec.STATS["rebuilds"] == 2, ec.STATS
+
+    def test_catalog_change_rebuilds(self):
+        self._seed()
+        em.encode(_inp(_pods("cc", (3, 3, 2, 2)), nodepools=[pool(weight=5)]))
+        assert ec.STATS["patches"] == 0 and ec.STATS["rebuilds"] == 2, ec.STATS
+
+    def test_zone_universe_change_rebuilds(self):
+        self._seed()
+        em.encode(_inp(_pods("zc", (3, 3, 2, 2)), zones=ZONES[:2]))
+        assert ec.STATS["patches"] == 0 and ec.STATS["rebuilds"] == 2, ec.STATS
+
+    def test_presorted_inputs_bypass_the_cache(self):
+        self._seed()
+        pods = _pods("ps", (3, 3, 2, 2))
+        srt = ffd_sort_with_sigs(pods)[0]
+        n = len(em._CORE_CACHE)
+        em.encode(
+            SolverInput(pods=srt, nodes=[], nodepools=[pool()], zones=ZONES,
+                        presorted=True)
+        )
+        assert ec.STATS == {"hits": 0, "patches": 0, "rebuilds": 1}, ec.STATS
+        assert len(em._CORE_CACHE) == n  # never cached, never a donor
+
+
+class TestStateRevStamp:
+    def test_stamp_skips_deep_catalog_compare(self):
+        """An equal (tracker identity, catalog element) stamp prefix proves
+        the deep pools/daemonset segment without the tuple compare; a
+        different tracker object with equal counters must NOT."""
+        trk = object()
+        stamp = (trk, (1, (0, 0, -1)), 7, 7)
+        em._CORE_CACHE.clear()
+        ec.reset_stats()
+        inp = _inp(_pods("sr", (3, 2, 2, 1)), state_rev=stamp)
+        em.encode(inp)  # donor entry carries the stamp
+        pods_f = [p for p in inp.pods
+                  if not p.scheduling_gated and p.node_name is None]
+        key, _ids = em._core_key(pods_f, inp)
+        presort = ffd_sort_with_sigs(pods_f, presorted=False)
+        structure = em._group_structure(presort[0], presort[1])
+        # fabricate a DIFFERENT deep catalog segment: only the stamp can match
+        fake = key[:2] + (("other-pools",), key[3]) + key[4:]
+        assert ec.try_patch(fake, presort, structure, em._CORE_CACHE,
+                            stamp) is not None
+        assert ec.try_patch(fake, presort, structure, em._CORE_CACHE,
+                            None) is None
+        other = (object(), (1, (0, 0, -1)), 7, 7)  # equal counters, new tracker
+        assert ec.try_patch(fake, presort, structure, em._CORE_CACHE,
+                            other) is None
+        # the cheap zones/cts/policy segment is ALWAYS compared, stamp or not
+        fake2 = key[:4] + (("zone-9z",),) + key[5:]
+        assert ec.try_patch(fake2, presort, structure, em._CORE_CACHE,
+                            stamp) is None
+
+    def test_encode_deltas_counters(self):
+        from karpenter_tpu.api.objects import (
+            NodeClaimTemplate,
+            NodePool,
+            ObjectMeta,
+        )
+
+        store = st.Store()
+        cluster = Cluster(store)
+        deltas = cluster.encode_deltas
+        t0, c0, p0, n0 = deltas.snapshot()
+        assert t0 is deltas
+        store.create(st.PODS, mkpod("ed-p0"))
+        store.create(
+            st.NODEPOOLS,
+            NodePool(meta=ObjectMeta(name="ed"), template=NodeClaimTemplate()),
+        )
+        _, c1, p1, n1 = deltas.snapshot()
+        assert p1 > p0 and c1 > c0
+        # stamps with the same tracker and catalog element compare equal;
+        # any catalog motion breaks the prefix
+        assert (t0, (c1, "tok"))[:2] == (deltas, (c1, "tok"))
+        assert (t0, (c0, "tok")) != (t0, (c1, "tok"))
+
+
+class TestParityWithCacheHot:
+    def test_solver_parity_on_patched_encode(self):
+        """End-to-end: solve a base input, then a delta input whose encode is
+        served by the patch path — reference/TPU parity must hold on both."""
+        em._CORE_CACHE.clear()
+        ec.reset_stats()
+        base = _pods("par", (6, 4, 3, 2))
+        assert_zone_parity(
+            SolverInput(pods=base, nodes=_nodes(), nodepools=[pool()],
+                        zones=ZONES),
+            expect_device=None,
+        )
+        assert ec.STATS["rebuilds"] >= 1
+        before = ec.STATS["patches"]
+        delta = _pods("par2", (4, 6, 1, 5))
+        assert_zone_parity(
+            SolverInput(pods=delta, nodes=_nodes(), nodepools=[pool()],
+                        zones=ZONES),
+            expect_device=None,
+        )
+        assert ec.STATS["patches"] > before, ec.STATS

@@ -152,3 +152,52 @@ def test_manager_stop_resigns_for_fast_handoff():
     clock.advance(0.1)  # far inside what WOULD have been the lease window
     b.tick()
     assert b.is_leader(), "standby must take over without waiting for expiry"
+
+
+class TestClockSkew:
+    def test_skewed_candidate_cannot_seize_live_lease(self):
+        """Cross-host skew regression: a candidate whose clock runs far
+        AHEAD of the holder's must not judge expiry from the holder's
+        wall-clock renew_time (the old `now - renew_time` check made it
+        seize instantly — dual leaders). Client-go semantics: expiry is
+        measured on the OBSERVER's clock from the moment it last saw the
+        record change."""
+        store = st.Store()
+        ca, cb = FakeClock(), FakeClock()
+        cb.advance(3600)  # candidate's clock is an hour ahead of the holder's
+        a = LeaderElector(store, "a", lease_s=15, renew_s=10, clock=ca)
+        b = LeaderElector(store, "b", lease_s=15, clock=cb)
+        a.tick()
+        b.tick()
+        assert a.is_leader() and not b.is_leader(), (
+            "skewed candidate seized a fresh lease"
+        )
+        for _ in range(5):
+            ca.advance(6)
+            cb.advance(6)
+            a.tick()
+            b.tick()
+            assert a.is_leader() and not b.is_leader(), (
+                "skewed candidate seized a LIVE, renewing lease"
+            )
+        # the holder dies: expiry runs on b's own clock from its last
+        # observed record change, so takeover still works
+        cb.advance(16)
+        b.tick()
+        assert b.is_leader()
+
+    def test_skewed_behind_candidate_still_takes_over_expiry(self):
+        """Skew the other way: a candidate BEHIND the holder's clock sees
+        renew_time in its future; the old check would never fire (lease
+        immortal). Observation-based expiry is skew-independent."""
+        store = st.Store()
+        ca, cb = FakeClock(), FakeClock()
+        ca.advance(3600)  # holder's clock is an hour ahead
+        a = LeaderElector(store, "a", lease_s=15, clock=ca)
+        b = LeaderElector(store, "b", lease_s=15, clock=cb)
+        a.tick()
+        b.tick()
+        assert a.is_leader() and not b.is_leader()
+        cb.advance(16)  # holder silent for a full lease on b's clock
+        b.tick()
+        assert b.is_leader(), "lease became immortal under backward skew"

@@ -392,3 +392,31 @@ def test_custom_key_weighted_anti_stays_on_oracle():
     tpu = solver.solve(inp)
     assert ref.placements == tpu.placements
     assert solver.stats["fallback_solves"] == 1, solver.stats
+
+
+class TestRelaxOrderingParity:
+    def test_gated_and_bound_pods_do_not_perturb_ffd_order(self):
+        """Regression: solve_async must FFD-sort the FILTERED pod list.
+        A gated/bound pod holding a signature's first uid slot inside an
+        equal-(cpu,mem) block used to shift signature first-appearance in
+        the unfiltered sort, regrouping the schedulable pods into a
+        processing order the oracle (which sorts only schedulable pods)
+        never sees."""
+        from karpenter_tpu.utils.resources import Resources
+
+        sel_x, sel_y = {"app": "ox"}, {"app": "oy"}
+        gated = mkpod("a0", labels=dict(sel_y),
+                      topology_spread=[sa_tsc(sel_y)], scheduling_gated=True)
+        bound = mkpod("a1", labels=dict(sel_y),
+                      topology_spread=[sa_tsc(sel_y)], node_name="pre-bound")
+        p1 = mkpod("a2", labels=dict(sel_x), topology_spread=[sa_tsc(sel_x)])
+        p2 = mkpod("a3", labels=dict(sel_y), topology_spread=[sa_tsc(sel_y)])
+        # One existing node with room for exactly ONE pod: whichever pod is
+        # processed first claims it, so an order swap shows up in placements.
+        n = mknode("n-tight", "zone-1a")
+        n.free = Resources.parse({"cpu": "1", "memory": "1Gi"})
+        n.free["pods"] = 10
+        inp = SolverInput(pods=[gated, bound, p1, p2], nodes=[n],
+                          nodepools=[pool()], zones=ZONES)
+        ref, tpu = assert_relax_parity(inp)
+        assert ref.placements.get("a2") == ("node", "n-tight"), ref.placements
