@@ -794,9 +794,40 @@ def _run(plat: str) -> None:
                 "encode_fresh_ms": round(encode_fresh_s * 1000, 2),
                 "first_solve_ms": round(compile_s * 1000, 1),
                 "first_call_s": round(compile_s, 2),
+                # robustness trajectory: a perf run that silently leaned on
+                # the fallback chain (or tripped the breaker) is a regression
+                # even if the latency numbers held
+                **_robustness_snapshot(),
             }
         )
     )
+
+
+def _robustness_snapshot() -> dict:
+    """Fallback counts by reason + final breaker state from the process-wide
+    registry (solver/resilient.py exports; zero/closed in a clean run)."""
+    from karpenter_tpu.metrics.registry import (
+        SOLVER_BREAKER_STATE,
+        SOLVER_FALLBACK,
+    )
+
+    reasons = (
+        "timeout", "device_error", "encode_bug", "unknown",
+        "invariant_gate", "breaker_open", "fallback_error",
+        "solver_exception",
+    )
+    by_reason = {
+        r: SOLVER_FALLBACK.value(reason=r)
+        for r in reasons
+        if SOLVER_FALLBACK.value(reason=r) > 0
+    }
+    state = {0.0: "closed", 1.0: "half-open", 2.0: "open"}.get(
+        SOLVER_BREAKER_STATE.value(), "closed"
+    )
+    return {
+        "solver_fallback_total": by_reason,
+        "solver_breaker_state": state,
+    }
 
 
 if __name__ == "__main__":

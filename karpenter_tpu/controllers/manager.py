@@ -12,9 +12,18 @@ for live operation.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
-from typing import Callable, List, Protocol
+from typing import Callable, Dict, List, Protocol
+
+from ..metrics.registry import CONTROLLER_ERRORS
+
+log = logging.getLogger("karpenter_tpu")
+
+#: backoff ceiling: a crash-looping controller is still probed at least once
+#: every BACKOFF_CAP ticks so recovery is observed without a restart
+BACKOFF_CAP = 32
 
 
 class Controller(Protocol):
@@ -28,6 +37,12 @@ class Manager:
     def __init__(self, elector=None, on_elected: Callable[[], None] = None):
         self.controllers: List[Controller] = []
         self._stop = threading.Event()
+        # crash-loop containment: per-controller consecutive-failure counts
+        # and exponential tick backoff — a persistently crashing controller
+        # is skipped for min(2**(failures-1), BACKOFF_CAP) ticks instead of
+        # being retried at full rate with the same input forever
+        self._failures: Dict[str, int] = {}
+        self._skip: Dict[str, int] = {}
         # lease-based leader election (controllers/leaderelection.py):
         # standbys tick the elector but run nothing until they take over —
         # the reference's singleton-controller HA model (settings.md:21)
@@ -63,13 +78,37 @@ class Manager:
                         "on_elected hook: %s", e
                     )
         for c in self.controllers:
+            if self._skip.get(c.name, 0) > 0:
+                self._skip[c.name] -= 1
+                continue
             try:
                 did = bool(c.reconcile()) or did
             except Exception as e:  # a controller crash must not kill the loop
-                import logging
-
-                logging.getLogger("karpenter_tpu").exception("controller %s: %s", c.name, e)
+                f = self._failures.get(c.name, 0) + 1
+                self._failures[c.name] = f
+                self._skip[c.name] = min(2 ** (f - 1), BACKOFF_CAP)
+                CONTROLLER_ERRORS.inc(controller=c.name)
+                log.exception(
+                    "controller %s: %s (consecutive failures: %d, backing "
+                    "off %d ticks)", c.name, e, f, self._skip[c.name],
+                )
+            else:
+                if self._failures.get(c.name):
+                    log.info("controller %s recovered after %d failures",
+                             c.name, self._failures[c.name])
+                self._failures[c.name] = 0
         return did
+
+    def health(self) -> Dict[str, Dict[str, int]]:
+        """Per-controller crash-loop snapshot: consecutive failures and
+        remaining backoff ticks (0/0 = healthy)."""
+        return {
+            c.name: {
+                "consecutive_failures": self._failures.get(c.name, 0),
+                "backoff_ticks_remaining": self._skip.get(c.name, 0),
+            }
+            for c in self.controllers
+        }
 
     def settle(self, max_ticks: int = 200) -> int:
         """Tick until fixed point; returns tick count. Raises if not settled

@@ -20,6 +20,8 @@ from collections import defaultdict
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
+from .. import faults
+
 WatchFn = Callable[[str, str, Any], None]  # (event, kind, obj); event in ADDED|MODIFIED|DELETED
 
 
@@ -121,6 +123,7 @@ class Store:
                     raise
 
     def update(self, kind: str, obj: Any) -> Any:
+        faults.check("store.update")
         self._admit_update(kind, obj)
         with self._lock:
             key = self._key(obj)

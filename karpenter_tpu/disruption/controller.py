@@ -117,10 +117,13 @@ class DisruptionController:
         from ..solver.backend import TPUSolver
 
         self._batched = None
-        if isinstance(solver, TPUSolver):
+        # unwrap a ResilientSolver shell: the batched evaluator keys off the
+        # concrete device backend underneath
+        inner = getattr(solver, "inner", solver)
+        if isinstance(inner, TPUSolver):
             from .batched import BatchedConsolidationEvaluator
 
-            self._batched = BatchedConsolidationEvaluator(solver)
+            self._batched = BatchedConsolidationEvaluator(inner)
 
     # ------------------------------------------------------------------ main
 

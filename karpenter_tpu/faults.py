@@ -1,0 +1,155 @@
+"""Deterministic fault injection: seeded, scriptable failure plans.
+
+The TPU seam adds a failure domain the reference never had — XLA runtime
+errors, device OOM, compile stalls, garbage decodes — and the only way to
+PROVE the resilience layer (solver/resilient.py, controllers/manager.py
+backoff) is to make those failures happen on demand, hermetically and
+reproducibly. This module is the chaos-test seam: a registry of named
+injection sites wired into the production code paths, consulted on every
+pass through the site, and a `FaultPlan` that scripts exactly what each
+site does ("device dies for 3 solves then recovers") or fails with seeded
+probability.
+
+Sites wired into production code:
+
+- ``solver.device_dispatch`` — TPUSolver._dispatch, before the kernel call
+  (covers the initial dispatch AND overflow-retry redispatches).
+- ``solver.decode``         — TPUSolver device-result decode, after fetch.
+- ``cloud.create``          — KwokCloud.create_fleet, before the launch.
+- ``store.update``          — Store.update, before persistence.
+
+The check is a no-op module-level None test when no plan is active, so the
+hot paths pay one attribute load in production.
+
+Usage (tests):
+
+    plan = FaultPlan(seed=7)
+    plan.fail_n("solver.device_dispatch", 3, DeviceError("injected XLA err"))
+    with active(plan):
+        ...  # first 3 dispatches raise, then the device "recovers"
+    assert plan.fired["solver.device_dispatch"] == 3
+
+Outcomes in a script may be: an Exception instance (raised, re-instantiated
+per fire so tracebacks never chain), an Exception class (instantiated and
+raised), a callable (invoked — may raise or side-effect, e.g. advance a fake
+clock to trip a deadline), or the string "ok" (explicit no-op).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from collections import defaultdict, deque
+from contextlib import contextmanager
+from typing import Callable, Dict, Optional
+
+SITES = (
+    "solver.device_dispatch",
+    "solver.decode",
+    "cloud.create",
+    "store.update",
+)
+
+
+class FaultError(Exception):
+    """Base class for injected faults."""
+
+
+class DeviceError(FaultError):
+    """A transient device/runtime failure (XLA error, OOM, dead tunnel)."""
+
+
+class DecodeError(FaultError, ValueError):
+    """A deterministic garbage-decode failure (classified as an encode bug)."""
+
+
+class FaultPlan:
+    """A seeded, deterministic schedule of outcomes per injection site.
+
+    Per-site outcome resolution order on each check():
+      1. the next scripted outcome, if the script is non-empty;
+      2. the probabilistic rule (seeded RNG), if one is set;
+      3. ok.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._scripts: Dict[str, deque] = defaultdict(deque)
+        self._maybe: Dict[str, tuple] = {}  # site -> (p, exc)
+        self._lock = threading.Lock()
+        self.calls: Dict[str, int] = defaultdict(int)  # checks per site
+        self.fired: Dict[str, int] = defaultdict(int)  # raises per site
+
+    # -- scripting ----------------------------------------------------------
+
+    def script(self, site: str, *outcomes) -> "FaultPlan":
+        """Append explicit outcomes consumed one per check, in order."""
+        self._scripts[site].extend(outcomes)
+        return self
+
+    def fail_n(self, site: str, n: int, exc=None) -> "FaultPlan":
+        """Site fails the next `n` checks, then recovers (script suffix)."""
+        exc = exc if exc is not None else DeviceError(f"injected fault at {site}")
+        return self.script(site, *([exc] * n))
+
+    def maybe(self, site: str, p: float, exc=None) -> "FaultPlan":
+        """Fail each UNSCRIPTED check with probability `p` (seeded RNG, so a
+        given (seed, call sequence) always fires identically)."""
+        exc = exc if exc is not None else DeviceError(f"injected fault at {site}")
+        self._maybe[site] = (p, exc)
+        return self
+
+    # -- consumption --------------------------------------------------------
+
+    def check(self, site: str) -> None:
+        with self._lock:
+            self.calls[site] += 1
+            out = self._scripts[site].popleft() if self._scripts[site] else None
+            if out is None and site in self._maybe:
+                p, exc = self._maybe[site]
+                if self._rng.random() < p:
+                    out = exc
+        if out is None or out == "ok":
+            return
+        if callable(out) and not (isinstance(out, type) and issubclass(out, BaseException)):
+            out()  # side-effect hook; may itself raise
+            return
+        with self._lock:
+            self.fired[site] += 1
+        if isinstance(out, type):
+            raise out(f"injected fault at {site}")
+        # re-instantiate so each fire raises a fresh exception object
+        raise type(out)(*out.args)
+
+    def pending(self, site: str) -> int:
+        """Scripted outcomes not yet consumed (test bookkeeping)."""
+        with self._lock:
+            return len(self._scripts[site])
+
+
+# -- global activation seam (production sites consult this) ------------------
+
+_ACTIVE: Optional[FaultPlan] = None
+
+
+def use(plan: Optional[FaultPlan]) -> None:
+    global _ACTIVE
+    _ACTIVE = plan
+
+
+@contextmanager
+def active(plan: FaultPlan):
+    """Scope a plan: sites fire only inside the with-block."""
+    prev = _ACTIVE
+    use(plan)
+    try:
+        yield plan
+    finally:
+        use(prev)
+
+
+def check(site: str) -> None:
+    """Production-site hook: free when no plan is active."""
+    if _ACTIVE is not None:
+        _ACTIVE.check(site)

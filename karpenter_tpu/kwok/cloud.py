@@ -22,6 +22,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from .. import faults
 from ..api import wellknown as wk
 from ..api.objects import Node, ObjectMeta, Taint
 from ..cloudprovider.types import InstanceType
@@ -79,7 +80,7 @@ class KwokCloud:
     ):
         self.store = store
         self.types = {it.name: it for it in instance_types}
-        self.limits = ApiLimits(enabled=rate_limits)
+        self.limits = ApiLimits(enabled=rate_limits, clock=clock)
         self.auto_register_delay_s = auto_register_delay_s
         self.clock = clock  # instance launch_time shares the control-plane clock
         self._instances: Dict[str, Instance] = {}
@@ -112,6 +113,7 @@ class KwokCloud:
         """Launch ONE instance choosing the lowest-price override (the
         reference strategy), walking up the price list past ICE'd offerings."""
         self.limits.mutating.take_or_raise("CreateFleet")
+        faults.check("cloud.create")
         errors: List[FleetError] = []
         with self._lock:
             for ov in sorted(overrides, key=lambda o: (o.price, o.instance_type, o.zone)):

@@ -18,16 +18,17 @@ class ThrottleError(Exception):
 
 
 class TokenBucket:
-    def __init__(self, rate: float, burst: int):
+    def __init__(self, rate: float, burst: int, clock=time.monotonic):
         self.rate = float(rate)
         self.burst = float(burst)
+        self.clock = clock
         self._tokens = float(burst)
-        self._last = time.monotonic()
+        self._last = clock()
         self._lock = threading.Lock()
 
     def try_take(self, n: float = 1.0) -> bool:
         with self._lock:
-            now = time.monotonic()
+            now = self.clock()
             self._tokens = min(self.burst, self._tokens + (now - self._last) * self.rate)
             self._last = now
             if self._tokens >= n:
@@ -51,11 +52,11 @@ class NopLimiter:
 class ApiLimits:
     """The reference's per-API-class buckets."""
 
-    def __init__(self, enabled: bool = True):
+    def __init__(self, enabled: bool = True, clock=time.monotonic):
         if enabled:
-            self.non_mutating = TokenBucket(20, 100)
-            self.mutating = TokenBucket(5, 50)
-            self.terminate = TokenBucket(5, 100)
-            self.tags = TokenBucket(10, 100)
+            self.non_mutating = TokenBucket(20, 100, clock=clock)
+            self.mutating = TokenBucket(5, 50, clock=clock)
+            self.terminate = TokenBucket(5, 100, clock=clock)
+            self.tags = TokenBucket(10, 100, clock=clock)
         else:
             self.non_mutating = self.mutating = self.terminate = self.tags = NopLimiter()

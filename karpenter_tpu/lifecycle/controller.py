@@ -25,6 +25,10 @@ from ..controllers import store as st
 from ..metrics.registry import NODECLAIMS_CREATED, NODECLAIMS_TERMINATED
 
 
+#: ticks-equivalent pause after a throttled create before retrying that claim
+THROTTLE_BACKOFF_S = 1.0
+
+
 class LaunchController:
     name = "nodeclaim.launch"
 
@@ -32,15 +36,33 @@ class LaunchController:
         self.store = store
         self.cloud_provider = cloud_provider
         self.clock = clock
+        self._throttled_until: dict = {}  # claim name -> clock() deadline
 
     def reconcile(self) -> bool:
+        from ..kwok.ratelimit import ThrottleError
+
         did = False
+        now = self.clock()
+        # drop backoff entries for claims that no longer exist
+        live = {c.name for c in self.store.list(st.NODECLAIMS)}
+        self._throttled_until = {
+            k: v for k, v in self._throttled_until.items() if k in live
+        }
         for claim in self.store.list(st.NODECLAIMS):
             if claim.launched or claim.meta.deleting:
+                continue
+            if self._throttled_until.get(claim.name, 0) > now:
                 continue
             try:
                 self.cloud_provider.create(claim, claim.instance_type_options)
                 NODECLAIMS_CREATED.inc(nodepool=claim.nodepool)
+                self._throttled_until.pop(claim.name, None)
+            except ThrottleError:
+                # per-claim isolation: one throttled create must not abort
+                # the remaining launches this tick — back this claim off
+                # briefly and move on (the bucket refills on the same clock)
+                self._throttled_until[claim.name] = now + THROTTLE_BACKOFF_S
+                continue
             except InsufficientCapacityError:
                 # ICE: delete the claim; the provisioner re-solves with the
                 # failed offerings masked (instance.go:450-486 flow)

@@ -11,15 +11,18 @@ usually means a controller/infra problem, not node problems.
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import List
 
 from ..api import wellknown as wk
 from ..cloudprovider.types import CloudProvider, RepairPolicy
 from ..controllers import store as st
-from ..metrics.registry import NODECLAIMS_TERMINATED
+from ..metrics.registry import NODECLAIMS_TERMINATED, REPAIR_BREAKER_OPEN
 
 UNHEALTHY_BREAKER_FRACTION = 0.2  # disruption.md:208-234
+
+log = logging.getLogger("karpenter_tpu")
 
 
 class RepairController:
@@ -29,6 +32,20 @@ class RepairController:
         self.store = store
         self.cloud_provider = cloud_provider
         self.clock = clock
+        self._breaker_open = False
+
+    def _set_breaker(self, open_: bool, unhealthy: int = 0, total: int = 0) -> None:
+        if open_ and not self._breaker_open:
+            # log once per trip, not every tick while the fleet stays sick
+            log.warning(
+                "node repair breaker OPEN: %d/%d nodes unhealthy (> %.0f%%) "
+                "— refusing to repair a fleet-wide problem",
+                unhealthy, total, UNHEALTHY_BREAKER_FRACTION * 100,
+            )
+        elif not open_ and self._breaker_open:
+            log.info("node repair breaker closed")
+        self._breaker_open = open_
+        REPAIR_BREAKER_OPEN.set(1.0 if open_ else 0.0)
 
     def reconcile(self) -> bool:
         policies: List[RepairPolicy] = self.cloud_provider.repair_policies()
@@ -45,9 +62,13 @@ class RepairController:
 
         unhealthy = [n for n in nodes if matches(n)]
         if not unhealthy:
+            self._set_breaker(False)
             return False
         if len(unhealthy) / len(nodes) > UNHEALTHY_BREAKER_FRACTION and len(nodes) > 1:
-            return False  # circuit breaker: fleet-wide problem, do nothing
+            # circuit breaker: fleet-wide problem, do nothing
+            self._set_breaker(True, len(unhealthy), len(nodes))
+            return False
+        self._set_breaker(False)
 
         claims_by_node = {c.node_name: c for c in self.store.list(st.NODECLAIMS) if c.node_name}
         did = False

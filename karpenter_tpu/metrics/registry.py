@@ -253,3 +253,35 @@ PODS_UNSCHEDULABLE = REGISTRY.register(
 ICE_CACHE_SIZE = REGISTRY.register(
     Gauge("karpenter_unavailable_offerings_count", "ICE-cached unavailable offerings")
 )
+
+# -- resilience series (solver/resilient.py, controllers/manager.py,
+#    lifecycle/repair.py — this framework's addition) -------------------------
+
+SOLVER_FALLBACK = REGISTRY.register(
+    Counter(
+        "karpenter_tpu_solver_fallback_total",
+        "Solves routed to the fallback chain, by reason (timeout / "
+        "device_error / encode_bug / unknown / invariant_gate / "
+        "breaker_open / fallback_error / solver_exception)",
+        ("reason",),
+    )
+)
+SOLVER_BREAKER_STATE = REGISTRY.register(
+    Gauge(
+        "karpenter_tpu_solver_breaker_state",
+        "Device-path circuit breaker state: 0=closed, 1=half-open, 2=open",
+    )
+)
+CONTROLLER_ERRORS = REGISTRY.register(
+    Counter(
+        "karpenter_controller_errors_total",
+        "Controller reconcile exceptions caught by the manager tick loop",
+        ("controller",),
+    )
+)
+REPAIR_BREAKER_OPEN = REGISTRY.register(
+    Gauge(
+        "karpenter_repair_breaker_open",
+        "1 while the node-repair >20% unhealthy circuit breaker is tripped",
+    )
+)

@@ -86,6 +86,10 @@ def main(argv=None) -> int:
         compile_cache_dir=o.compile_cache_dir or None,
         leader_elect=o.leader_elect,
         lease_path=o.lease_path or None,
+        resilient=o.solver_resilient,
+        solver_deadline_s=o.solver_deadline_s,
+        breaker_threshold=o.solver_breaker_threshold,
+        breaker_probe_s=o.solver_breaker_probe_s,
     )
     serve_endpoints(o.metrics_port, o.health_probe_port,
                     enable_profiling=o.enable_profiling)

@@ -74,6 +74,10 @@ def new_kwok_operator(
     renew_s: float = 10.0,
     shared_store: Optional[st.Store] = None,
     shared_cloud: Optional[KwokCloud] = None,
+    resilient: bool = True,
+    solver_deadline_s: float = 0.0,
+    breaker_threshold: int = 3,
+    breaker_probe_s: float = 30.0,
 ) -> Operator:
     store = shared_store if shared_store is not None else st.Store()
     # the operator's clock is authoritative for every age stamp, including a
@@ -113,6 +117,20 @@ def new_kwok_operator(
     cloud_provider = decorate(cloud_provider)
     cluster = Cluster(store, clock=clock)
     solver = solver or ReferenceSolver()
+    if resilient:
+        # deadline + failure classification + invariant gate + circuit
+        # breaker around whatever backend was configured; transparent on
+        # success (solver/resilient.py) and attribute access delegates, so
+        # warmup/prewarm/stats below still reach the wrapped backend
+        from ..solver.resilient import ResilientSolver
+
+        solver = ResilientSolver(
+            solver,
+            deadline_s=solver_deadline_s or None,
+            breaker_threshold=breaker_threshold,
+            breaker_probe_s=breaker_probe_s,
+            clock=clock,
+        )
     provisioner = Provisioner(
         store,
         cluster,

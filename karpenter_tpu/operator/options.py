@@ -41,6 +41,15 @@ class Options:
     leader_elect: bool = True
     # solver backend: tpu | reference
     solver_backend: str = "tpu"
+    # resilient execution layer (solver/resilient.py): wrap the backend in
+    # deadline + classification + invariant gate + circuit breaker
+    solver_resilient: bool = True
+    # per-solve deadline on the device path, seconds; 0 = no deadline
+    solver_deadline_s: float = 0.0
+    # breaker opens after this many consecutive device-path failures
+    solver_breaker_threshold: int = 3
+    # half-open probe interval once open, seconds
+    solver_breaker_probe_s: float = 30.0
     max_launch_instance_types: int = 60  # instance.go:60
     # kwok provider
     kwok_rate_limits: bool = False

@@ -144,17 +144,44 @@ class Provisioner:
         self._first_seen = None
         t0 = time.perf_counter()
         inp = self.build_input(pending)
-        solve_async = getattr(self.solver, "solve_async", None)
-        if solve_async is not None:
-            # async seam: kernel + link transfer run while the claim-creation
-            # lookups below are prepared on host (backend.AsyncSolve)
-            handle = solve_async(inp)
-            nodepools: Dict[str, NodePool] = {
-                p.name: p for p in self.store.list(st.NODEPOOLS)
-            }
-            result = handle.result()
-        else:
-            result = self.solver.solve(inp)
+        try:
+            solve_async = getattr(self.solver, "solve_async", None)
+            if solve_async is not None:
+                # async seam: kernel + link transfer run while the
+                # claim-creation lookups below are prepared on host
+                # (backend.AsyncSolve)
+                handle = solve_async(inp)
+                nodepools: Dict[str, NodePool] = {
+                    p.name: p for p in self.store.list(st.NODEPOOLS)
+                }
+                result = handle.result()
+            else:
+                result = self.solver.solve(inp)
+                nodepools = {p.name: p for p in self.store.list(st.NODEPOOLS)}
+        except Exception as e:
+            # a solver exception must degrade, not abort the batch: the
+            # configured solver (even ResilientSolver, if its whole chain is
+            # exhausted) gets one last replay on the python oracle so the
+            # pending pods still make progress this tick; a second failure
+            # defers the batch to the next tick instead of crash-looping the
+            # manager at full rate
+            import logging
+
+            from ..metrics.registry import SOLVER_FALLBACK
+            from ..solver.backend import ReferenceSolver
+
+            SOLVER_FALLBACK.inc(reason="solver_exception")
+            logging.getLogger("karpenter_tpu").exception(
+                "solver failed beyond its fallback chain (%s) — replaying "
+                "batch on the reference oracle", e,
+            )
+            try:
+                result = ReferenceSolver().solve(inp)
+            except Exception:
+                logging.getLogger("karpenter_tpu").exception(
+                    "oracle replay failed too; deferring batch to next tick"
+                )
+                return False
             nodepools = {p.name: p for p in self.store.list(st.NODEPOOLS)}
         PROVISIONER_SCHEDULING_DURATION.observe(time.perf_counter() - t0)
         did = False

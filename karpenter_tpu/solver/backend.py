@@ -18,6 +18,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import faults
 from ..api import wellknown as wk
 from ..provisioning.scheduler import (
     ClaimResult,
@@ -852,6 +853,7 @@ class TPUSolver(Solver):
         Returns (flat_device_array, unpack_fn)."""
         from .tpu.ffd import ffd_solve
 
+        faults.check("solver.device_dispatch")
         out = ffd_solve(*args, max_claims=M, zone_engine=enc.V > 0)
         # ONE device→host transfer: all outputs packed into a single
         # int32 buffer on device (bit-packed masks, uint16 takes), so the
@@ -940,6 +942,7 @@ class TPUSolver(Solver):
                 M = min(M * 2, self.max_claims)
                 fd, up = self._dispatch(enc, args, M)
                 flat = np.asarray(fd)
+            faults.check("solver.decode")
             c_mask = _unpack_words(f["c_mask_words"], T)
             c_zone, c_ct = unpack_zc_bits(f["c_zc_bits"], Z, C)
             c_gmask = _unpack_gmask(f["c_gbits"], G)

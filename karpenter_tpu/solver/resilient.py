@@ -1,0 +1,447 @@
+"""Resilient solver execution: deadline, classification, invariant gate,
+circuit breaker, fallback routing.
+
+The device is a failure domain the reference control plane never had: XLA
+runtime errors, device OOM, compile stalls, and garbage decodes now sit on
+the pod-scheduling critical path. `ResilientSolver` wraps any backend behind
+the same `Solver` seam and guarantees the provisioner one of two outcomes per
+solve: a result that passed the post-solve invariant gate, or an exception
+AFTER the whole fallback chain (native → oracle) was exhausted — never a
+silently corrupt result, never an unbounded stall.
+
+Layers (each independently clock-injectable and testable):
+
+- **Deadline** — a per-solve bound on the device path. `deadline_mode`
+  "thread" enforces it in real time via a watchdog (an abandoned straggler
+  thread keeps the doomed device call off the tick path); "posthoc" measures
+  the injected clock around the call — deterministic, used by tests that
+  script a clock advance into a fault site. Expired solves classify as
+  ``timeout`` and replay on the fallback chain.
+- **Classification** — failures split into ``timeout``, ``device_error``
+  (transient: XLA/runtime/OOM — retrying the device later can succeed),
+  ``encode_bug`` (deterministic: the same input will fail forever), and
+  ``unknown``. Every fallback is counted by reason
+  (``karpenter_tpu_solver_fallback_total``).
+- **Invariant gate** — `check_invariants` validates a result BEFORE it can
+  reach the provisioner: placements reference real nodes or claim slots, no
+  node's free allocatable is oversubscribed (including pod slots), every
+  claim's `pod_uids` are exactly the pods placed on it, and errors are
+  disjoint from placements. A violating result is rejected and the solve
+  replays on the next rung of the chain — a garbage decode can waste a solve,
+  but it cannot create a corrupt NodeClaim.
+- **Circuit breaker** — after `breaker_threshold` consecutive device-path
+  failures the breaker opens and solves go STRAIGHT to fallback (no device
+  dispatch, no deadline wait). After `breaker_probe_s` on the injected clock
+  a half-open probe re-tries the device: success closes, failure re-opens.
+  State is exported as ``karpenter_tpu_solver_breaker_state``
+  (0=closed, 1=half-open, 2=open).
+
+SPEC.md "Failure semantics" documents the ladder; tests/test_resilient_solver.py
+and the chaos tests drive it via karpenter_tpu/faults.py.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..faults import DeviceError, FaultError
+from ..metrics.registry import SOLVER_BREAKER_STATE, SOLVER_FALLBACK
+from ..utils.resources import PODS
+from .backend import AsyncSolve, ReferenceSolver, Solver
+from .encode import quantize_input
+
+log = logging.getLogger("karpenter_tpu")
+
+
+class SolveTimeout(Exception):
+    """The device path exceeded the per-solve deadline."""
+
+
+class InvariantViolation(Exception):
+    """Every rung of the fallback chain produced an invalid result."""
+
+
+# -- failure classification ---------------------------------------------------
+
+#: transient: retrying the device later can succeed (breaker territory)
+DEVICE_ERROR = "device_error"
+#: deterministic host/encode/decode bug: same input fails forever
+ENCODE_BUG = "encode_bug"
+TIMEOUT = "timeout"
+UNKNOWN = "unknown"
+
+
+def classify_failure(exc: BaseException) -> str:
+    """Map a device-path exception to a fallback reason."""
+    if isinstance(exc, SolveTimeout):
+        return TIMEOUT
+    if isinstance(exc, DeviceError):
+        return DEVICE_ERROR
+    if isinstance(exc, FaultError):  # other injected faults default transient
+        return DEVICE_ERROR
+    name = type(exc).__name__
+    mod = type(exc).__module__ or ""
+    # XLA/jax runtime surface: XlaRuntimeError (RuntimeError subclass),
+    # jaxlib errors, resource exhaustion
+    if "Xla" in name or mod.startswith(("jax", "jaxlib")):
+        return DEVICE_ERROR
+    if isinstance(exc, (RuntimeError, OSError, MemoryError, ConnectionError)):
+        return DEVICE_ERROR
+    # host-side determinism: shape/index/key/assertion failures in
+    # encode/decode repeat on every retry of the same input
+    if isinstance(exc, (ValueError, TypeError, KeyError, IndexError, AssertionError)):
+        return ENCODE_BUG
+    return UNKNOWN
+
+
+# -- post-solve invariant gate ------------------------------------------------
+
+
+def check_invariants(qinp, result) -> List[str]:
+    """Validate a SolverResult against its (quantized) input. Returns a list
+    of violation strings (empty = valid). Mirrors the scheduler's own
+    commit-time rules so a correct backend always passes:
+
+    - placements reference input nodes or in-range claim slots;
+    - placement/error keys are schedulable input pods, and disjoint;
+    - each claim's pod_uids are EXACTLY the pods placed on that slot;
+    - no node's free allocatable is oversubscribed (any resource key, and
+      one pod slot per pod — scheduler requires free[pods] >= 1 per add).
+    """
+    violations: List[str] = []
+    pods_by_uid = {
+        p.meta.uid: p
+        for p in qinp.pods
+        if not p.scheduling_gated and not p.bound
+    }
+    nodes = {n.id: n for n in qinp.nodes}
+    n_claims = len(result.claims)
+
+    placed_on_claim: Dict[int, set] = {}
+    placed_on_node: Dict[str, list] = {}
+    for uid, tgt in result.placements.items():
+        if uid not in pods_by_uid:
+            violations.append(f"placement for unknown/unschedulable pod {uid!r}")
+            continue
+        if not isinstance(tgt, tuple) or len(tgt) != 2:
+            violations.append(f"malformed placement target {tgt!r} for {uid!r}")
+        elif tgt[0] == "node":
+            if tgt[1] not in nodes:
+                violations.append(f"pod {uid!r} placed on phantom node {tgt[1]!r}")
+            else:
+                placed_on_node.setdefault(tgt[1], []).append(uid)
+        elif tgt[0] == "claim":
+            if not isinstance(tgt[1], int) or not (0 <= tgt[1] < n_claims):
+                violations.append(
+                    f"pod {uid!r} placed on out-of-range claim slot {tgt[1]!r} "
+                    f"(claims={n_claims})"
+                )
+            else:
+                placed_on_claim.setdefault(tgt[1], set()).add(uid)
+        else:
+            violations.append(f"unknown placement kind {tgt[0]!r} for {uid!r}")
+
+    overlap = set(result.placements) & set(result.errors)
+    if overlap:
+        violations.append(
+            f"{len(overlap)} pods both placed and errored (e.g. {sorted(overlap)[:3]})"
+        )
+    for uid in result.errors:
+        if uid not in pods_by_uid:
+            violations.append(f"error recorded for unknown pod {uid!r}")
+
+    for i, claim in enumerate(result.claims):
+        uids = list(claim.pod_uids)
+        if len(set(uids)) != len(uids):
+            violations.append(f"claim {i} lists duplicate pod uids")
+        if set(uids) != placed_on_claim.get(i, set()):
+            missing = placed_on_claim.get(i, set()) - set(uids)
+            extra = set(uids) - placed_on_claim.get(i, set())
+            violations.append(
+                f"claim {i} pod_uids inconsistent with placements "
+                f"(missing={sorted(missing)[:3]} extra={sorted(extra)[:3]})"
+            )
+
+    for node_id, uids in placed_on_node.items():
+        free = nodes[node_id].free
+        used: Dict[str, int] = {}
+        for uid in uids:
+            for k, v in pods_by_uid[uid].requests.items():
+                if v > 0:
+                    used[k] = used.get(k, 0) + v
+        for k, v in used.items():
+            if v > free.get_(k):
+                violations.append(
+                    f"node {node_id!r} oversubscribed on {k}: "
+                    f"placed={v} free={free.get_(k)}"
+                )
+        if len(uids) > free.get_(PODS):
+            violations.append(
+                f"node {node_id!r} pod slots oversubscribed: "
+                f"placed={len(uids)} free={free.get_(PODS)}"
+            )
+    return violations
+
+
+# -- circuit breaker ----------------------------------------------------------
+
+CLOSED, HALF_OPEN, OPEN = "closed", "half-open", "open"
+_STATE_GAUGE_VALUE = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with clock-injectable half-open probes."""
+
+    def __init__(self, threshold: int = 3, probe_interval_s: float = 30.0,
+                 clock=time.monotonic):
+        self.threshold = max(1, int(threshold))
+        self.probe_interval_s = probe_interval_s
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at: Optional[float] = None
+        self._export()
+
+    def _export(self) -> None:
+        SOLVER_BREAKER_STATE.set(_STATE_GAUGE_VALUE[self._state])
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def consecutive_failures(self) -> int:
+        with self._lock:
+            return self._consecutive_failures
+
+    def allow(self) -> bool:
+        """May the device path run? Open flips to half-open (one probe
+        allowed) once the probe interval elapses on the injected clock."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                if self.clock() - self._opened_at >= self.probe_interval_s:
+                    self._state = HALF_OPEN
+                    self._export()
+                    return True
+                return False
+            # HALF_OPEN: one probe is already in flight this interval; route
+            # concurrent solves to fallback until it reports
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            if self._state != CLOSED:
+                log.info("solver breaker: closed (device probe succeeded)")
+            self._state = CLOSED
+            self._opened_at = None
+            self._export()
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive_failures += 1
+            if self._state == HALF_OPEN or (
+                self._state == CLOSED
+                and self._consecutive_failures >= self.threshold
+            ):
+                if self._state != OPEN:
+                    log.warning(
+                        "solver breaker: OPEN after %d consecutive device "
+                        "failures — solves route straight to fallback; next "
+                        "probe in %.0fs",
+                        self._consecutive_failures, self.probe_interval_s,
+                    )
+                self._state = OPEN
+                self._opened_at = self.clock()
+            self._export()
+
+
+# -- the wrapper --------------------------------------------------------------
+
+
+class ResilientSolver(Solver):
+    """Deadline + breaker + invariant gate + fallback routing around any
+    `Solver`. Transparent on success (the inner result passes through
+    untouched — parity with the unwrapped backend is asserted in
+    tests/test_solver_parity.py), attribute access delegates to the inner
+    solver (`stats`, `warmup`, `prewarm_aot`, ...).
+    """
+
+    def __init__(
+        self,
+        inner: Solver,
+        fallbacks: Optional[Sequence[Solver]] = None,
+        deadline_s: Optional[float] = None,
+        deadline_mode: Optional[str] = None,  # "thread" | "posthoc" | None=auto
+        breaker: Optional[CircuitBreaker] = None,
+        breaker_threshold: int = 3,
+        breaker_probe_s: float = 30.0,
+        clock=time.monotonic,
+    ):
+        self.inner = inner
+        if fallbacks is None:
+            # the existing fallback chain: native C++ core, then the python
+            # oracle (NativeSolver degrades to the oracle internally too, but
+            # an explicit final rung keeps the ladder honest if native's own
+            # decode is what is broken)
+            from .native import NativeSolver
+
+            fallbacks = [NativeSolver(), ReferenceSolver()]
+        self.fallbacks = list(fallbacks)
+        self.deadline_s = deadline_s
+        if deadline_mode is None:
+            deadline_mode = "thread" if clock is time.monotonic else "posthoc"
+        self.deadline_mode = deadline_mode
+        self.clock = clock
+        self.breaker = breaker or CircuitBreaker(
+            threshold=breaker_threshold, probe_interval_s=breaker_probe_s,
+            clock=clock,
+        )
+        self.resilient_stats: Dict[str, int] = {
+            "solves": 0,
+            "device_path": 0,
+            "fallback": 0,
+            "gate_rejections": 0,
+            "breaker_short_circuits": 0,
+        }
+
+    def __getattr__(self, name):
+        # delegation AFTER normal lookup fails: stats/warmup/prewarm_aot/
+        # max_claims etc. read through to the wrapped backend
+        return getattr(self.inner, name)
+
+    # -- public seam --------------------------------------------------------
+
+    def solve(self, inp):
+        return self.solve_async(inp).result()
+
+    def solve_async(self, inp) -> AsyncSolve:
+        self.resilient_stats["solves"] += 1
+        if not self.breaker.allow():
+            self.resilient_stats["breaker_short_circuits"] += 1
+            SOLVER_FALLBACK.inc(reason="breaker_open")
+            return AsyncSolve(lambda: self._fallback_solve(inp))
+        self.resilient_stats["device_path"] += 1
+        t0 = self.clock()
+        inner_async = getattr(self.inner, "solve_async", None)
+        handle = None
+        if inner_async is not None:
+            try:
+                # dispatch eagerly: the async pipelining the provisioner seam
+                # relies on (host work overlapping device compute) survives
+                # the wrapper; the deadline window opened at t0
+                handle = inner_async(inp)
+            except Exception as e:  # noqa: BLE001 — classified below
+                # rebind: `e` is unset once the except block exits, and the
+                # lambda runs later (deferred AsyncSolve result)
+                exc = e
+                return AsyncSolve(lambda: self._handle_failure(inp, exc))
+
+        def finish():
+            try:
+                if handle is not None:
+                    res = self._wait(handle.result, t0)
+                else:
+                    res = self._wait(lambda: self.inner.solve(inp), t0)
+            except Exception as e:  # noqa: BLE001 — classified
+                return self._handle_failure(inp, e)
+            violations = check_invariants(quantize_input(inp), res)
+            if violations:
+                self.resilient_stats["gate_rejections"] += 1
+                self.breaker.record_failure()
+                SOLVER_FALLBACK.inc(reason="invariant_gate")
+                log.error(
+                    "solver invariant gate REJECTED a %s result (%d "
+                    "violations, e.g. %s) — replaying on fallback chain",
+                    type(self.inner).__name__, len(violations), violations[0],
+                )
+                return self._fallback_solve(inp)
+            self.breaker.record_success()
+            return res
+
+        return AsyncSolve(finish)
+
+    # -- internals ----------------------------------------------------------
+
+    def _wait(self, fn, t0: float):
+        """Run the blocking device-path wait under the deadline."""
+        if not self.deadline_s:
+            return fn()
+        if self.deadline_mode == "posthoc":
+            # deterministic mode: measure the injected clock around the call;
+            # a fault-plan hook advancing the clock mid-solve trips this
+            res = fn()
+            elapsed = self.clock() - t0
+            if elapsed > self.deadline_s:
+                raise SolveTimeout(
+                    f"solve exceeded deadline: {elapsed:.3f}s > {self.deadline_s}s"
+                )
+            return res
+        remaining = self.deadline_s - (time.monotonic() - t0)
+        if remaining <= 0:
+            raise SolveTimeout(f"deadline {self.deadline_s}s expired before wait")
+        box: dict = {}
+        done = threading.Event()
+
+        def run():
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised in caller
+                box["error"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=run, daemon=True, name="resilient-solve")
+        t.start()
+        if not done.wait(remaining):
+            # abandon the straggler: a hung XLA call cannot be cancelled, but
+            # it must not hold the control loop hostage
+            raise SolveTimeout(
+                f"solve exceeded deadline {self.deadline_s}s (device call abandoned)"
+            )
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
+
+    def _handle_failure(self, inp, exc: BaseException):
+        reason = classify_failure(exc)
+        self.breaker.record_failure()
+        SOLVER_FALLBACK.inc(reason=reason)
+        log.warning(
+            "solver %s failed (%s: %s) — classified %r, falling back "
+            "(consecutive failures: %d)",
+            type(self.inner).__name__, type(exc).__name__, exc, reason,
+            self.breaker.consecutive_failures,
+        )
+        return self._fallback_solve(inp)
+
+    def _fallback_solve(self, inp):
+        """Walk the chain; every rung's result faces the same gate."""
+        self.resilient_stats["fallback"] += 1
+        last_violations: List[str] = []
+        for fb in self.fallbacks:
+            try:
+                res = fb.solve(inp)
+            except Exception as e:  # noqa: BLE001 — try the next rung
+                SOLVER_FALLBACK.inc(reason="fallback_error")
+                log.error("fallback %s failed: %s", type(fb).__name__, e)
+                continue
+            last_violations = check_invariants(quantize_input(inp), res)
+            if not last_violations:
+                return res
+            SOLVER_FALLBACK.inc(reason="invariant_gate")
+            log.error(
+                "invariant gate rejected fallback %s result (%s)",
+                type(fb).__name__, last_violations[0],
+            )
+        raise InvariantViolation(
+            "every rung of the fallback chain failed or violated invariants: "
+            + (last_violations[0] if last_violations else "no rung produced a result")
+        )

@@ -1,0 +1,614 @@
+"""Resilient solver execution: deadline, classification, breaker, invariant
+gate, fallback routing — plus the fault-injected chaos runs that prove the
+full operator loop survives a dying device (ISSUE 2 acceptance)."""
+
+import dataclasses
+
+import pytest
+
+from karpenter_tpu import faults
+from karpenter_tpu.api import wellknown as wk
+from karpenter_tpu.api.objects import ObjectMeta, Pod
+from karpenter_tpu.controllers import store as st
+from karpenter_tpu.metrics.registry import (
+    CONTROLLER_ERRORS,
+    REPAIR_BREAKER_OPEN,
+    SOLVER_BREAKER_STATE,
+    SOLVER_FALLBACK,
+)
+from karpenter_tpu.operator.operator import new_kwok_operator
+from karpenter_tpu.provisioning.scheduler import (
+    ClaimResult,
+    ExistingNode,
+    SolverInput,
+    SolverResult,
+)
+from karpenter_tpu.scheduling.requirements import Requirements
+from karpenter_tpu.solver.backend import ReferenceSolver, Solver, TPUSolver
+from karpenter_tpu.solver.encode import quantize_input
+from karpenter_tpu.solver.resilient import (
+    CircuitBreaker,
+    InvariantViolation,
+    ResilientSolver,
+    SolveTimeout,
+    check_invariants,
+    classify_failure,
+)
+from karpenter_tpu.utils.resources import PODS, Resources
+
+from tests.test_e2e_kwok import FakeClock, mkpool
+from tests.test_solver_parity import ZONES, mkpod, pool
+
+
+def _inp(pods, nodes=()):
+    return SolverInput(pods=list(pods), nodes=list(nodes),
+                       nodepools=[pool()], zones=ZONES)
+
+
+# -- classification ----------------------------------------------------------
+
+
+def test_classify_failure():
+    assert classify_failure(SolveTimeout("late")) == "timeout"
+    assert classify_failure(faults.DeviceError("xla died")) == "device_error"
+    assert classify_failure(RuntimeError("RESOURCE_EXHAUSTED")) == "device_error"
+    assert classify_failure(MemoryError()) == "device_error"
+    assert classify_failure(OSError("tunnel")) == "device_error"
+    assert classify_failure(ValueError("bad shape")) == "encode_bug"
+    assert classify_failure(IndexError()) == "encode_bug"
+    assert classify_failure(faults.DecodeError("garbage")) == "device_error"
+    assert classify_failure(StopIteration()) == "unknown"
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+
+def test_breaker_opens_probes_and_recovers():
+    clock = FakeClock()
+    b = CircuitBreaker(threshold=3, probe_interval_s=30.0, clock=clock)
+    assert b.state == "closed" and b.allow()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed"  # below threshold
+    b.record_failure()
+    assert b.state == "open"
+    assert SOLVER_BREAKER_STATE.value() == 2.0
+    assert not b.allow()  # interval not elapsed: straight to fallback
+    clock.advance(29)
+    assert not b.allow()
+    clock.advance(2)
+    assert b.allow()  # half-open: one probe
+    assert b.state == "half-open"
+    assert SOLVER_BREAKER_STATE.value() == 1.0
+    assert not b.allow()  # concurrent solve while probing: fallback
+    b.record_success()
+    assert b.state == "closed" and b.allow()
+    assert SOLVER_BREAKER_STATE.value() == 0.0
+
+
+def test_breaker_probe_failure_reopens():
+    clock = FakeClock()
+    b = CircuitBreaker(threshold=1, probe_interval_s=10.0, clock=clock)
+    b.record_failure()
+    assert b.state == "open"
+    clock.advance(11)
+    assert b.allow()
+    b.record_failure()  # probe failed
+    assert b.state == "open"
+    assert not b.allow()  # new interval started
+    clock.advance(11)
+    assert b.allow()
+
+
+# -- invariant gate ----------------------------------------------------------
+
+
+def _gate_fixture():
+    pods = [mkpod("a", cpu="1"), mkpod("b", cpu="1")]
+    node = ExistingNode(
+        id="n1", labels={}, taints=[],
+        free=Resources.parse({"cpu": "1", "memory": "4Gi", "pods": "10"}),
+    )
+    inp = _inp(pods, [node])
+    return pods, node, quantize_input(inp)
+
+
+def _claim(uids):
+    return ClaimResult(nodepool="default", requirements=Requirements(),
+                       instance_type_names=["m5.large"], pod_uids=list(uids),
+                       requests=Resources.parse({"cpu": "1"}), taints=[],
+                       hostname="h")
+
+
+def test_gate_accepts_valid_result():
+    _, _, q = _gate_fixture()
+    res = SolverResult(
+        placements={"a": ("node", "n1"), "b": ("claim", 0)},
+        claims=[_claim(["b"])], errors={},
+    )
+    assert check_invariants(q, res) == []
+
+
+def test_gate_rejects_phantom_node_and_bad_slot():
+    _, _, q = _gate_fixture()
+    res = SolverResult(placements={"a": ("node", "ghost"), "b": ("claim", 3)},
+                       claims=[_claim([])], errors={})
+    v = check_invariants(q, res)
+    assert any("phantom node" in s for s in v)
+    assert any("out-of-range claim slot" in s for s in v)
+
+
+def test_gate_rejects_oversubscription():
+    # both 1-cpu pods on a node with 1 cpu free
+    _, _, q = _gate_fixture()
+    res = SolverResult(
+        placements={"a": ("node", "n1"), "b": ("node", "n1")},
+        claims=[], errors={},
+    )
+    v = check_invariants(q, res)
+    assert any("oversubscribed on cpu" in s for s in v)
+
+
+def test_gate_rejects_pod_slot_oversubscription():
+    pods = [mkpod(f"p{i}", cpu="1m", mem="1Mi") for i in range(3)]
+    node = ExistingNode(
+        id="n1", labels={}, taints=[],
+        free=Resources.parse({"cpu": "10", "memory": "4Gi", "pods": "2"}),
+    )
+    q = quantize_input(_inp(pods, [node]))
+    res = SolverResult(
+        placements={p.meta.uid: ("node", "n1") for p in pods},
+        claims=[], errors={},
+    )
+    v = check_invariants(q, res)
+    assert any("pod slots oversubscribed" in s for s in v)
+
+
+def test_gate_rejects_claim_uid_mismatch_and_overlap():
+    _, _, q = _gate_fixture()
+    res = SolverResult(
+        placements={"a": ("claim", 0)},
+        claims=[_claim(["a", "b"])],  # b never placed on slot 0
+        errors={"a": "also errored"},  # overlaps placements
+    )
+    v = check_invariants(q, res)
+    assert any("inconsistent with placements" in s for s in v)
+    assert any("both placed and errored" in s for s in v)
+
+
+# -- ResilientSolver routing -------------------------------------------------
+
+
+class _ScriptedSolver(Solver):
+    """Inner backend whose outcomes are scripted per solve: an exception
+    instance (raised), a SolverResult (returned), or 'oracle' (delegate)."""
+
+    def __init__(self, *outcomes, clock=None, advance=0.0):
+        self.outcomes = list(outcomes)
+        self.calls = 0
+        self.clock = clock
+        self.advance = advance  # FakeClock seconds consumed per solve
+
+    def solve(self, inp):
+        self.calls += 1
+        if self.clock is not None and self.advance:
+            self.clock.advance(self.advance)
+        out = self.outcomes.pop(0) if self.outcomes else "oracle"
+        if isinstance(out, BaseException):
+            raise out
+        if out == "oracle":
+            return ReferenceSolver().solve(inp)
+        return out
+
+
+def test_device_error_falls_back_to_oracle():
+    clock = FakeClock()
+    inner = _ScriptedSolver(faults.DeviceError("xla"))
+    rs = ResilientSolver(inner, fallbacks=[ReferenceSolver()], clock=clock)
+    before = SOLVER_FALLBACK.value(reason="device_error")
+    inp = _inp([mkpod("a")])
+    res = rs.solve(inp)
+    assert res.placements["a"][0] == "claim"
+    assert SOLVER_FALLBACK.value(reason="device_error") == before + 1
+    assert rs.resilient_stats["fallback"] == 1
+    assert rs.breaker.consecutive_failures == 1
+
+
+def test_posthoc_deadline_trips_and_falls_back():
+    clock = FakeClock()
+    inner = _ScriptedSolver("oracle", clock=clock, advance=10.0)
+    rs = ResilientSolver(inner, fallbacks=[ReferenceSolver()],
+                         deadline_s=5.0, clock=clock)
+    assert rs.deadline_mode == "posthoc"  # auto: non-wall clock injected
+    before = SOLVER_FALLBACK.value(reason="timeout")
+    res = rs.solve(_inp([mkpod("a")]))
+    assert res.placements["a"][0] == "claim"  # served by fallback
+    assert SOLVER_FALLBACK.value(reason="timeout") == before + 1
+
+
+def test_thread_deadline_abandons_hung_solve():
+    import threading
+
+    release = threading.Event()
+
+    class Hung(Solver):
+        def solve(self, inp):
+            release.wait(5)
+            return ReferenceSolver().solve(inp)
+
+    rs = ResilientSolver(Hung(), fallbacks=[ReferenceSolver()],
+                         deadline_s=0.05, deadline_mode="thread")
+    before = SOLVER_FALLBACK.value(reason="timeout")
+    res = rs.solve(_inp([mkpod("a")]))
+    release.set()
+    assert res.placements["a"][0] == "claim"
+    assert SOLVER_FALLBACK.value(reason="timeout") == before + 1
+
+
+def test_gate_rejection_replays_on_fallback():
+    garbage = SolverResult(placements={"a": ("node", "ghost")}, claims=[],
+                           errors={})
+    inner = _ScriptedSolver(garbage)
+    rs = ResilientSolver(inner, fallbacks=[ReferenceSolver()],
+                         clock=FakeClock())
+    before = SOLVER_FALLBACK.value(reason="invariant_gate")
+    res = rs.solve(_inp([mkpod("a")]))
+    assert res.placements["a"][0] == "claim"  # oracle's valid result
+    assert rs.resilient_stats["gate_rejections"] == 1
+    assert SOLVER_FALLBACK.value(reason="invariant_gate") == before + 1
+
+
+def test_exhausted_chain_raises_invariant_violation():
+    garbage = SolverResult(placements={"a": ("node", "ghost")}, claims=[],
+                           errors={})
+    inner = _ScriptedSolver(garbage)
+    bad_fb = _ScriptedSolver(dataclasses.replace(garbage))
+    rs = ResilientSolver(inner, fallbacks=[bad_fb], clock=FakeClock())
+    with pytest.raises(InvariantViolation):
+        rs.solve(_inp([mkpod("a")]))
+
+
+def test_breaker_short_circuits_device_and_recovers_on_probe():
+    clock = FakeClock()
+    inner = _ScriptedSolver(
+        faults.DeviceError("1"), faults.DeviceError("2"),  # trip (threshold 2)
+    )
+    rs = ResilientSolver(inner, fallbacks=[ReferenceSolver()],
+                         breaker_threshold=2, breaker_probe_s=30.0,
+                         clock=clock)
+    inp = _inp([mkpod("a")])
+    rs.solve(inp)
+    rs.solve(inp)
+    assert rs.breaker.state == "open"
+    before_calls = inner.calls
+    before_sc = SOLVER_FALLBACK.value(reason="breaker_open")
+    res = rs.solve(inp)  # open: device never consulted
+    assert inner.calls == before_calls
+    assert res.placements and rs.resilient_stats["breaker_short_circuits"] == 1
+    assert SOLVER_FALLBACK.value(reason="breaker_open") == before_sc + 1
+    clock.advance(31)
+    res = rs.solve(inp)  # half-open probe: inner now healthy again
+    assert inner.calls == before_calls + 1
+    assert rs.breaker.state == "closed"
+    assert res.placements["a"][0] == "claim"
+
+
+def test_delegates_attributes_to_inner():
+    inner = TPUSolver()
+    rs = ResilientSolver(inner, clock=FakeClock())
+    assert rs.stats is inner.stats
+    assert hasattr(rs, "warmup") and hasattr(rs, "prewarm_aot")
+    assert not hasattr(ResilientSolver(ReferenceSolver(),
+                                       clock=FakeClock()), "warmup")
+
+
+# -- parity with the wrapper on both backends (acceptance) -------------------
+
+
+def test_parity_holds_under_resilient_wrapper():
+    from tests.test_solver_parity import assert_parity
+
+    import random
+
+    random.seed(7)
+    pods = [
+        mkpod(f"p{i:03d}", cpu=f"{random.choice([100, 250, 500, 1000])}m",
+              mem=f"{random.choice([128, 256, 512, 1024])}Mi")
+        for i in range(40)
+    ]
+    inp = _inp(pods)
+    ref = ResilientSolver(ReferenceSolver(), clock=FakeClock()).solve(
+        quantize_input(inp))
+    tpu = ResilientSolver(TPUSolver(), clock=FakeClock()).solve(inp)
+    # same exactness bar as assert_parity's core checks
+    assert ref.placements == tpu.placements
+    assert set(ref.errors) == set(tpu.errors)
+    assert len(ref.claims) == len(tpu.claims)
+    for rc, tc in zip(ref.claims, tpu.claims):
+        assert rc.pod_uids == tc.pod_uids
+        assert sorted(rc.instance_type_names) == sorted(tc.instance_type_names)
+    # and the unwrapped oracle agrees: the wrapper was transparent
+    bare = ReferenceSolver().solve(quantize_input(inp))
+    assert bare.placements == ref.placements
+
+
+# -- operator-loop chaos (acceptance: converge via fallback) -----------------
+
+
+def _mkpods(op, n, prefix="c"):
+    for i in range(n):
+        op.store.create(st.PODS, Pod(
+            meta=ObjectMeta(name=f"{prefix}{i:03d}", uid=f"{prefix}{i:03d}"),
+            requests=Resources.parse({"cpu": "500m", "memory": "512Mi"}),
+        ))
+
+
+@pytest.mark.chaos
+def test_operator_converges_while_device_dies_then_breaker_recovers():
+    """solver.device_dispatch scripted to fail K times: every pod still
+    binds (served by the fallback ladder), the breaker opens, and a later
+    half-open probe against the recovered device closes it again."""
+    clock = FakeClock()
+    op = new_kwok_operator(
+        clock=clock, solver=TPUSolver(),
+        breaker_threshold=2, breaker_probe_s=30.0,
+    )
+    op.store.create(st.NODEPOOLS, mkpool())
+    # the device is dead for the whole first phase (50 >> any dispatch count
+    # the provisioner + disruption sims produce before the breaker opens)
+    plan = faults.FaultPlan(seed=3)
+    plan.fail_n("solver.device_dispatch", 50)
+    before_dev = SOLVER_FALLBACK.value(reason="device_error")
+    with faults.active(plan):
+        _mkpods(op, 8, "k")
+        for _ in range(6):
+            op.manager.tick()
+            clock.advance(1)
+        op.manager.settle()
+        assert op.solver.breaker.state == "open"
+        assert all(p.node_name for p in op.store.list(st.PODS)), (
+            "pods did not bind via fallback while the device was dead"
+        )
+        assert SOLVER_FALLBACK.value(reason="device_error") > before_dev
+        assert plan.fired["solver.device_dispatch"] >= 2  # >= threshold
+    # device recovered (fault scope exited); the next solve past the probe
+    # interval is the half-open probe and closes the breaker
+    clock.advance(31)
+    _mkpods(op, 4, "r")
+    for _ in range(6):
+        op.manager.tick()
+        clock.advance(1)
+    op.manager.settle()
+    assert op.solver.breaker.state == "closed"
+    assert op.solver.stats["device_solves"] >= 1  # probe ran on-device
+    assert all(p.node_name for p in op.store.list(st.PODS))
+
+
+@pytest.mark.chaos
+def test_gate_rejections_never_produce_a_nodeclaim():
+    """A backend decoding garbage (placements onto a phantom node, claims
+    with stray uids) must never materialize a NodeClaim from that garbage:
+    the gate replays the solve on the oracle and only oracle claims land."""
+
+    class GarbageFirst(Solver):
+        def __init__(self):
+            self.calls = 0
+
+        def solve(self, inp):
+            self.calls += 1
+            if self.calls <= 2:
+                uids = [p.meta.uid for p in inp.pods]
+                return SolverResult(
+                    placements={u: ("node", "phantom-node") for u in uids},
+                    claims=[ClaimResult(
+                        nodepool="default", requirements=Requirements(),
+                        instance_type_names=["m5.large"],
+                        pod_uids=["never-existed"],
+                        requests=Resources.parse({"cpu": "1"}), taints=[],
+                        hostname="x")],
+                    errors={},
+                )
+            return ReferenceSolver().solve(inp)
+
+    clock = FakeClock()
+    inner = GarbageFirst()
+    op = new_kwok_operator(clock=clock, solver=inner, breaker_threshold=99)
+    op.store.create(st.NODEPOOLS, mkpool())
+    _mkpods(op, 5, "g")
+    for _ in range(6):
+        op.manager.tick()
+        clock.advance(1)
+    op.manager.settle()
+    assert inner.calls >= 1
+    assert op.solver.resilient_stats["gate_rejections"] >= 1
+    for c in op.store.list(st.NODECLAIMS):
+        assert "never-existed" not in c.meta.name
+    for p in op.store.list(st.PODS):
+        assert p.node_name and p.node_name != "phantom-node"
+
+
+@pytest.mark.chaos
+def test_store_update_faults_are_contained_by_manager_backoff():
+    """store.update dying under a controller must not kill the loop: the
+    manager counts the error, backs the controller off, and the system
+    converges once the fault clears."""
+    clock = FakeClock()
+    op = new_kwok_operator(clock=clock)
+    op.store.create(st.NODEPOOLS, mkpool())
+    plan = faults.FaultPlan(seed=1)
+    plan.fail_n("store.update", 3, faults.FaultError("etcd burp"))
+    with faults.active(plan):
+        _mkpods(op, 4, "s")
+        for _ in range(10):
+            op.manager.tick()
+            clock.advance(1)
+    for _ in range(40):  # drain any backoff skips, then settle
+        op.manager.tick()
+        clock.advance(1)
+    op.manager.settle()
+    assert all(p.node_name for p in op.store.list(st.PODS))
+    health = op.manager.health()
+    assert all(h["consecutive_failures"] == 0 for h in health.values()), health
+
+
+# -- manager containment -----------------------------------------------------
+
+
+def test_manager_backoff_and_health():
+    from karpenter_tpu.controllers.manager import Manager
+
+    class Flaky:
+        name = "flaky"
+
+        def __init__(self):
+            self.calls = 0
+            self.fail = True
+
+        def reconcile(self):
+            self.calls += 1
+            if self.fail:
+                raise RuntimeError("boom")
+            return False
+
+    m = Manager()
+    c = Flaky()
+    m.register(c)
+    before = CONTROLLER_ERRORS.value(controller="flaky")
+    m.tick()  # fail #1 -> skip 1
+    m.tick()  # skipped
+    assert c.calls == 1
+    assert m.health()["flaky"] == {
+        "consecutive_failures": 1, "backoff_ticks_remaining": 0,
+    }
+    m.tick()  # fail #2 -> skip 2
+    m.tick(); m.tick()  # skipped twice
+    assert c.calls == 2
+    assert CONTROLLER_ERRORS.value(controller="flaky") == before + 2
+    c.fail = False
+    m.tick()  # recovers
+    assert c.calls == 3
+    assert m.health()["flaky"]["consecutive_failures"] == 0
+    m.tick()  # no backoff anymore
+    assert c.calls == 4
+
+
+def test_manager_backoff_is_capped():
+    from karpenter_tpu.controllers.manager import BACKOFF_CAP, Manager
+
+    class AlwaysFail:
+        name = "af"
+
+        def reconcile(self):
+            raise RuntimeError("no")
+
+    m = Manager()
+    m.register(AlwaysFail())
+    for _ in range(10):
+        m.tick()
+        m._skip["af"] = 0  # force retry each tick to drive the counter up
+    assert m.health()["af"]["consecutive_failures"] == 10
+    m.tick()
+    assert m._skip["af"] <= BACKOFF_CAP
+
+
+# -- satellites: launch throttling, token bucket, repair breaker -------------
+
+
+def test_launch_throttle_is_per_claim(monkeypatch):
+    """One throttled create must not abort the other launches this tick."""
+    from karpenter_tpu.kwok.ratelimit import ThrottleError
+
+    clock = FakeClock()
+    op = new_kwok_operator(clock=clock)
+    op.store.create(st.NODEPOOLS, mkpool())
+    _mkpods(op, 1, "t")
+    op.manager.settle()
+    assert all(p.node_name for p in op.store.list(st.PODS))
+
+    # now throttle exactly the FIRST create of the next wave
+    from karpenter_tpu.lifecycle.controller import LaunchController
+
+    launch = next(c for c in op.manager.controllers
+                  if isinstance(c, LaunchController))
+    real_create = op.cloud_provider.create
+    state = {"throttled": 0}
+
+    def flaky_create(claim, opts):
+        if state["throttled"] < 1:
+            state["throttled"] += 1
+            raise ThrottleError("RequestLimitExceeded")
+        return real_create(claim, opts)
+
+    monkeypatch.setattr(op.cloud_provider, "create", flaky_create)
+    # distinct-zone selectors -> one claim per pod, racing the same tick
+    for i, z in enumerate(("zone-1a", "zone-1b", "zone-1c")):
+        op.store.create(st.PODS, Pod(
+            meta=ObjectMeta(name=f"big{i}", uid=f"big{i}"),
+            requests=Resources.parse({"cpu": "7", "memory": "1Gi"}),
+            node_selector={wk.ZONE_LABEL: z},
+        ))
+    for _ in range(3):
+        op.manager.tick()
+    launched = [c for c in op.store.list(st.NODECLAIMS) if c.launched]
+    assert len(launched) >= 2, (
+        "throttling one claim starved the rest of the batch"
+    )
+    clock.advance(2)  # past THROTTLE_BACKOFF_S: the throttled claim retries
+    op.manager.settle()
+    clock.advance(60)
+    op.manager.settle()
+    assert all(p.node_name for p in op.store.list(st.PODS))
+
+
+def test_token_bucket_is_clock_injectable():
+    from karpenter_tpu.kwok.ratelimit import TokenBucket
+
+    clock = FakeClock()
+    tb = TokenBucket(rate=1.0, burst=2, clock=clock)
+    assert tb.try_take() and tb.try_take()
+    assert not tb.try_take()  # burst drained, no wall sleep involved
+    clock.advance(1)
+    assert tb.try_take()  # refilled deterministically on the fake clock
+    assert not tb.try_take()
+
+
+def test_repair_breaker_gauge_sets_and_clears():
+    from karpenter_tpu.cloudprovider.types import RepairPolicy
+    from karpenter_tpu.lifecycle.repair import RepairController
+
+    class FakeCP:
+        def repair_policies(self):
+            return [RepairPolicy(condition_type="Ready",
+                                 condition_status="False",
+                                 toleration_duration_s=30)]
+
+    from karpenter_tpu.api.objects import Node
+
+    clock = FakeClock()
+    store = st.Store()
+    rc = RepairController(store, FakeCP(), clock=clock)
+    for i in range(4):
+        store.create(st.NODES, Node(
+            meta=ObjectMeta(name=f"n{i}"),
+            allocatable=Resources.parse({"cpu": "4"}),
+        ))
+    # 3/4 unhealthy: breaker trips
+    for i in range(3):
+        n = store.get(st.NODES, f"n{i}")
+        n.conditions["Ready"] = "False"
+        n.condition_since["Ready"] = clock()
+        store.update(st.NODES, n)
+    rc.reconcile()
+    assert REPAIR_BREAKER_OPEN.value() == 1.0
+    # fleet heals to 1/6 unhealthy (<= 20%): breaker clears
+    for i in range(1, 3):
+        n = store.get(st.NODES, f"n{i}")
+        n.conditions["Ready"] = "True"
+        store.update(st.NODES, n)
+    for i in range(4, 6):
+        store.create(st.NODES, Node(
+            meta=ObjectMeta(name=f"n{i}"),
+            allocatable=Resources.parse({"cpu": "4"}),
+        ))
+    rc.reconcile()
+    assert REPAIR_BREAKER_OPEN.value() == 0.0
