@@ -26,7 +26,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..provisioning.scheduler import SolverInput
-from ..solver.backend import TPUSolver, kernel_args, unpack_zc_bits
+from ..solver.backend import TPUSolver, host_kernel_args, unpack_zc_bits
 from ..solver.encode import UnpackableInput, encode, quantize_input
 from ..solver.tpu.consolidate import (
     _V_COUNT0,
@@ -139,16 +139,27 @@ class BatchedConsolidationEvaluator:
         )
 
         try:
-            args, dims = kernel_args(enc, self.solver._bucket)
+            host_args, dims, prov = host_kernel_args(enc, self.solver._bucket)
         except UnpackableInput:
             return None  # Z*C > 32 — sequential path takes over
-        v_count0_host = np.asarray(args[_V_COUNT0])
+        v_count0_host = host_args[_V_COUNT0]
         # upload the shared arrays once — replicated across the candidate
         # mesh when one exists, so per-dispatch traffic is the batched axes
-        # only, never the constant universe
-        from ..solver.tpu.consolidate import replicate_shared
+        # only, never the constant universe. With the solver's argument
+        # arena, the universe adopts INTO it: shape-identical universes
+        # (re-prepares within one disruption tick, or the single-solve
+        # path's bucket) share residency and upload only stale entries as
+        # one packed buffer; the mesh sharding keys a separate bucket so
+        # replicated and single-device buffers never mix.
+        arena = getattr(self.solver, "arena", None)
+        if arena is not None:
+            from ..solver.tpu.consolidate import universe_sharding
 
-        args = replicate_shared(tuple(args))
+            args = arena.adopt(host_args, prov, sharding=universe_sharding())
+        else:
+            from ..solver.tpu.consolidate import replicate_shared
+
+            args = replicate_shared(tuple(host_args))
 
         id_to_e = {nid: e for e, nid in enumerate(enc.node_ids)}
         node_idx = {cid: id_to_e[nid] for cid, nid in candidate_node.items()

@@ -272,6 +272,28 @@ SOLVER_BREAKER_STATE = REGISTRY.register(
         "Device-path circuit breaker state: 0=closed, 1=half-open, 2=open",
     )
 )
+SOLVER_UPLOAD_BYTES = REGISTRY.register(
+    Gauge(
+        "karpenter_tpu_solver_upload_bytes_per_solve",
+        "Host→device bytes uploaded by the last device solve (argument-"
+        "arena delta upload; 0 = exact encode-cache hit, every kernel arg "
+        "reused device-resident — solver/arena.py)",
+    )
+)
+SOLVER_UPLOAD_ARRAYS = REGISTRY.register(
+    Gauge(
+        "karpenter_tpu_solver_upload_arrays_per_solve",
+        "ffd.ARG_SPEC entries found stale (uploaded) by the last device "
+        "solve; the full set is ~36",
+    )
+)
+SOLVER_ARENA_HIT_RATE = REGISTRY.register(
+    Gauge(
+        "karpenter_tpu_solver_arena_hit_rate",
+        "Fraction of arena adoptions that reused EVERY resident buffer "
+        "(zero-upload dispatches) since process start",
+    )
+)
 CONTROLLER_ERRORS = REGISTRY.register(
     Counter(
         "karpenter_controller_errors_total",

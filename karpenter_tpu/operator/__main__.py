@@ -71,7 +71,11 @@ def main(argv=None) -> int:
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
     )
     log = logging.getLogger("karpenter_tpu")
-    solver = TPUSolver() if o.solver_backend == "tpu" else ReferenceSolver()
+    solver = (
+        TPUSolver(arena=o.solver_arena)
+        if o.solver_backend == "tpu"
+        else ReferenceSolver()
+    )
     op = new_kwok_operator(
         solver=solver,
         batch_idle_s=o.batch_idle_duration_s,

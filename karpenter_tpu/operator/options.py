@@ -44,6 +44,10 @@ class Options:
     # resilient execution layer (solver/resilient.py): wrap the backend in
     # deadline + classification + invariant gate + circuit breaker
     solver_resilient: bool = True
+    # device-resident argument arena (solver/arena.py): keep kernel args on
+    # device across solves, uploading only stale entries as one packed
+    # buffer; false = per-array re-upload every solve (debug escape hatch)
+    solver_arena: bool = True
     # per-solve deadline on the device path, seconds; 0 = no deadline
     solver_deadline_s: float = 0.0
     # breaker opens after this many consecutive device-path failures

@@ -27,6 +27,14 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "candidates") -> Mesh
     return Mesh(np.asarray(devs), (axis,))
 
 
+# Memoized jitted vmap per (mesh devices, axis names, arity, max_claims):
+# rebuilding jax.jit(vmap(...)) per call discarded the trace cache, so every
+# multichip dispatch re-traced and re-lowered the whole kernel even though
+# the compiled executable was shape-identical. Keyed on device ids (not the
+# Mesh object — equal meshes over the same devices must share an entry).
+_JIT_CACHE: dict = {}
+
+
 def batched_solve(mesh: Mesh, batched_args: tuple, max_claims: int):
     """vmap ffd_solve over a leading candidate axis, sharded across the mesh.
 
@@ -35,14 +43,39 @@ def batched_solve(mesh: Mesh, batched_args: tuple, max_claims: int):
     size. Returns FFDOutput with leading batch axes, sharded the same way.
     """
     axis = mesh.axis_names[0]
-    sharding = NamedSharding(mesh, P(axis))
-
-    fn = jax.vmap(functools.partial(ffd_solve.__wrapped__, max_claims=max_claims))
-    jfn = jax.jit(fn, in_shardings=(sharding,) * len(batched_args), out_shardings=sharding)
+    key = (
+        tuple(d.id for d in mesh.devices.flat),
+        mesh.axis_names,
+        len(batched_args),
+        int(max_claims),
+    )
+    ent = _JIT_CACHE.get(key)
+    if ent is None:
+        sharding = NamedSharding(mesh, P(axis))
+        fn = jax.vmap(functools.partial(ffd_solve.__wrapped__, max_claims=max_claims))
+        jfn = jax.jit(
+            fn, in_shardings=(sharding,) * len(batched_args), out_shardings=sharding
+        )
+        ent = (jfn, sharding)
+        _JIT_CACHE[key] = ent
+    jfn, sharding = ent
     placed = tuple(jax.device_put(a, sharding) for a in batched_args)
     return jfn(*placed)
 
 
-def replicate_args(args: tuple, batch: int) -> tuple:
-    """Tile single-solve args to a batch (test/dryrun helper)."""
-    return tuple(np.broadcast_to(np.asarray(a)[None], (batch,) + np.asarray(a).shape).copy() for a in args)
+def replicate_args(args: tuple, batch: int, sharding=None) -> tuple:
+    """Tile single-solve args to a batch (test/dryrun helper).
+
+    Each base array uploads ONCE and broadcasts ON DEVICE — the former
+    `np.broadcast_to(...).copy()` materialized a full [B, ...] host copy
+    per arg, an O(batch) host-memory blowup at width 64+. Device-resident
+    inputs (argument-arena buffers) skip the upload entirely; pass a
+    NamedSharding to place the broadcast rows directly on a mesh."""
+    out = []
+    for a in args:
+        base = jnp.asarray(a)
+        b = jnp.broadcast_to(base[None], (batch,) + base.shape)
+        if sharding is not None:
+            b = jax.device_put(b, sharding)
+        out.append(b)
+    return tuple(out)

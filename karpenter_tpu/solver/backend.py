@@ -147,28 +147,48 @@ def unpack_zc_bits(bits: np.ndarray, Z: int, C: int) -> Tuple[np.ndarray, np.nda
     return joint.any(axis=2), joint.any(axis=1)
 
 
-# Padded+uploaded CORE kernel args cached across solves: the pod/pool/type
+# Padded HOST-side core kernel args cached across solves: the pod/pool/type
 # stage of an encode is shared by every solve of an unchanged pending set
-# (encode._EncodeCore), so its ~25 padded arrays upload once and stay
-# device-resident; only node-state and pool-usage arrays rebuild per solve.
-# Entries pin the identity arrays they key on so ids can't be recycled.
-_CORE_ARGS_CACHE: dict = {}
-_CORE_ARGS_CACHE_MAX = 4
+# (encode._EncodeCore), so its ~25 padded arrays build once per core
+# REVISION — keyed on enc.core_rev, which encode_cache.try_patch preserves,
+# so a delta-patched encode (pods moved within the known signature universe)
+# reuses the padded tables a plain id()-keyed cache would rebuild.
+_CORE_HOST_CACHE: dict = {}
+_CORE_HOST_CACHE_MAX = 4
+
+# ARG_SPEC entries that are pure functions of (core tables, pad dims) —
+# provenance-tagged; the rest rebuild per solve and are content-hashed by
+# their consumers (the argument arena / the device-conversion cache).
+STATIC_CORE_NAMES = frozenset({
+    "group_req", "group_compat_t", "group_zc_bits", "group_pool",
+    "group_pair_nok", "group_device", "type_alloc", "type_charge",
+    "offer_zc_bits", "pool_type", "pool_zc_bits", "pool_daemon",
+    "q_member", "q_owner", "q_kind", "q_cap", "v_member", "v_owner",
+    "v_kind", "v_cap", "v_primary", "v_aff", "zone_col_mask", "col_axis",
+    "group_daxis",
+})
+PER_SOLVE_NAMES = frozenset({
+    "run_group", "run_count", "pool_limit", "pool_usage0", "node_free",
+    "node_compat", "node_q_member", "node_q_owner", "v_count0", "node_zone",
+    "node_dom2",
+})
 
 
-def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
-    """The padded positional arrays for tpu.ffd.ffd_solve (order = ffd.ARG_SPEC),
-    plus dims.
+def host_kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict, tuple]:
+    """Padded HOST (numpy) positional arrays for tpu.ffd.ffd_solve (order =
+    ffd.ARG_SPEC), their dims, and per-entry provenance tokens.
 
     Shapes bucket to bounded sizes so compilations cache across solves
     (SURVEY.md §7: bucketed padding avoids recompilation storms). Zone ×
     capacity-type admission and offering availability are packed into uint32
-    bit masks (ffd.py "Bit-packing"); raises ValueError when Z*C > 32 (the
-    hybrid solver falls back). Shared by the single-solve path, the driver
-    entry points, and the batched consolidation evaluator.
-    """
-    import jax.numpy as jnp
+    bit masks (ffd.py "Bit-packing"); raises UnpackableInput when Z*C > 32
+    (the hybrid solver falls back).
 
+    prov[i] is a hashable content-identity token (same token ⇒ same bytes)
+    for STATIC_CORE_NAMES entries when the encode carries a core revision,
+    else None — consumers (solver/arena.py ArgumentArena, _device_args)
+    use tokens to skip hashing/re-uploading unchanged arrays.
+    """
     INT32_MAX_NP = np.int32(2**31 - 1)
     S, G, T, E, P = len(enc.run_group), enc.G, enc.T, enc.E, enc.P
     R, Z, C = enc.group_req.shape[1], len(enc.zones), len(enc.capacity_types)
@@ -194,11 +214,16 @@ def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
     # columns — the kernel's "zone" tables are really domain tables, and the
     # joint packing is untouched either way (column masks select bits)
     D = len(enc.v_domains) if enc.v_domains is not None else Z
-    ckey = (id(enc.run_group), R, Z, C, Sp, Gp, Tp, Pp, Qp, Vp, enc.v_axis)
-    hit = _CORE_ARGS_CACHE.get(ckey)
-    if hit is not None and hit[0] is enc.run_group:
-        core_args = hit[1]
-    else:
+    # static-core key: Sp-independent (the run split is per-solve), so one
+    # cached pad set serves every pod-count bucket of the same core
+    core_rev = getattr(enc, "core_rev", -1)
+    skey = (
+        (core_rev, R, Z, C, Gp, Tp, Pp, Qp, Vp, D, enc.v_axis)
+        if core_rev >= 0
+        else None
+    )
+    core_args = _CORE_HOST_CACHE.get(skey) if skey is not None else None
+    if core_args is None:
         zone_col = np.zeros(D, dtype=np.uint32)
         col_axis = np.zeros(D, dtype=np.int32)
         if enc.v_axis == "ct":
@@ -238,94 +263,66 @@ def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
         # pairwise-INcompatibility words; padded groups are compatible with all
         pair_nok = pack_words(~pad(enc.group_pair, (Gp, Gp), fill=True), Gp)
         core_args = {
-            "run_group": jnp.asarray(pad(enc.run_group, (Sp,))),
-            "run_count": jnp.asarray(pad(enc.run_count, (Sp,))),
-            "group_req": jnp.asarray(pad(enc.group_req, (Gp, R))),
-            "group_compat_t": jnp.asarray(pad(enc.group_compat_t, (Gp, Tp))),
-            "group_zc_bits": jnp.asarray(pad(group_zc, (Gp,))),
-            "group_pool": jnp.asarray(pad(enc.group_pool, (Gp, Pp))),
-            "group_pair_nok": jnp.asarray(pair_nok),
-            "group_device": jnp.asarray(pad(~enc.group_fallback, (Gp,))),
-            "type_alloc": jnp.asarray(pad(enc.type_alloc, (Tp, R))),
-            "type_charge": jnp.asarray(pad(type_charge, (Tp, R))),
-            "offer_zc_bits": jnp.asarray(pad(offer_zc, (Tp,))),
-            "pool_type": jnp.asarray(pad(enc.pool_type, (Pp, Tp))),
-            "pool_zc_bits": jnp.asarray(pad(pool_zc, (Pp,))),
-            "pool_daemon": jnp.asarray(pad(enc.pool_daemon, (Pp, R))),
-            "q_member": jnp.asarray(pad(enc.q_member, (Gp, Qp))),
-            "q_owner": jnp.asarray(pad(enc.q_owner, (Gp, Qp))),
-            "q_kind": jnp.asarray(pad(enc.q_kind, (Qp,))),
-            "q_cap": jnp.asarray(pad(enc.q_cap, (Qp,), fill=1)),
-            "v_member": jnp.asarray(pad(enc.v_member, (Gp, Vp))),
-            "v_owner": jnp.asarray(pad(enc.v_owner, (Gp, Vp))),
-            "v_kind": jnp.asarray(pad(enc.v_kind, (Vp,))),
-            "v_cap": jnp.asarray(pad(enc.v_cap, (Vp,), fill=1)),
-            "v_primary": jnp.asarray(pad(enc.v_primary, (Gp,), fill=np.int32(-1))),
-            "v_aff": jnp.asarray(pad(enc.v_aff, (Gp,), fill=np.int32(-1))),
-            "zone_col_mask": jnp.asarray(zone_col),
-            "col_axis": jnp.asarray(col_axis),
-            "group_daxis": jnp.asarray(
+            "group_req": pad(enc.group_req, (Gp, R)),
+            "group_compat_t": pad(enc.group_compat_t, (Gp, Tp)),
+            "group_zc_bits": pad(group_zc, (Gp,)),
+            "group_pool": pad(enc.group_pool, (Gp, Pp)),
+            "group_pair_nok": pair_nok,
+            "group_device": pad(~enc.group_fallback, (Gp,)),
+            "type_alloc": pad(enc.type_alloc, (Tp, R)),
+            "type_charge": pad(type_charge, (Tp, R)),
+            "offer_zc_bits": pad(offer_zc, (Tp,)),
+            "pool_type": pad(enc.pool_type, (Pp, Tp)),
+            "pool_zc_bits": pad(pool_zc, (Pp,)),
+            "pool_daemon": pad(enc.pool_daemon, (Pp, R)),
+            "q_member": pad(enc.q_member, (Gp, Qp)),
+            "q_owner": pad(enc.q_owner, (Gp, Qp)),
+            "q_kind": pad(enc.q_kind, (Qp,)),
+            "q_cap": pad(enc.q_cap, (Qp,), fill=1),
+            "v_member": pad(enc.v_member, (Gp, Vp)),
+            "v_owner": pad(enc.v_owner, (Gp, Vp)),
+            "v_kind": pad(enc.v_kind, (Vp,)),
+            "v_cap": pad(enc.v_cap, (Vp,), fill=1),
+            "v_primary": pad(enc.v_primary, (Gp,), fill=np.int32(-1)),
+            "v_aff": pad(enc.v_aff, (Gp,), fill=np.int32(-1)),
+            "zone_col_mask": zone_col,
+            "col_axis": col_axis,
+            "group_daxis": (
                 pad(enc.group_daxis, (Gp,))
                 if enc.group_daxis is not None
                 else np.zeros(Gp, np.int32)
             ),
         }
-        if len(_CORE_ARGS_CACHE) >= _CORE_ARGS_CACHE_MAX:
-            _CORE_ARGS_CACHE.pop(next(iter(_CORE_ARGS_CACHE)))
-        _CORE_ARGS_CACHE[ckey] = (enc.run_group, core_args)
-
-    ca = core_args
-    args = (
-        ca["run_group"],
-        ca["run_count"],
-        ca["group_req"],
-        ca["group_compat_t"],
-        ca["group_zc_bits"],
-        ca["group_pool"],
-        ca["group_pair_nok"],
-        ca["group_device"],
-        ca["type_alloc"],
-        ca["type_charge"],
-        ca["offer_zc_bits"],
-        ca["pool_type"],
-        ca["pool_zc_bits"],
-        ca["pool_daemon"],
-        jnp.asarray(pad(enc.pool_limit, (Pp, R), fill=INT32_MAX_NP)),
-        jnp.asarray(pad(enc.pool_usage, (Pp, R))),
-        jnp.asarray(pad(enc.node_free, (Ep, R))),
-        jnp.asarray(pad(enc.node_compat, (Gp, Ep))),
-        ca["q_member"],
-        ca["q_owner"],
-        ca["q_kind"],
-        ca["q_cap"],
-        jnp.asarray(pad(enc.node_q_member, (Ep, Qp))),
-        jnp.asarray(pad(enc.node_q_owner, (Ep, Qp))),
-        ca["v_member"],
-        ca["v_owner"],
-        ca["v_kind"],
-        ca["v_cap"],
-        ca["v_primary"],
-        ca["v_aff"],
-        jnp.asarray(pad(enc.v_count0, (Vp, D))),
-        jnp.asarray(
-            pad(
-                enc.v_node_domain if enc.v_node_domain is not None else enc.node_zone,
-                (Ep,),
-                fill=np.int32(-1),
-            )
+        if skey is not None:
+            if len(_CORE_HOST_CACHE) >= _CORE_HOST_CACHE_MAX:
+                _CORE_HOST_CACHE.pop(next(iter(_CORE_HOST_CACHE)))
+            _CORE_HOST_CACHE[skey] = core_args
+    per_solve = {
+        "run_group": pad(enc.run_group, (Sp,)),
+        "run_count": pad(enc.run_count, (Sp,)),
+        "pool_limit": pad(enc.pool_limit, (Pp, R), fill=INT32_MAX_NP),
+        "pool_usage0": pad(enc.pool_usage, (Pp, R)),
+        "node_free": pad(enc.node_free, (Ep, R)),
+        "node_compat": pad(enc.node_compat, (Gp, Ep)),
+        "node_q_member": pad(enc.node_q_member, (Ep, Qp)),
+        "node_q_owner": pad(enc.node_q_owner, (Ep, Qp)),
+        "v_count0": pad(enc.v_count0, (Vp, D)),
+        "node_zone": pad(
+            enc.v_node_domain if enc.v_node_domain is not None else enc.node_zone,
+            (Ep,),
+            fill=np.int32(-1),
         ),
-        ca["zone_col_mask"],
-        jnp.asarray(
+        "node_dom2": (
             pad(enc.node_dom2, (Ep,), fill=np.int32(-1))
             if enc.node_dom2 is not None
             else np.full(Ep, -1, np.int32)
         ),
-        ca["col_axis"],
-        ca["group_daxis"],
-    )
+    }
     from .tpu.ffd import ARG_SPEC
 
-    assert len(args) == len(ARG_SPEC), "kernel_args out of sync with ffd.ARG_SPEC"
+    assert STATIC_CORE_NAMES | PER_SOLVE_NAMES == set(ARG_SPEC) and not (
+        STATIC_CORE_NAMES & PER_SOLVE_NAMES
+    ), "static/per-solve partition out of sync with ffd.ARG_SPEC"
     assert list(ARG_SPEC) == [
         "run_group", "run_count", "group_req", "group_compat_t", "group_zc_bits",
         "group_pool", "group_pair_nok", "group_device", "type_alloc", "type_charge",
@@ -335,11 +332,65 @@ def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
         "v_cap", "v_primary", "v_aff", "v_count0", "node_zone", "zone_col_mask",
         "node_dom2", "col_axis", "group_daxis",
     ], "kernel_args order out of sync with ffd.ARG_SPEC"
+    args = tuple(
+        core_args[n] if n in STATIC_CORE_NAMES else per_solve[n] for n in ARG_SPEC
+    )
+    prov = tuple(
+        (skey, n) if (skey is not None and n in STATIC_CORE_NAMES) else None
+        for n in ARG_SPEC
+    )
     dims = dict(
         S=S, G=G, T=T, E=E, P=P, R=R, Z=Z, C=C,
         Sp=Sp, Gp=Gp, Tp=Tp, Ep=Ep, Pp=Pp, Qp=Qp, Vp=Vp, W=W,
     )
-    return args, dims
+    return args, dims, prov
+
+
+# Device conversions of provenance-tagged host arrays — the plain (non-
+# arena) upload path: keyed by the same (static key, name) tokens the
+# arena uses, so a patched encode re-uploads none of the tables it shares
+# with its donor core. Bounded FIFO sized for ~4 cores × ~25 static
+# entries; tokens embed a monotonic core_rev, so eviction tracks core age.
+_DEV_CACHE: dict = {}
+_DEV_CACHE_MAX = 128
+
+
+def _device_args(host_args: tuple, prov: tuple, ledger=None) -> tuple:
+    """Per-array jnp conversion of host_kernel_args output (arena-off path:
+    one host→device message per stale array, the pre-arena behavior)."""
+    import jax.numpy as jnp
+
+    out = []
+    up_bytes = 0
+    up_arrays = 0
+    for a, tok in zip(host_args, prov):
+        if tok is None:
+            out.append(jnp.asarray(a))
+            up_bytes += a.nbytes
+            up_arrays += 1
+            continue
+        hit = _DEV_CACHE.get(tok)
+        if hit is None:
+            hit = jnp.asarray(a)
+            while len(_DEV_CACHE) >= _DEV_CACHE_MAX:
+                _DEV_CACHE.pop(next(iter(_DEV_CACHE)))
+            _DEV_CACHE[tok] = hit
+            up_bytes += a.nbytes
+            up_arrays += 1
+        out.append(hit)
+    if ledger is not None:
+        ledger.record_upload(up_bytes, up_arrays, msgs=up_arrays)
+    return tuple(out)
+
+
+def kernel_args(enc: EncodedInput, bucket) -> Tuple[tuple, dict]:
+    """Device-resident padded positional arrays for tpu.ffd.ffd_solve (order
+    = ffd.ARG_SPEC), plus dims — a device-conversion wrapper over
+    `host_kernel_args`. Shared by the driver entry points, the AOT prewarm,
+    and tests; TPUSolver's solve path goes through the argument arena
+    instead (solver/arena.py) for packed delta uploads."""
+    host_args, dims, prov = host_kernel_args(enc, bucket)
+    return _device_args(host_args, prov), dims
 
 
 # Bucketed shape of every ffd.ARG_SPEC positional, in dim symbols — the AOT
@@ -552,7 +603,8 @@ class TPUSolver(Solver):
     unsupported constructs) fall back to the reference path.
     """
 
-    def __init__(self, max_claims: int = 1024, fallback: Optional[Solver] = None):
+    def __init__(self, max_claims: int = 1024, fallback: Optional[Solver] = None,
+                 arena: bool = True):
         self.max_claims = max_claims
         if fallback is None:
             # fallback chain: native C++ core (compiled-class speed), which
@@ -563,6 +615,24 @@ class TPUSolver(Solver):
             fallback = NativeSolver()
         self.fallback = fallback
         self.stats: Dict[str, int] = {"device_solves": 0, "fallback_solves": 0}
+        # device-resident argument arena + transfer accounting (solver/
+        # arena.py): arena=False restores the per-array upload path (debug
+        # escape hatch, `--solver-arena false`); the ledger counts either way
+        from .arena import ArgumentArena, TransferLedger
+
+        self.ledger = TransferLedger()
+        self.arena: Optional[ArgumentArena] = (
+            ArgumentArena(self.ledger) if arena else None
+        )
+
+    def invalidate_arena(self) -> None:
+        """Drop every device-resident kernel-arg buffer. The resilience
+        layer calls this before ANY fallback replay (gate rejection, device
+        failure, timeout): a failed device solve leaves residency in an
+        unknown state, and a replay must never trust it (SPEC.md "Transfer
+        semantics"). The next device solve pays one full packed upload."""
+        if self.arena is not None:
+            self.arena.invalidate()
 
     def solve(self, inp: SolverInput) -> SolverResult:
         return self.solve_async(inp).result()
@@ -877,9 +947,13 @@ class TPUSolver(Solver):
             "used": ((), "i32"),
         }
 
+        ledger = self.ledger
+
         def unpack(flat: np.ndarray) -> dict:
             if flat[0]:  # take overflowed uint16 — re-fetch full width (rare)
-                return _unpack_flat(np.asarray(_pack_outputs_wide(out)), wide_shapes)
+                wide = np.asarray(_pack_outputs_wide(out))
+                ledger.record_fetch(wide.nbytes)
+                return _unpack_flat(wide, wide_shapes)
             off = 1
             f = {}
             for name, (sh, n) in (
@@ -915,9 +989,19 @@ class TPUSolver(Solver):
 
     def _device_solve_async(self, enc: EncodedInput):
         try:
-            args, dims = kernel_args(enc, self._bucket)
+            host_args, dims, prov = host_kernel_args(enc, self._bucket)
         except UnpackableInput:
             return None  # Z*C > 32 — replay on fallback
+        # transfer ledger window: every host→device byte of this solve
+        # (arena packed upload OR per-array conversions) and every fetched
+        # result byte lands in one per-solve record (solver/arena.py)
+        self.ledger.begin_solve()
+        if self.arena is not None:
+            # device-resident arena: only stale entries upload, packed into
+            # ONE buffer; an exact encode-cache hit uploads nothing at all
+            args = self.arena.adopt(host_args, prov)
+        else:
+            args = _device_args(host_args, prov, ledger=self.ledger)
         S, E, T, G = dims["S"], dims["E"], dims["T"], dims["G"]
         Z, C = dims["Z"], dims["C"]
         total_pods = int(sum(len(p) for p in enc.group_pods))
@@ -925,30 +1009,37 @@ class TPUSolver(Solver):
         # (most solves open far fewer claims than pods) and double on
         # saturation — each M is a cached compile bucket, and a too-big M
         # inflates every [M,T] intermediate (VERDICT r1: M=8192 for a
-        # 462-claim solve was ~17× wasted bandwidth).
+        # 462-claim solve was ~17× wasted bandwidth). Redispatches reuse the
+        # same resident device args — no re-upload.
         M0 = initial_claim_bucket(total_pods, self.max_claims)
         flat_dev, unpack = self._dispatch(enc, args, M0)
 
         def finish() -> Optional[SolverResult]:
-            M = M0
-            flat, up = np.asarray(flat_dev), unpack
-            while True:
-                f = up(flat)
-                used = int(f["used"])
-                if used < M:
-                    break
-                if M >= self.max_claims:
-                    return None  # true overflow — replay on fallback
-                M = min(M * 2, self.max_claims)
-                fd, up = self._dispatch(enc, args, M)
-                flat = np.asarray(fd)
-            faults.check("solver.decode")
-            c_mask = _unpack_words(f["c_mask_words"], T)
-            c_zone, c_ct = unpack_zc_bits(f["c_zc_bits"], Z, C)
-            c_gmask = _unpack_gmask(f["c_gbits"], G)
-            return decode(enc, f["take_e"][:S, :E], f["take_c"][:S],
-                          f["leftover"][:S], c_mask,
-                          c_zone, c_ct, f["c_pool"], c_gmask, f["c_cum"], used)
+            try:
+                M = M0
+                flat, up = np.asarray(flat_dev), unpack
+                self.ledger.record_fetch(flat.nbytes)
+                while True:
+                    f = up(flat)
+                    used = int(f["used"])
+                    if used < M:
+                        break
+                    if M >= self.max_claims:
+                        return None  # true overflow — replay on fallback
+                    M = min(M * 2, self.max_claims)
+                    fd, up = self._dispatch(enc, args, M)
+                    flat = np.asarray(fd)
+                    self.ledger.record_fetch(flat.nbytes)
+                faults.check("solver.decode")
+                c_mask = _unpack_words(f["c_mask_words"], T)
+                c_zone, c_ct = unpack_zc_bits(f["c_zc_bits"], Z, C)
+                c_gmask = _unpack_gmask(f["c_gbits"], G)
+                return decode(enc, f["take_e"][:S, :E], f["take_c"][:S],
+                              f["leftover"][:S], c_mask,
+                              c_zone, c_ct, f["c_pool"], c_gmask, f["c_cum"],
+                              used)
+            finally:
+                self.ledger.end_solve()
 
         return finish
 

@@ -259,6 +259,12 @@ class EncodedInput:
     group_daxis: Optional[np.ndarray] = None  # [G] i32 axis per group
     node_dom2: Optional[np.ndarray] = None  # [E] i32 second-axis column (-1)
 
+    # revision stamp of the encode core this input was assembled around
+    # (_EncodeCore.core_rev): same stamp ⇒ byte-identical core tables.
+    # backend.host_kernel_args derives per-entry provenance tokens from it
+    # so the argument arena skips hashing/uploading core-derived args.
+    core_rev: int = -1
+
     @property
     def v_domain_perm(self) -> List[int]:
         """ct-mode only: indices into capacity_types in canonical v_domains
@@ -545,6 +551,11 @@ class _EncodeCore:
     # reuse them verbatim. () / -1 = not patchable (batch-local sig ids).
     group_snums: tuple = ()
     sig_epoch: int = -1
+    # content-identity revision (encode_cache.next_core_rev): stamped by
+    # every full _build_core, PRESERVED by try_patch (shared tables are the
+    # donor's). (core_rev, table name) is the provenance token the argument
+    # arena / device-conversion caches key on. -1 = no provenance.
+    core_rev: int = -1
 
 
 _CORE_CACHE: Dict[tuple, tuple] = {}
@@ -1180,7 +1191,14 @@ def _build_core(
         cid=cid,
         group_snums=group_snums if sigs_interned else (),
         sig_epoch=_SIG_EPOCH if sigs_interned else -1,
+        core_rev=_fresh_core_rev(),
     )
+
+
+def _fresh_core_rev() -> int:
+    from . import encode_cache as ec
+
+    return ec.next_core_rev()
 
 
 def _encode_with_nodes(core: _EncodeCore, inp: SolverInput) -> EncodedInput:
@@ -1393,4 +1411,5 @@ def _encode_with_nodes(core: _EncodeCore, inp: SolverInput) -> EncodedInput:
         sig_axis=core.sig_axis,
         group_daxis=core.group_daxis,
         node_dom2=node_dom2,
+        core_rev=core.core_rev,
     )

@@ -45,6 +45,21 @@ def reset_stats() -> None:
         STATS[k] = 0
 
 
+# Per-table revision tags (solver/arena.py provenance): every full
+# `_build_core` stamps its core with the next value, and try_patch's
+# dataclasses.replace PRESERVES the donor's stamp because every [G]/[T]/[P]
+# table is shared verbatim — so (core_rev, table name) is a content-identity
+# token for core-derived kernel args, and a patched encode's static tables
+# provably need no re-hash and no re-upload. Monotonic, never reused.
+_CORE_REV = 0
+
+
+def next_core_rev() -> int:
+    global _CORE_REV
+    _CORE_REV += 1
+    return _CORE_REV
+
+
 def try_patch(key, presort, structure, core_cache, state_rev=None):
     """Scan `core_cache` for a donor core with the same catalog segment and
     the same ordered distinct-signature sequence as the new pod set; return
@@ -83,6 +98,11 @@ def try_patch(key, presort, structure, core_cache, state_rev=None):
         ) or k2[2:4] == key[2:4]
         if not same_catalog:
             continue
+        # the donor's core_rev rides through replace() untouched — the
+        # patched core's shared tables ARE the donor's, so downstream
+        # provenance consumers (backend.host_kernel_args, the argument
+        # arena) treat them as unchanged; only the run split / pod lists
+        # (content-hashed, never revision-tagged) differ
         return dataclasses.replace(
             core2,
             group_pods=group_pods,

@@ -171,17 +171,30 @@ def _sharded_ffd():
     )
 
 
+def universe_sharding():
+    """Replicated placement for the shared consolidation universe on the
+    process's candidate mesh, or None on single-device rigs. This is the
+    sharding the argument arena keys its universe bucket on when the
+    batched evaluator adopts through it (disruption/batched.py prepare):
+    one packed upload lands replicated on every mesh device."""
+    mesh = candidate_mesh()
+    if mesh is None:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec())
+
+
 def replicate_shared(kernel_args: tuple) -> tuple:
     """Commit the shared universe to every mesh device ONCE (prepare time):
     without this, the jit's replicated in_shardings re-broadcasts the whole
     constant universe on every dispatch — per-batch traffic proportional to
-    the problem, not the batch."""
-    mesh = candidate_mesh()
-    if mesh is None:
+    the problem, not the batch. (Arena-off path only: with the argument
+    arena the evaluator adopts the universe instead — packed delta uploads
+    straight into replicated residency.)"""
+    repl = universe_sharding()
+    if repl is None:
         return tuple(jax.device_put(a) for a in kernel_args)
-    from jax.sharding import NamedSharding, PartitionSpec
-
-    repl = NamedSharding(mesh, PartitionSpec())
     return tuple(jax.device_put(a, repl) for a in kernel_args)
 
 

@@ -1,0 +1,79 @@
+"""Static drift checks for the kernel argument contract.
+
+ffd.ARG_SPEC is the single source of truth for the kernel's positional
+tensor arguments; backend.host_kernel_args builds in that order, the arena
+keys residency per-entry on it, and the AOT prewarm sizes shapes from
+_AOT_SHAPES. Any of those drifting out of sync fails at runtime with shape
+errors at best and silent misbinding at worst — so the alignment is
+asserted statically here, with no device work.
+"""
+
+import inspect
+
+from karpenter_tpu.solver import backend
+from karpenter_tpu.solver.tpu import ffd
+
+STATICS = ("max_claims", "emit_takes", "zone_engine")
+
+
+def test_arg_spec_matches_kernel_signature():
+    params = list(inspect.signature(ffd.ffd_solve.__wrapped__).parameters)
+    tensor = [p for p in params if p not in STATICS]
+    assert tuple(tensor) == ffd.ARG_SPEC, (
+        "ffd_solve's positional tensor params drifted from ffd.ARG_SPEC"
+    )
+    # statics trail the tensor args, so positional call sites stay valid
+    assert params == tensor + [p for p in params if p in STATICS]
+
+
+def test_arg_index_matches_spec():
+    assert ffd.ARG_INDEX == {n: i for i, n in enumerate(ffd.ARG_SPEC)}
+
+
+def test_aot_shape_table_covers_spec():
+    assert set(backend._AOT_SHAPES) == set(ffd.ARG_SPEC), (
+        "_AOT_SHAPES keys drifted from ffd.ARG_SPEC"
+    )
+
+
+def test_staleness_partition_covers_spec():
+    static, per_solve = backend.STATIC_CORE_NAMES, backend.PER_SOLVE_NAMES
+    assert not (static & per_solve), static & per_solve
+    assert static | per_solve == set(ffd.ARG_SPEC), (
+        "arena staleness partition drifted from ffd.ARG_SPEC"
+    )
+
+
+def test_host_kernel_args_arity_and_provenance():
+    from karpenter_tpu.solver.encode import encode, quantize_input
+
+    from tests.test_solver_parity import ZONES, mkpod, pool
+
+    from karpenter_tpu.provisioning.scheduler import SolverInput
+
+    inp = SolverInput(pods=[mkpod("p0"), mkpod("p1")], nodes=[],
+                      nodepools=[pool()], zones=ZONES)
+    enc = encode(quantize_input(inp))
+    solver = backend.TPUSolver()
+    host_args, dims, prov = backend.host_kernel_args(enc, solver._bucket)
+    assert len(host_args) == len(ffd.ARG_SPEC)
+    assert len(prov) == len(ffd.ARG_SPEC)
+    # D (domain-axis width) is derived the same way prewarm_aot derives it
+    dims = dict(dims)
+    dims["D"] = int(host_args[ffd.ARG_SPEC.index("zone_col_mask")].shape[0])
+    for name, a, tok in zip(ffd.ARG_SPEC, host_args, prov):
+        assert tuple(a.shape) == tuple(
+            dims[s] for s in backend._AOT_SHAPES[name]
+        ), f"{name}: host shape diverges from _AOT_SHAPES"
+        if name in backend.STATIC_CORE_NAMES:
+            assert tok is not None and tok[1] == name, (
+                f"{name}: static-core entry missing provenance token"
+            )
+        else:
+            assert tok is None, (
+                f"{name}: per-solve entry must take the digest path"
+            )
+    # the device-facing wrapper preserves arity
+    dev_args, dev_dims = backend.kernel_args(enc, solver._bucket)
+    assert len(dev_args) == len(ffd.ARG_SPEC)
+    assert {k: dims[k] for k in dev_dims} == dict(dev_dims)

@@ -1,0 +1,185 @@
+"""Device-resident argument arena: parity + transfer-ledger invariants.
+
+ISSUE 3 acceptance: arena-on and arena-off solves are bit-identical across
+mutation / exact-hit / bucket-change / fallback-replay sequences, and the
+TransferLedger PROVES the transfer claims instead of timing them — an exact
+encode-cache hit uploads zero bytes, a steady-state node-delta solve pays
+exactly one packed message carrying only the stale entries, and a
+ResilientSolver fallback replay invalidates residency before reuse.
+"""
+
+import dataclasses
+
+from karpenter_tpu import faults
+from karpenter_tpu.provisioning.scheduler import SolverInput
+from karpenter_tpu.solver.backend import ReferenceSolver, TPUSolver
+from karpenter_tpu.solver.resilient import ResilientSolver
+from karpenter_tpu.solver.tpu.ffd import ARG_SPEC
+
+from tests.test_e2e_kwok import FakeClock
+from tests.test_solver_parity import ZONES, mkpod, pool
+
+_CPUS = [
+    "150m", "250m", "300m", "500m", "700m", "900m", "1", "1100m", "1300m",
+    "1500m", "1700m", "1900m", "2", "2100m", "2300m", "2500m", "2700m",
+    "2900m", "3", "3100m",
+]
+
+
+def _inp(n=40, specs=1, prefix="p"):
+    """`specs` distinct pod sizes: specs=1 stays in the smallest shape
+    bucket; specs=20 pushes the run/group axes past the first bucket edge
+    (Sp/Gp: 16), forcing a different arena bucket."""
+    pods = [mkpod(f"{prefix}{i}", cpu=_CPUS[i % specs]) for i in range(n)]
+    return SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+
+
+def _assert_same(a, b, tag=""):
+    assert a.placements == b.placements, f"{tag}: placements diverge"
+    assert set(a.errors) == set(b.errors), f"{tag}: errors diverge"
+    assert len(a.claims) == len(b.claims), f"{tag}: claim count diverges"
+    for i, (ca, cb) in enumerate(zip(a.claims, b.claims)):
+        assert ca.pod_uids == cb.pod_uids, f"{tag}: claim {i} pods diverge"
+        assert ca.nodepool == cb.nodepool, f"{tag}: claim {i} pool diverges"
+        assert sorted(ca.instance_type_names) == sorted(cb.instance_type_names), (
+            f"{tag}: claim {i} type set diverges"
+        )
+
+
+# -- parity ------------------------------------------------------------------
+
+
+def test_parity_across_mutate_hit_and_bucket_change():
+    """The full residency lifecycle — cold, exact hit, pod-delta mutation,
+    bucket change, return to the first bucket — decides identically with the
+    arena on and off."""
+    on, off = TPUSolver(), TPUSolver(arena=False)
+    a = _inp(40)
+    seq = [
+        ("cold", a),
+        ("exact-hit", a),
+        ("mutate", dataclasses.replace(a, pods=a.pods[:-3])),
+        ("bucket-change", _inp(60, specs=20, prefix="q")),
+        ("back-to-first-bucket", a),
+    ]
+    for tag, inp in seq:
+        _assert_same(on.solve(inp), off.solve(inp), tag)
+    st = on.arena.stats
+    # the sequence must actually exercise every hit class, or the parity
+    # proof proves nothing
+    assert st["full_uploads"] >= 2, st  # cold + bucket change
+    assert st["delta_uploads"] >= 1, st  # the pod-delta mutation
+    assert st["exact_hits"] >= 1, st
+    assert len(on.arena._buckets) == 2  # both shape buckets resident
+
+
+def test_bucket_return_is_exact_hit():
+    """Leaving a bucket and coming back must not re-upload: buckets hold
+    residency independently (a control loop alternates surge shapes)."""
+    s = TPUSolver()
+    a, b = _inp(40), _inp(60, specs=20, prefix="q")
+    s.solve(a)
+    s.solve(b)
+    hits_before = s.arena.stats["exact_hits"]
+    s.solve(a)
+    assert s.arena.stats["exact_hits"] == hits_before + 1
+    assert s.ledger.solve["h2d_bytes"] == 0
+
+
+# -- ledger invariants -------------------------------------------------------
+
+
+def test_exact_hit_uploads_zero_bytes():
+    s = TPUSolver()
+    inp = _inp(40)
+    s.solve(inp)
+    assert s.ledger.outcomes["full_upload"] == 1
+    full_bytes = s.ledger.solve["h2d_bytes"]
+    assert full_bytes > 0 and s.ledger.solve["h2d_msgs"] == 1
+    s.solve(inp)  # unchanged input: exact encode-cache hit
+    assert s.ledger.solve["h2d_bytes"] == 0
+    assert s.ledger.solve["h2d_arrays"] == 0
+    assert s.ledger.solve["h2d_msgs"] == 0
+    assert s.ledger.outcomes["exact_hit"] == 1
+    # decode still fetched (the ledger counts BOTH directions)
+    assert s.ledger.solve["d2h_bytes"] > 0
+    assert s.ledger.arena_hit_rate == 0.5
+
+
+def test_delta_solve_pays_one_packed_message():
+    """A pod-count mutation inside one shape bucket re-uploads ONLY the
+    stale entries, packed into a single message, strictly smaller than the
+    cold upload."""
+    s = TPUSolver()
+    inp = _inp(40)
+    s.solve(inp)
+    full = dict(s.ledger.solve)
+    assert full["h2d_arrays"] == len(ARG_SPEC)
+    s.solve(dataclasses.replace(inp, pods=inp.pods[:-3]))
+    delta = dict(s.ledger.solve)
+    assert s.ledger.outcomes["delta_upload"] == 1
+    assert delta["h2d_msgs"] == 1  # ONE packed buffer, not per-array puts
+    assert 1 <= delta["h2d_arrays"] < len(ARG_SPEC)  # only stale entries
+    assert 0 < delta["h2d_bytes"] < full["h2d_bytes"]
+
+
+def test_arena_off_uploads_per_array():
+    """The debug escape hatch (--solver-arena=false) ships every array as
+    its own message — the behavior the arena exists to replace."""
+    from karpenter_tpu.solver import backend, encode as em
+
+    # cold caches: earlier tests leave the core/device caches warm, which
+    # would (correctly) skim static-core uploads even with the arena off
+    em._CORE_CACHE.clear()
+    backend._DEV_CACHE.clear()
+    s = TPUSolver(arena=False)
+    s.solve(_inp(40))
+    assert s.ledger.solve["h2d_msgs"] == len(ARG_SPEC)
+    assert s.ledger.outcomes == {
+        "exact_hit": 0, "delta_upload": 0, "full_upload": 0
+    }
+    assert s.ledger.arena_hit_rate == 0.0
+
+
+# -- fallback-replay invalidation --------------------------------------------
+
+
+def test_fallback_replay_invalidates_arena():
+    """A device failure routes to the fallback AND drops residency: the
+    replay (and the next device solve) must not trust buffers a failed
+    dispatch may have left in an unknown state."""
+    inner = TPUSolver()
+    rs = ResilientSolver(inner, fallbacks=[ReferenceSolver()],
+                         clock=FakeClock())
+    off = TPUSolver(arena=False)
+    inp = _inp(40)
+    warm = rs.solve(inp)
+    _assert_same(warm, off.solve(inp), "warm")
+    assert inner.arena._buckets  # residency established
+
+    plan = faults.FaultPlan(seed=0)
+    plan.fail_n("solver.device_dispatch", 1)
+    with faults.active(plan):
+        replayed = rs.solve(inp)
+    assert plan.fired["solver.device_dispatch"] == 1
+    assert inner.arena.stats["invalidations"] >= 1
+    assert not inner.arena._buckets  # residency dropped before replay
+    _assert_same(replayed, warm, "fallback-replay")
+
+    # device recovered: next solve pays a full packed upload, not a hit
+    full_before = inner.arena.stats["full_uploads"]
+    recovered = rs.solve(inp)
+    assert inner.arena.stats["full_uploads"] == full_before + 1
+    assert inner.ledger.solve["h2d_msgs"] == 1
+    _assert_same(recovered, warm, "recovered")
+
+
+def test_explicit_invalidate_is_safe_anytime():
+    s = TPUSolver()
+    s.invalidate_arena()  # empty arena: no-op beyond the counter
+    inp = _inp(40)
+    r1 = s.solve(inp)
+    s.invalidate_arena()
+    r2 = s.solve(inp)
+    assert s.arena.stats["full_uploads"] == 2  # re-upload, same answer
+    _assert_same(r1, r2, "post-invalidate")
