@@ -17,7 +17,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Protocol
 
-from ..metrics.registry import CONTROLLER_ERRORS
+from ..metrics.registry import CONTROLLER_ERRORS, CONTROLLER_TICK_SECONDS
 
 log = logging.getLogger("karpenter_tpu")
 
@@ -81,9 +81,13 @@ class Manager:
             if self._skip.get(c.name, 0) > 0:
                 self._skip[c.name] -= 1
                 continue
+            t0 = time.perf_counter()
             try:
                 did = bool(c.reconcile()) or did
             except Exception as e:  # a controller crash must not kill the loop
+                CONTROLLER_TICK_SECONDS.observe(
+                    time.perf_counter() - t0, controller=c.name
+                )
                 f = self._failures.get(c.name, 0) + 1
                 self._failures[c.name] = f
                 self._skip[c.name] = min(2 ** (f - 1), BACKOFF_CAP)
@@ -93,6 +97,9 @@ class Manager:
                     "off %d ticks)", c.name, e, f, self._skip[c.name],
                 )
             else:
+                CONTROLLER_TICK_SECONDS.observe(
+                    time.perf_counter() - t0, controller=c.name
+                )
                 if self._failures.get(c.name):
                     log.info("controller %s recovered after %d failures",
                              c.name, self._failures[c.name])

@@ -8,9 +8,12 @@ so command construction (and therefore behavior) is bit-identical to the
 reference-style sequential evaluation; only wall-clock changes.
 
 prepare() builds and uploads the shared universe once; evaluate_prepared()
-dispatches one batch of subsets against it — the controller's tiered prefix
-search (config 5: 10k-node multi-node consolidation) issues several small
-batches against a single prepared universe instead of re-encoding per phase.
+dispatches one batch of subsets against it — the controller's speculative
+binary replay (speculative_binary_search; config 5: 10k-node multi-node
+consolidation) issues 1-2 batched dispatches against a single prepared
+universe instead of one sequential round-trip per binary-search probe.
+tiered_prefix_search (the previous largest-acceptable ladder) remains for
+callers that want maximal-prefix semantics rather than binary-search parity.
 
 Falls back (returns None) when the universe contains constructs the device
 kernel can't express (fallback groups / off-device topology-affinity forms —
@@ -25,6 +28,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import faults
+from ..metrics.registry import PROBE_BATCH_SIZE
 from ..provisioning.scheduler import SolverInput
 from ..solver.backend import TPUSolver, host_kernel_args, unpack_zc_bits
 from ..solver.encode import UnpackableInput, encode, quantize_input
@@ -86,6 +91,86 @@ def tiered_prefix_search(evaluate_ks, n_max: int, acceptable, width: int = 64):
         else:
             k_hi = min(ks)
     return k_lo, probed, dispatches
+
+
+def binary_probe_frontier(lo: int, hi: int, levels: int) -> List[int]:
+    """Every prefix length the sequential binary search over [lo, hi] can
+    probe within its first `levels` iterations — the top of its decision
+    tree. Enumerable WITHOUT verdicts: each probe's (lo, hi) interval is
+    fully determined by the accept/reject outcomes above it, and the tree
+    covers both outcomes of every node. Level d holds ≤ 2^(d-1) mids, so
+    `levels` levels cost ≤ 2^levels − 1 rows."""
+    out: List[int] = []
+    frontier = [(lo, hi)]
+    for _ in range(max(0, levels)):
+        nxt: List[Tuple[int, int]] = []
+        for l, h in frontier:
+            if l > h:
+                continue
+            m = (l + h) // 2
+            out.append(m)
+            nxt.append((m + 1, h))  # accepted: search above
+            nxt.append((l, m - 1))  # rejected: search below
+        if not nxt:
+            break
+        frontier = nxt
+    return sorted(set(out))
+
+
+def speculative_binary_search(
+    evaluate_ks, lo: int, hi: int, acceptable, probe_batch_max: int = 512
+):
+    """Decision-for-decision replay of the sequential binary search
+
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            if acceptable(mid): best = mid; lo = mid + 1
+            else:               hi = mid - 1
+
+    with the probe frontier evaluated in BATCHED dispatches instead of one
+    round-trip per probe. When the remaining interval fits `probe_batch_max`
+    every prefix in it is evaluated at once (all O(n) prefixes in a bucket);
+    otherwise one dispatch covers the top levels of the binary decision tree
+    (all candidate mids of those levels — speculative: half are on paths
+    the replay won't take) and the replay consumes cached verdicts until it
+    runs dry. One tree dispatch narrows the interval by 2^levels, so any
+    fleet up to ~probe_batch_max² candidates resolves in ≤ 2 dispatches.
+
+    Because the replay consumes verdicts in exactly the sequential order,
+    the returned best_k is IDENTICAL to the sequential search's — batching
+    changes wall-clock, never the decision.
+
+    evaluate_ks(ks) -> verdict per k (the caller decides what a verdict is
+    and whether some ks can be answered without touching the device, e.g.
+    budget-clamped prefixes). Returns (best_k | None, probed {k: verdict},
+    eval_batches)."""
+    probe_batch_max = max(1, int(probe_batch_max))
+    # 2^levels − 1 ≤ probe_batch_max: the deepest full tree that fits a batch
+    levels = max(1, (probe_batch_max + 1).bit_length() - 1)
+    probed: Dict[int, object] = {}
+    batches = 0
+    best: Optional[int] = None
+    while lo <= hi:
+        mid = (lo + hi) // 2
+        if mid not in probed:
+            if hi - lo + 1 <= probe_batch_max:
+                ks = [k for k in range(lo, hi + 1) if k not in probed]
+            else:
+                ks = [
+                    k
+                    for k in binary_probe_frontier(lo, hi, levels)
+                    if k not in probed
+                ]
+            verdicts = evaluate_ks(ks)
+            batches += 1
+            for k, v in zip(ks, verdicts):
+                probed[k] = v
+        if acceptable(mid, probed[mid]):
+            best = mid
+            lo = mid + 1
+        else:
+            hi = mid - 1
+    return best, probed, batches
 
 
 @dataclasses.dataclass
@@ -194,23 +279,42 @@ class BatchedConsolidationEvaluator:
             node_idx=node_idx, v_delta=v_delta, v_count0_host=v_count0_host,
         )
 
-    def evaluate_prepared(
+    def evaluate_prepared_async(
         self, prep: PreparedUniverse, subsets: Sequence[Sequence[int]]
-    ) -> List[SubsetVerdict]:
+    ):
+        """Dispatch one probe batch; returns a finish() callable that blocks
+        on the device→host fetch and builds the verdicts. The split lets the
+        pipelined solve service run the dispatch on its dispatcher thread
+        and the fetch/decode on its decoder thread, like any other solve.
+        The probe batch passes the same `solver.device_dispatch` fault site
+        as single solves, so chaos plans kill it too."""
+        faults.check("solver.device_dispatch")
+        PROBE_BATCH_SIZE.observe(len(subsets))
         enc = prep.enc
         out = simulate_subsets(
             prep.args, prep.pod_cand, prep.pod_run, subsets, prep.node_idx,
             self.max_claims, candidate_v_delta=prep.v_delta, verdict_only=True,
             zone_engine=enc.V > 0, v_count0_host=prep.v_count0_host,
         )
+        return lambda: self._finish_verdicts(prep, out, len(subsets))
+
+    def evaluate_prepared(
+        self, prep: PreparedUniverse, subsets: Sequence[Sequence[int]]
+    ) -> List[SubsetVerdict]:
+        return self.evaluate_prepared_async(prep, subsets)()
+
+    def _finish_verdicts(
+        self, prep: PreparedUniverse, out, n_subsets: int
+    ) -> List[SubsetVerdict]:
+        enc = prep.enc
         T, Z, C = enc.T, len(enc.zones), len(enc.capacity_types)
-        leftover, used, zc_bits, c_mask = fetch_verdicts(out, T, len(subsets))
+        leftover, used, zc_bits, c_mask = fetch_verdicts(out, T, n_subsets)
         B_, M_ = zc_bits.shape
         c_zone_flat, c_ct_flat = unpack_zc_bits(zc_bits.reshape(-1), Z, C)
         c_zone = c_zone_flat.reshape(B_, M_, Z)
         c_ct = c_ct_flat.reshape(B_, M_, C)
         verdicts: List[SubsetVerdict] = []
-        for b in range(len(subsets)):
+        for b in range(n_subsets):
             feasible = leftover[b] == 0 and used[b] <= 1
             price = None
             type_count = 0

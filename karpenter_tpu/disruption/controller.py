@@ -91,7 +91,9 @@ class DisruptionController:
         replacement_timeout_s: float = 10 * 60,
         multi_node_max_candidates: int = 100,
         multi_node_max_candidates_batched: int = 10_000,
-        batch_phase_width: int = 64,  # two-dispatch search ≤ ~4k candidates
+        batch_phase_width: int = 64,  # single-consolidation chunk width
+        probe_batch_max: int = 512,  # widest speculative-probe frontier
+        solve_service=None,  # pipelined device owner (solver/pipeline.py)
     ):
         self.store = store
         self.cluster = cluster
@@ -108,6 +110,14 @@ class DisruptionController:
         # (config 5: 10k-node multi-node consolidation)
         self.multi_node_max_candidates_batched = multi_node_max_candidates_batched
         self.batch_phase_width = batch_phase_width
+        # speculative binary probes: one dispatch carries up to this many
+        # candidate-prefix rows (all O(n) prefixes when the search interval
+        # fits, else the top levels of the binary decision tree)
+        self.probe_batch_max = probe_batch_max
+        # when a SolveService owns the device, simulate re-solves and probe
+        # batches queue through it — interleaved fairly with provisioning
+        # instead of grabbing the device directly
+        self._solve_service = solve_service
         self._command: Optional[Command] = None
         self._provisioner_helper: Optional[Provisioner] = None
         self._prep_cache = None  # per-reconcile prepared batched universe
@@ -361,7 +371,19 @@ class DisruptionController:
     def _prepared_universe(self, consolidatable: List[Candidate]):
         """Encode + upload the simulation universe once per reconcile; both
         consolidation methods evaluate subset batches against it."""
-        key = tuple(c.claim.name for c in consolidatable)
+        from ..api.objects import pod_mutation_epoch
+
+        # content-aware key: claim names alone survive pod mutations (a
+        # constraint dropped between reconciles leaves the names unchanged),
+        # so a stale universe could serve probes against constraints that no
+        # longer exist. Pod object identity + the global mutation epoch pin
+        # the exact pod contents; the entry pins the pod objects so a freed
+        # id can't be recycled into a colliding key.
+        key = (
+            tuple(c.claim.name for c in consolidatable),
+            pod_mutation_epoch(),
+            tuple(id(p) for c in consolidatable for p in c.pods),
+        )
         if self._prep_cache is not None and self._prep_cache[0] == key:
             return self._prep_cache[1]
         import dataclasses as _dc
@@ -382,7 +404,7 @@ class DisruptionController:
             prep = self._batched.prepare(base, candidate_pods, candidate_node)
         except Exception:
             prep = None
-        self._prep_cache = (key, prep)
+        self._prep_cache = (key, prep, [p for c in consolidatable for p in c.pods])
         return prep
 
     def _max_budget_prefix(self, pool: List[Candidate], method: str, budgets) -> int:
@@ -396,19 +418,32 @@ class DisruptionController:
                 hi = mid - 1
         return lo
 
-    def _multi_batched(self, consolidatable: List[Candidate], budgets):
-        """Tiered largest-feasible-prefix search on the device evaluator.
+    def _evaluate_probe_batch(self, prep, subsets):
+        """One batched speculative-probe dispatch, through the solve service
+        when one owns the device (fair interleave with provisioning), else
+        straight at the evaluator."""
+        if self._solve_service is not None:
+            ticket = self._solve_service.submit_fn(
+                lambda: self._batched.evaluate_prepared_async(prep, subsets),
+                kind="disruption",
+            )
+            return ticket.result()
+        return self._batched.evaluate_prepared(prep, subsets)
 
-        Phase 1 probes ≤batch_phase_width evenly spaced prefix lengths over
-        the whole (budget-clamped) pool; each later phase refines between the
-        largest accepted probe and the next probe above it, until the gap is
-        fully enumerated — O(log_width(N)) vmapped dispatches instead of
-        O(N) sequential re-solves (config 5; disruption.md:104-106's
-        heuristic subset, spanning the fleet instead of a fixed cap).
+    def _multi_batched(self, consolidatable: List[Candidate], budgets):
+        """Batched speculative probes: a decision-for-decision replay of the
+        sequential binary search over cost-ordered prefixes, with the probe
+        frontier evaluated as 1-2 vmapped dispatches against the prepared
+        (arena-resident, mesh-replicated) universe instead of one device
+        round-trip per probe (batched.speculative_binary_search). Budget-
+        clamped prefixes (k > kmax) answer host-side — the sequential loop
+        rejects them without solving too, so the replay stays faithful.
         Returns Command | None, or NotImplemented to use the sequential path.
         """
         method = "multi-consolidation"
         pool = consolidatable[: self.multi_node_max_candidates_batched]
+        if len(pool) < 2:
+            return None
         kmax = min(self._max_budget_prefix(pool, method, budgets), len(pool))
         if kmax < 2:
             return None  # budget admits no >=2-node command this loop
@@ -420,7 +455,7 @@ class DisruptionController:
             cum_price.append(cum_price[-1] + c.price)
 
         def acceptable(k: int, v) -> bool:
-            if not v.ok:
+            if v is None or not v.ok:
                 return False
             if v.has_replacement and (
                 v.replacement_price is None or v.replacement_price >= cum_price[k]
@@ -428,25 +463,54 @@ class DisruptionController:
                 return False
             return True
 
-        from .batched import tiered_prefix_search
+        from .batched import speculative_binary_search
+
+        dispatches = 0
 
         def eval_ks(ks):
-            return self._batched.evaluate_prepared(
-                prep, [list(range(k)) for k in ks]
-            )
+            nonlocal dispatches
+            # out-of-budget prefixes reject host-side (None verdict) exactly
+            # like the sequential loop's `ok = False` without a solve
+            dev_ks = [k for k in ks if k <= kmax]
+            by_k = {}
+            if dev_ks:
+                verdicts = self._evaluate_probe_batch(
+                    prep, [list(range(k)) for k in dev_ks]
+                )
+                dispatches += 1
+                by_k = dict(zip(dev_ks, verdicts))
+            return [by_k.get(k) for k in ks]
 
         try:
-            _k_best, probed, _d = tiered_prefix_search(
-                eval_ks, kmax, acceptable, width=max(self.batch_phase_width, 2)
+            best_k, probed, _batches = speculative_binary_search(
+                eval_ks, 2, len(pool), acceptable,
+                probe_batch_max=max(self.probe_batch_max, 2),
             )
         except Exception:
             return NotImplemented  # device failure mid-search: sequential path
         self.stats["batched_prefixes_evaluated"] = (
             self.stats.get("batched_prefixes_evaluated", 0) + len(probed)
         )
-        # validate accepted prefixes, largest first (the winning command is
-        # re-materialized sequentially, so behavior stays bit-identical)
-        for k in sorted((k for k, v in probed.items() if acceptable(k, v)), reverse=True):
+        self.stats["probe_dispatches"] = (
+            self.stats.get("probe_dispatches", 0) + dispatches
+        )
+        self.stats["probe_decisions"] = self.stats.get("probe_decisions", 0) + 1
+        if best_k is None:
+            return None
+        # re-materialize the winner sequentially so command construction is
+        # bit-identical to the sequential path; on (unexpected) divergence,
+        # degrade to the next-largest accepted probe BELOW the decision —
+        # speculative rows above best_k sit on paths the replay rejected and
+        # must never outrank the binary-search decision
+        ranked = [best_k] + sorted(
+            (
+                k
+                for k, v in probed.items()
+                if k < best_k and k <= kmax and acceptable(k, v)
+            ),
+            reverse=True,
+        )
+        for k in ranked:
             ok, claim_res = self._simulate(pool[:k], allow_replacement=True, require_cheaper=True)
             if ok:
                 try:
@@ -547,7 +611,13 @@ class DisruptionController:
         removed = {c.node.meta.name for c in cands}
         inp = self._provisioner_helper.build_input(pods)
         inp.nodes = [n for n in inp.nodes if n.id not in removed]
-        result = self.solver.solve(inp)
+        if self._solve_service is not None:
+            # disruption-class: never coalesced (each probe is a distinct
+            # hypothetical universe, not a cluster snapshot), fair-interleaved
+            # with provisioning solves on the shared device queue
+            result = self._solve_service.submit(inp, kind="disruption").result()
+        else:
+            result = self.solver.solve(inp)
         if result.errors:
             return False, None
         if len(result.claims) > 1:

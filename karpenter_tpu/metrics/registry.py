@@ -307,3 +307,47 @@ REPAIR_BREAKER_OPEN = REGISTRY.register(
         "1 while the node-repair >20% unhealthy circuit breaker is tripped",
     )
 )
+CONTROLLER_TICK_SECONDS = REGISTRY.register(
+    Histogram(
+        "karpenter_controller_tick_seconds",
+        "Per-controller reconcile duration per manager tick (crashing "
+        "reconciles observe too, so a slow failure is as visible as a slow "
+        "success)",
+        ("controller",),
+    )
+)
+
+# -- pipelined solve service (solver/pipeline.py) -----------------------------
+
+SOLVE_PIPELINE_DEPTH = REGISTRY.register(
+    Gauge(
+        "karpenter_tpu_solve_pipeline_depth",
+        "Solves currently in flight on the pipelined solve service "
+        "(dispatched to the device, not yet decoded)",
+    )
+)
+SOLVE_PIPELINE_OCCUPANCY = REGISTRY.register(
+    Gauge(
+        "karpenter_tpu_solve_pipeline_occupancy",
+        "Fraction of wall time since service start with at least one solve "
+        "in flight (1.0 = the device never waited on the host)",
+    )
+)
+SOLVE_COALESCED = REGISTRY.register(
+    Counter(
+        "karpenter_tpu_solve_coalesced_requests_total",
+        "Queued solve requests superseded before dispatch by a newer "
+        "cluster-state revision of the same class (the stale snapshot never "
+        "ran)",
+        ("kind",),
+    )
+)
+PROBE_BATCH_SIZE = REGISTRY.register(
+    Histogram(
+        "karpenter_tpu_disruption_probe_batch_size",
+        "Candidate-prefix rows per batched speculative-probe dispatch "
+        "(disruption consolidation; one row = one full re-solve of the "
+        "universe minus that prefix)",
+        buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+    )
+)

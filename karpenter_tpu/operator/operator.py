@@ -50,6 +50,7 @@ class Operator:
     manager: Manager
     solver: Solver
     interruption_queue: InterruptionQueue = field(default_factory=InterruptionQueue)
+    solve_service: Optional[object] = None  # solver/pipeline.py SolveService
 
 
 def new_kwok_operator(
@@ -78,6 +79,9 @@ def new_kwok_operator(
     solver_deadline_s: float = 0.0,
     breaker_threshold: int = 3,
     breaker_probe_s: float = 30.0,
+    solver_pipeline: bool = True,
+    pipeline_depth: int = 2,
+    probe_batch_max: int = 512,
 ) -> Operator:
     store = shared_store if shared_store is not None else st.Store()
     # the operator's clock is authoritative for every age stamp, including a
@@ -131,6 +135,15 @@ def new_kwok_operator(
             breaker_probe_s=breaker_probe_s,
             clock=clock,
         )
+    solve_service = None
+    if solver_pipeline:
+        # one owner for the device solve seam: controller solves queue
+        # through the service's three-stage pipeline (encode ∥ compute ∥
+        # decode), provisioning snapshots coalesce, and disruption probes
+        # interleave fairly with pending-pod solves (solver/pipeline.py)
+        from ..solver.pipeline import SolveService
+
+        solve_service = SolveService(solver, depth=pipeline_depth, clock=clock)
     provisioner = Provisioner(
         store,
         cluster,
@@ -140,6 +153,7 @@ def new_kwok_operator(
         batch_max_s=batch_max_s,
         clock=clock,
         preference_policy=preference_policy,
+        solve_service=solve_service,
     )
     from ..controllers.volume import VolumeTopologyController
 
@@ -217,6 +231,8 @@ def new_kwok_operator(
             DisruptionController(
                 store, cluster, cloud_provider, solver, clock=clock,
                 preference_policy=preference_policy,
+                probe_batch_max=probe_batch_max,
+                solve_service=solve_service,
             )
         )
     if snapshot_path is not None:
@@ -271,4 +287,5 @@ def new_kwok_operator(
         manager=manager,
         solver=solver,
         interruption_queue=queue,
+        solve_service=solve_service,
     )

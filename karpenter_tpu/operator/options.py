@@ -48,6 +48,17 @@ class Options:
     # device across solves, uploading only stale entries as one packed
     # buffer; false = per-array re-upload every solve (debug escape hatch)
     solver_arena: bool = True
+    # pipelined solve service (solver/pipeline.py): one device owner, host
+    # encode / device compute / host decode of independent solves overlap,
+    # provisioning snapshots coalesce on newer cluster-state revisions;
+    # false = each controller blocks on its own solve round-trip
+    solver_pipeline: bool = True
+    # in-flight bound for the pipeline (solves dispatched but not decoded)
+    pipeline_depth: int = 2
+    # widest speculative-probe frontier one batched disruption dispatch may
+    # carry (all O(n) candidate prefixes batch when they fit; fleets up to
+    # ~probe_batch_max² resolve in two dispatches)
+    probe_batch_max: int = 512
     # per-solve deadline on the device path, seconds; 0 = no deadline
     solver_deadline_s: float = 0.0
     # breaker opens after this many consecutive device-path failures

@@ -27,6 +27,21 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "candidates") -> Mesh
     return Mesh(np.asarray(devs), (axis,))
 
 
+def batch_bucket(b: int, mesh: Optional[Mesh] = None, mult: int = 8) -> int:
+    """Bucket a candidate-batch size so dispatches compile once per bucket,
+    not once per exact row count, and the batch axis divides evenly across
+    the mesh when one exists (lcm of the bucket multiple and the device
+    count). Shared by simulate_subsets and the speculative-probe planner so
+    a probe frontier sized to `probe_batch_max` lands on the same compiled
+    executable every decision."""
+    import math
+
+    if mesh is not None:
+        n_dev = int(mesh.devices.size)
+        mult = mult * n_dev // math.gcd(mult, n_dev)
+    return max(mult, ((b + mult - 1) // mult) * mult)
+
+
 # Memoized jitted vmap per (mesh devices, axis names, arity, max_claims):
 # rebuilding jax.jit(vmap(...)) per call discarded the trace cache, so every
 # multichip dispatch re-traced and re-lowered the whole kernel even though

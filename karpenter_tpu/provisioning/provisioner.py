@@ -37,6 +37,7 @@ class Provisioner:
         batch_max_s: float = 10.0,
         clock=time.monotonic,
         preference_policy: str = "Respect",
+        solve_service=None,
     ):
         self.store = store
         self.cluster = cluster
@@ -46,6 +47,10 @@ class Provisioner:
         self.batch_max_s = batch_max_s
         self.clock = clock
         self.preference_policy = preference_policy  # settings.md:38
+        # pipelined device owner (solver/pipeline.py): solves queue through
+        # it so provisioning snapshots coalesce and interleave fairly with
+        # disruption probes; None = call the solver seam directly
+        self._solve_service = solve_service
         self._first_seen: Optional[float] = None
         self._last_count = 0
         self._claim_seq = 0
@@ -125,6 +130,12 @@ class Provisioner:
             state_rev=state_rev,
         )
 
+    def _nodepools(self) -> Dict[str, NodePool]:
+        """Name-keyed NodePool snapshot, fetched once per solve alongside the
+        in-flight handle (the claim-creation loop and the oracle-replay path
+        both key replacements off it)."""
+        return {p.name: p for p in self.store.list(st.NODEPOOLS)}
+
     def _next_claim_name(self, nodepool: str, suffix: str = "") -> str:
         """Store-aware name allocation: a freshly-promoted HA standby (or a
         restart) must not collide with claims the previous leader created."""
@@ -145,20 +156,36 @@ class Provisioner:
         t0 = time.perf_counter()
         inp = self.build_input(pending)
         try:
-            solve_async = getattr(self.solver, "solve_async", None)
-            if solve_async is not None:
-                # async seam: kernel + link transfer run while the
-                # claim-creation lookups below are prepared on host
-                # (backend.AsyncSolve)
-                handle = solve_async(inp)
-                nodepools: Dict[str, NodePool] = {
-                    p.name: p for p in self.store.list(st.NODEPOOLS)
-                }
-                result = handle.result()
+            if self._solve_service is not None:
+                # pipelined path: the service owns the device — this snapshot
+                # queues behind (and fairly interleaves with) disruption
+                # probes, and a newer snapshot submitted while this one is
+                # still queued supersedes it (Superseded below)
+                ticket = self._solve_service.submit(
+                    inp, kind="provisioning", rev=inp.state_rev
+                )
+                nodepools = self._nodepools()
+                result = ticket.result()
             else:
-                result = self.solver.solve(inp)
-                nodepools = {p.name: p for p in self.store.list(st.NODEPOOLS)}
+                solve_async = getattr(self.solver, "solve_async", None)
+                if solve_async is not None:
+                    # async seam: kernel + link transfer run while the
+                    # claim-creation lookups below are prepared on host
+                    # (backend.AsyncSolve)
+                    handle = solve_async(inp)
+                    nodepools = self._nodepools()
+                    result = handle.result()
+                else:
+                    result = self.solver.solve(inp)
+                    nodepools = self._nodepools()
         except Exception as e:
+            from ..solver.pipeline import Superseded
+
+            if isinstance(e, Superseded):
+                # a newer cluster snapshot's solve covers this batch; acting
+                # on the stale result would double-provision — defer and let
+                # the next tick pick up whatever that solve leaves pending
+                return False
             # a solver exception must degrade, not abort the batch: the
             # configured solver (even ResilientSolver, if its whole chain is
             # exhausted) gets one last replay on the python oracle so the
@@ -182,7 +209,7 @@ class Provisioner:
                     "oracle replay failed too; deferring batch to next tick"
                 )
                 return False
-            nodepools = {p.name: p for p in self.store.list(st.NODEPOOLS)}
+            nodepools = self._nodepools()
         PROVISIONER_SCHEDULING_DURATION.observe(time.perf_counter() - t0)
         did = False
         for claim_res in result.claims:

@@ -13,6 +13,7 @@ bit-identical; `tests/test_solver_parity.py` asserts it.
 from __future__ import annotations
 
 import abc
+import threading as _threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
@@ -582,18 +583,25 @@ class AsyncSolve:
     output is streaming to the host; result() blocks, decodes, and returns
     the SolverResult. Lets a control loop overlap host encode/decode of one
     solve with device compute + link transfer of another (the tunnel RTT is
-    the e2e seam's floor — pipelining hides it across solves)."""
+    the e2e seam's floor — pipelining hides it across solves).
+
+    result() is idempotent and thread-safe: the pipelined SolveService
+    (solver/pipeline.py) decodes handles on its own thread while the
+    submitting controller may also hold the handle — the deferred fn must
+    run exactly once no matter who resolves first."""
 
     def __init__(self, fn):
         self._fn = fn
         self._result: Optional[SolverResult] = None
         self._done = False
+        self._lock = _threading.Lock()
 
     def result(self) -> SolverResult:
-        if not self._done:
-            self._result = self._fn()
-            self._done = True
-        return self._result
+        with self._lock:
+            if not self._done:
+                self._result = self._fn()
+                self._done = True
+            return self._result
 
 
 class TPUSolver(Solver):

@@ -1,0 +1,327 @@
+"""Pipelined solve service: the single owner of the device solve seam.
+
+Every `Solver.solve()` in the control plane is a blocking round-trip: host
+encode, device compute, link transfer, host decode — serialized per caller.
+The `AsyncSolve` seam (backend.py) already splits dispatch from decode, but
+each control loop still waits out its own round-trip before the next solve's
+encode starts. `SolveService` turns the seam into a three-stage pipeline:
+
+        dispatcher thread            device / link           decoder thread
+    ┌──────────────────────┐   ┌─────────────────────┐   ┌─────────────────┐
+    │ encode + dispatch N+1│ ∥ │ compute + d2h  N    │ ∥ │ decode      N−1 │
+    └──────────────────────┘   └─────────────────────┘   └─────────────────┘
+
+Host encode of request N+1 overlaps device compute of request N overlaps
+host decode of request N−1. Controllers submit() and block on a
+`SolveTicket`; the service serializes actual device ownership through one
+dispatcher thread, so concurrent submitters never race the arena or the
+encode cache.
+
+Coalescing: provisioning-class requests are whole-cluster snapshots — a
+newer snapshot strictly covers any older one still waiting in the queue
+(`SolverInput.state_rev`, the encode-cache revision stamp, records which
+snapshot each request carries). Submitting a new provisioning request
+supersedes every provisioning request still QUEUED (not yet dispatched):
+the stale snapshot never runs and its ticket raises `Superseded`, so a
+caller can never act on a superseded snapshot. Requests already dispatched
+are never cancelled — their results deliver normally.
+
+Fairness: the dispatcher round-robins between the provisioning and
+disruption classes, so a disruption controller probing candidate subsets
+cannot starve pending-pod provisioning (or vice versa).
+
+Resilience composes per-request, not per-dispatch: hand the service a
+`ResilientSolver` and each submitted request passes through the breaker /
+deadline / invariant gate exactly once — the deadline window opens when the
+service dispatches (queue wait is not solve time), and overflow-retry
+re-dispatches inside TPUSolver stay inside that one request's window. A
+dead device mid-pipeline therefore drains in-flight requests onto the
+fallback ladder individually; none are lost, none run twice.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Optional
+
+from ..metrics.registry import (
+    SOLVE_COALESCED,
+    SOLVE_PIPELINE_DEPTH,
+    SOLVE_PIPELINE_OCCUPANCY,
+)
+
+PROVISIONING = "provisioning"
+DISRUPTION = "disruption"
+
+
+class Superseded(Exception):
+    """The request coalesced away: a newer cluster-state revision was
+    submitted before this one dispatched. The newer request's solve covers
+    the cluster; the caller must NOT act on this stale snapshot — defer to
+    the next tick (the superseding ticket is available as `.by`)."""
+
+    def __init__(self, by: Optional["SolveTicket"] = None):
+        super().__init__("solve request superseded by a newer cluster snapshot")
+        self.by = by
+
+
+class ServiceStopped(Exception):
+    """The service was closed before this request could run."""
+
+
+class SolveTicket:
+    """Caller-side handle for a submitted request. result() blocks until the
+    decode stage delivers (or re-raises the request's failure)."""
+
+    def __init__(self, kind: str, rev=None):
+        self.kind = kind
+        self.rev = rev
+        self._event = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def _deliver(self, result=None, error: Optional[BaseException] = None) -> None:
+        self._result = result
+        self._error = error
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def superseded(self) -> bool:
+        return isinstance(self._error, Superseded)
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("solve ticket not resolved in time")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class _Request:
+    __slots__ = ("ticket", "inp", "fn", "rev")
+
+    def __init__(self, ticket: SolveTicket, inp=None, fn=None, rev=None):
+        self.ticket = ticket
+        self.inp = inp
+        self.fn = fn  # generic device work: fn() dispatches, returns finish()
+        self.rev = rev
+
+
+class SolveService:
+    """Owns the device: all solve dispatches in the process serialize
+    through this service's dispatcher thread (construction starts the
+    worker threads; they are daemons and idle at zero cost)."""
+
+    def __init__(self, solver, depth: int = 2, clock=time.monotonic):
+        self.solver = solver
+        self.depth = max(1, int(depth))
+        self.clock = clock
+        self._cv = threading.Condition()
+        self._pending: Dict[str, deque] = {PROVISIONING: deque(), DISRUPTION: deque()}
+        self._inflight: deque = deque()  # (_Request, finish_fn)
+        self._last_kind = DISRUPTION  # provisioning gets the first slot
+        self._stopped = False
+        self.stats: Dict[str, int] = {
+            "submitted": 0,
+            "dispatched": 0,
+            "completed": 0,
+            "failed": 0,
+            "coalesced": 0,
+        }
+        # occupancy: wall-time fraction with >=1 request in flight (device or
+        # link busy) since construction — 1.0 means the device never idled
+        # between solves
+        self._started_at = clock()
+        self._busy_since: Optional[float] = None
+        self._busy_s = 0.0
+        self._decoding = 0  # requests popped from _inflight, still in finish()
+        self._dispatching = 0  # requests popped from _pending, not yet in flight
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True, name="solve-dispatch"
+        )
+        self._decoder = threading.Thread(
+            target=self._decode_loop, daemon=True, name="solve-decode"
+        )
+        self._dispatcher.start()
+        self._decoder.start()
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, inp, kind: str = PROVISIONING, rev=None) -> SolveTicket:
+        """Queue a SolverInput. Provisioning-class submits coalesce: every
+        provisioning request still queued (undispatched) is superseded —
+        its ticket raises Superseded — because this newer snapshot covers
+        it. `rev` is the snapshot's encode-cache revision stamp
+        (SolverInput.state_rev), recorded for observability."""
+        if rev is None:
+            rev = getattr(inp, "state_rev", None)
+        ticket = SolveTicket(kind, rev=rev)
+        with self._cv:
+            if self._stopped:
+                raise ServiceStopped("solve service is closed")
+            if kind == PROVISIONING:
+                q = self._pending[PROVISIONING]
+                while q:
+                    stale = q.popleft()
+                    self.stats["coalesced"] += 1
+                    SOLVE_COALESCED.inc(kind=kind)
+                    stale.ticket._deliver(error=Superseded(by=ticket))
+            self._pending[kind].append(_Request(ticket, inp=inp, rev=rev))
+            self.stats["submitted"] += 1
+            self._cv.notify_all()
+        return ticket
+
+    def submit_fn(self, dispatch_fn: Callable, kind: str = DISRUPTION) -> SolveTicket:
+        """Queue generic device work: dispatch_fn() runs on the dispatcher
+        thread (host prep + device dispatch) and returns a finish callable;
+        finish() runs on the decoder thread and its return value resolves
+        the ticket. Used by the disruption controller's batched speculative
+        probes so they share the device queue (and its fairness) with
+        ordinary solves. Never coalesced."""
+        ticket = SolveTicket(kind)
+        with self._cv:
+            if self._stopped:
+                raise ServiceStopped("solve service is closed")
+            self._pending[kind].append(_Request(ticket, fn=dispatch_fn))
+            self.stats["submitted"] += 1
+            self._cv.notify_all()
+        return ticket
+
+    # -- introspection -------------------------------------------------------
+
+    def occupancy(self) -> float:
+        with self._cv:
+            busy = self._busy_s
+            if self._busy_since is not None:
+                busy += self.clock() - self._busy_since
+            wall = self.clock() - self._started_at
+        return (busy / wall) if wall > 0 else 0.0
+
+    def queue_depth(self) -> int:
+        with self._cv:
+            return sum(len(q) for q in self._pending.values())
+
+    def close(self) -> None:
+        """Stop accepting work; fail queued (undispatched) requests with
+        ServiceStopped; let in-flight requests drain."""
+        with self._cv:
+            self._stopped = True
+            for q in self._pending.values():
+                while q:
+                    q.popleft().ticket._deliver(error=ServiceStopped())
+            self._cv.notify_all()
+        for t in (self._dispatcher, self._decoder):
+            t.join(timeout=30)
+
+    # -- pipeline stages -----------------------------------------------------
+
+    def _next_request_locked(self) -> Optional[_Request]:
+        order = (
+            (DISRUPTION, PROVISIONING)
+            if self._last_kind == PROVISIONING
+            else (PROVISIONING, DISRUPTION)
+        )
+        for kind in order:
+            if self._pending[kind]:
+                self._last_kind = kind
+                return self._pending[kind].popleft()
+        return None
+
+    def _mark_busy_locked(self) -> None:
+        if self._busy_since is None:
+            self._busy_since = self.clock()
+
+    def _mark_idle_locked(self) -> None:
+        if self._busy_since is not None and not self._inflight and not self._decoding:
+            self._busy_s += self.clock() - self._busy_since
+            self._busy_since = None
+        SOLVE_PIPELINE_OCCUPANCY.set(self._occupancy_locked())
+
+    def _occupancy_locked(self) -> float:
+        busy = self._busy_s
+        if self._busy_since is not None:
+            busy += self.clock() - self._busy_since
+        wall = self.clock() - self._started_at
+        return (busy / wall) if wall > 0 else 0.0
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stopped and (
+                    len(self._inflight) >= self.depth
+                    or self._next_peek_locked() is None
+                ):
+                    self._cv.wait()
+                if self._stopped and self._next_peek_locked() is None:
+                    return
+                req = self._next_request_locked()
+                self._dispatching += 1
+            # encode + dispatch OUTSIDE the lock: this is the stage-1 host
+            # work that overlaps stage-2 device compute and stage-3 decode
+            try:
+                if req.fn is not None:
+                    finish = req.fn()
+                else:
+                    solve_async = getattr(self.solver, "solve_async", None)
+                    if solve_async is not None:
+                        finish = solve_async(req.inp).result
+                    else:
+                        # backend without an async seam (reference oracle):
+                        # the whole solve runs at decode, stage overlap
+                        # degrades gracefully to FIFO
+                        inp = req.inp
+                        finish = lambda _inp=inp: self.solver.solve(_inp)
+            except BaseException as e:  # noqa: BLE001 — delivered to caller
+                with self._cv:
+                    self.stats["failed"] += 1
+                    self._dispatching -= 1
+                    self._cv.notify_all()
+                req.ticket._deliver(error=e)
+                continue
+            with self._cv:
+                self.stats["dispatched"] += 1
+                self._dispatching -= 1
+                self._inflight.append((req, finish))
+                self._mark_busy_locked()
+                SOLVE_PIPELINE_DEPTH.set(len(self._inflight))
+                self._cv.notify_all()
+
+    def _next_peek_locked(self) -> Optional[str]:
+        for kind in (PROVISIONING, DISRUPTION):
+            if self._pending[kind]:
+                return kind
+        return None
+
+    def _decode_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._inflight and not (
+                    self._stopped
+                    and not self._dispatching
+                    and self._next_peek_locked() is None
+                ):
+                    self._cv.wait()
+                if not self._inflight:
+                    return  # stopped, nothing left to drain
+                req, finish = self._inflight.popleft()
+                self._decoding += 1
+                SOLVE_PIPELINE_DEPTH.set(len(self._inflight))
+                self._cv.notify_all()  # a dispatch slot just freed
+            try:
+                result = finish()
+            except BaseException as e:  # noqa: BLE001 — delivered to caller
+                with self._cv:
+                    self.stats["failed"] += 1
+                req.ticket._deliver(error=e)
+            else:
+                with self._cv:
+                    self.stats["completed"] += 1
+                req.ticket._deliver(result=result)
+            with self._cv:
+                self._decoding -= 1
+                self._mark_idle_locked()
+                self._cv.notify_all()

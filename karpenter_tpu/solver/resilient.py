@@ -272,6 +272,16 @@ class ResilientSolver(Solver):
     untouched — parity with the unwrapped backend is asserted in
     tests/test_solver_parity.py), attribute access delegates to the inner
     solver (`stats`, `warmup`, `prewarm_aot`, ...).
+
+    Resilience is PER-REQUEST, not per-dispatch: one solve_async() call is
+    one breaker admission, one deadline window (opened at dispatch, when the
+    pipelined SolveService hands the request to the device — queue wait is
+    not solve time), one gate check, and at most one fallback replay — even
+    when TPUSolver internally re-dispatches for claim-bucket overflow, or
+    when the request was one row of a batched speculative-probe frontier.
+    Under the SolveService this means a dead device drains each in-flight
+    request onto the fallback ladder individually; the breaker trips on
+    request failures, never on the fan-out of a single batched dispatch.
     """
 
     def __init__(

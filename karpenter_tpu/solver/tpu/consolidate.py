@@ -249,13 +249,9 @@ def simulate_subsets(
     # must divide evenly across the candidate mesh when one exists.
     NC = ((NC + 63) // 64) * 64
     mesh = candidate_mesh()
-    mult = 8
-    if mesh is not None:
-        import math
+    from ...parallel.sharded import batch_bucket
 
-        n_dev = int(mesh.devices.size)
-        mult = mult * n_dev // math.gcd(mult, n_dev)
-    Bp = max(mult, ((B + mult - 1) // mult) * mult)
+    Bp = batch_bucket(B, mesh)
 
     b_run_count = np.zeros((Bp, S), dtype=run_count_dtype)
     b_v_count0 = np.broadcast_to(v_count0, (Bp,) + v_count0.shape).copy()
