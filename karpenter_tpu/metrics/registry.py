@@ -294,6 +294,24 @@ SOLVER_ARENA_HIT_RATE = REGISTRY.register(
         "(zero-upload dispatches) since process start",
     )
 )
+# checkpointed-scan resume series (ISSUE 5 names these without the _tpu
+# segment — keep them as specified so the bench trajectory keys match)
+SOLVER_RESUME_HIT_RATE = REGISTRY.register(
+    Gauge(
+        "karpenter_solver_resume_hit_rate",
+        "Fraction of device dispatches that resumed the FFD scan from a "
+        "device-resident checkpoint instead of replaying every run "
+        "(solver/tpu/ffd.py ffd_resume) since process start",
+    )
+)
+SOLVER_RUNS_SKIPPED = REGISTRY.register(
+    Counter(
+        "karpenter_solver_runs_skipped_total",
+        "Scan runs skipped by checkpoint resume (prefix runs whose "
+        "decisions were replayed from the checkpoint carry instead of "
+        "re-executed)",
+    )
+)
 CONTROLLER_ERRORS = REGISTRY.register(
     Counter(
         "karpenter_controller_errors_total",

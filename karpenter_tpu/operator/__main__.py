@@ -72,7 +72,8 @@ def main(argv=None) -> int:
     )
     log = logging.getLogger("karpenter_tpu")
     solver = (
-        TPUSolver(arena=o.solver_arena)
+        TPUSolver(arena=o.solver_arena, resume=o.solver_resume,
+                  ckpt_every=o.resume_checkpoint_interval)
         if o.solver_backend == "tpu"
         else ReferenceSolver()
     )

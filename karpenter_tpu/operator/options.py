@@ -48,6 +48,16 @@ class Options:
     # device across solves, uploading only stale entries as one packed
     # buffer; false = per-array re-upload every solve (debug escape hatch)
     solver_arena: bool = True
+    # checkpointed-scan resume (solver/tpu/ffd.py + solver/SPEC.md "Resume
+    # semantics"): device solves harvest FFDState snapshots into a
+    # checkpoint ring so a warm re-solve replays only the changed run
+    # suffix; requires solver_arena (checkpoints are an arena residency
+    # class). false = every device solve replays the full scan.
+    solver_resume: bool = True
+    # scan steps between checkpoint-ring snapshots (>= 1, validated at
+    # startup): smaller catches mid-list mutations closer to the change at
+    # the cost of more HBM snapshot writes per solve
+    resume_checkpoint_interval: int = 16
     # pipelined solve service (solver/pipeline.py): one device owner, host
     # encode / device compute / host decode of independent solves overlap,
     # provisioning snapshots coalesce on newer cluster-state revisions;
@@ -139,4 +149,16 @@ def parse(argv: Optional[Sequence[str]] = None, cls=Options) -> Options:
         else:
             parser.add_argument(flag, type=str, default=default)
     ns = parser.parse_args(list(argv) if argv is not None else [])
-    return cls(**vars(ns))
+    out = cls(**vars(ns))
+    # resume tunable sanity, validated before any controller wiring: an
+    # interval < 1 would divide-by-zero the kernel's slot schedule at trace
+    # time, deep inside the first device solve — fail closed at startup
+    # with an actionable message instead.
+    interval = getattr(out, "resume_checkpoint_interval", None)
+    if interval is not None and int(interval) < 1:
+        raise SystemExit(
+            "refusing to start: --resume-checkpoint-interval must be >= 1 "
+            f"(got {interval}); it is the number of FFD scan steps between "
+            "checkpoint-ring snapshots (operator/options.py)"
+        )
+    return out

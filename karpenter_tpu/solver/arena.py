@@ -193,18 +193,67 @@ class ArgumentArena:
         self.max_buckets = max_buckets
         # bucket key -> [device buffers per entry, (token, digest) per entry]
         self._buckets: Dict[tuple, list] = {}
+        # checkpoint residency class (backend._plan_resume): per-bucket FFD
+        # scan checkpoints from the bucket's most recent solves. Device
+        # arrays + host metadata live together in the record; keying on the
+        # SAME bucket key as the resident args means a checkpoint can only
+        # be offered to a dispatch whose shapes (and therefore compiled
+        # kernel) match the solve that produced it.
+        self._ckpts: Dict[tuple, list] = {}
+        self.max_ckpts_per_bucket = 1
+        # ARG_SPEC indices the LAST adopt actually uploaded (() on an exact
+        # hit) — observability for tests/bench; checkpoint prefix validity
+        # uses context_signature() instead (robust to pipelined dispatches
+        # landing between a record's solve and the resuming one).
+        self.last_stale: tuple = ()
         self.stats: Dict[str, int] = {
             "adopts": 0, "exact_hits": 0, "delta_uploads": 0,
             "full_uploads": 0, "invalidations": 0,
         }
 
     def invalidate(self) -> None:
-        """Drop every resident buffer + tag. Called by the resilience layer
-        before fallback replays (a failed device solve leaves residency in
-        an unknown state) and safe to call any time — the next adopt simply
-        pays one full packed upload."""
+        """Drop every resident buffer + tag AND the checkpoint ring. Called
+        by the resilience layer before fallback replays (a failed device
+        solve leaves residency — and any checkpoint derived from it — in an
+        unknown state) and safe to call any time — the next adopt simply
+        pays one full packed upload and the next solve runs cold."""
         self._buckets.clear()
+        self._ckpts.clear()
+        self.last_stale = ()
         self.stats["invalidations"] += 1
+
+    def bucket_key(self, host_args: tuple, sharding=None) -> tuple:
+        return (tuple((a.shape, a.dtype.str) for a in host_args), sharding)
+
+    def put_checkpoint(self, key: tuple, record: dict) -> None:
+        """Record a solve's checkpoint set for its bucket (newest first,
+        bounded). Records die with the bucket on invalidate()."""
+        lst = self._ckpts.setdefault(key, [])
+        lst.insert(0, record)
+        del lst[self.max_ckpts_per_bucket:]
+
+    def get_checkpoints(self, key: tuple) -> list:
+        return self._ckpts.get(key, [])
+
+    def context_signature(self, key: tuple, exclude: tuple = ()) -> Optional[tuple]:
+        """Content signature of the bucket's resident entries OUTSIDE
+        `exclude` (ARG_SPEC indices), read from the adopt tags. Two equal
+        signatures prove byte-identical non-excluded kernel args — the
+        node-table/core-identity leg of checkpoint prefix validity
+        (backend._plan_resume) — independent of how many solves ran in
+        between. None until the bucket is fully tagged."""
+        bkt = self._buckets.get(key)
+        if bkt is None:
+            return None
+        tags = bkt[1]
+        out = []
+        for i, t in enumerate(tags):
+            if i in exclude:
+                continue
+            if t is None:
+                return None
+            out.append(t[1])
+        return tuple(out)
 
     def adopt(self, host_args: tuple, prov: tuple, sharding=None) -> tuple:
         """Return device-resident buffers matching `host_args`, uploading
@@ -240,6 +289,7 @@ class ArgumentArena:
             tags[i] = (tok, dig)
             stale.append(i)
         led = self.ledger
+        self.last_stale = tuple(stale)
         if not stale:
             self.stats["exact_hits"] += 1
             led.record_adopt("exact_hit")

@@ -30,7 +30,11 @@ from ..provisioning.scheduler import (
     SolverResult,
 )
 from ..scheduling.requirements import IN, Requirement, Requirements
-from ..metrics.registry import SOLVER_SOLVES
+from ..metrics.registry import (
+    SOLVER_RESUME_HIT_RATE,
+    SOLVER_RUNS_SKIPPED,
+    SOLVER_SOLVES,
+)
 from ..utils.resources import PODS, Resources
 from .encode import EncodedInput, UnpackableInput, encode, quantize_input
 
@@ -612,7 +616,8 @@ class TPUSolver(Solver):
     """
 
     def __init__(self, max_claims: int = 1024, fallback: Optional[Solver] = None,
-                 arena: bool = True):
+                 arena: bool = True, resume: bool = True,
+                 ckpt_every: int = 16, ckpt_slots: int = 4):
         self.max_claims = max_claims
         if fallback is None:
             # fallback chain: native C++ core (compiled-class speed), which
@@ -622,7 +627,10 @@ class TPUSolver(Solver):
 
             fallback = NativeSolver()
         self.fallback = fallback
-        self.stats: Dict[str, int] = {"device_solves": 0, "fallback_solves": 0}
+        self.stats: Dict[str, int] = {
+            "device_solves": 0, "fallback_solves": 0,
+            "resume_solves": 0, "resume_runs_skipped": 0,
+        }
         # device-resident argument arena + transfer accounting (solver/
         # arena.py): arena=False restores the per-array upload path (debug
         # escape hatch, `--solver-arena false`); the ledger counts either way
@@ -632,13 +640,25 @@ class TPUSolver(Solver):
         self.arena: Optional[ArgumentArena] = (
             ArgumentArena(self.ledger) if arena else None
         )
+        # checkpointed-scan resume (solver/tpu/ffd.py CheckpointRing +
+        # SPEC.md "Resume semantics"): cold solves harvest an FFDState
+        # snapshot ring every ckpt_every scan steps; a later solve whose run
+        # list shares a validated prefix replays only the suffix. The
+        # checkpoints are a residency class of the arena (they die with it
+        # on invalidate()), so resume requires the arena.
+        self.resume = bool(resume) and arena
+        self.ckpt_every = max(1, int(ckpt_every))
+        self.ckpt_slots = max(1, int(ckpt_slots))
 
     def invalidate_arena(self) -> None:
-        """Drop every device-resident kernel-arg buffer. The resilience
+        """Drop every device-resident kernel-arg buffer AND the checkpoint
+        ring (checkpoints are derived state of the same solves — a replay
+        must trust neither; SPEC.md "Resume semantics"). The resilience
         layer calls this before ANY fallback replay (gate rejection, device
         failure, timeout): a failed device solve leaves residency in an
         unknown state, and a replay must never trust it (SPEC.md "Transfer
-        semantics"). The next device solve pays one full packed upload."""
+        semantics"). The next device solve pays one full packed upload and
+        runs cold."""
         if self.arena is not None:
             self.arena.invalidate()
 
@@ -905,6 +925,41 @@ class TPUSolver(Solver):
             )
             for i, name in enumerate(ARG_SPEC)
         )
+        import jax.numpy as jnp
+
+        from .tpu.ffd import FFDState, ffd_resume, ffd_solve_ckpt
+
+        idx = {name: i for i, name in enumerate(ARG_SPEC)}
+        E, R = specs[idx["node_free"]].shape
+        T = specs[idx["group_compat_t"]].shape[1]
+        P = specs[idx["pool_type"]].shape[0]
+        Q = specs[idx["q_kind"]].shape[0]
+        V = specs[idx["v_kind"]].shape[0]
+        D = specs[idx["zone_col_mask"]].shape[0]
+        W = specs[idx["group_pair_nok"]].shape[1]
+
+        def state_spec(M):
+            sds = jax.ShapeDtypeStruct
+            return FFDState(
+                e_cum=sds((E, R), jnp.int32), c_cum=sds((M, R), jnp.int32),
+                c_mask=sds((M, T), jnp.bool_),
+                c_zc_bits=sds((M,), jnp.uint32),
+                c_gbits=sds((M, W), jnp.uint32), c_pool=sds((M,), jnp.int32),
+                used=sds((), jnp.int32), p_usage=sds((P, R), jnp.int32),
+                e_cm=sds((E, Q), jnp.int32), e_co=sds((E, Q), jnp.int32),
+                c_cm=sds((M, Q), jnp.int32), c_co=sds((M, Q), jnp.int32),
+                v_count=sds((V, D), jnp.int32),
+                v_owner_z=sds((V, D), jnp.bool_),
+                c_vm=sds((M, V), jnp.int32), c_vo=sds((M, V), jnp.bool_),
+            )
+
+        # the steady-state resume dispatch runs over the smallest suffix
+        # bucket (16 runs) — that is the shape a warm append-tail re-solve
+        # requests
+        resume_specs = tuple(
+            jax.ShapeDtypeStruct((16,), s.dtype) if i < 2 else s
+            for i, s in enumerate(specs)
+        )
         n = 0
         for M in claim_buckets:
             for ze in (False, True) if with_zone_engine else (False,):
@@ -912,6 +967,16 @@ class TPUSolver(Solver):
                     ffd_solve.lower(
                         *specs, max_claims=int(M), zone_engine=ze
                     ).compile()
+                    if self.resume:
+                        ck = dict(ckpt_every=self.ckpt_every,
+                                  n_ckpt=self.ckpt_slots)
+                        ffd_solve_ckpt.lower(
+                            *specs, max_claims=int(M), zone_engine=ze, **ck
+                        ).compile()
+                        ffd_resume.lower(
+                            state_spec(int(M)), *resume_specs,
+                            max_claims=int(M), zone_engine=ze, **ck
+                        ).compile()
                 except Exception:
                     return n  # a compile failure would repeat at every point
                 n += 1
@@ -926,13 +991,27 @@ class TPUSolver(Solver):
         avoids recompilation storms)."""
         return max(floor, ((n + mult - 1) // mult) * mult)
 
-    def _dispatch(self, enc: EncodedInput, args, M: int):
+    def _dispatch(self, enc: EncodedInput, args, M: int, harvest: bool = False):
         """Dispatch kernel + output packing; start the device→host copy.
-        Returns (flat_device_array, unpack_fn)."""
-        from .tpu.ffd import ffd_solve
+        Returns (flat_device_array, unpack_fn, out, ring). `harvest` (and
+        the resume knob) selects ffd_solve_ckpt so the solve also produces
+        a device-resident checkpoint ring for later suffix resumes — the
+        ring never crosses the tunnel."""
+        from .tpu.ffd import ffd_solve, ffd_solve_ckpt
 
         faults.check("solver.device_dispatch")
-        out = ffd_solve(*args, max_claims=M, zone_engine=enc.V > 0)
+        ring = None
+        if harvest and self.resume:
+            out, ring = ffd_solve_ckpt(
+                *args, max_claims=M, zone_engine=enc.V > 0,
+                ckpt_every=self.ckpt_every, n_ckpt=self.ckpt_slots,
+            )
+        else:
+            out = ffd_solve(*args, max_claims=M, zone_engine=enc.V > 0)
+        flat_dev, unpack = self._pack_dispatch(out)
+        return flat_dev, unpack, out, ring
+
+    def _pack_dispatch(self, out):
         # ONE device→host transfer: all outputs packed into a single
         # int32 buffer on device (bit-packed masks, uint16 takes), so the
         # tunnel pays one roundtrip per solve — not one per output array
@@ -1020,11 +1099,19 @@ class TPUSolver(Solver):
         # 462-claim solve was ~17× wasted bandwidth). Redispatches reuse the
         # same resident device args — no re-upload.
         M0 = initial_claim_bucket(total_pods, self.max_claims)
-        flat_dev, unpack = self._dispatch(enc, args, M0)
+        plan = self._plan_resume(enc, host_args, M0, S)
+        if plan is not None:
+            flat_dev, unpack, out, ring = self._dispatch_resume(
+                enc, args, host_args, plan, M0, S
+            )
+        else:
+            flat_dev, unpack, out, ring = self._dispatch(enc, args, M0,
+                                                         harvest=True)
 
         def finish() -> Optional[SolverResult]:
             try:
                 M = M0
+                cur_plan, cur_out, cur_ring = plan, out, ring
                 flat, up = np.asarray(flat_dev), unpack
                 self.ledger.record_fetch(flat.nbytes)
                 while True:
@@ -1032,24 +1119,208 @@ class TPUSolver(Solver):
                     used = int(f["used"])
                     if used < M:
                         break
+                    if cur_plan is not None:
+                        # a resumed dispatch saturated its claim slots; the
+                        # donor record's M no longer matches, so the retry
+                        # replays COLD at the doubled bucket (still against
+                        # the arena-resident args — no re-upload)
+                        cur_plan = None
                     if M >= self.max_claims:
                         return None  # true overflow — replay on fallback
                     M = min(M * 2, self.max_claims)
-                    fd, up = self._dispatch(enc, args, M)
+                    fd, up, cur_out, cur_ring = self._dispatch(
+                        enc, args, M, harvest=True
+                    )
                     flat = np.asarray(fd)
                     self.ledger.record_fetch(flat.nbytes)
                 faults.check("solver.decode")
                 c_mask = _unpack_words(f["c_mask_words"], T)
                 c_zone, c_ct = unpack_zc_bits(f["c_zc_bits"], Z, C)
                 c_gmask = _unpack_gmask(f["c_gbits"], G)
-                return decode(enc, f["take_e"][:S, :E], f["take_c"][:S],
-                              f["leftover"][:S], c_mask,
-                              c_zone, c_ct, f["c_pool"], c_gmask, f["c_cum"],
-                              used)
+                if cur_plan is not None:
+                    # suffix dispatch: rows [0:k] of the full take tables are
+                    # the donor record's (decision-identical by construction —
+                    # the checkpoint IS the carry after those rows), rows
+                    # [k:S] come from this dispatch. State outputs (c_*) need
+                    # no stitching: the suffix's final state equals a full
+                    # replay's.
+                    k = cur_plan["k"]
+                    rec = cur_plan["rec"]
+                    take_e_p = np.concatenate(
+                        [rec["take_e"][:k], f["take_e"][: S - k]]
+                    )
+                    take_c_p = np.concatenate(
+                        [rec["take_c"][:k], f["take_c"][: S - k]]
+                    )
+                    leftover_p = np.concatenate(
+                        [rec["leftover"][:k], f["leftover"][: S - k]]
+                    )
+                    self.stats["resume_solves"] += 1
+                    self.stats["resume_runs_skipped"] += k
+                    SOLVER_RUNS_SKIPPED.inc(k)
+                else:
+                    take_e_p = f["take_e"][:S]
+                    take_c_p = f["take_c"][:S]
+                    leftover_p = f["leftover"][:S]
+                res = decode(enc, take_e_p[:, :E], take_c_p,
+                             leftover_p, c_mask,
+                             c_zone, c_ct, f["c_pool"], c_gmask, f["c_cum"],
+                             used)
+                self._record_checkpoint(
+                    enc, host_args, M, S, cur_plan, cur_out, cur_ring,
+                    take_e_p, take_c_p, leftover_p,
+                )
+                SOLVER_RESUME_HIT_RATE.set(self.resume_hit_rate)
+                return res
             finally:
                 self.ledger.end_solve()
 
         return finish
+
+    @property
+    def resume_hit_rate(self) -> float:
+        """Fraction of device dispatches that resumed from a checkpoint."""
+        return self.stats["resume_solves"] / max(1, self.ledger.solves)
+
+    def _plan_resume(self, enc: EncodedInput, host_args, M0: int, S: int):
+        """Pick the newest valid checkpoint for this dispatch, or None.
+
+        Prefix validity (SPEC.md "Resume semantics"): (a) a record exists
+        for the CURRENT arena bucket (same padded shapes ⇒ same compile
+        bucket as the donor), (b) every non-run kernel arg is byte-identical
+        to the donor's (arena context signature — the node-table-revision
+        leg), (c) the donor/current run lists share a common prefix of
+        (snum, group, count) triples, (d) the donor's claim bucket and
+        zone-engine static match this dispatch. The chosen checkpoint is
+        the one covering the most runs within the common prefix; the
+        donor's device-resident FINAL state (covering its whole run list)
+        wins on pure appends regardless of the ring interval."""
+        if not self.resume or self.arena is None:
+            return None
+        from . import encode_cache as ec
+        from .tpu.ffd import ARG_INDEX
+
+        run_idx = (ARG_INDEX["run_group"], ARG_INDEX["run_count"])
+        key = self.arena.bucket_key(host_args)
+        recs = self.arena.get_checkpoints(key)
+        if not recs:
+            return None
+        rec = recs[0]
+        if rec["M"] != M0 or rec["zone_engine"] != (enc.V > 0):
+            return None
+        ctx = self.arena.context_signature(key, exclude=run_idx)
+        if ctx is None or ctx != rec["ctx_sig"]:
+            return None  # node/pool/core tables moved since the donor solve
+        cur = ec.run_identity(enc)
+        if not cur or len(cur) != S:
+            return None  # signatures not interned — prefixes not comparable
+        lcp = ec.run_lcp(rec["run_ident"], cur)
+        if lcp < 1:
+            return None
+        if lcp == len(cur) == len(rec["run_ident"]):
+            # identical run list: the exact-hit cold path already dispatches
+            # with ZERO upload bytes (arena residency); a resume would add
+            # suffix-array uploads just to skip a scan the jit cache replays
+            # cheaply. Keep the seed's exact-hit ledger invariants intact.
+            return None
+        if rec["final_covered"] <= lcp:
+            k, init = rec["final_covered"], rec["final_state"]
+        else:
+            cand = None
+            for covered, slot in rec["ring_covered"]:
+                if 1 <= covered <= lcp and (cand is None or covered > cand[0]):
+                    cand = (covered, slot)
+            if cand is None or rec["ring"] is None:
+                return None
+            import jax
+
+            k = cand[0]
+            slot = cand[1]
+            init = jax.tree_util.tree_map(lambda a: a[slot],
+                                          rec["ring"].states)
+        return {"k": k, "init": init, "rec": rec, "key": key, "ctx_sig": ctx}
+
+    def _dispatch_resume(self, enc: EncodedInput, args, host_args, plan,
+                         M: int, S: int):
+        """Dispatch only runs[k:] on top of the planned checkpoint. The 34
+        non-run args are the arena-resident buffers (zero upload — the
+        unchanged prefix ships nothing); only the two tiny suffix run
+        arrays cross the tunnel."""
+        import jax
+
+        from .tpu.ffd import ffd_resume
+
+        faults.check("solver.device_dispatch")
+        k = plan["k"]
+        Sp2 = self._bucket(S - k, 16, 16)
+        sg = np.zeros((Sp2,), host_args[0].dtype)
+        sc = np.zeros((Sp2,), host_args[1].dtype)
+        sg[: S - k] = np.asarray(host_args[0])[k:S]
+        sc[: S - k] = np.asarray(host_args[1])[k:S]
+        dev_sg = jax.device_put(sg)
+        dev_sc = jax.device_put(sc)
+        self.ledger.record_upload(sg.nbytes + sc.nbytes, 2, msgs=2)
+        out, ring = ffd_resume(
+            plan["init"], dev_sg, dev_sc, *args[2:],
+            max_claims=M, zone_engine=enc.V > 0,
+            ckpt_every=self.ckpt_every, n_ckpt=self.ckpt_slots,
+        )
+        flat_dev, unpack = self._pack_dispatch(out)
+        return flat_dev, unpack, out, ring
+
+    def _ring_coverage(self, Sp: int, S_real: int, base: int):
+        """Host-side recomputation of which REAL-run prefix each ring slot
+        covers — deterministic from the slot schedule (step j*K writes slot
+        (j-1) % n; last write wins; padded steps past S_real don't mutate
+        state, so a checkpoint at position p covers min(p, S_real) real
+        runs). No device fetch of CheckpointRing.prefix is ever needed."""
+        K, n = self.ckpt_every, self.ckpt_slots
+        cov: Dict[int, int] = {}
+        for j in range(1, Sp // K + 1):
+            cov[(j - 1) % n] = base + min(j * K, S_real)
+        return sorted(((c, s) for s, c in cov.items()), reverse=True)
+
+    def _record_checkpoint(self, enc: EncodedInput, host_args, M: int,
+                           S: int, plan, out, ring, take_e_p, take_c_p,
+                           leftover_p) -> None:
+        """After a successful device solve, record its checkpoints as the
+        bucket's resume donor: run identity, host-side take rows (a resumed
+        successor needs prefix rows it won't re-execute), and the
+        device-resident ring + final state (never fetched)."""
+        if not self.resume or self.arena is None or out is None:
+            return
+        from . import encode_cache as ec
+
+        ident = ec.run_identity(enc)
+        if not ident or len(ident) != S:
+            return
+        from .tpu.ffd import ARG_INDEX
+
+        key = self.arena.bucket_key(host_args)
+        ctx = self.arena.context_signature(
+            key, exclude=(ARG_INDEX["run_group"], ARG_INDEX["run_count"])
+        )
+        if ctx is None:
+            return
+        if plan is not None:
+            base, suffix_real = plan["k"], S - plan["k"]
+            Sp_disp = self._bucket(suffix_real, 16, 16)
+        else:
+            base, suffix_real = 0, S
+            Sp_disp = int(host_args[0].shape[0])
+        self.arena.put_checkpoint(key, {
+            "run_ident": ident,
+            "take_e": np.asarray(take_e_p),
+            "take_c": np.asarray(take_c_p),
+            "leftover": np.asarray(leftover_p),
+            "M": M,
+            "zone_engine": enc.V > 0,
+            "ctx_sig": ctx,
+            "ring": ring,
+            "ring_covered": self._ring_coverage(Sp_disp, suffix_real, base),
+            "final_state": out.state,
+            "final_covered": S,
+        })
 
 
 def _unpack_words(words: np.ndarray, width: int) -> np.ndarray:

@@ -264,6 +264,11 @@ class EncodedInput:
     # backend.host_kernel_args derives per-entry provenance tokens from it
     # so the argument arena skips hashing/uploading core-derived args.
     core_rev: int = -1
+    # interned sort-signature number per group (same universe as
+    # encode_cache's patch check); () when sigs were not interned. Run-list
+    # prefix matching (encode_cache.run_identity) keys on these so a group
+    # index means the same pod spec across two encodes.
+    group_snums: tuple = ()
 
     @property
     def v_domain_perm(self) -> List[int]:
@@ -1412,4 +1417,5 @@ def _encode_with_nodes(core: _EncodeCore, inp: SolverInput) -> EncodedInput:
         group_daxis=core.group_daxis,
         node_dom2=node_dom2,
         core_rev=core.core_rev,
+        group_snums=core.group_snums,
     )

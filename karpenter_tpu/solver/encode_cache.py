@@ -111,3 +111,43 @@ def try_patch(key, presort, structure, core_cache, state_rev=None):
             sorted_uids=sorted_uids,
         )
     return None
+
+
+# --- run-list prefix identity (checkpointed-scan resume) -------------------
+#
+# backend.py resumes the FFD scan from a device-resident checkpoint when a
+# PREFIX of the sorted run list is unchanged between the previous encode and
+# the current one. "Unchanged" must mean decision-identical: the kernel's
+# step i reads (run_group[i], run_count[i]) plus [G]-indexed tables, so two
+# runs are the same step iff they have the same interned signature number
+# (same pod spec — group indices alone can be renumbered by a mid-list
+# insert), the same group index (the [G] tables are positional), and the
+# same count. Node-table identity (the "node-table revision" leg of the
+# prefix rule) is checked separately by the arena's staleness partition —
+# see backend._plan_resume.
+
+
+def run_identity(enc) -> tuple:
+    """Tuple of (snum, group, count) per REAL run of `enc`, in scan order.
+    () when signatures were not interned (batch-local ids are not
+    comparable across solves — resume must not match on them)."""
+    snums = getattr(enc, "group_snums", ())
+    if not snums:
+        return ()
+    out = []
+    for g, c in zip(enc.run_group, enc.run_count):
+        g = int(g)
+        c = int(c)
+        if c <= 0:
+            break  # runs are front-packed; padding never precedes a real run
+        out.append((snums[g], g, c))
+    return tuple(out)
+
+
+def run_lcp(prev: tuple, cur: tuple) -> int:
+    """Longest common prefix length of two run_identity() tuples."""
+    n = min(len(prev), len(cur))
+    k = 0
+    while k < n and prev[k] == cur[k]:
+        k += 1
+    return k

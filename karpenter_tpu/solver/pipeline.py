@@ -205,6 +205,21 @@ class SolveService:
         with self._cv:
             return sum(len(q) for q in self._pending.values())
 
+    def resume_stats(self) -> Dict[str, float]:
+        """Checkpoint-resume counters of the owned backend (zeros when the
+        backend has none). The service serializes every device dispatch
+        through one solver/arena, so a coalesced provisioning snapshot
+        naturally resumes from the checkpoint its superseded predecessor's
+        dispatch left device-resident — no extra wiring per request."""
+        inner = self.solver
+        # unwrap the resilience layer's delegation chain if present
+        stats = getattr(inner, "stats", None) or {}
+        return {
+            "resume_solves": int(stats.get("resume_solves", 0)),
+            "resume_runs_skipped": int(stats.get("resume_runs_skipped", 0)),
+            "resume_hit_rate": float(getattr(inner, "resume_hit_rate", 0.0)),
+        }
+
     def close(self) -> None:
         """Stop accepting work; fail queued (undispatched) requests with
         ServiceStopped; let in-flight requests drain."""

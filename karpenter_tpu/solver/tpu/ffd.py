@@ -172,6 +172,23 @@ class FFDOutput(NamedTuple):
     state: FFDState
 
 
+class CheckpointRing(NamedTuple):
+    """Fixed-size ring of FFDState snapshots taken every `ckpt_every` scan
+    steps. `states` holds each FFDState field stacked along a leading
+    [n_ckpt] axis; `prefix[slot]` is the 1-based count of scan steps already
+    applied when slot was written (-1 = never written). Because the scan
+    carry IS the complete decision state, resuming from `states[slot]` over
+    `runs[prefix[slot]:]` is decision-identical to a cold solve by
+    construction. Slot positions are deterministic (step j·ckpt_every lands
+    in slot (j-1) % n_ckpt), so the host never needs to fetch `prefix` —
+    it recomputes coverage from (S, ckpt_every, n_ckpt) alone. Padded steps
+    (run_count == 0) do not mutate the state, so a checkpoint at position p
+    covers min(p, S_real) REAL runs."""
+
+    states: FFDState  # each field: [n_ckpt, ...field shape]
+    prefix: jnp.ndarray  # [n_ckpt] int32 — scan steps applied, -1 empty
+
+
 def _fit_count(alloc, cum, req):
     """[N] per-node count of additional `req` pods fitting: min over R of
     floor((alloc - cum) / req); req==0 axes don't constrain. Clamped >= 0."""
@@ -254,10 +271,7 @@ def _gbit_word(g, W):
     )
 
 
-@functools.partial(
-    jax.jit, static_argnames=("max_claims", "emit_takes", "zone_engine")
-)
-def ffd_solve(
+def _ffd_scan(
     # runs
     run_group,  # [S] i32
     run_count,  # [S] i32
@@ -305,7 +319,10 @@ def ffd_solve(
     max_claims: int,
     emit_takes: bool = True,
     zone_engine: bool = True,
-) -> FFDOutput:
+    init_state: FFDState | None = None,
+    ckpt_every: int = 0,
+    n_ckpt: int = 0,
+):
     E, R = node_free.shape
     G, T = group_compat_t.shape
     P = pool_type.shape[0]
@@ -318,7 +335,7 @@ def ffd_solve(
     eidx = jnp.arange(E, dtype=jnp.int32)
     midx = jnp.arange(M, dtype=jnp.int32)
 
-    state = FFDState(
+    state0 = FFDState(
         e_cum=jnp.zeros((E, R), jnp.int32),
         c_cum=jnp.zeros((M, R), jnp.int32),
         c_mask=jnp.zeros((M, T), bool),
@@ -336,6 +353,9 @@ def ffd_solve(
         c_vm=jnp.zeros((M, V), jnp.int32),
         c_vo=jnp.zeros((M, V), bool),
     )
+    # a resume replays the suffix against the donor's final carry; cold
+    # solves start from the zero/input-derived state above
+    state = state0 if init_state is None else init_state
 
     # a node marks its column on EVERY axis (its zone and, under mixed-axis
     # solves, its capacity type) — matching the oracle, which records every
@@ -1459,12 +1479,341 @@ def ffd_solve(
             return new_st, (te, tc, lo)
         return new_st, lo
 
-    state, ys = jax.lax.scan(step, state, (run_group, run_count))
+    S = run_group.shape[0]
+    ring = None
+    if ckpt_every > 0 and n_ckpt > 0:
+        # carry a fixed-size snapshot ring through the scan: step pos=i+1
+        # writes slot ((pos//K)-1) % n_ckpt when pos % K == 0. The write
+        # happens OUTSIDE step's count>0 cond so padded steps still advance
+        # the (deterministic) slot schedule — the host recomputes coverage
+        # without fetching `prefix`.
+        ring0 = jax.tree_util.tree_map(
+            lambda a: jnp.zeros((n_ckpt,) + a.shape, a.dtype), state0
+        )
+        prefix0 = jnp.full((n_ckpt,), -1, jnp.int32)
+
+        def step_ck(carry, run):
+            st, ring_st, pref = carry
+            g, count, i = run
+            new_st, ys_i = step(st, (g, count))
+            pos = i + jnp.int32(1)
+            write = (pos % ckpt_every) == 0
+            slot = ((pos // ckpt_every) - 1) % n_ckpt
+            ring_st = jax.tree_util.tree_map(
+                lambda r, s: r.at[slot].set(jnp.where(write, s, r[slot])),
+                ring_st, new_st,
+            )
+            pref = pref.at[slot].set(jnp.where(write, pos, pref[slot]))
+            return (new_st, ring_st, pref), ys_i
+
+        (state, ring_states, prefix), ys = jax.lax.scan(
+            step_ck,
+            (state, ring0, prefix0),
+            (run_group, run_count, jnp.arange(S, dtype=jnp.int32)),
+        )
+        ring = CheckpointRing(states=ring_states, prefix=prefix)
+    else:
+        state, ys = jax.lax.scan(step, state, (run_group, run_count))
     if emit_takes:
         take_e, take_c, leftover = ys
     else:
-        S = run_group.shape[0]
         take_e = jnp.zeros((0, E), jnp.int32)
         take_c = jnp.zeros((0, M), jnp.int32)
         leftover = ys.reshape(S)
-    return FFDOutput(take_e=take_e, take_c=take_c, leftover=leftover, state=state)
+    out = FFDOutput(take_e=take_e, take_c=take_c, leftover=leftover, state=state)
+    return out, ring
+
+
+# --- jitted entry points -------------------------------------------------
+#
+# All three wrap the SAME traced body (_ffd_scan), so resume is
+# decision-identical to a cold solve by construction. The
+# `functools.partial(jax.jit)` decorator style keeps `__wrapped__` a plain
+# traceable function, which consolidate.py and parallel/sharded.py vmap
+# directly and tests/test_arg_spec_drift.py introspects. ffd_solve's
+# signature is frozen by ARG_SPEC — the checkpoint/resume statics
+# (ckpt_every, n_ckpt) live only on the new entry points.
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_claims", "emit_takes", "zone_engine")
+)
+def ffd_solve(
+    run_group,
+    run_count,
+    group_req,
+    group_compat_t,
+    group_zc_bits,
+    group_pool,
+    group_pair_nok,
+    group_device,
+    type_alloc,
+    type_charge,
+    offer_zc_bits,
+    pool_type,
+    pool_zc_bits,
+    pool_daemon,
+    pool_limit,
+    pool_usage0,
+    node_free,
+    node_compat,
+    q_member,
+    q_owner,
+    q_kind,
+    q_cap,
+    node_q_member,
+    node_q_owner,
+    v_member,
+    v_owner,
+    v_kind,
+    v_cap,
+    v_primary,
+    v_aff,
+    v_count0,
+    node_zone,
+    zone_col_mask,
+    node_dom2,
+    col_axis,
+    group_daxis,
+    *,
+    max_claims: int,
+    emit_takes: bool = True,
+    zone_engine: bool = True,
+) -> FFDOutput:
+    out, _ = _ffd_scan(
+        run_group,
+        run_count,
+        group_req,
+        group_compat_t,
+        group_zc_bits,
+        group_pool,
+        group_pair_nok,
+        group_device,
+        type_alloc,
+        type_charge,
+        offer_zc_bits,
+        pool_type,
+        pool_zc_bits,
+        pool_daemon,
+        pool_limit,
+        pool_usage0,
+        node_free,
+        node_compat,
+        q_member,
+        q_owner,
+        q_kind,
+        q_cap,
+        node_q_member,
+        node_q_owner,
+        v_member,
+        v_owner,
+        v_kind,
+        v_cap,
+        v_primary,
+        v_aff,
+        v_count0,
+        node_zone,
+        zone_col_mask,
+        node_dom2,
+        col_axis,
+        group_daxis,
+        max_claims=max_claims,
+        emit_takes=emit_takes,
+        zone_engine=zone_engine,
+    )
+    return out
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_claims", "emit_takes", "zone_engine",
+                     "ckpt_every", "n_ckpt"),
+)
+def ffd_solve_ckpt(
+    run_group,
+    run_count,
+    group_req,
+    group_compat_t,
+    group_zc_bits,
+    group_pool,
+    group_pair_nok,
+    group_device,
+    type_alloc,
+    type_charge,
+    offer_zc_bits,
+    pool_type,
+    pool_zc_bits,
+    pool_daemon,
+    pool_limit,
+    pool_usage0,
+    node_free,
+    node_compat,
+    q_member,
+    q_owner,
+    q_kind,
+    q_cap,
+    node_q_member,
+    node_q_owner,
+    v_member,
+    v_owner,
+    v_kind,
+    v_cap,
+    v_primary,
+    v_aff,
+    v_count0,
+    node_zone,
+    zone_col_mask,
+    node_dom2,
+    col_axis,
+    group_daxis,
+    *,
+    max_claims: int,
+    emit_takes: bool = True,
+    zone_engine: bool = True,
+    ckpt_every: int = 16,
+    n_ckpt: int = 4,
+):
+    """Cold solve that also harvests a checkpoint ring (device-resident;
+    zero extra transfer unless the caller fetches it)."""
+    return _ffd_scan(
+        run_group,
+        run_count,
+        group_req,
+        group_compat_t,
+        group_zc_bits,
+        group_pool,
+        group_pair_nok,
+        group_device,
+        type_alloc,
+        type_charge,
+        offer_zc_bits,
+        pool_type,
+        pool_zc_bits,
+        pool_daemon,
+        pool_limit,
+        pool_usage0,
+        node_free,
+        node_compat,
+        q_member,
+        q_owner,
+        q_kind,
+        q_cap,
+        node_q_member,
+        node_q_owner,
+        v_member,
+        v_owner,
+        v_kind,
+        v_cap,
+        v_primary,
+        v_aff,
+        v_count0,
+        node_zone,
+        zone_col_mask,
+        node_dom2,
+        col_axis,
+        group_daxis,
+        max_claims=max_claims,
+        emit_takes=emit_takes,
+        zone_engine=zone_engine,
+        ckpt_every=ckpt_every,
+        n_ckpt=n_ckpt,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_claims", "emit_takes", "zone_engine",
+                     "ckpt_every", "n_ckpt"),
+)
+def ffd_resume(
+    init_state,  # FFDState pytree — a checkpoint from a prefix-valid solve
+    run_group,
+    run_count,
+    group_req,
+    group_compat_t,
+    group_zc_bits,
+    group_pool,
+    group_pair_nok,
+    group_device,
+    type_alloc,
+    type_charge,
+    offer_zc_bits,
+    pool_type,
+    pool_zc_bits,
+    pool_daemon,
+    pool_limit,
+    pool_usage0,
+    node_free,
+    node_compat,
+    q_member,
+    q_owner,
+    q_kind,
+    q_cap,
+    node_q_member,
+    node_q_owner,
+    v_member,
+    v_owner,
+    v_kind,
+    v_cap,
+    v_primary,
+    v_aff,
+    v_count0,
+    node_zone,
+    zone_col_mask,
+    node_dom2,
+    col_axis,
+    group_daxis,
+    *,
+    max_claims: int,
+    emit_takes: bool = True,
+    zone_engine: bool = True,
+    ckpt_every: int = 16,
+    n_ckpt: int = 4,
+):
+    """Replay only `runs[k:]` on top of checkpoint `init_state` (the carry
+    after the first k runs). Returns takes FOR THE SUFFIX ONLY plus a fresh
+    ring whose positions are suffix-relative."""
+    return _ffd_scan(
+        run_group,
+        run_count,
+        group_req,
+        group_compat_t,
+        group_zc_bits,
+        group_pool,
+        group_pair_nok,
+        group_device,
+        type_alloc,
+        type_charge,
+        offer_zc_bits,
+        pool_type,
+        pool_zc_bits,
+        pool_daemon,
+        pool_limit,
+        pool_usage0,
+        node_free,
+        node_compat,
+        q_member,
+        q_owner,
+        q_kind,
+        q_cap,
+        node_q_member,
+        node_q_owner,
+        v_member,
+        v_owner,
+        v_kind,
+        v_cap,
+        v_primary,
+        v_aff,
+        v_count0,
+        node_zone,
+        zone_col_mask,
+        node_dom2,
+        col_axis,
+        group_daxis,
+        max_claims=max_claims,
+        emit_takes=emit_takes,
+        zone_engine=zone_engine,
+        init_state=init_state,
+        ckpt_every=ckpt_every,
+        n_ckpt=n_ckpt,
+    )

@@ -77,3 +77,53 @@ def test_host_kernel_args_arity_and_provenance():
     dev_args, dev_dims = backend.kernel_args(enc, solver._bucket)
     assert len(dev_args) == len(ffd.ARG_SPEC)
     assert {k: dims[k] for k in dev_dims} == dict(dev_dims)
+
+
+# -- checkpointed-scan resume (ISSUE 5) --------------------------------------
+
+RESUME_STATICS = STATICS + ("ckpt_every", "n_ckpt")
+
+
+def test_checkpoint_ring_layout_matches_ffd_state():
+    """The ring's per-slot snapshots ARE FFDState pytrees (tree_map-stacked),
+    and the resume entry point replays from one of them — a field added to
+    FFDState without flowing through CheckpointRing would resume from a
+    truncated carry and silently diverge. Pin the structural contract."""
+    assert ffd.CheckpointRing._fields == ("states", "prefix")
+    # the stacked-states leaf set is exactly FFDState's (annotation is the
+    # contract; construction uses tree_map over an FFDState so it cannot
+    # partially drift)
+    assert "FFDState" in str(ffd.CheckpointRing.__annotations__["states"])
+    # the scan carry the kernel snapshots — every decision-bearing register
+    assert ffd.FFDState._fields == (
+        "e_cum", "c_cum", "c_mask", "c_zc_bits", "c_gbits", "c_pool",
+        "used", "p_usage", "e_cm", "e_co", "c_cm", "c_co",
+        "v_count", "v_owner_z", "c_vm", "c_vo",
+    ), "FFDState fields changed: update checkpoint ring + resume plumbing"
+
+
+def test_resume_entry_points_share_the_tensor_contract():
+    """ffd_solve_ckpt and ffd_resume take the SAME 36 positional tensors as
+    ffd_solve (resume with a leading init_state), so the arena's per-entry
+    residency and the suffix dispatch's args[2:] splice stay valid."""
+    import inspect
+
+    for fn, lead in (("ffd_solve_ckpt", ()), ("ffd_resume", ("init_state",))):
+        params = list(inspect.signature(getattr(ffd, fn).__wrapped__).parameters)
+        tensor = [p for p in params if p not in RESUME_STATICS]
+        assert tuple(tensor) == lead + ffd.ARG_SPEC, (
+            f"{fn}'s tensor params drifted from ffd.ARG_SPEC"
+        )
+        assert params == tensor + list(RESUME_STATICS), (
+            f"{fn}: statics must trail as ({', '.join(RESUME_STATICS)})"
+        )
+
+
+def test_cold_entry_point_signature_is_frozen():
+    """ffd_solve keeps its pre-resume signature: no ckpt statics. vmap call
+    sites (parallel/sharded.py, consolidate.py) and the AOT prewarm bind it
+    positionally; checkpoint harvesting belongs ONLY to ffd_solve_ckpt."""
+    import inspect
+
+    params = list(inspect.signature(ffd.ffd_solve.__wrapped__).parameters)
+    assert "ckpt_every" not in params and "n_ckpt" not in params

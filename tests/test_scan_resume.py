@@ -1,0 +1,220 @@
+"""Checkpointed FFD scan with incremental suffix resume: parity + ledger.
+
+ISSUE 5 acceptance: a warm re-solve that resumes from a checkpoint ring
+slot is DECISION-IDENTICAL to a cold full-scan solve of the same input —
+by construction (the snapshot is the complete scan carry), proven here
+property-style across randomized fleets and mutation points. The ledger
+invariants ride along: an exact repeat stays a zero-upload exact hit, a
+resumed solve uploads only the suffix run arrays, and a fallback replay
+invalidates the checkpoint ring together with the arena residency it
+lives in.
+
+The ring snapshots every ckpt_every steps of the PADDED run axis, so the
+test solver uses ckpt_every=2 with 16 slots: for fleets of ~24 runs
+(padded to 32) every even scan position stays resident and any mutation
+at run index >= 2 finds a covering slot.
+"""
+
+import dataclasses
+import random
+
+from karpenter_tpu import faults
+from karpenter_tpu.provisioning.scheduler import SolverInput
+from karpenter_tpu.solver.backend import ReferenceSolver, TPUSolver
+from karpenter_tpu.solver.resilient import ResilientSolver
+
+from tests.test_e2e_kwok import FakeClock
+from tests.test_solver_parity import ZONES, mkpod, pool
+from tests.test_transfer_arena import _assert_same
+
+N_SPECS = 24
+
+
+def _warm_solver():
+    return TPUSolver(ckpt_every=2, ckpt_slots=16)
+
+
+def _fleet(rng=None, n_specs=N_SPECS, prefix="p"):
+    """n_specs DISTINCT pod sizes -> ~n_specs FFD runs; replica counts
+    randomized when an rng is given. Spec k=0 is the smallest size, i.e.
+    the LAST run in the kernel's descending FFD order."""
+    pods = []
+    for k in range(n_specs):
+        count = rng.randrange(3, 8) if rng else 4
+        for j in range(count):
+            pods.append(
+                mkpod(f"{prefix}{k:02d}-{j}", cpu=f"{100 + 7 * k}m",
+                      mem=f"{64 + 16 * k}Mi")
+            )
+    return SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+
+
+def _add_replica(inp, k, uid):
+    """A new pod with spec k's scheduling signature: changes one run's
+    count without disturbing the signature universe (a NEW size would
+    rebuild the encode core and legitimately cold-solve)."""
+    donor_cpu = f"{100 + 7 * k}m"
+    donor_mem = f"{64 + 16 * k}Mi"
+    pods = list(inp.pods) + [mkpod(uid, cpu=donor_cpu, mem=donor_mem)]
+    return dataclasses.replace(inp, pods=pods)
+
+
+def _del_replica(inp, k, prefix="p"):
+    name = f"{prefix}{k:02d}-0"
+    pods = [p for p in inp.pods if p.meta.name != name]
+    assert len(pods) == len(inp.pods) - 1
+    return dataclasses.replace(inp, pods=pods)
+
+
+def _mknode(name="n1", zone="zone-1a"):
+    from karpenter_tpu.api import wellknown as wk
+    from karpenter_tpu.provisioning.scheduler import ExistingNode
+    from karpenter_tpu.utils.resources import Resources
+
+    free = Resources.parse({"cpu": "8", "memory": "32Gi"})
+    free["pods"] = 110
+    return ExistingNode(
+        id=name,
+        labels={
+            wk.ZONE_LABEL: zone,
+            wk.HOSTNAME_LABEL: name,
+            wk.CAPACITY_TYPE_LABEL: "on-demand",
+            wk.ARCH_LABEL: "amd64",
+            wk.OS_LABEL: "linux",
+        },
+        taints=[],
+        free=free,
+    )
+
+
+# -- deterministic core: append-tail resume ---------------------------------
+
+
+def test_append_tail_resumes_and_matches_cold():
+    """Appending replicas of the smallest spec changes only the LAST run's
+    count: the warm solver must resume (skipping a non-trivial prefix) and
+    decide exactly as a resume-disabled cold solver."""
+    inp = _fleet()
+    tail = _add_replica(inp, 0, "tail-0")
+    warm, cold = _warm_solver(), TPUSolver(resume=False)
+    _assert_same(warm.solve(inp), cold.solve(inp), "baseline")
+    _assert_same(warm.solve(tail), cold.solve(tail), "append-tail")
+    assert warm.stats["resume_solves"] == 1, warm.stats
+    assert warm.stats["resume_runs_skipped"] > 0, warm.stats
+    assert warm.resume_hit_rate == 0.5
+
+
+def test_resume_disabled_knob_never_resumes():
+    inp = _fleet()
+    s = TPUSolver(resume=False)
+    s.solve(inp)
+    s.solve(_add_replica(inp, 0, "tail-0"))
+    assert s.stats["resume_solves"] == 0
+
+
+# -- property suite: randomized fleets x mutation points --------------------
+
+
+def test_random_mutations_resume_identical_to_cold():
+    """Across randomized fleets and mutation classes, a warm solver with
+    checkpoints and a cold resume-disabled solver must be bit-identical on
+    every step — whether or not the mutation admitted a resume. Node-table
+    changes rewrite non-run kernel args, so the context signature must
+    force those solves cold."""
+    rng = random.Random(0xC5)
+    resumes = 0
+    for trial in range(8):
+        inp = _fleet(rng, prefix=f"t{trial}x")
+        kind = ("append_tail", "mid_insert", "delete", "node_change")[trial % 4]
+        if kind == "append_tail":
+            mut = _add_replica(inp, 0, f"t{trial}-tail")
+        elif kind == "mid_insert":
+            k = rng.randrange(4, N_SPECS - 4)
+            mut = _add_replica(inp, k, f"t{trial}-mid{k}")
+        elif kind == "delete":
+            mut = _del_replica(inp, rng.randrange(2, N_SPECS - 2),
+                               prefix=f"t{trial}x")
+        else:  # node_change: the node table feeds e_* kernel args
+            mut = dataclasses.replace(inp, nodes=[_mknode(f"t{trial}-n")])
+        warm, cold = _warm_solver(), TPUSolver(resume=False)
+        _assert_same(warm.solve(inp), cold.solve(inp), f"{trial}:{kind}:base")
+        _assert_same(warm.solve(mut), cold.solve(mut), f"{trial}:{kind}:mut")
+        if kind == "node_change":
+            assert warm.stats["resume_solves"] == 0, (
+                f"{kind}: resumed across a node-table change"
+            )
+        resumes += warm.stats["resume_solves"]
+    # the suite must actually exercise the resume path, or the parity
+    # property proves nothing
+    assert resumes >= 3, f"only {resumes} resumes across the property suite"
+
+
+# -- ledger invariants -------------------------------------------------------
+
+
+def test_exact_repeat_stays_zero_upload_exact_hit():
+    """The identical-run-list carve-out: an exact repeat must remain the
+    arena's zero-upload exact hit, NOT a degenerate full-skip resume that
+    would pay suffix-run uploads for nothing."""
+    s = _warm_solver()
+    inp = _fleet()
+    s.solve(inp)
+    s.solve(inp)
+    assert s.stats["resume_solves"] == 0
+    assert s.ledger.solve["h2d_bytes"] == 0
+    assert s.ledger.solve["h2d_msgs"] == 0
+    assert s.ledger.outcomes["exact_hit"] == 1
+
+
+def test_resumed_solve_uploads_only_suffix_runs():
+    """A resumed dispatch re-uploads the stale run entries (one packed
+    arena message) plus the two suffix run arrays — strictly less than the
+    cold full upload; the unchanged 34 non-run args and the checkpoint
+    itself never cross the link again."""
+    s = _warm_solver()
+    inp = _fleet()
+    s.solve(inp)
+    full_bytes = s.ledger.solve["h2d_bytes"]
+    assert full_bytes > 0
+    s.solve(_add_replica(inp, 0, "tail-0"))
+    assert s.stats["resume_solves"] == 1
+    assert 0 < s.ledger.solve["h2d_bytes"] < full_bytes
+    # <= 3 messages: 1 packed delta upload + 2 suffix run arrays
+    assert s.ledger.solve["h2d_msgs"] <= 3, dict(s.ledger.solve)
+    # outcomes are cumulative: only the cold solve paid a full upload; the
+    # resumed solve classified as a delta
+    assert s.ledger.outcomes["full_upload"] == 1
+    assert s.ledger.outcomes["delta_upload"] == 1
+
+
+# -- fallback replay invalidates the ring ------------------------------------
+
+
+def test_fallback_replay_invalidates_checkpoint_ring():
+    """A device failure drops checkpoint records together with arena
+    residency (they are one residency class): the post-recovery solve runs
+    cold off fresh uploads — and only later re-solves resume again."""
+    inner = TPUSolver(ckpt_every=2, ckpt_slots=16)
+    rs = ResilientSolver(inner, fallbacks=[ReferenceSolver()],
+                         clock=FakeClock())
+    cold = TPUSolver(resume=False)
+    inp = _fleet()
+    _assert_same(rs.solve(inp), cold.solve(inp), "warm")
+    assert inner.arena._ckpts, "first device solve recorded no checkpoint"
+
+    plan = faults.FaultPlan(seed=0)
+    plan.fail_n("solver.device_dispatch", 1)
+    tail = _add_replica(inp, 0, "tail-0")
+    with faults.active(plan):
+        replayed = rs.solve(tail)
+    assert plan.fired["solver.device_dispatch"] == 1
+    assert not inner.arena._ckpts, "fallback replay left checkpoints resident"
+    _assert_same(replayed, cold.solve(tail), "fallback-replay")
+
+    # recovered: the next solve must NOT trust the dropped ring (cold), but
+    # it re-records, so the one after resumes again
+    _assert_same(rs.solve(tail), cold.solve(tail), "recovered-cold")
+    assert inner.stats["resume_solves"] == 0
+    tail2 = _add_replica(tail, 0, "tail-1")
+    _assert_same(rs.solve(tail2), cold.solve(tail2), "recovered-resume")
+    assert inner.stats["resume_solves"] == 1, inner.stats
