@@ -312,6 +312,33 @@ SOLVER_RUNS_SKIPPED = REGISTRY.register(
         "re-executed)",
     )
 )
+# on-device decode + relax ladder series (ISSUE 6 — same naming rule as
+# the resume series: no _tpu segment, bench trajectory keys match)
+SOLVER_WIDE_REFETCH = REGISTRY.register(
+    Counter(
+        "karpenter_solver_wide_refetch_total",
+        "Device solves whose packed claim-delta overflowed uint16 (value "
+        ">65535 or entry count over capacity) and fell back to fetching "
+        "the full dense take tables — the double-fetch carve-out of the "
+        "on-device decode path (solver/backend.py _pack_dispatch)",
+    )
+)
+SOLVER_DECODE_BYTES = REGISTRY.register(
+    Gauge(
+        "karpenter_solver_decode_bytes_per_solve",
+        "Device→host result bytes fetched by the last device solve "
+        "(packed claim-delta when --solver-device-decode is on; dense "
+        "take tables otherwise or after a wide re-fetch)",
+    )
+)
+SOLVER_RELAX_DISPATCHES = REGISTRY.register(
+    Gauge(
+        "karpenter_solver_relax_dispatches_per_solve",
+        "Kernel dispatches the last preference-relaxation solve needed: "
+        "1 on the device-resident ladder path, ~rungs on the host-driven "
+        "redispatch loop (solver/backend.py _relax_solve)",
+    )
+)
 CONTROLLER_ERRORS = REGISTRY.register(
     Counter(
         "karpenter_controller_errors_total",

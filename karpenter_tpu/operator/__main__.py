@@ -73,7 +73,9 @@ def main(argv=None) -> int:
     log = logging.getLogger("karpenter_tpu")
     solver = (
         TPUSolver(arena=o.solver_arena, resume=o.solver_resume,
-                  ckpt_every=o.resume_checkpoint_interval)
+                  ckpt_every=o.resume_checkpoint_interval,
+                  device_decode=o.solver_device_decode,
+                  relax_ladder=o.solver_relax_ladder)
         if o.solver_backend == "tpu"
         else ReferenceSolver()
     )

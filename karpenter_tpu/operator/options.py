@@ -58,6 +58,16 @@ class Options:
     # startup): smaller catches mid-list mutations closer to the change at
     # the cost of more HBM snapshot writes per solve
     resume_checkpoint_interval: int = 16
+    # on-device decode (solver/SPEC.md "Decode & ladder semantics"): device
+    # solves fetch a packed uint16 claim-delta instead of the dense take
+    # tables, with an overflow-flag wide re-fetch carve-out; false = every
+    # solve fetches the full O(S×E + S×M) tables (debug escape hatch)
+    solver_device_decode: bool = True
+    # device-resident relax ladder: preference-relaxation rungs are
+    # pre-materialized at encode time and one kernel dispatch scans them,
+    # committing the first rung at which each failing pod places; false =
+    # the host redispatches once per dropped preference (_relax_solve loop)
+    solver_relax_ladder: bool = True
     # pipelined solve service (solver/pipeline.py): one device owner, host
     # encode / device compute / host decode of independent solves overlap,
     # provisioning snapshots coalesce on newer cluster-state revisions;
@@ -161,4 +171,20 @@ def parse(argv: Optional[Sequence[str]] = None, cls=Options) -> Options:
             f"(got {interval}); it is the number of FFD scan steps between "
             "checkpoint-ring snapshots (operator/options.py)"
         )
+    # decode/ladder knob sanity: these gate correctness-critical solver
+    # paths, so a typo'd env value ("ture", "on") must not silently become
+    # False and mask the fast path being off in prod — fail closed like the
+    # resume interval above instead of inheriting bool()'s permissiveness.
+    for name in ("solver_device_decode", "solver_relax_ladder"):
+        if not hasattr(out, name):
+            continue
+        env = os.environ.get(_env_name(name))
+        if env is not None and env.lower() not in (
+            "1", "true", "yes", "0", "false", "no",
+        ):
+            raise SystemExit(
+                f"refusing to start: {_env_name(name)}={env!r} is not a "
+                "recognized boolean (use 1/true/yes or 0/false/no); "
+                "guessing here would silently disable a solver fast path"
+            )
     return out

@@ -51,6 +51,7 @@ import numpy as np
 
 from ..metrics.registry import (
     SOLVER_ARENA_HIT_RATE,
+    SOLVER_DECODE_BYTES,
     SOLVER_UPLOAD_ARRAYS,
     SOLVER_UPLOAD_BYTES,
 )
@@ -109,6 +110,12 @@ class TransferLedger:
         n = sum(self.outcomes.values())
         return self.outcomes["exact_hit"] / n if n else 0.0
 
+    @property
+    def decode_bytes_per_solve(self) -> float:
+        """Average device→host result-fetch bytes per solve — the number the
+        on-device decode (backend delta packing) is meant to shrink."""
+        return self.total["d2h_bytes"] / self.solves if self.solves else 0.0
+
     def end_solve(self) -> Dict[str, int]:
         """Close the per-solve window: push gauges, return its counters."""
         with self._lock:
@@ -116,6 +123,7 @@ class TransferLedger:
         SOLVER_UPLOAD_BYTES.set(snap["h2d_bytes"])
         SOLVER_UPLOAD_ARRAYS.set(snap["h2d_arrays"])
         SOLVER_ARENA_HIT_RATE.set(self.arena_hit_rate)
+        SOLVER_DECODE_BYTES.set(snap["d2h_bytes"])
         return snap
 
     def snapshot(self) -> Dict[str, object]:
@@ -201,6 +209,11 @@ class ArgumentArena:
         # kernel) match the solve that produced it.
         self._ckpts: Dict[tuple, list] = {}
         self.max_ckpts_per_bucket = 1
+        # relax-ladder residency class (backend._ladder_arg): per-bucket
+        # device-resident run_ladder tables, keyed on content digest — the
+        # same preference fleet re-solving reuses the rung table with zero
+        # upload. Dies with the bucket on invalidate(), like checkpoints.
+        self._ladders: Dict[tuple, Tuple[bytes, object]] = {}
         # ARG_SPEC indices the LAST adopt actually uploaded (() on an exact
         # hit) — observability for tests/bench; checkpoint prefix validity
         # uses context_signature() instead (robust to pipelined dispatches
@@ -219,6 +232,7 @@ class ArgumentArena:
         pays one full packed upload and the next solve runs cold."""
         self._buckets.clear()
         self._ckpts.clear()
+        self._ladders.clear()
         self.last_stale = ()
         self.stats["invalidations"] += 1
 
@@ -234,6 +248,19 @@ class ArgumentArena:
 
     def get_checkpoints(self, key: tuple) -> list:
         return self._ckpts.get(key, [])
+
+    def put_ladder(self, key: tuple, host_table: np.ndarray, dev) -> None:
+        """Record a bucket's device-resident relax-ladder table (one per
+        bucket — a bucket's preference fleet has one current rung layout)."""
+        self._ladders[(key, host_table.shape)] = (_digest(host_table), dev)
+
+    def get_ladder(self, key: tuple, host_table: np.ndarray):
+        """The bucket's resident ladder table if its content matches, else
+        None (the caller uploads and re-records)."""
+        rec = self._ladders.get((key, host_table.shape))
+        if rec is None or rec[0] != _digest(host_table):
+            return None
+        return rec[1]
 
     def context_signature(self, key: tuple, exclude: tuple = ()) -> Optional[tuple]:
         """Content signature of the bucket's resident entries OUTSIDE

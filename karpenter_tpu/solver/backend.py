@@ -31,9 +31,12 @@ from ..provisioning.scheduler import (
 )
 from ..scheduling.requirements import IN, Requirement, Requirements
 from ..metrics.registry import (
+    SOLVER_DECODE_BYTES,
+    SOLVER_RELAX_DISPATCHES,
     SOLVER_RESUME_HIT_RATE,
     SOLVER_RUNS_SKIPPED,
     SOLVER_SOLVES,
+    SOLVER_WIDE_REFETCH,
 )
 from ..utils.resources import PODS, Resources
 from .encode import EncodedInput, UnpackableInput, encode, quantize_input
@@ -567,6 +570,89 @@ def _pack_outputs_wide(out):
     return fn(out)
 
 
+DELTA_CAP_QUANTUM = 256  # entry-capacity bucket, bounds compile variants
+DELTA_UNIQ_QUANTUM = 16  # unique claim-meta row capacity bucket
+
+
+def delta_capacity(total_pods: int, Sp: int, Ep: int, Mb: int) -> int:
+    """Entry capacity of the claim-delta buffer (SPEC.md "Decode & ladder
+    semantics"). Every nonzero take entry accounts for ≥ 1 placed pod, so
+    `total_pods` is a hard ceiling, and Sp·(Ep+Mb) is the structural one;
+    the steady-state heuristic Sp + 2·Ep + 4·Mb (one entry per run, a
+    couple of runs per existing node, a handful of pouring runs per claim
+    — measured ~3.6 on the 50k surge bench) is far tighter for surge
+    fleets, where runs are large and few. A solve that genuinely exceeds
+    the capacity trips the overflow flag and re-fetches full width —
+    correctness never depends on the bound."""
+    need = min(total_pods, Sp + 2 * Ep + 4 * Mb, Sp * (Ep + Mb))
+    q = DELTA_CAP_QUANTUM
+    return max(q, ((need + q - 1) // q) * q)
+
+
+def delta_uniq_capacity(Sp: int, Mb: int) -> int:
+    """Unique claim-meta row capacity. Distinct rows track deployment
+    waves (~runs), not claims — claims of one wave differ only in c_cum,
+    which never crosses the link (the host rebuilds it from the entries).
+    Sp + 48 leaves ~50% headroom over the measured 50k surge (52 rows at
+    32 runs: each wave contributes its full-claim mask plus a partial-fill
+    variant); genuine excess trips the overflow re-fetch."""
+    q = DELTA_UNIQ_QUANTUM
+    need = min(Mb, Sp + 48)
+    return max(q, ((need + q - 1) // q) * q)
+
+
+def _pack_outputs_delta(out, cap: int, cap_u: int):
+    """Delta packing: same single-buffer discipline as _pack_outputs, but
+    (a) the take tables travel as the on-device compaction's run-major
+    (code, count) uint16 pairs plus per-run entry counts — the dominant
+    O(S×E + S×M) term of the fetch drops to O(actual placements); (b) the
+    per-claim identity rows (type-mask words, zone/ct bits, group bits,
+    pool) are deduped on device into a unique-row table + uint16 ids; and
+    (c) c_cum never crosses the link at all — the host rebuilds it from
+    the entries (pool daemon base + take × group_req, _claim_cum_from_
+    entries). Header [overflow, n, n_u] leads; overflow covers >65535
+    takes, entry-count saturation, AND unique-row saturation — all
+    re-fetched full-width by the host."""
+    import jax
+    import jax.numpy as jnp
+
+    from .tpu.ffd import compact_claim_meta, compact_takes
+
+    def go(out):
+        st = out.state
+        M, Tp = st.c_mask.shape
+        W = (Tp + 31) // 32
+        cm = jnp.pad(st.c_mask, ((0, 0), (0, W * 32 - Tp))).reshape(M, W, 32)
+        weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+        cm_words = (cm.astype(jnp.uint32) * weights[None, None, :]).sum(
+            axis=2, dtype=jnp.uint32
+        )
+        overflow_t, n, cnt16, pairs = compact_takes(
+            out.take_e, out.take_c, cap
+        )
+        overflow_u, n_u, uniq, mid16 = compact_claim_meta(
+            cm_words, st.c_zc_bits, st.c_gbits, st.c_pool, cap_u
+        )
+        parts = [
+            (overflow_t | overflow_u).reshape(1),
+            n.reshape(1),
+            n_u.reshape(1),
+            cnt16.ravel(),
+            pairs.ravel(),
+            out.leftover.ravel(),
+            uniq.ravel(),
+            mid16.ravel(),
+            st.used.reshape(1),
+        ]
+        return jnp.concatenate(parts)
+
+    fn = _PACK_CACHE.get(("delta", cap, cap_u))
+    if fn is None:
+        fn = jax.jit(go)
+        _PACK_CACHE[("delta", cap, cap_u)] = fn
+    return fn(out)
+
+
 def _unpack_flat(flat: np.ndarray, shapes: dict) -> dict:
     """Host-side inverse of _pack_outputs; `shapes` carries the device-side
     array shapes (known locally from the output metadata, no transfer)."""
@@ -617,7 +703,8 @@ class TPUSolver(Solver):
 
     def __init__(self, max_claims: int = 1024, fallback: Optional[Solver] = None,
                  arena: bool = True, resume: bool = True,
-                 ckpt_every: int = 16, ckpt_slots: int = 4):
+                 ckpt_every: int = 16, ckpt_slots: int = 4,
+                 device_decode: bool = True, relax_ladder: bool = True):
         self.max_claims = max_claims
         if fallback is None:
             # fallback chain: native C++ core (compiled-class speed), which
@@ -630,7 +717,17 @@ class TPUSolver(Solver):
         self.stats: Dict[str, int] = {
             "device_solves": 0, "fallback_solves": 0,
             "resume_solves": 0, "resume_runs_skipped": 0,
+            "wide_refetches": 0, "ladder_solves": 0,
+            "relax_dispatches": 0, "ladder_rungs_used": 0,
         }
+        # on-device decode (tpu/ffd.compact_takes + decode_delta): fetch the
+        # take tables as a packed claim-delta instead of dense grids;
+        # false = dense uint16 packing (debug escape hatch / parity oracle)
+        self.device_decode = bool(device_decode)
+        # device-resident relax ladder (ffd_solve_ladder): fold the host
+        # relax-and-redispatch loop into one kernel dispatch when every
+        # laddered run is homogeneous; false = host loop (`_relax_solve`)
+        self.relax_ladder = bool(relax_ladder)
         # device-resident argument arena + transfer accounting (solver/
         # arena.py): arena=False restores the per-array upload path (debug
         # escape hatch, `--solver-arena false`); the ledger counts either way
@@ -690,6 +787,16 @@ class TPUSolver(Solver):
                     if not p.scheduling_gated and p.node_name is None
                 ]
             )
+            if self.relax_ladder:
+                # device-resident ladder: rungs pre-materialized as ghost
+                # groups, ONE dispatch walks them in-kernel — decision-
+                # identical to the host loop (see _ladder_dispatch). Bails
+                # to the host loop for mixed-ladder runs / fallback classes.
+                lad = self._ladder_dispatch(qinp, relax_plan, order)
+                if lad is not None:
+                    return AsyncSolve(
+                        lambda: self._ladder_finish(qinp, relax_plan, order, lad)
+                    )
             dropped = {u: 0 for u in relax_plan}
             first = self._relax_dispatch(qinp, relax_plan, order, dropped)
             return AsyncSolve(
@@ -767,6 +874,7 @@ class TPUSolver(Solver):
         oracle is by induction: pods before the relaxed one replay
         identically, the relaxed pod retries under the same state."""
         budget = 1 + sum(len(v) for v in items_map.values())
+        n_disp = 0
         for it in range(budget):
             disp = first if (it == 0 and first is not None) else (
                 self._relax_dispatch(qinp, items_map, order, dropped)
@@ -774,6 +882,7 @@ class TPUSolver(Solver):
             if disp is None:
                 break
             minp, enc, handle = disp
+            n_disp += 1
             out = handle()
             if out is None or not min_values_post_check(minp, out):
                 break
@@ -786,7 +895,12 @@ class TPUSolver(Solver):
                     break
             if cand is None:
                 self.stats["device_solves"] += 1
+                self.stats["relax_dispatches"] = n_disp
+                self.stats["ladder_rungs_used"] = max(
+                    dropped.values(), default=0
+                )
                 SOLVER_SOLVES.inc(backend="device")
+                SOLVER_RELAX_DISPATCHES.set(float(n_disp))
                 # per-pod relaxation SPLITS original runs (a relaxed pod's
                 # materialized signature differs from its unrelaxed twins),
                 # so canonicalize fungible-pod assignments over the ORIGINAL
@@ -795,6 +909,257 @@ class TPUSolver(Solver):
             dropped[cand] += 1
         self.stats["fallback_solves"] += 1
         return self.fallback.solve(qinp)
+
+    # -- device-resident relax ladder ---------------------------------------
+
+    def _ladder_dispatch(self, qinp, items_map, order):
+        """Pre-materialize the whole relax ladder and dispatch it as ONE
+        kernel launch (ffd_solve_ladder), instead of the host loop's
+        dispatch-per-dropped-preference.
+
+        Construction: level-0 materializations of the ordered pods form the
+        base runs — identical to the host loop's first iteration. For every
+        run whose pods share one ladder (the same (weight, kind, idx) item
+        list — relax.py's ORIGINAL-order invariant makes the drop order a
+        pure function of it), one GHOST pod per rung l ≥ 1 — the run's
+        representative re-materialized with its l lowest-weight preferences
+        dropped — is appended AFTER the originals. encode() then interns the
+        rung's group tables (signature interning merges a rung with any
+        same-spec native group, exactly as the host loop's re-encode
+        would), but the run axis is truncated to the original runs before
+        dispatch, so a ghost never pours. run_ladder[s, l-1] carries rung
+        l's group id, -1 past the run's ladder.
+
+        Decision identity with the host loop, by induction over the scan:
+        the host loop drops one preference of the FIRST failing pod per
+        redispatch, and every pod before it replays identically (prefix
+        stability), so each pod individually walks rungs 0..L until it
+        places or exhausts, retrying from rung 0 after any placement (a
+        rung placement can open a claim its unrelaxed twins join on the
+        host loop's next redispatch). That is exactly the kernel cascade;
+        failed attempts never mutate the carry, and identical pods fail
+        identically once one exhausts, so the cascade commits the same
+        leftovers without re-walking each twin.
+
+        Returns an in-flight dispatch record, or None to use the host loop:
+        a run mixing different ladders (a natively-hard pod whose level-0
+        signature collides with a materialized one), a ghost signature
+        merging into the last original run, a fallback-class encode, or
+        unpackable kernel args."""
+        import dataclasses
+
+        from . import relax as rx
+        from .encode import _pod_signature
+
+        pods0 = [
+            rx.materialize_pod(p, items_map[p.meta.uid], 0)
+            if p.meta.uid in items_map
+            else p
+            for p in order
+        ]
+        n_orig = len(pods0)
+        if n_orig == 0:
+            return None
+        sigs = [_pod_signature(p) for p in pods0]
+        runs: List[List[int]] = []  # [start, count]
+        for i, sg in enumerate(sigs):
+            if runs and sg == sigs[i - 1]:
+                runs[-1][1] += 1
+            else:
+                runs.append([i, 1])
+        ladders = []
+        for start, cnt in runs:
+            keys = {
+                tuple(
+                    (w, k, ix)
+                    for (w, k, _t, ix) in items_map.get(order[j].meta.uid, ())
+                )
+                for j in range(start, start + cnt)
+            }
+            if len(keys) != 1:
+                return None  # mixed ladder within one run — host loop
+            ladders.append(next(iter(keys)))
+        ghosts = []
+        ghost_of = []  # (run_idx, rung_level) per ghost
+        for ri, (start, cnt) in enumerate(runs):
+            items = items_map.get(order[start].meta.uid, ())
+            if not items:
+                continue
+            rep = order[start]
+            for lvl in range(1, len(items) + 1):
+                gp = rx.materialize_pod(rep, items, lvl)
+                gp = dataclasses.replace(
+                    gp,
+                    meta=dataclasses.replace(
+                        gp.meta,
+                        name=f"~rung-{lvl}-{rep.meta.name}",
+                        uid=f"~rung:{rep.meta.uid}:{lvl}",
+                    ),
+                )
+                ghosts.append(gp)
+                ghost_of.append((ri, lvl))
+        if not ghosts:
+            return None
+        minp = dataclasses.replace(qinp, pods=pods0 + ghosts, presorted=True)
+        enc = encode(minp)
+        if (
+            enc.group_fallback.any()
+            or enc.has_topology
+            or enc.has_affinity
+            or enc.G == 0
+        ):
+            return None
+        rc = np.asarray(enc.run_count)
+        rg = np.asarray(enc.run_group)
+        cum = np.cumsum(rc)
+        bidx = int(np.searchsorted(cum, n_orig))
+        if bidx >= len(rc) or int(cum[bidx]) != n_orig:
+            return None  # a ghost merged into the last original run
+        S_orig = bidx + 1
+        if S_orig != len(runs) or not np.array_equal(
+            rc[:S_orig], np.asarray([c for _, c in runs], dtype=rc.dtype)
+        ):
+            return None  # encode split the originals differently
+        if str(enc.sorted_uids[n_orig]) != ghosts[0].meta.uid:
+            return None  # presorted order not preserved — don't guess
+        pod_run = np.repeat(np.arange(len(rc)), rc)
+        Lmax = max(len(l) for l in ladders)
+        Lp = self._bucket(Lmax, 2, 2)
+        ladder_rows = np.full((S_orig, Lp), -1, np.int32)
+        for j, (ri, lvl) in enumerate(ghost_of):
+            ladder_rows[ri, lvl - 1] = rg[pod_run[n_orig + j]]
+        # truncated view: run axis = original runs only; the group axis (and
+        # group_pods, for decode's requirement unions) keeps the rung groups
+        enc2 = dataclasses.replace(
+            enc,
+            run_group=np.ascontiguousarray(rg[:S_orig]),
+            run_count=np.ascontiguousarray(rc[:S_orig]),
+            sorted_uids=enc.sorted_uids[:n_orig],
+        )
+        try:
+            host_args, dims, prov = host_kernel_args(enc2, self._bucket)
+        except UnpackableInput:
+            return None
+        self.ledger.begin_solve()
+        if self.arena is not None:
+            args = self.arena.adopt(host_args, prov)
+        else:
+            args = _device_args(host_args, prov, ledger=self.ledger)
+        Sp = int(host_args[0].shape[0])
+        lad_host = np.full((Sp, Lp), -1, np.int32)
+        lad_host[:S_orig] = ladder_rows
+        dev_lad = self._ladder_arg(host_args, lad_host)
+        M0 = initial_claim_bucket(n_orig, self.max_claims)
+        flat_dev, unpack, _ = self._ladder_kernel(enc2, dev_lad, args, M0,
+                                                  n_orig)
+        return {
+            "enc": enc2,
+            "args": args,
+            "dev_lad": dev_lad,
+            "flat_dev": flat_dev,
+            "unpack": unpack,
+            "dims": dims,
+            "M0": M0,
+            "n_orig": n_orig,
+            "rungs": int(Lmax),
+        }
+
+    def _ladder_arg(self, host_args, lad_host: np.ndarray):
+        """Upload (or reuse) the run_ladder table. Ladder rungs are a
+        per-bucket arena residency class like checkpoints (solver/arena.py
+        _ladders): keyed by the arg bucket + a content digest, dropped by
+        invalidate() together with buffers and the checkpoint ring — a
+        fallback replay can never reuse a stale ladder."""
+        import jax
+
+        if self.arena is not None:
+            key = self.arena.bucket_key(host_args)
+            dev = self.arena.get_ladder(key, lad_host)
+            if dev is not None:
+                return dev
+            dev = jax.device_put(lad_host)
+            self.ledger.record_upload(lad_host.nbytes, 1, msgs=1)
+            self.arena.put_ladder(key, lad_host, dev)
+            return dev
+        dev = jax.device_put(lad_host)
+        self.ledger.record_upload(lad_host.nbytes, 1, msgs=1)
+        return dev
+
+    def _ladder_kernel(self, enc: EncodedInput, dev_lad, args, M: int,
+                       n_orig: int):
+        from .tpu.ffd import ffd_solve_ladder
+
+        faults.check("solver.device_dispatch")
+        out = ffd_solve_ladder(dev_lad, *args, max_claims=M,
+                               zone_engine=enc.V > 0)
+        flat_dev, unpack = self._pack_dispatch(out, total_pods=n_orig)
+        return flat_dev, unpack, out
+
+    def _ladder_finish(self, qinp: SolverInput, items_map, order,
+                       lad) -> SolverResult:
+        """Fetch + decode the ladder dispatch. Any failure to stand the
+        result up (claim overflow past max_claims, min-values violation)
+        replays on the host relax loop, which itself degrades to the
+        fallback chain — the ladder only ever SHORTCUTS the host loop."""
+        enc, dims = lad["enc"], lad["dims"]
+        res = None
+        try:
+            M = lad["M0"]
+            up = lad["unpack"]
+            flat = np.asarray(lad["flat_dev"])
+            self.ledger.record_fetch(flat.nbytes)
+            f = None
+            while True:
+                f = up(flat)
+                used = int(f["used"])
+                if used < M:
+                    break
+                if M >= self.max_claims:
+                    f = None  # true overflow — host loop replay
+                    break
+                M = min(M * 2, self.max_claims)
+                fd, up, _ = self._ladder_kernel(
+                    enc, lad["dev_lad"], lad["args"], M, lad["n_orig"]
+                )
+                flat = np.asarray(fd)
+                self.ledger.record_fetch(flat.nbytes)
+            if f is not None:
+                faults.check("solver.decode")
+                S, E = dims["S"], dims["E"]
+                T, G, Z, C = dims["T"], dims["G"], dims["Z"], dims["C"]
+                c_mask = _unpack_words(f["c_mask_words"], T)
+                c_zone, c_ct = unpack_zc_bits(f["c_zc_bits"], Z, C)
+                c_gmask = _unpack_gmask(f["c_gbits"], G)
+                if "entries" in f:
+                    # rung pours charge the base group's requests (relaxation
+                    # drops preferences, never resources), so the c_cum
+                    # rebuild over run_group is exact on the ladder too
+                    c_cum = _claim_cum_from_entries(
+                        enc, f["entries"], f["c_pool"], f["Ep"], M
+                    )
+                    res = decode_delta(
+                        enc, f["entries"], f["leftover"][:S], E, f["Ep"],
+                        c_mask, c_zone, c_ct, f["c_pool"], c_gmask,
+                        c_cum, used,
+                    )
+                else:
+                    res = decode(
+                        enc, f["take_e"][:S, :E], f["take_c"][:S],
+                        f["leftover"][:S], c_mask, c_zone, c_ct,
+                        f["c_pool"], c_gmask, f["c_cum"], used,
+                    )
+        finally:
+            self.ledger.end_solve()
+        if res is not None and min_values_post_check(qinp, res):
+            self.stats["device_solves"] += 1
+            self.stats["ladder_solves"] += 1
+            self.stats["relax_dispatches"] = 1
+            self.stats["ladder_rungs_used"] = lad["rungs"]
+            SOLVER_SOLVES.inc(backend="device")
+            SOLVER_RELAX_DISPATCHES.set(1.0)
+            return canonicalize_placements(qinp, res)
+        dropped = {u: 0 for u in items_map}
+        return self._relax_solve(qinp, items_map, order, dropped, None)
 
     def warmup(self, instance_types, zones, capacity_types=("on-demand", "spot"),
                pod_presets=(12, 600), with_zone_spread=True) -> int:
@@ -991,7 +1356,8 @@ class TPUSolver(Solver):
         avoids recompilation storms)."""
         return max(floor, ((n + mult - 1) // mult) * mult)
 
-    def _dispatch(self, enc: EncodedInput, args, M: int, harvest: bool = False):
+    def _dispatch(self, enc: EncodedInput, args, M: int, harvest: bool = False,
+                  total_pods: Optional[int] = None):
         """Dispatch kernel + output packing; start the device→host copy.
         Returns (flat_device_array, unpack_fn, out, ring). `harvest` (and
         the resume knob) selects ffd_solve_ckpt so the solve also produces
@@ -1008,14 +1374,20 @@ class TPUSolver(Solver):
             )
         else:
             out = ffd_solve(*args, max_claims=M, zone_engine=enc.V > 0)
-        flat_dev, unpack = self._pack_dispatch(out)
+        flat_dev, unpack = self._pack_dispatch(out, total_pods=total_pods)
         return flat_dev, unpack, out, ring
 
-    def _pack_dispatch(self, out):
+    def _pack_dispatch(self, out, total_pods: Optional[int] = None):
         # ONE device→host transfer: all outputs packed into a single
         # int32 buffer on device (bit-packed masks, uint16 takes), so the
         # tunnel pays one roundtrip per solve — not one per output array
         # (VERDICT r2 'what's weak' #1: 9 sync fetches dominated the seam).
+        # With the device-decode knob on (and a known pod count), the take
+        # tables additionally compact on device to a claim-delta (tpu/
+        # ffd.compact_takes) — O(actual placements) uint16 instead of
+        # O(S×E + S×M) — with the overflow flag re-fetching wide. uint16
+        # run/code coding caps the delta path at 65535 runs and a combined
+        # node+claim axis of 65536; larger shapes keep the dense packing.
         Sp, Ep = out.take_e.shape
         Mb, Tp = out.state.c_mask.shape
         Wm = (Tp + 31) // 32
@@ -1033,41 +1405,114 @@ class TPUSolver(Solver):
             "c_cum": ((Mb, Rr), "i32"),
             "used": ((), "i32"),
         }
+        tail_shapes = {
+            "leftover": ((Sp,), "i32"),
+            "c_mask_words": ((Mb, Wm), "u32"),
+            "c_zc_bits": ((Mb,), "u32"),
+            "c_gbits": ((Mb, Wg), "u32"),
+            "c_pool": ((Mb,), "i32"),
+            "c_cum": ((Mb, Rr), "i32"),
+            "used": ((), "i32"),
+        }
 
         ledger = self.ledger
+        use_delta = (
+            self.device_decode
+            and total_pods is not None
+            and Sp <= 65535
+            and Ep + Mb <= 65535
+        )
 
-        def unpack(flat: np.ndarray) -> dict:
-            if flat[0]:  # take overflowed uint16 — re-fetch full width (rare)
-                wide = np.asarray(_pack_outputs_wide(out))
-                ledger.record_fetch(wide.nbytes)
-                return _unpack_flat(wide, wide_shapes)
-            off = 1
-            f = {}
-            for name, (sh, n) in (
-                ("take_e", ((Sp, Ep), Sp * Ep)),
-                ("take_c", ((Sp, Mb), Sp * Mb)),
-            ):
-                w = (n + 1) // 2
-                f[name] = (
-                    flat[off : off + w]
-                    .view(np.uint16)[:n]
-                    .astype(np.int32)
-                    .reshape(sh)
+        if use_delta:
+            cap = delta_capacity(total_pods, Sp, Ep, Mb)
+            cap_u = delta_uniq_capacity(Sp, Mb)
+            Wt = Wm + 1 + Wg + 1  # meta row: cm_words ++ zc ++ gbits ++ pool
+
+            def unpack(flat: np.ndarray) -> dict:
+                if flat[0]:  # uint16/capacity overflow — re-fetch wide (rare)
+                    SOLVER_WIDE_REFETCH.inc()
+                    self.stats["wide_refetches"] += 1
+                    wide = np.asarray(_pack_outputs_wide(out))
+                    ledger.record_fetch(wide.nbytes)
+                    return _unpack_flat(wide, wide_shapes)
+                n = int(flat[1])
+                off = 3
+                cnt = flat[off : off + Sp // 2].view(np.uint16)[:Sp]
+                off += Sp // 2
+                pairs = (
+                    flat[off : off + cap].view(np.uint16).reshape(cap, 2)
                 )
-                off += w
-            rest = {
-                "leftover": ((Sp,), "i32"),
-                "c_mask_words": ((Mb, Wm), "u32"),
-                "c_zc_bits": ((Mb,), "u32"),
-                "c_gbits": ((Mb, Wg), "u32"),
-                "c_pool": ((Mb,), "i32"),
-                "c_cum": ((Mb, Rr), "i32"),
-                "used": ((), "i32"),
-            }
-            f.update(_unpack_flat(flat[off:], rest))
-            return f
+                off += cap
+                leftover = flat[off : off + Sp]
+                off += Sp
+                uniq = (
+                    flat[off : off + cap_u * Wt]
+                    .view(np.uint32)
+                    .reshape(cap_u, Wt)
+                )
+                off += cap_u * Wt
+                mid = flat[off : off + Mb // 2].view(np.uint16)[:Mb]
+                off += Mb // 2
+                used = flat[off]
+                # entries: run-major (code, count) pairs + per-run counts
+                # rebuild the run column with one repeat
+                s_col = np.repeat(
+                    np.arange(Sp, dtype=np.int64), cnt.astype(np.int64)
+                )
+                entries = np.stack(
+                    [
+                        s_col,
+                        pairs[:n, 0].astype(np.int64),
+                        pairs[:n, 1].astype(np.int64),
+                    ],
+                    axis=1,
+                )
+                # expand the deduped claim-identity rows back to [Mb]
+                meta = uniq[np.minimum(mid.astype(np.int64), cap_u - 1)]
+                c_pool = (
+                    np.ascontiguousarray(meta[:, Wt - 1]).view(np.int32)
+                )
+                return {
+                    "entries": entries,
+                    "Ep": Ep,
+                    "leftover": leftover,
+                    "c_mask_words": meta[:, :Wm],
+                    "c_zc_bits": np.ascontiguousarray(meta[:, Wm]),
+                    "c_gbits": np.ascontiguousarray(
+                        meta[:, Wm + 1 : Wm + 1 + Wg]
+                    ),
+                    "c_pool": c_pool,
+                    "used": used,
+                }
 
-        flat_dev = _pack_outputs(out)
+            flat_dev = _pack_outputs_delta(out, cap, cap_u)
+        else:
+
+            def unpack(flat: np.ndarray) -> dict:
+                if flat[0]:  # take overflowed uint16 — re-fetch full width
+                    SOLVER_WIDE_REFETCH.inc()
+                    self.stats["wide_refetches"] += 1
+                    wide = np.asarray(_pack_outputs_wide(out))
+                    ledger.record_fetch(wide.nbytes)
+                    return _unpack_flat(wide, wide_shapes)
+                off = 1
+                f = {}
+                for name, (sh, n) in (
+                    ("take_e", ((Sp, Ep), Sp * Ep)),
+                    ("take_c", ((Sp, Mb), Sp * Mb)),
+                ):
+                    w = (n + 1) // 2
+                    f[name] = (
+                        flat[off : off + w]
+                        .view(np.uint16)[:n]
+                        .astype(np.int32)
+                        .reshape(sh)
+                    )
+                    off += w
+                f.update(_unpack_flat(flat[off:], tail_shapes))
+                return f
+
+            flat_dev = _pack_outputs(out)
         try:
             flat_dev.copy_to_host_async()
         except AttributeError:
@@ -1102,11 +1547,12 @@ class TPUSolver(Solver):
         plan = self._plan_resume(enc, host_args, M0, S)
         if plan is not None:
             flat_dev, unpack, out, ring = self._dispatch_resume(
-                enc, args, host_args, plan, M0, S
+                enc, args, host_args, plan, M0, S, total_pods=total_pods
             )
         else:
-            flat_dev, unpack, out, ring = self._dispatch(enc, args, M0,
-                                                         harvest=True)
+            flat_dev, unpack, out, ring = self._dispatch(
+                enc, args, M0, harvest=True, total_pods=total_pods
+            )
 
         def finish() -> Optional[SolverResult]:
             try:
@@ -1129,7 +1575,7 @@ class TPUSolver(Solver):
                         return None  # true overflow — replay on fallback
                     M = min(M * 2, self.max_claims)
                     fd, up, cur_out, cur_ring = self._dispatch(
-                        enc, args, M, harvest=True
+                        enc, args, M, harvest=True, total_pods=total_pods
                     )
                     flat = np.asarray(fd)
                     self.ledger.record_fetch(flat.nbytes)
@@ -1137,6 +1583,50 @@ class TPUSolver(Solver):
                 c_mask = _unpack_words(f["c_mask_words"], T)
                 c_zone, c_ct = unpack_zc_bits(f["c_zc_bits"], Z, C)
                 c_gmask = _unpack_gmask(f["c_gbits"], G)
+                if "entries" in f:
+                    # delta-decoded fetch: the take tables never crossed the
+                    # link. A resumed dispatch splices the donor's recorded
+                    # dense prefix rows in as triples (suffix runs shift by
+                    # k); decode_delta rebuilds decode()'s exact codes
+                    # stream from the merged entry set.
+                    Ep_ = f["Ep"]
+                    if cur_plan is not None:
+                        k = cur_plan["k"]
+                        rec = cur_plan["rec"]
+                        pre = _entries_from_dense(
+                            rec["take_e"][:k], rec["take_c"][:k], Ep_
+                        )
+                        suf = f["entries"].astype(np.int64)
+                        suf[:, 0] += k
+                        entries_p = np.concatenate([pre, suf])
+                        leftover_p = np.concatenate(
+                            [rec["leftover"][:k], f["leftover"][: S - k]]
+                        )
+                        self.stats["resume_solves"] += 1
+                        self.stats["resume_runs_skipped"] += k
+                        SOLVER_RUNS_SKIPPED.inc(k)
+                    else:
+                        entries_p = f["entries"]
+                        leftover_p = f["leftover"][:S]
+                    c_cum = _claim_cum_from_entries(
+                        enc, entries_p, f["c_pool"], Ep_, M
+                    )
+                    res = decode_delta(enc, entries_p, leftover_p, E, Ep_,
+                                       c_mask, c_zone, c_ct, f["c_pool"],
+                                       c_gmask, c_cum, used)
+                    if self.resume:
+                        # the resume donor record stays DENSE (its stitching
+                        # contract predates the delta path); reconstruct the
+                        # rows host-side — same bytes a dense fetch carries
+                        take_e_p, take_c_p = _dense_from_entries(
+                            entries_p, S, Ep_, M
+                        )
+                        self._record_checkpoint(
+                            enc, host_args, M, S, cur_plan, cur_out,
+                            cur_ring, take_e_p, take_c_p, leftover_p,
+                        )
+                    SOLVER_RESUME_HIT_RATE.set(self.resume_hit_rate)
+                    return res
                 if cur_plan is not None:
                     # suffix dispatch: rows [0:k] of the full take tables are
                     # the donor record's (decision-identical by construction —
@@ -1241,7 +1731,7 @@ class TPUSolver(Solver):
         return {"k": k, "init": init, "rec": rec, "key": key, "ctx_sig": ctx}
 
     def _dispatch_resume(self, enc: EncodedInput, args, host_args, plan,
-                         M: int, S: int):
+                         M: int, S: int, total_pods: Optional[int] = None):
         """Dispatch only runs[k:] on top of the planned checkpoint. The 34
         non-run args are the arena-resident buffers (zero upload — the
         unchanged prefix ships nothing); only the two tiny suffix run
@@ -1265,7 +1755,7 @@ class TPUSolver(Solver):
             max_claims=M, zone_engine=enc.V > 0,
             ckpt_every=self.ckpt_every, n_ckpt=self.ckpt_slots,
         )
-        flat_dev, unpack = self._pack_dispatch(out)
+        flat_dev, unpack = self._pack_dispatch(out, total_pods=total_pods)
         return flat_dev, unpack, out, ring
 
     def _ring_coverage(self, Sp: int, S_real: int, base: int):
@@ -1375,7 +1865,127 @@ def decode(
         if parts:
             segs.append(np.concatenate([p.astype(np.int64, copy=False) for p in parts]))
     codes = np.concatenate(segs) if segs else np.zeros(0, np.int64)
+    return _decode_from_codes(
+        enc, codes, E, c_mask, c_zone, c_ct, c_pool, c_gmask, c_cum, used
+    )
 
+
+def decode_delta(
+    enc: EncodedInput,
+    entries: np.ndarray,  # [n, 3] int32 (run, code, count), code = e | Ep+m
+    leftover: np.ndarray,  # [S]
+    E: int,  # unpadded node count
+    Ep: int,  # padded node axis the device codes split on
+    c_mask: np.ndarray,
+    c_zone: np.ndarray,
+    c_ct: np.ndarray,
+    c_pool: np.ndarray,
+    c_gmask: np.ndarray,
+    c_cum: np.ndarray,
+    used: int,
+) -> SolverResult:
+    """Rebuild the exact codes stream decode() derives from the dense take
+    tables, from the packed claim-delta instead — bit-identical by
+    construction: within a run, node codes (< Ep, ascending) sort before
+    claim codes (Ep+m -> E+m, ascending in m since E+m preserves order)
+    sort before the leftover row (sentinel key), which is precisely
+    decode()'s per-run emission order (nodes, claims, leftovers)."""
+    S = len(enc.run_group)
+    s = entries[:, 0].astype(np.int64)
+    cd = entries[:, 1].astype(np.int64)
+    v = entries[:, 2].astype(np.int64)
+    keep = (s < S) & (v > 0)
+    s, cd, v = s[keep], cd[keep], v[keep]
+    code = np.where(cd >= Ep, cd - Ep + E, cd)
+    lo = leftover[:S].astype(np.int64)
+    ls = np.flatnonzero(lo)
+    SENT = np.int64(np.iinfo(np.int64).max)
+    s_all = np.concatenate([s, ls])
+    code_all = np.concatenate([code, np.full(ls.size, SENT)])
+    v_all = np.concatenate([v, lo[ls]])
+    order = np.lexsort((code_all, s_all))
+    codes = np.repeat(
+        np.where(code_all[order] == SENT, np.int64(-1), code_all[order]),
+        v_all[order],
+    )
+    return _decode_from_codes(
+        enc, codes, E, c_mask, c_zone, c_ct, c_pool, c_gmask, c_cum, used
+    )
+
+
+def _entries_from_dense(take_e: np.ndarray, take_c: np.ndarray,
+                        Ep: int) -> np.ndarray:
+    """Dense take rows -> (run, code, count) triples in the device coding
+    (claims offset by the PADDED node axis). Used to splice a resume donor's
+    recorded prefix rows into a delta-decoded suffix."""
+    rs, cs = np.nonzero(take_e)
+    rs2, cs2 = np.nonzero(take_c)
+    return np.concatenate(
+        [
+            np.stack([rs, cs, take_e[rs, cs]], axis=1),
+            np.stack([rs2, cs2 + Ep, take_c[rs2, cs2]], axis=1),
+        ]
+    ).astype(np.int64)
+
+
+def _claim_cum_from_entries(enc: EncodedInput, entries: np.ndarray,
+                            c_pool: np.ndarray, Ep: int,
+                            Mb: int) -> np.ndarray:
+    """Rebuild the kernel's c_cum [M, R] from the claim-delta: every opened
+    claim starts at its pool's daemonset overhead and accumulates
+    take × group_req per pouring run — exactly ffd's pour arithmetic
+    (pool_daemon[p] on open, + take·req per pour), in int32 wraparound
+    semantics, so the result is bit-identical to fetching c_cum and the
+    requests decode() derives from it never diverge."""
+    R = enc.group_req.shape[1]
+    cum = np.zeros((Mb, R), dtype=np.int64)
+    pool = np.asarray(c_pool[:Mb]).astype(np.int64)
+    opened = pool >= 0
+    cum[opened] = enc.pool_daemon[pool[opened]].astype(np.int64)
+    s = entries[:, 0].astype(np.int64)
+    cd = entries[:, 1].astype(np.int64)
+    v = entries[:, 2].astype(np.int64)
+    csel = (cd >= Ep) & (cd - Ep < Mb) & (s < len(enc.run_group))
+    if csel.any():
+        m = cd[csel] - Ep
+        g = enc.run_group[s[csel]].astype(np.int64)
+        np.add.at(cum, m, v[csel, None] * enc.group_req[g].astype(np.int64))
+    return cum.astype(np.int32)  # int64 -> int32 truncation == device wrap
+
+
+def _dense_from_entries(entries: np.ndarray, S: int, Ep: int,
+                        Mb: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Inverse of the compaction for the checkpoint record: a resume donor
+    stores dense take rows (the resume machinery predates the delta path
+    and its stitching contract stays dense)."""
+    take_e = np.zeros((S, Ep), np.int32)
+    take_c = np.zeros((S, Mb), np.int32)
+    s = entries[:, 0].astype(np.int64)
+    cd = entries[:, 1].astype(np.int64)
+    v = entries[:, 2].astype(np.int64)
+    keep = s < S
+    s, cd, v = s[keep], cd[keep], v[keep]
+    node = cd < Ep
+    take_e[s[node], cd[node]] = v[node]
+    take_c[s[~node], cd[~node] - Ep] = v[~node]
+    return take_e, take_c
+
+
+def _decode_from_codes(
+    enc: EncodedInput,
+    codes: np.ndarray,  # [total_pods] int64: node e -> e, claim m -> E+m, -1
+    E: int,
+    c_mask: np.ndarray,  # [M, T]
+    c_zone: np.ndarray,  # [M, Z]
+    c_ct: np.ndarray,  # [M, C]
+    c_pool: np.ndarray,  # [M]
+    c_gmask: np.ndarray,  # [M, G]
+    c_cum: np.ndarray,  # [M, R]
+    used: int,
+) -> SolverResult:
+    """Shared tail of decode()/decode_delta(): codes stream (aligned with
+    enc.sorted_uids) -> SolverResult."""
+    uid_sorted = enc.sorted_uids
     targets = np.empty(E + used, dtype=object)
     for e in range(E):
         targets[e] = ("node", enc.node_ids[e])

@@ -220,6 +220,23 @@ class SolveService:
             "resume_hit_rate": float(getattr(inner, "resume_hit_rate", 0.0)),
         }
 
+    def decode_stats(self) -> Dict[str, float]:
+        """On-device decode + relax-ladder counters of the owned backend
+        (zeros when the backend has none) — the ISSUE 6 bench keys."""
+        inner = self.solver
+        stats = getattr(inner, "stats", None) or {}
+        ledger = getattr(inner, "ledger", None)
+        return {
+            "decode_bytes_per_solve": float(
+                getattr(ledger, "decode_bytes_per_solve", 0.0) or 0.0
+            ),
+            "relax_dispatches_per_solve": float(
+                stats.get("relax_dispatches", 0)
+            ),
+            "ladder_rungs_used": int(stats.get("ladder_rungs_used", 0)),
+            "wide_refetches": int(stats.get("wide_refetches", 0)),
+        }
+
     def close(self) -> None:
         """Stop accepting work; fail queued (undispatched) requests with
         ServiceStopped; let in-flight requests drain."""

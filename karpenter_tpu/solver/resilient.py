@@ -434,9 +434,10 @@ class ResilientSolver(Solver):
 
     def _fallback_solve(self, inp):
         """Walk the chain; every rung's result faces the same gate."""
-        # a replay must never trust device-resident argument buffers left
-        # by the failed / gate-rejected solve — drop the arena first so the
-        # next device solve re-uploads from scratch (solver/arena.py)
+        # a replay must never trust device-resident state left by the
+        # failed / gate-rejected solve — drop the arena first (argument
+        # buffers, checkpoint ring, AND resident relax-ladder rung tables)
+        # so the next device solve re-uploads from scratch (solver/arena.py)
         inv = getattr(self.inner, "invalidate_arena", None)
         if inv is not None:
             inv()

@@ -271,6 +271,90 @@ def _gbit_word(g, W):
     )
 
 
+# --- post-scan take compaction (on-device decode; SPEC.md "Decode &
+# ladder semantics") -------------------------------------------------------
+#
+# The dense take tables are O(S×E + S×M) but almost entirely zero: every
+# nonzero entry accounts for >= 1 placed pod. Compacting them to (run,
+# code, count) uint16 triples ON DEVICE shrinks the d2h fetch to O(actual
+# placements); backend._pack_outputs_delta splices the result into the
+# single packed output buffer and decode_delta rebuilds the codes stream
+# bit-identically. Layout constants are pinned by test_arg_spec_drift.py.
+
+DELTA_HEADER_WORDS = 3  # [overflow_flag, entry_count, uniq_meta_count] i32
+DELTA_ENTRY_U16 = 2  # (code, count) uint16 per entry word; code = e | E+m
+
+
+def compact_takes(take_e, take_c, cap: int):
+    """[Sp,E]/[Sp,M] dense takes -> run-major packed nonzero entries.
+
+    Returns (overflow i32 scalar, n i32 scalar, cnt16 [Sp/2] i32,
+    pairs [cap] i32). Entries travel as (code, count) uint16 pairs — one
+    int32 word each — in row-major (= run-major) order; the per-run entry
+    counts `cnt16` (uint16, also bitcast-packed) let the host rebuild the
+    run index with one np.repeat, so the run column never crosses the
+    link. `overflow` is set when a take exceeds uint16 range OR more than
+    `cap` entries exist — the host re-fetches full width in that (rare)
+    case, so correctness never depends on the bounds."""
+    Sp = take_e.shape[0]
+    K = take_e.shape[1] + take_c.shape[1]
+    grid = jnp.concatenate([take_e, take_c], axis=1)  # [Sp, K]
+    val = grid.ravel()
+    code = jnp.tile(jnp.arange(K, dtype=jnp.int32), Sp)
+    mask = val > 0
+    cnt_s = jnp.sum((grid > 0).astype(jnp.int32), axis=1)  # [Sp]
+    pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    n = jnp.sum(mask.astype(jnp.int32))
+    tgt = jnp.where(mask, pos, cap)  # overflow/padding scatters drop
+    ent_c = jnp.zeros((cap,), jnp.int32).at[tgt].set(code, mode="drop")
+    ent_v = jnp.zeros((cap,), jnp.int32).at[tgt].set(val, mode="drop")
+    overflow = ((n > cap) | (jnp.max(val, initial=0) > 65535)).astype(
+        jnp.int32
+    )
+    pair = jnp.stack([ent_c, ent_v], axis=1)  # [cap, 2]
+    pairs = jax.lax.bitcast_convert_type(pair.astype(jnp.uint16), jnp.int32)
+    c16 = cnt_s.astype(jnp.uint16).reshape(-1, 2)  # Sp is 16-bucketed: even
+    cnt16 = jax.lax.bitcast_convert_type(c16, jnp.int32)
+    return overflow, n, cnt16, pairs
+
+
+def compact_claim_meta(cm_words, c_zc_bits, c_gbits, c_pool, cap_u: int):
+    """Dedup the per-claim identity rows (type-mask words ++ zone/ct bits ++
+    group bits ++ pool) into a unique-row table + per-claim uint16 ids.
+
+    Hundreds of claims open from a handful of deployment waves, so the
+    distinct rows number ~runs, not ~claims — fetching `uniq [cap_u, Wt]`
+    plus `mid16` ids replaces the O(M×T) c_mask fetch that dominated the
+    dense tail. Returns (overflow_u i32 scalar, n_u i32 scalar,
+    uniq [cap_u, Wt] i32, mid16 [M/2] i32)."""
+    M = c_pool.shape[0]
+    meta = jnp.concatenate(
+        [
+            cm_words.astype(jnp.uint32),
+            c_zc_bits[:, None].astype(jnp.uint32),
+            c_gbits.astype(jnp.uint32),
+            jax.lax.bitcast_convert_type(c_pool, jnp.uint32)[:, None],
+        ],
+        axis=1,
+    )  # [M, Wt]
+    eq = jnp.all(meta[:, None, :] == meta[None, :, :], axis=2)  # [M, M]
+    first = jnp.argmax(eq, axis=1)  # first row equal to mine (diag is True)
+    is_rep = first == jnp.arange(M, dtype=first.dtype)
+    pos = jnp.cumsum(is_rep.astype(jnp.int32)) - 1
+    n_u = jnp.sum(is_rep.astype(jnp.int32))
+    tgt = jnp.where(is_rep, pos, cap_u)
+    uniq = (
+        jnp.zeros((cap_u, meta.shape[1]), jnp.uint32)
+        .at[tgt]
+        .set(meta, mode="drop")
+    )
+    mid = pos[first]  # [M] in [0, n_u)
+    overflow_u = (n_u > cap_u).astype(jnp.int32)
+    m16 = mid.astype(jnp.uint16).reshape(-1, 2)  # M is >=128-bucketed: even
+    mid16 = jax.lax.bitcast_convert_type(m16, jnp.int32)
+    return overflow_u, n_u, jax.lax.bitcast_convert_type(uniq, jnp.int32), mid16
+
+
 def _ffd_scan(
     # runs
     run_group,  # [S] i32
@@ -322,6 +406,7 @@ def _ffd_scan(
     init_state: FFDState | None = None,
     ckpt_every: int = 0,
     n_ckpt: int = 0,
+    run_ladder=None,  # [S, L] i32 — per-run relax rung groups (-1 pad)
 ):
     E, R = node_free.shape
     G, T = group_compat_t.shape
@@ -1481,6 +1566,114 @@ def _ffd_scan(
 
     S = run_group.shape[0]
     ring = None
+    if run_ladder is not None:
+        # Relax-ladder scan (solver/SPEC.md "Decode & ladder semantics"):
+        # each run carries its pre-materialized rung groups — rung j's group
+        # encodes the run's pod spec with its j lowest-weight preferences
+        # dropped (relax.py ORIGINAL-order invariant). The cascade replays
+        # the host relax loop's per-pod walk in one dispatch: pour the base
+        # group for every still-unplaced pod, then ladder ONE pod up the
+        # rungs until it places, then return to the base rung — a rung
+        # placement can open a claim that un-relaxed twins may join, exactly
+        # as the host loop's next redispatch would discover. Failed attempts
+        # never mutate the carry, and identical pods fail identically once
+        # one exhausts the ladder, so the remaining count is committed as
+        # leftover without re-walking each twin.
+        assert emit_takes and ckpt_every == 0 and init_state is None, (
+            "run_ladder excludes verdict mode, checkpoint harvest, and resume"
+        )
+        Lw = run_ladder.shape[1]
+
+        def step_ladder(st: FFDState, run):
+            g, count, lrow = run
+
+            def cascade(st_in):
+                # every iteration either places >= 1 pod (and pods place at
+                # most `count` times) or advances the rung counter (which
+                # resets only on placement), so the walk is bounded; fuel
+                # makes the bound explicit for the while_loop
+                fuel0 = (count + jnp.int32(1)) * jnp.int32(Lw + 2) + jnp.int32(4)
+
+                def cond(c):
+                    _, lvl, remaining, _, _, fuel = c
+                    return (remaining > 0) & (lvl <= Lw) & (fuel > 0)
+
+                def body(c):
+                    st_, lvl, remaining, te_a, tc_a, fuel = c
+                    is_base = lvl == 0
+                    gv = lrow[jnp.clip(lvl - 1, 0, Lw - 1)]
+                    valid = is_base | (gv >= 0)
+                    g_cur = jnp.where(is_base, g, jnp.clip(gv, 0, G - 1))
+                    # base pours the whole remainder (the closed-form pour
+                    # already accounts for self-interactions); a rung pours
+                    # exactly ONE pod — the host loop relaxes one pod per
+                    # iteration, and its twins must retry from the base
+                    cnt = jnp.where(is_base, remaining, jnp.int32(1))
+                    new_st, (te, tc, lo) = jax.lax.cond(
+                        valid,
+                        lambda s: step_body(s, g_cur, cnt),
+                        lambda s: (
+                            s,
+                            (
+                                jnp.zeros((E,), jnp.int32),
+                                jnp.zeros((M,), jnp.int32),
+                                cnt,
+                            ),
+                        ),
+                        st_,
+                    )
+                    placed = cnt - lo
+                    nxt = jnp.where(
+                        is_base,
+                        jnp.int32(1),
+                        jnp.where(placed > 0, jnp.int32(0), lvl + 1),
+                    )
+                    nxt = jnp.where(valid, nxt, jnp.int32(Lw + 1))
+                    return (
+                        new_st,
+                        nxt,
+                        remaining - placed,
+                        te_a + te,
+                        tc_a + tc,
+                        fuel - 1,
+                    )
+
+                st_f, _, rem_f, te_f, tc_f, _ = jax.lax.while_loop(
+                    cond,
+                    body,
+                    (
+                        st_in,
+                        jnp.int32(0),
+                        count.astype(jnp.int32),
+                        jnp.zeros((E,), jnp.int32),
+                        jnp.zeros((M,), jnp.int32),
+                        fuel0,
+                    ),
+                )
+                return st_f, (te_f, tc_f, rem_f)
+
+            return jax.lax.cond(
+                count > 0,
+                cascade,
+                lambda s: (
+                    s,
+                    (
+                        jnp.zeros((E,), jnp.int32),
+                        jnp.zeros((M,), jnp.int32),
+                        jnp.int32(0),
+                    ),
+                ),
+                st,
+            )
+
+        state, ys = jax.lax.scan(
+            step_ladder, state, (run_group, run_count, run_ladder)
+        )
+        take_e, take_c, leftover = ys
+        out = FFDOutput(
+            take_e=take_e, take_c=take_c, leftover=leftover, state=state
+        )
+        return out, None
     if ckpt_every > 0 and n_ckpt > 0:
         # carry a fixed-size snapshot ring through the scan: step pos=i+1
         # writes slot ((pos//K)-1) % n_ckpt when pos % K == 0. The write
@@ -1817,3 +2010,99 @@ def ffd_resume(
         ckpt_every=ckpt_every,
         n_ckpt=n_ckpt,
     )
+
+@functools.partial(
+    jax.jit, static_argnames=("max_claims", "emit_takes", "zone_engine")
+)
+def ffd_solve_ladder(
+    run_ladder,  # [S, L] i32 — rung groups per run (-1 pad), leading axis
+    run_group,
+    run_count,
+    group_req,
+    group_compat_t,
+    group_zc_bits,
+    group_pool,
+    group_pair_nok,
+    group_device,
+    type_alloc,
+    type_charge,
+    offer_zc_bits,
+    pool_type,
+    pool_zc_bits,
+    pool_daemon,
+    pool_limit,
+    pool_usage0,
+    node_free,
+    node_compat,
+    q_member,
+    q_owner,
+    q_kind,
+    q_cap,
+    node_q_member,
+    node_q_owner,
+    v_member,
+    v_owner,
+    v_kind,
+    v_cap,
+    v_primary,
+    v_aff,
+    v_count0,
+    node_zone,
+    zone_col_mask,
+    node_dom2,
+    col_axis,
+    group_daxis,
+    *,
+    max_claims: int,
+    emit_takes: bool = True,
+    zone_engine: bool = True,
+) -> FFDOutput:
+    """Single-dispatch preference relaxation: the scan walks each run's
+    pre-materialized rung groups (run_ladder row) inside the step, so the
+    whole host relax loop collapses to one kernel launch. Takes accumulate
+    across rungs per run; leftover counts pods that exhausted their ladder.
+    Tensor contract: run_ladder leads, then the frozen ARG_SPEC 36 — the
+    arena's per-entry residency and AOT prewarm stay valid unchanged."""
+    out, _ = _ffd_scan(
+        run_group,
+        run_count,
+        group_req,
+        group_compat_t,
+        group_zc_bits,
+        group_pool,
+        group_pair_nok,
+        group_device,
+        type_alloc,
+        type_charge,
+        offer_zc_bits,
+        pool_type,
+        pool_zc_bits,
+        pool_daemon,
+        pool_limit,
+        pool_usage0,
+        node_free,
+        node_compat,
+        q_member,
+        q_owner,
+        q_kind,
+        q_cap,
+        node_q_member,
+        node_q_owner,
+        v_member,
+        v_owner,
+        v_kind,
+        v_cap,
+        v_primary,
+        v_aff,
+        v_count0,
+        node_zone,
+        zone_col_mask,
+        node_dom2,
+        col_axis,
+        group_daxis,
+        max_claims=max_claims,
+        emit_takes=emit_takes,
+        zone_engine=zone_engine,
+        run_ladder=run_ladder,
+    )
+    return out

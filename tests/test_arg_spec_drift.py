@@ -127,3 +127,53 @@ def test_cold_entry_point_signature_is_frozen():
 
     params = list(inspect.signature(ffd.ffd_solve.__wrapped__).parameters)
     assert "ckpt_every" not in params and "n_ckpt" not in params
+
+
+# -- on-device decode + relax ladder (ISSUE 6) --------------------------------
+
+
+def test_ladder_entry_point_shares_the_tensor_contract():
+    """ffd_solve_ladder takes run_ladder then the SAME 36 positional tensors
+    as ffd_solve, statics trailing — so _ladder_arg can splice the arena's
+    resident args after the rung table without re-deriving the order."""
+    params = list(inspect.signature(ffd.ffd_solve_ladder.__wrapped__).parameters)
+    tensor = [p for p in params if p not in STATICS]
+    assert tuple(tensor) == ("run_ladder",) + ffd.ARG_SPEC, (
+        "ffd_solve_ladder's tensor params drifted from run_ladder + ARG_SPEC"
+    )
+    assert params == tensor + list(STATICS), (
+        f"ffd_solve_ladder: statics must trail as ({', '.join(STATICS)})"
+    )
+
+
+def test_claim_delta_wire_layout_is_pinned():
+    """backend._pack_dispatch's unpack slices the flat delta buffer by these
+    constants; ffd's compaction writes it. Either side drifting silently
+    misdecodes, so the layout is pinned here, not discovered at runtime."""
+    assert ffd.DELTA_HEADER_WORDS == 3, (
+        "delta header is [overflow, entry_count, uniq_meta_count]"
+    )
+    assert ffd.DELTA_ENTRY_U16 == 2, (
+        "each entry word packs (code, count) as two uint16 halves"
+    )
+
+
+def test_delta_capacity_properties():
+    """Capacity functions gate compile-variant count (quantum-bucketed) and
+    the overflow carve-out (hard ceilings). Monotone in every argument so a
+    growing fleet never shrinks the buffer mid-session."""
+    caps = [backend.delta_capacity(n, 32, 224, 512) for n in (1, 10_000, 50_000)]
+    assert caps == sorted(caps)
+    for c in caps:
+        assert c % backend.DELTA_CAP_QUANTUM == 0 and c >= backend.DELTA_CAP_QUANTUM
+    # total_pods is a hard ceiling: 1 pod never needs >1 quantum of entries
+    assert backend.delta_capacity(1, 1024, 4096, 4096) == backend.DELTA_CAP_QUANTUM
+    # structural ceiling Sp*(E+M) binds tiny problems regardless of pod count
+    assert backend.delta_capacity(10**9, 2, 3, 4) == backend.DELTA_CAP_QUANTUM
+
+    us = [backend.delta_uniq_capacity(s, 512) for s in (1, 32, 256)]
+    assert us == sorted(us)
+    for u in us:
+        assert u % backend.DELTA_UNIQ_QUANTUM == 0 and u >= backend.DELTA_UNIQ_QUANTUM
+    # Mb is a hard ceiling: can't have more distinct meta rows than claims
+    assert backend.delta_uniq_capacity(10_000, 8) == backend.DELTA_UNIQ_QUANTUM

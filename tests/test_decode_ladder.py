@@ -1,0 +1,275 @@
+"""On-device decode + device-resident relax ladder (ISSUE 6).
+
+Two transfer-side contracts. (1) The packed claim-delta fetch
+(ffd.compact_takes / compact_claim_meta -> backend._pack_dispatch) must
+reconstruct a SolverResult decision-identical to the dense take-table
+decode — including the >65535/over-capacity overflow carve-out, where the
+host must detect the flag and re-fetch full width rather than misdecode.
+(2) The single-dispatch relax ladder (ffd.ffd_solve_ladder) must commit
+the same rung per pod as the host relax-and-redispatch loop, which itself
+is pinned to the Python oracle — a 3-way parity across every preference
+kind relax.py supports.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import wellknown as wk
+from karpenter_tpu.api.objects import TopologySpreadConstraint
+from karpenter_tpu.provisioning.scheduler import SolverInput
+from karpenter_tpu.scheduling.requirements import IN, Requirement, Requirements
+from karpenter_tpu.solver import backend
+from karpenter_tpu.solver.arena import ArgumentArena
+from karpenter_tpu.solver.backend import ReferenceSolver, TPUSolver, quantize_input
+from karpenter_tpu.solver.tpu import ffd
+
+from tests.test_relax_device import sa_tsc, waff
+from tests.test_zone_device import ZONES, mknode, mkpod, pool
+
+
+def _assert_same_decisions(a, b, tag):
+    """The parity contract: errors, placements, and claim identity. NOT
+    dataclass equality — SolverResult carries path-dependent extras."""
+    assert set(a.errors) == set(b.errors), f"{tag}: errors diverge"
+    assert a.placements == b.placements, f"{tag}: placements diverge"
+    assert len(a.claims) == len(b.claims), f"{tag}: claim count diverges"
+    for i, (ca, cb) in enumerate(zip(a.claims, b.claims)):
+        assert ca.nodepool == cb.nodepool, f"{tag}: claim {i} nodepool"
+        assert sorted(ca.instance_type_names) == sorted(cb.instance_type_names), (
+            f"{tag}: claim {i} types"
+        )
+        assert ca.pod_uids == cb.pod_uids, f"{tag}: claim {i} pods"
+
+
+def _random_fleet(rng, n_pods):
+    """Mixed fleet: plain pods, hard zone spreads, a couple of deployment
+    waves — enough claim/node-take variety to exercise every delta field
+    (multi-entry runs, daemon-opened claims, pours into existing nodes)."""
+    pods = []
+    for i in range(n_pods):
+        kind = rng.randrange(4)
+        cpu = rng.choice(["1", "2", "500m"])
+        mem = rng.choice(["1Gi", "2Gi", "512Mi"])
+        if kind == 0:
+            pods.append(mkpod(f"p{i}", cpu, mem))
+        else:
+            app = f"app-{rng.randrange(3)}"
+            tsc = TopologySpreadConstraint(
+                max_skew=1, topology_key=wk.ZONE_LABEL,
+                label_selector={"app": app},
+            )
+            pods.append(mkpod(f"p{i}", cpu, mem, labels={"app": app},
+                              topology_spread=[tsc]))
+    nodes = []
+    if rng.random() < 0.5:
+        nodes = [mknode("n-a", "zone-1a", matching=rng.randrange(3),
+                        sel={"app": "app-0"}),
+                 mknode("n-b", "zone-1b")]
+    return SolverInput(pods=pods, nodes=nodes, nodepools=[pool()], zones=ZONES)
+
+
+class TestDeltaDecodeParity:
+    def test_randomized_fleet_delta_vs_dense(self):
+        """Property-style: seeded random fleets, delta decode vs dense
+        decode must be decision-identical with zero wide re-fetches."""
+        rng = random.Random(0x15506)
+        for trial in range(5):
+            inp = _random_fleet(rng, 12 + trial * 9)
+            delta = TPUSolver()
+            dense = TPUSolver(device_decode=False)
+            rd = delta.solve(inp)
+            rn = dense.solve(inp)
+            _assert_same_decisions(rd, rn, f"trial {trial}")
+            assert delta.stats["device_solves"] == 1, delta.stats
+            assert delta.stats["wide_refetches"] == 0, delta.stats
+
+    def test_oversize_take_value_trips_overflow(self):
+        """A take >65535 cannot travel as a uint16 half-word: the flag must
+        be raised even when the entry count is comfortably under cap."""
+        take_e = np.zeros((2, 3), np.int32)
+        take_c = np.zeros((2, 2), np.int32)
+        take_c[0, 0] = 65536  # first value outside uint16 range
+        take_e[1, 1] = 7
+        overflow, n, _, _ = ffd.compact_takes(take_e, take_c, cap=16)
+        assert int(overflow) == 1
+        assert int(n) == 2
+        take_c[0, 0] = 65535  # largest representable value: no overflow
+        overflow, _, _, _ = ffd.compact_takes(take_e, take_c, cap=16)
+        assert int(overflow) == 0
+
+    def test_entry_count_over_capacity_trips_overflow(self):
+        take_e = np.ones((2, 4), np.int32)  # 8 entries > cap 4
+        take_c = np.zeros((2, 2), np.int32)
+        overflow, n, _, _ = ffd.compact_takes(take_e, take_c, cap=4)
+        assert int(overflow) == 1 and int(n) == 8
+
+    def test_uniq_meta_over_capacity_trips_overflow(self):
+        M, Wm = 32, 2
+        cm = np.arange(M * Wm, dtype=np.int32).reshape(M, Wm)  # all distinct
+        zc = np.zeros(M, np.uint32)
+        gb = np.zeros((M, 1), np.uint32)
+        pl = np.zeros(M, np.int32)
+        overflow_u, n_u, _, _ = ffd.compact_claim_meta(cm, zc, gb, pl, cap_u=16)
+        assert int(overflow_u) == 1 and int(n_u) == M
+
+    def test_forced_overflow_takes_wide_refetch_path(self, monkeypatch):
+        """End-to-end overflow: shrink the entry capacity below what the
+        fleet needs, so the kernel raises the flag and the host must serve
+        the solve from the full-width re-fetch — decision-identical to the
+        dense path, with the carve-out counted in stats and metrics."""
+        from karpenter_tpu.metrics.registry import SOLVER_WIDE_REFETCH
+
+        monkeypatch.setattr(backend, "delta_capacity", lambda *a: 2)
+        inp = _random_fleet(random.Random(7), 30)
+        before = SOLVER_WIDE_REFETCH.value()
+        delta = TPUSolver()
+        dense = TPUSolver(device_decode=False)
+        rd = delta.solve(inp)
+        rn = dense.solve(inp)
+        _assert_same_decisions(rd, rn, "forced overflow")
+        assert delta.stats["wide_refetches"] >= 1, delta.stats
+        assert SOLVER_WIDE_REFETCH.value() >= before + 1
+
+    def test_knob_off_keeps_dense_path(self):
+        inp = _random_fleet(random.Random(3), 10)
+        dense = TPUSolver(device_decode=False)
+        dense.solve(inp)
+        assert dense.stats["wide_refetches"] == 0
+        assert dense.stats["device_solves"] == 1
+
+
+# -- relax ladder: 3-way parity across the preference kinds -------------------
+
+
+def _three_way(inp, expect_ladder=True):
+    """Oracle vs host relax loop vs single-dispatch ladder. The host loop
+    is already pinned to the oracle (test_relax_device.py); this pins the
+    ladder to BOTH, plus the one-dispatch accounting claim."""
+    ref = ReferenceSolver().solve(quantize_input(inp))
+    host = TPUSolver(relax_ladder=False)
+    lad = TPUSolver()
+    r_host = host.solve(inp)
+    r_lad = lad.solve(inp)
+    _assert_same_decisions(ref, r_host, "oracle vs host loop")
+    _assert_same_decisions(ref, r_lad, "oracle vs ladder")
+    if expect_ladder:
+        assert lad.stats["ladder_solves"] >= 1, lad.stats
+        assert lad.stats["relax_dispatches"] == 1, lad.stats
+        assert lad.stats["ladder_rungs_used"] >= 1, lad.stats
+    return lad
+
+
+class TestLadderParity:
+    def _one_zone_pool(self):
+        return pool(extra=Requirements.of(
+            Requirement.create(wk.ZONE_LABEL, IN, ["zone-1a"])))
+
+    def test_schedule_anyway_spreads(self):
+        # one-zone pool makes every SA zone spread beyond the first pod
+        # impossible: the whole fleet must walk its ladder
+        sel = {"app": "soft"}
+        pods = [mkpod(f"s{i}", labels=dict(sel), topology_spread=[sa_tsc(sel)])
+                for i in range(6)]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[self._one_zone_pool()],
+                          zones=ZONES)
+        _three_way(inp)
+
+    def test_weighted_positive_pod_affinity(self):
+        # weighted affinity toward a label that only lives in zone-1b while
+        # the pool is pinned to zone-1a: the preference must drop
+        nodes = [mknode("n-b", "zone-1b", matching=2, sel={"svc": "db"})]
+        pods = [mkpod(f"a{i}", labels={"svc": "web"},
+                      affinity_terms=[waff({"svc": "db"}, weight=10)])
+                for i in range(4)]
+        inp = SolverInput(pods=pods, nodes=nodes,
+                          nodepools=[self._one_zone_pool()], zones=ZONES)
+        _three_way(inp)
+
+    def test_preferred_node_affinity(self):
+        amd_pool = pool(extra=Requirements.of(
+            Requirement.create(wk.ARCH_LABEL, IN, ["amd64"])))
+        prefs = [
+            (10, Requirements.of(Requirement.create(
+                wk.ZONE_LABEL, IN, ["zone-1b"]))),
+            (50, Requirements.of(Requirement.create(
+                wk.ARCH_LABEL, IN, ["arm64"]))),
+        ]
+        pods = [mkpod(f"n{i}", preferred_node_affinity=list(prefs))
+                for i in range(3)]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[amd_pool], zones=ZONES)
+        _three_way(inp)
+
+    def test_mixed_preference_kinds_one_solve(self):
+        sel = {"app": "mix"}
+        pods = [
+            mkpod(f"m{i}", labels=dict(sel), topology_spread=[sa_tsc(sel)],
+                  preferred_node_affinity=[(30, Requirements.of(
+                      Requirement.create(wk.ZONE_LABEL, IN, ["zone-1c"])))])
+            for i in range(4)
+        ] + [
+            mkpod(f"w{i}", labels={"svc": "web"},
+                  affinity_terms=[waff({"svc": "db"}, weight=5)])
+            for i in range(2)
+        ]
+        inp = SolverInput(pods=pods, nodes=[],
+                          nodepools=[self._one_zone_pool()], zones=ZONES)
+        _three_way(inp)
+
+    def test_satisfiable_prefs_stay_single_dispatch(self):
+        # nothing needs to relax: still exactly one dispatch, rung 0 wins
+        sel = {"app": "easy"}
+        pods = [mkpod(f"e{i}", labels=dict(sel), topology_spread=[sa_tsc(sel)])
+                for i in range(3)]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        lad = TPUSolver()
+        ref = ReferenceSolver().solve(quantize_input(inp))
+        _assert_same_decisions(ref, lad.solve(inp), "satisfiable")
+        assert lad.stats["relax_dispatches"] <= 1, lad.stats
+
+    def test_ladder_composes_with_delta_decode(self):
+        # both ISSUE 6 paths on at once (the default production config)
+        sel = {"app": "both"}
+        pods = [mkpod(f"b{i}", labels=dict(sel), topology_spread=[sa_tsc(sel)])
+                for i in range(5)]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[self._one_zone_pool()],
+                          zones=ZONES)
+        lad = _three_way(inp)
+        assert lad.stats["wide_refetches"] == 0, lad.stats
+
+
+class TestLadderResidency:
+    def test_arena_invalidation_drops_resident_rungs(self):
+        """The resilience layer's fallback replay calls arena.invalidate();
+        a stale device-resident rung table surviving that would let a
+        post-fault solve walk rungs from before the fault."""
+        arena = ArgumentArena()
+        key = ("bucket",)
+        table = np.arange(12, dtype=np.int32).reshape(4, 3)
+        arena.put_ladder(key, table, dev="resident")
+        assert arena.get_ladder(key, table) == "resident"
+        # content drift alone must miss (digest mismatch)
+        other = table.copy()
+        other[0, 0] = 99
+        assert arena.get_ladder(key, other) is None
+        arena.invalidate()
+        assert arena.get_ladder(key, table) is None
+
+    def test_repeat_solve_reuses_resident_ladder(self):
+        sel = {"app": "resident"}
+        pods = [mkpod(f"r{i}", labels=dict(sel), topology_spread=[sa_tsc(sel)])
+                for i in range(4)]
+        one_zone = pool(extra=Requirements.of(
+            Requirement.create(wk.ZONE_LABEL, IN, ["zone-1a"])))
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[one_zone], zones=ZONES)
+        solver = TPUSolver()
+        r1 = solver.solve(inp)
+        assert solver.stats["ladder_solves"] >= 1, solver.stats
+        n_resident = len(solver.arena._ladders) if solver.arena else 0
+        r2 = solver.solve(inp)
+        _assert_same_decisions(r1, r2, "repeat solve")
+        if solver.arena is not None:
+            assert len(solver.arena._ladders) == n_resident, (
+                "re-solving the same fleet grew ladder residency"
+            )
