@@ -339,6 +339,32 @@ SOLVER_RELAX_DISPATCHES = REGISTRY.register(
         "redispatch loop (solver/backend.py _relax_solve)",
     )
 )
+# mesh-sharded solve series (ISSUE 7 — same naming rule as the resume /
+# decode series: no _tpu segment, bench trajectory keys match)
+SOLVER_MESH_DEVICES = REGISTRY.register(
+    Gauge(
+        "karpenter_solver_mesh_devices",
+        "Devices in the provisioning-solve mesh the solver last dispatched "
+        "across (1 = single-device scan; solver/backend.py _shard_mesh)",
+    )
+)
+SOLVER_SHARD_FIXUP_RUNS = REGISTRY.register(
+    Counter(
+        "karpenter_solver_shard_fixup_runs_total",
+        "Run-block scan steps replayed by the sharded solve's carry-"
+        "exchange fix-up (blocks whose block-local placement could differ "
+        "under the true prefix carry re-run via ffd_resume — SPEC.md "
+        "\"Sharding semantics\")",
+    )
+)
+SOLVER_SHARDED_FALLBACK = REGISTRY.register(
+    Counter(
+        "karpenter_solver_sharded_fallback_total",
+        "Sharded-solve requests that fell back to the single-device scan "
+        "(inexpressible carry combine: active domain event engine, block "
+        "misalignment, or claim-slot overflow during the stitch)",
+    )
+)
 CONTROLLER_ERRORS = REGISTRY.register(
     Counter(
         "karpenter_controller_errors_total",

@@ -12,6 +12,7 @@ No cross-candidate communication is needed during the solve, so collectives
 from __future__ import annotations
 
 import functools
+import weakref
 from typing import Optional
 
 import jax
@@ -42,12 +43,38 @@ def batch_bucket(b: int, mesh: Optional[Mesh] = None, mult: int = 8) -> int:
     return max(mult, ((b + mult - 1) // mult) * mult)
 
 
-# Memoized jitted vmap per (mesh devices, axis names, arity, max_claims):
-# rebuilding jax.jit(vmap(...)) per call discarded the trace cache, so every
-# multichip dispatch re-traced and re-lowered the whole kernel even though
-# the compiled executable was shape-identical. Keyed on device ids (not the
-# Mesh object — equal meshes over the same devices must share an entry).
+# Memoized jitted vmap per (mesh identity, arity, max_claims): rebuilding
+# jax.jit(vmap(...)) per call discarded the trace cache, so every multichip
+# dispatch re-traced and re-lowered the whole kernel even though the
+# compiled executable was shape-identical. The identity token covers device
+# ids, the device-grid SHAPE, and axis names — equal meshes over the same
+# devices share an entry, while a RESHAPED mesh (same flat devices, new
+# grid) can never serve the stale compiled fn its predecessor lowered.
 _JIT_CACHE: dict = {}
+
+# Per-Mesh-object token memo: the token construction walks mesh.devices
+# (O(n_devices) python per call), which showed up in the batched_solve hot
+# path — the disruption engine calls this once per probe frontier. Weak keys
+# keep dead meshes from pinning their tokens.
+_MESH_TOKENS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _mesh_token(mesh: Mesh) -> tuple:
+    try:
+        tok = _MESH_TOKENS.get(mesh)
+    except TypeError:
+        tok = None  # un-weakref-able mesh implementation: compute per call
+    if tok is None:
+        tok = (
+            tuple(int(d.id) for d in mesh.devices.flat),
+            tuple(mesh.devices.shape),
+            tuple(mesh.axis_names),
+        )
+        try:
+            _MESH_TOKENS[mesh] = tok
+        except TypeError:
+            pass
+    return tok
 
 
 def batched_solve(mesh: Mesh, batched_args: tuple, max_claims: int):
@@ -59,8 +86,7 @@ def batched_solve(mesh: Mesh, batched_args: tuple, max_claims: int):
     """
     axis = mesh.axis_names[0]
     key = (
-        tuple(d.id for d in mesh.devices.flat),
-        mesh.axis_names,
+        _mesh_token(mesh),
         len(batched_args),
         int(max_claims),
     )

@@ -56,7 +56,8 @@ from ..metrics.registry import (
     SOLVER_UPLOAD_BYTES,
 )
 
-_LEDGER_FIELDS = ("h2d_bytes", "h2d_arrays", "h2d_msgs", "d2h_bytes", "d2h_msgs")
+_LEDGER_FIELDS = ("h2d_bytes", "h2d_arrays", "h2d_msgs", "d2h_bytes",
+                  "d2h_msgs", "h2d_shard_bytes")
 
 
 class TransferLedger:
@@ -84,10 +85,14 @@ class TransferLedger:
             self.solves += 1
             self.solve = dict.fromkeys(_LEDGER_FIELDS, 0)
 
-    def record_upload(self, nbytes: int, arrays: int, msgs: int = 1) -> None:
+    def record_upload(self, nbytes: int, arrays: int, msgs: int = 1,
+                      shard_bytes: int = 0) -> None:
+        """`shard_bytes` ≤ `nbytes`: the portion uploaded under a PARTITIONED
+        byte sharding (each mesh device receives only its 1/Nd slice of
+        those bytes; the remainder replicates to every device)."""
         with self._lock:
             for k, v in (("h2d_bytes", nbytes), ("h2d_arrays", arrays),
-                         ("h2d_msgs", msgs)):
+                         ("h2d_msgs", msgs), ("h2d_shard_bytes", shard_bytes)):
                 self.solve[k] += v
                 self.total[k] += v
 
@@ -115,6 +120,19 @@ class TransferLedger:
         """Average device→host result-fetch bytes per solve — the number the
         on-device decode (backend delta packing) is meant to shrink."""
         return self.total["d2h_bytes"] / self.solves if self.solves else 0.0
+
+    def shard_upload_bytes_per_device(self, n_devices: int) -> float:
+        """Average host→device bytes landing on EACH device per solve under
+        an n-way mesh: partitioned bytes split 1/Nd per device, everything
+        else replicates whole. Equals upload_bytes_per_solve at n=1; the
+        sharded-solve target is ≈ 1/Nd of the replicated-args baseline on
+        run-dominated uploads (SPEC.md "Sharding semantics")."""
+        if not self.solves:
+            return 0.0
+        n = max(1, int(n_devices))
+        shard = self.total["h2d_shard_bytes"]
+        repl = self.total["h2d_bytes"] - shard
+        return (repl + shard / n) / self.solves
 
     def end_solve(self) -> Dict[str, int]:
         """Close the per-solve window: push gauges, return its counters."""
@@ -185,6 +203,35 @@ def _unpack_fn(specs: tuple, sharding):
     return fn
 
 
+def _buffer_sharding(out_sharding):
+    """Input placement for a packed upload group: when the group's OUT
+    sharding partitions its leading axis over a mesh axis, the 1-D byte
+    buffer partitions over the same axis — each device receives only its
+    1/Nd byte slice over the tunnel, and the jitted unpack's out_shardings
+    redistribute on-device (ICI, not the host link). Returns
+    (byte_sharding | None, n_way): replicated groups ship whole to every
+    device (n_way = 1)."""
+    try:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if (
+            isinstance(out_sharding, NamedSharding)
+            and len(out_sharding.spec)
+            and out_sharding.spec[0] is not None
+        ):
+            n = int(out_sharding.mesh.devices.size)
+            if n > 1:
+                return (
+                    NamedSharding(
+                        out_sharding.mesh, PartitionSpec(out_sharding.spec[0])
+                    ),
+                    n,
+                )
+    except Exception:
+        pass
+    return None, 1
+
+
 class ArgumentArena:
     """Per-bucket device-resident kernel args with packed delta uploads.
 
@@ -214,6 +261,13 @@ class ArgumentArena:
         # same preference fleet re-solving reuses the rung table with zero
         # upload. Dies with the bucket on invalidate(), like checkpoints.
         self._ladders: Dict[tuple, Tuple[bytes, object]] = {}
+        # mesh-sharded residency class (backend._plan_shard_resume): one
+        # record per sharded bucket holding the solve's block-boundary
+        # carries (host numpy — the PER-DEVICE checkpoints of the sharded
+        # scan), per-block run identities, and the stitched take rows, so a
+        # later sharded solve replays only from the first changed block.
+        # Dropped by invalidate() with everything else.
+        self._shards: Dict[tuple, dict] = {}
         # ARG_SPEC indices the LAST adopt actually uploaded (() on an exact
         # hit) — observability for tests/bench; checkpoint prefix validity
         # uses context_signature() instead (robust to pipelined dispatches
@@ -233,6 +287,7 @@ class ArgumentArena:
         self._buckets.clear()
         self._ckpts.clear()
         self._ladders.clear()
+        self._shards.clear()
         self.last_stale = ()
         self.stats["invalidations"] += 1
 
@@ -248,6 +303,15 @@ class ArgumentArena:
 
     def get_checkpoints(self, key: tuple) -> list:
         return self._ckpts.get(key, [])
+
+    def put_shard_record(self, key: tuple, record: dict) -> None:
+        """Record a sharded solve's block-boundary carries + stitched rows
+        for its bucket (one per bucket — the newest sharded solve is the
+        only useful resume donor). Dies on invalidate()."""
+        self._shards[key] = record
+
+    def get_shard_record(self, key: tuple):
+        return self._shards.get(key)
 
     def put_ladder(self, key: tuple, host_table: np.ndarray, dev) -> None:
         """Record a bucket's device-resident relax-ladder table (one per
@@ -286,7 +350,16 @@ class ArgumentArena:
         """Return device-resident buffers matching `host_args`, uploading
         only stale entries as ONE packed buffer. `prov` aligns with
         `host_args` (backend.host_kernel_args): a hashable content-identity
-        token per entry, or None to force the digest path."""
+        token per entry, or None to force the digest path.
+
+        `sharding` may be a single placement for every entry (the batched-
+        consolidation universe) or a TUPLE aligned with `host_args` — the
+        mesh-sharded solve places the run blocks partitioned over the
+        "shards" axis and the core tables replicated. Per-entry shardings
+        pack stale entries into one buffer PER DISTINCT SHARDING (≤2
+        messages for a sharded solve: one partitioned, one replicated);
+        partitioned groups upload only 1/Nd of their bytes to each device
+        (_buffer_sharding), counted as shard bytes on the ledger."""
         import jax
 
         self.stats["adopts"] += 1
@@ -321,24 +394,46 @@ class ArgumentArena:
             self.stats["exact_hits"] += 1
             led.record_adopt("exact_hit")
             return tuple(dev)
-        # pack stale entries into one contiguous byte buffer → one upload →
-        # jitted unpack scatters into typed device buffers
-        specs = []
-        parts = []
-        off = 0
-        for i in stale:
-            a = np.ascontiguousarray(host_args[i])
-            specs.append((off, a.shape, a.dtype.str))
-            parts.append(a.reshape(-1).view(np.uint8))
-            off += a.nbytes
-        buf = np.concatenate(parts) if len(parts) > 1 else parts[0]
-        dev_buf = (jax.device_put(buf) if sharding is None
-                   else jax.device_put(buf, sharding))
-        new = _unpack_fn(tuple(specs), sharding)(dev_buf)
-        for j, i in enumerate(stale):
-            dev[i] = new[j]
+        # pack stale entries into one contiguous byte buffer per distinct
+        # sharding → one upload each → jitted unpack scatters into typed
+        # device buffers (a single/None sharding keeps the one-message path)
+        if isinstance(sharding, tuple):
+            groups: Dict[object, List[int]] = {}
+            for i in stale:
+                groups.setdefault(sharding[i], []).append(i)
+        else:
+            groups = {sharding: list(stale)}
+        total_bytes = 0
+        total_shard = 0
+        for shd, idxs in groups.items():
+            specs = []
+            parts = []
+            off = 0
+            for i in idxs:
+                a = np.ascontiguousarray(host_args[i])
+                specs.append((off, a.shape, a.dtype.str))
+                parts.append(a.reshape(-1).view(np.uint8))
+                off += a.nbytes
+            buf = np.concatenate(parts) if len(parts) > 1 else parts[0]
+            buf_shd, n_way = _buffer_sharding(shd)
+            if buf_shd is not None and buf.nbytes % n_way:
+                # equal byte split across the mesh axis; tail padding is
+                # past every spec's range, the unpack never reads it
+                pad = n_way - buf.nbytes % n_way
+                buf = np.concatenate([buf, np.zeros(pad, np.uint8)])
+            dev_buf = (
+                jax.device_put(buf) if shd is None
+                else jax.device_put(buf, buf_shd if buf_shd is not None else shd)
+            )
+            new = _unpack_fn(tuple(specs), shd)(dev_buf)
+            for j, i in enumerate(idxs):
+                dev[i] = new[j]
+            total_bytes += off
+            if n_way > 1:
+                total_shard += off
         full = len(stale) == len(host_args)
         self.stats["full_uploads" if full else "delta_uploads"] += 1
-        led.record_upload(off, len(stale), msgs=1)
+        led.record_upload(total_bytes, len(stale), msgs=len(groups),
+                          shard_bytes=total_shard)
         led.record_adopt("full_upload" if full else "delta_upload")
         return tuple(dev)

@@ -32,9 +32,12 @@ from ..provisioning.scheduler import (
 from ..scheduling.requirements import IN, Requirement, Requirements
 from ..metrics.registry import (
     SOLVER_DECODE_BYTES,
+    SOLVER_MESH_DEVICES,
     SOLVER_RELAX_DISPATCHES,
     SOLVER_RESUME_HIT_RATE,
     SOLVER_RUNS_SKIPPED,
+    SOLVER_SHARD_FIXUP_RUNS,
+    SOLVER_SHARDED_FALLBACK,
     SOLVER_SOLVES,
     SOLVER_WIDE_REFETCH,
 )
@@ -704,7 +707,8 @@ class TPUSolver(Solver):
     def __init__(self, max_claims: int = 1024, fallback: Optional[Solver] = None,
                  arena: bool = True, resume: bool = True,
                  ckpt_every: int = 16, ckpt_slots: int = 4,
-                 device_decode: bool = True, relax_ladder: bool = True):
+                 device_decode: bool = True, relax_ladder: bool = True,
+                 shards: int = 0):
         self.max_claims = max_claims
         if fallback is None:
             # fallback chain: native C++ core (compiled-class speed), which
@@ -719,7 +723,19 @@ class TPUSolver(Solver):
             "resume_solves": 0, "resume_runs_skipped": 0,
             "wide_refetches": 0, "ladder_solves": 0,
             "relax_dispatches": 0, "ladder_rungs_used": 0,
+            "sharded_solves": 0, "shard_fixup_runs": 0,
+            "sharded_fallbacks": 0, "shard_resume_solves": 0,
+            "shard_resume_runs_skipped": 0,
         }
+        # mesh-sharded provisioning solve (ISSUE 7, SPEC.md "Sharding
+        # semantics"): shards >= 2 partitions ONE solve's run axis across a
+        # device mesh (block-local scans + host carry-exchange stitch,
+        # decision-identical to the one-device scan); 0/1 keeps every solve
+        # single-device. The actual mesh is the largest power of 2 ≤
+        # min(shards, visible devices, 16), built lazily (_shard_mesh).
+        self.shards = max(0, int(shards))
+        self._shard_mesh_cache: object = False  # False = not yet probed
+        self._shard_prewarmed: set = set()  # mesh device-set tokens AOT'd
         # on-device decode (tpu/ffd.compact_takes + decode_delta): fetch the
         # take tables as a packed claim-delta instead of dense grids;
         # false = dense uint16 packing (debug escape hatch / parity oracle)
@@ -746,6 +762,36 @@ class TPUSolver(Solver):
         self.resume = bool(resume) and arena
         self.ckpt_every = max(1, int(ckpt_every))
         self.ckpt_slots = max(1, int(ckpt_slots))
+
+    def _shard_mesh(self):
+        """Lazy mesh for mesh-sharded provisioning solves: the largest
+        power-of-2 device count ≤ min(shards, visible devices, 16) on a
+        1-D "shards" axis, or None when fewer than 2 devices are usable.
+        Cached — mesh construction touches the device registry. The 16 cap
+        matches ffd.SHARD_BLOCK_MULT: the padded run axis is always a
+        multiple of 16, so any mesh this returns divides it evenly."""
+        if self.shards < 2:
+            return None
+        if self._shard_mesh_cache is not False:
+            return self._shard_mesh_cache
+        mesh = None
+        try:
+            import jax
+
+            from ..parallel.sharded import make_mesh
+
+            limit = min(self.shards, len(jax.devices()), 16)
+            n = 1
+            while n * 2 <= limit:
+                n *= 2
+            if n >= 2:
+                mesh = make_mesh(n, axis="shards")
+        except Exception:
+            mesh = None
+        self._shard_mesh_cache = mesh
+        if mesh is not None:
+            SOLVER_MESH_DEVICES.set(int(mesh.devices.size))
+        return mesh
 
     def invalidate_arena(self) -> None:
         """Drop every device-resident kernel-arg buffer AND the checkpoint
@@ -1345,6 +1391,40 @@ class TPUSolver(Solver):
                 except Exception:
                     return n  # a compile failure would repeat at every point
                 n += 1
+        mesh = self._shard_mesh()
+        if mesh is not None:
+            # mesh-sharded entry point: lower once per mesh (keyed on the
+            # device set — a resized slice must relower) with sharding-
+            # carrying ShapeDtypeStructs so the AOT executable bakes in the
+            # same GSPMD partitioning production dispatches request. Only
+            # zone_engine=False exists sharded (V>0 fleets decline).
+            token = tuple(int(d.id) for d in mesh.devices.flat)
+            Nd = int(mesh.devices.size)
+            Sp = specs[0].shape[0]
+            if token not in self._shard_prewarmed and Sp % Nd == 0:
+                try:
+                    from jax.sharding import NamedSharding, PartitionSpec
+
+                    from .tpu.ffd import ffd_solve_sharded
+
+                    blocked = NamedSharding(mesh,
+                                            PartitionSpec("shards", None))
+                    repl = NamedSharding(mesh, PartitionSpec())
+                    sh_specs = tuple(
+                        jax.ShapeDtypeStruct((Nd, Sp // Nd), s.dtype,
+                                             sharding=blocked)
+                        if i < 2 else
+                        jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=repl)
+                        for i, s in enumerate(specs)
+                    )
+                    for M in claim_buckets:
+                        ffd_solve_sharded.lower(
+                            *sh_specs, max_claims=int(M), zone_engine=False
+                        ).compile()
+                        n += 1
+                    self._shard_prewarmed.add(token)
+                except Exception:
+                    return n
         return n
 
     # -- device path --------------------------------------------------------
@@ -1524,6 +1604,13 @@ class TPUSolver(Solver):
             host_args, dims, prov = host_kernel_args(enc, self._bucket)
         except UnpackableInput:
             return None  # Z*C > 32 — replay on fallback
+        if self.shards >= 2:
+            # mesh-sharded run-axis solve; declines (inexpressible carry
+            # combine, no usable mesh, stitch overflow) fall through to the
+            # single-device path below — trivially decision-identical
+            sharded = self._sharded_solve_async(enc, host_args, dims, prov)
+            if sharded is not None:
+                return sharded
         # transfer ledger window: every host→device byte of this solve
         # (arena packed upload OR per-array conversions) and every fetched
         # result byte lands in one per-solve record (solver/arena.py)
@@ -1811,6 +1898,489 @@ class TPUSolver(Solver):
             "final_state": out.state,
             "final_covered": S,
         })
+
+    # -- mesh-sharded solve (ISSUE 7; SPEC.md "Sharding semantics") ----------
+    #
+    # One provisioning solve partitioned across a device mesh: the padded
+    # run axis splits into Nd contiguous blocks (encode.mesh_run_blocks),
+    # every device scans its block from the INITIAL carry in parallel
+    # (ffd.ffd_solve_sharded — the same traced scan body as ffd_solve), and
+    # a host-side carry-exchange pass stitches blocks left-to-right into the
+    # sequential result. For each block the stitch either ACCEPTS the
+    # block-local decisions (proved non-interacting with the true prefix
+    # carry — claims renumber by offset, counts combine additively over the
+    # scan's initial bases) or REPLAYS the block via ffd_resume from the
+    # stitched carry (the replay IS the sequential scan for that block, so
+    # it is the universal correctness escape hatch). Decision identity with
+    # the one-device scan is by induction over blocks; the accept conditions
+    # are conservative SUPERSETS of every cross-block interaction the kernel
+    # can express (see _shard_stitch). Fleets the combine can't express —
+    # active domain event engine (V>0) or hostname-constraint axis (Q>0) —
+    # decline up front, counted in karpenter_solver_sharded_fallback_total,
+    # and run the single-device path: trivially decision-identical.
+
+    # FFDState fields indexed by claim slot — the rows the accept path
+    # renumbers by the prefix claim offset
+    _SHARD_CLAIM_FIELDS = ("c_cum", "c_mask", "c_zc_bits", "c_gbits",
+                           "c_pool", "c_cm", "c_co", "c_vm", "c_vo")
+
+    def _shard_decline(self) -> None:
+        self.stats["sharded_fallbacks"] += 1
+        SOLVER_SHARDED_FALLBACK.inc()
+
+    def _shard_bases(self, host_args) -> dict:
+        """The non-zero initial values of the scan carry (state0 seeds
+        p_usage/e_cm/e_co/v_count from these tables), as host int32 — the
+        additive combine must subtract them so a block's LOCAL deltas add
+        onto the true prefix carry exactly once."""
+        from .tpu.ffd import ARG_INDEX
+
+        return {
+            "p_usage": np.asarray(host_args[ARG_INDEX["pool_usage0"]],
+                                  dtype=np.int32),
+            "e_cm": np.asarray(host_args[ARG_INDEX["node_q_member"]],
+                               dtype=np.int32),
+            "e_co": np.asarray(host_args[ARG_INDEX["node_q_owner"]],
+                               dtype=np.int32),
+            "v_count": np.asarray(host_args[ARG_INDEX["v_count0"]],
+                                  dtype=np.int32),
+        }
+
+    @staticmethod
+    def _shard_state0(lane_state, bases) -> dict:
+        """Host analog of the kernel's state0 carry (shapes from one lane's
+        fetched state): the stitch's running true carry starts here."""
+        from .tpu.ffd import FFDState
+
+        st = {f: np.zeros_like(np.asarray(getattr(lane_state, f)[0]))
+              for f in FFDState._fields}
+        st["c_pool"] = np.full_like(st["c_pool"], -1)
+        st["p_usage"] = bases["p_usage"].copy()
+        st["e_cm"] = bases["e_cm"].copy()
+        st["e_co"] = bases["e_co"].copy()
+        st["v_count"] = bases["v_count"].copy()
+        return st
+
+    def _sharded_solve_async(self, enc: EncodedInput, host_args, dims, prov):
+        """Dispatch one solve mesh-sharded; None declines to the
+        single-device path (decline reasons that reflect an inexpressible
+        carry combine are counted — no-mesh is not a fallback, it is the
+        normal shape of a 1-device rig)."""
+        mesh = self._shard_mesh()
+        if mesh is None:
+            return None
+        Nd = int(mesh.devices.size)
+        S = dims["S"]
+        Sp = int(host_args[0].shape[0])
+        if enc.V > 0 or enc.Q > 0:
+            # the domain event engine / hostname-constraint allowances read
+            # cross-block state the accept conditions don't bound — the
+            # carry combine is inexpressible for these fleets (soft-spread
+            # relax-ladder fleets land here too; SPEC.md lists the rules)
+            self._shard_decline()
+            return None
+        if S < Nd or Sp % Nd:
+            self._shard_decline()
+            return None
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from .encode import mesh_run_blocks
+        from .tpu.ffd import ffd_solve_sharded
+
+        Sblk = Sp // Nd
+        SOLVER_MESH_DEVICES.set(Nd)
+        rgb, rcb = mesh_run_blocks(
+            np.asarray(host_args[0]), np.asarray(host_args[1]), Nd
+        )
+        sh_args = (rgb, rcb) + tuple(host_args[2:])
+        blocked = NamedSharding(mesh, PartitionSpec("shards", None))
+        repl = NamedSharding(mesh, PartitionSpec())
+        shardings = (blocked, blocked) + (repl,) * (len(host_args) - 2)
+        self.ledger.begin_solve()
+        key = None
+        if self.arena is not None:
+            args = self.arena.adopt(sh_args, prov, sharding=shardings)
+            key = self.arena.bucket_key(sh_args, shardings)
+        else:
+            up = 0
+            up_shard = 0
+            for a in sh_args[:2]:
+                up += a.nbytes
+                up_shard += a.nbytes
+            for a in sh_args[2:]:
+                up += a.nbytes
+            args = tuple(
+                jax.device_put(a, s) for a, s in zip(sh_args, shardings)
+            )
+            self.ledger.record_upload(up, len(sh_args), msgs=len(sh_args),
+                                      shard_bytes=up_shard)
+        total_pods = int(sum(len(p) for p in enc.group_pods))
+        M0 = initial_claim_bucket(total_pods, self.max_claims)
+        plan = self._plan_shard_resume(enc, key, M0, S, Nd, Sblk)
+        if plan is not None:
+            return self._dispatch_shard_resume(
+                enc, host_args, dims, mesh, args, plan, M0, Nd, Sblk
+            )
+        faults.check("solver.device_dispatch")
+        out = ffd_solve_sharded(*args, max_claims=M0, zone_engine=False)
+
+        def finish() -> Optional[SolverResult]:
+            try:
+                return self._sharded_finish(
+                    enc, host_args, dims, mesh, args, out, M0, key
+                )
+            finally:
+                self.ledger.end_solve()
+
+        return finish
+
+    def _sharded_finish(self, enc, host_args, dims, mesh, args, out, M0,
+                        key) -> Optional[SolverResult]:
+        """Stitch loop with claim-overflow doubling (mirrors the cold
+        finish): a saturated stitch redispatches the whole sharded solve at
+        the doubled bucket against the same resident args."""
+        from .tpu.ffd import ffd_solve_sharded
+
+        M, cur = M0, out
+        while True:
+            res = self._shard_stitch(enc, host_args, dims, mesh, args, cur, M)
+            if res is not None:
+                break
+            if M >= self.max_claims:
+                return None  # true overflow — replay on the fallback chain
+            M = min(M * 2, self.max_claims)
+            faults.check("solver.device_dispatch")
+            cur = ffd_solve_sharded(*args, max_claims=M, zone_engine=False)
+        take_e_p, take_c_p, leftover_p, P, fixup, carries = res
+        self.stats["sharded_solves"] += 1
+        self.stats["shard_fixup_runs"] += fixup
+        if fixup:
+            SOLVER_SHARD_FIXUP_RUNS.inc(fixup)
+        res_out = self._shard_decode(enc, dims, take_e_p, take_c_p,
+                                     leftover_p, P)
+        self._record_shard(enc, key, M, dims["S"], len(carries),
+                           carries, take_e_p, take_c_p, leftover_p)
+        return res_out
+
+    def _shard_stitch(self, enc, host_args, dims, mesh, args, out, M):
+        """Fetch the lane-local outputs and stitch blocks left-to-right
+        under the running TRUE carry P. Returns (take_e [Sp, Ep], take_c
+        [Sp, M], leftover [Sp], final carry dict, fixup_runs, block-boundary
+        carries) or None when any path saturates the claim bucket.
+
+        Block d ACCEPTS iff all of (evaluated against P at block start —
+        valid for every run of the block because claim capacity/type masks/
+        offering bits only shrink and node/pool state only grows):
+          (a) no run of the block resource+compat-fits ANY open claim of P
+              (the fit test ignores offering bits, pair compatibility, and
+              pool admission — a strict SUPERSET of kernel-admissible
+              pours, so "no superset fit" proves the kernel pours nothing
+              into prefix claims);
+          (b) the prefix never touched existing nodes (e_cum at zero,
+              hostname counts at their seeds) — node capacity is monotone,
+              so an untouched prefix means the lane saw true node state;
+          (c) no finite-limit pool's usage moved from its seed (prefix
+              consumed no limited headroom the lane assumed free);
+          (d) P.used + lane.used <= M and the lane itself never saturated —
+              sufficient for slot-clamp equivalence: a lane clamped by
+              slots_left must end at used == M, so an unsaturated lane was
+              never clamped, and the bound keeps the sequential scan
+              unclamped too.
+        Otherwise the block REPLAYS via ffd_resume from P — sequentially
+        exact by construction — and its replayed real runs count into the
+        fix-up gauge."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from .tpu.ffd import ARG_INDEX, FFDState, ffd_resume
+
+        INT32_MAX_NP = np.int32(2**31 - 1)
+        h = jax.tree_util.tree_map(np.asarray, out)
+        self.ledger.record_fetch(
+            sum(x.nbytes for x in jax.tree_util.tree_leaves(h)), msgs=1
+        )
+        st = h.state
+        Nd = int(st.used.shape[0])
+        Sblk = int(h.take_e.shape[1])
+        T = dims["T"]
+        bases = self._shard_bases(host_args)
+        P = self._shard_state0(st, bases)
+        rg = np.asarray(host_args[0]).reshape(Nd, Sblk)
+        rc = np.asarray(host_args[1]).reshape(Nd, Sblk)
+        type_alloc = np.asarray(host_args[ARG_INDEX["type_alloc"]])
+        group_req = np.asarray(host_args[ARG_INDEX["group_req"]])
+        group_compat_t = np.asarray(host_args[ARG_INDEX["group_compat_t"]])
+        pool_limit = np.asarray(host_args[ARG_INDEX["pool_limit"]])
+        finite_pool = (pool_limit < INT32_MAX_NP).any(axis=1)
+        repl = NamedSharding(mesh, PartitionSpec())
+        rows_e = []
+        rows_c = []
+        rows_l = []
+        carries = []
+        fixup = 0
+        for d in range(Nd):
+            real = rc[d] > 0
+            n_real = int(real.sum())
+            if n_real == 0:
+                # pure padding block: no-op lanes, nothing to stitch
+                rows_e.append(np.asarray(h.take_e[d]))
+                rows_c.append(np.zeros((Sblk, M), h.take_c.dtype))
+                rows_l.append(np.asarray(h.leftover[d]))
+                carries.append({f: v.copy() for f, v in P.items()})
+                continue
+            lane_used = int(st.used[d])
+            offset = int(P["used"])
+            replay = lane_used >= M or offset + lane_used > M  # (d)
+            if not replay and d > 0:
+                if P["e_cum"].any() or (P["e_cm"] != bases["e_cm"]).any() \
+                        or (P["e_co"] != bases["e_co"]).any():
+                    replay = True  # (b)
+                elif (finite_pool[:, None]
+                      & (P["p_usage"] != bases["p_usage"])).any():
+                    replay = True  # (c)
+                elif offset > 0:
+                    open_m = np.flatnonzero(P["c_pool"] >= 0)
+                    if open_m.size:
+                        # (a) superset fit: claim survives if EVERY nonzero
+                        # request axis still fits under some surviving type
+                        # the group tolerates
+                        room = (
+                            type_alloc[None, :, :].astype(np.int64)
+                            - P["c_cum"][open_m][:, None, :]
+                        )  # [m, Tp, R]
+                        cmask = P["c_mask"][open_m]  # [m, Tp]
+                        for g in np.unique(rg[d][real]):
+                            req = group_req[int(g)]
+                            fit = ((room >= req[None, None, :])
+                                   | (req[None, None, :] == 0)).all(axis=2)
+                            if (fit & cmask
+                                    & group_compat_t[int(g)][None, :]).any():
+                                replay = True
+                                break
+            if not replay:
+                u = lane_used
+                row_c = np.zeros((Sblk, M), h.take_c.dtype)
+                if u:
+                    row_c[:, offset:offset + u] = h.take_c[d][:, :u]
+                    for f in self._SHARD_CLAIM_FIELDS:
+                        P[f][offset:offset + u] = np.asarray(
+                            getattr(st, f)[d][:u]
+                        )
+                P["used"] = np.int32(offset + u)
+                P["e_cum"] = P["e_cum"] + np.asarray(st.e_cum[d])
+                P["e_cm"] = P["e_cm"] + np.asarray(st.e_cm[d]) - bases["e_cm"]
+                P["e_co"] = P["e_co"] + np.asarray(st.e_co[d]) - bases["e_co"]
+                P["p_usage"] = (P["p_usage"] + np.asarray(st.p_usage[d])
+                                - bases["p_usage"])
+                P["v_count"] = (P["v_count"] + np.asarray(st.v_count[d])
+                                - bases["v_count"])
+                P["v_owner_z"] = P["v_owner_z"] | np.asarray(st.v_owner_z[d])
+                rows_e.append(np.asarray(h.take_e[d]))
+                rows_c.append(row_c)
+                rows_l.append(np.asarray(h.leftover[d]))
+            else:
+                # fix-up replay: the block re-runs sequentially from the
+                # true carry; claims number from P.used automatically
+                fixup += n_real
+                faults.check("solver.device_dispatch")
+                init = jax.device_put(
+                    FFDState(**{f: P[f] for f in FFDState._fields}), repl
+                )
+                dev_sg = jax.device_put(rg[d], repl)
+                dev_sc = jax.device_put(rc[d], repl)
+                self.ledger.record_upload(
+                    sum(v.nbytes for v in P.values())
+                    + rg[d].nbytes + rc[d].nbytes,
+                    len(P) + 2, msgs=3,
+                )
+                r_out, _ = ffd_resume(
+                    init, dev_sg, dev_sc, *args[2:],
+                    max_claims=M, zone_engine=False,
+                    ckpt_every=self.ckpt_every, n_ckpt=self.ckpt_slots,
+                )
+                rh = jax.tree_util.tree_map(np.asarray, r_out)
+                self.ledger.record_fetch(
+                    sum(x.nbytes
+                        for x in jax.tree_util.tree_leaves(rh)), msgs=1
+                )
+                if int(rh.state.used) >= M:
+                    return None  # replay saturated the bucket — double M
+                P = {f: np.array(getattr(rh.state, f))  # writable copies
+                     for f in rh.state._fields}
+                rows_e.append(rh.take_e)
+                rows_c.append(rh.take_c)
+                rows_l.append(rh.leftover)
+            carries.append({f: np.copy(v) for f, v in P.items()})
+        if int(P["used"]) > M:
+            return None
+        return (
+            np.concatenate(rows_e),
+            np.concatenate(rows_c),
+            np.concatenate(rows_l),
+            P,
+            fixup,
+            carries,
+        )
+
+    def _shard_decode(self, enc, dims, take_e_p, take_c_p, leftover_p, P):
+        """Dense decode of the stitched tables — the stitched carry already
+        lives host-side, so claim metadata unpacks straight from it."""
+        S, E, T, G = dims["S"], dims["E"], dims["T"], dims["G"]
+        Z, C = dims["Z"], dims["C"]
+        c_mask = np.asarray(P["c_mask"])[:, :T]
+        c_zone, c_ct = unpack_zc_bits(np.asarray(P["c_zc_bits"]), Z, C)
+        c_gmask = _unpack_gmask(np.asarray(P["c_gbits"]), G)
+        return decode(
+            enc, take_e_p[:S, :E], take_c_p[:S], leftover_p[:S], c_mask,
+            c_zone, c_ct, np.asarray(P["c_pool"]), c_gmask,
+            np.asarray(P["c_cum"]), int(P["used"]),
+        )
+
+    def _record_shard(self, enc, key, M, S, Nd, carries, take_e_p, take_c_p,
+                      leftover_p) -> None:
+        """Record the sharded solve as its bucket's shard-resume donor: the
+        block-boundary carries ARE the per-device checkpoints (host-side —
+        unlike the plain ring they already crossed the link during the
+        stitch), so a later solve differing only from block b onward
+        replays one suffix from carries[b-1]."""
+        if not self.resume or self.arena is None or key is None:
+            return
+        from . import encode_cache as ec
+        from .tpu.ffd import ARG_INDEX
+
+        ident = ec.run_identity(enc)
+        if not ident or len(ident) != S:
+            return
+        ctx = self.arena.context_signature(
+            key, exclude=(ARG_INDEX["run_group"], ARG_INDEX["run_count"])
+        )
+        if ctx is None:
+            return
+        self.arena.put_shard_record(key, {
+            "run_ident": ident,
+            "M": M,
+            "n_shards": Nd,
+            "ctx_sig": ctx,
+            "carries": carries,
+            "take_e": np.asarray(take_e_p),
+            "take_c": np.asarray(take_c_p),
+            "leftover": np.asarray(leftover_p),
+        })
+
+    def _plan_shard_resume(self, enc, key, M0: int, S: int, Nd: int,
+                           Sblk: int):
+        """Newest valid shard record reusable from a whole-block boundary:
+        same bucket/claim bucket/mesh width, byte-identical non-run context
+        (arena signature leg), and a run-identity common prefix covering
+        b >= 1 complete blocks. Identical run lists keep the zero-upload
+        exact-hit cold path, mirroring _plan_resume."""
+        if not self.resume or self.arena is None or key is None:
+            return None
+        from . import encode_cache as ec
+        from .tpu.ffd import ARG_INDEX
+
+        rec = self.arena.get_shard_record(key)
+        if rec is None or rec["M"] != M0 or rec["n_shards"] != Nd:
+            return None
+        ctx = self.arena.context_signature(
+            key, exclude=(ARG_INDEX["run_group"], ARG_INDEX["run_count"])
+        )
+        if ctx is None or ctx != rec["ctx_sig"]:
+            return None
+        cur = ec.run_identity(enc)
+        if not cur or len(cur) != S:
+            return None
+        lcp = ec.run_lcp(rec["run_ident"], cur)
+        if lcp == len(cur) == len(rec["run_ident"]):
+            return None  # exact hit — cold sharded path is already 0-upload
+        b = min(lcp // Sblk, Nd - 1)
+        if b < 1:
+            return None
+        return {"b": b, "carry": rec["carries"][b - 1], "rec": rec}
+
+    def _dispatch_shard_resume(self, enc, host_args, dims, mesh, args, plan,
+                               M: int, Nd: int, Sblk: int):
+        """Replay only blocks [b:] as ONE replicated ffd_resume from the
+        recorded block-boundary carry; rows [0, b*Sblk) splice from the
+        donor record. Composes suffix resume with sharding: the per-device
+        checkpoints (block carries) bound the replay to the changed tail."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from .tpu.ffd import FFDState, ffd_resume
+
+        faults.check("solver.device_dispatch")
+        b = plan["b"]
+        k = b * Sblk
+        Sp = int(host_args[0].shape[0])
+        repl = NamedSharding(mesh, PartitionSpec())
+        carry = plan["carry"]
+        sg = np.asarray(host_args[0])[k:Sp]
+        sc = np.asarray(host_args[1])[k:Sp]
+        init = jax.device_put(
+            FFDState(**{f: carry[f] for f in FFDState._fields}), repl
+        )
+        dev_sg = jax.device_put(sg, repl)
+        dev_sc = jax.device_put(sc, repl)
+        self.ledger.record_upload(
+            sum(v.nbytes for v in carry.values()) + sg.nbytes + sc.nbytes,
+            len(carry) + 2, msgs=3,
+        )
+        out, _ = ffd_resume(
+            init, dev_sg, dev_sc, *args[2:],
+            max_claims=M, zone_engine=False,
+            ckpt_every=self.ckpt_every, n_ckpt=self.ckpt_slots,
+        )
+
+        def finish() -> Optional[SolverResult]:
+            try:
+                import jax as _jax
+
+                rh = _jax.tree_util.tree_map(np.asarray, out)
+                self.ledger.record_fetch(
+                    sum(x.nbytes
+                        for x in _jax.tree_util.tree_leaves(rh)), msgs=1
+                )
+                if int(rh.state.used) >= M:
+                    # suffix overflowed the donor's bucket: redo COLD
+                    # sharded at the doubled bucket (resident args reused)
+                    from .tpu.ffd import ffd_solve_sharded
+
+                    if M >= self.max_claims:
+                        return None
+                    M2 = min(M * 2, self.max_claims)
+                    faults.check("solver.device_dispatch")
+                    cold = ffd_solve_sharded(
+                        *args, max_claims=M2, zone_engine=False
+                    )
+                    return self._sharded_finish(
+                        enc, host_args, dims, mesh, args, cold, M2, None
+                    )
+                rec = plan["rec"]
+                pre_c = rec["take_c"][:k]
+                if rec["take_c"].shape[1] < M:
+                    pad = np.zeros(
+                        (k, M - rec["take_c"].shape[1]), pre_c.dtype
+                    )
+                    pre_c = np.concatenate([pre_c, pad], axis=1)
+                take_e_p = np.concatenate([rec["take_e"][:k], rh.take_e])
+                take_c_p = np.concatenate([pre_c, rh.take_c])
+                leftover_p = np.concatenate(
+                    [rec["leftover"][:k], rh.leftover]
+                )
+                P = {f: np.asarray(getattr(rh.state, f))
+                     for f in rh.state._fields}
+                self.stats["sharded_solves"] += 1
+                self.stats["shard_resume_solves"] += 1
+                self.stats["shard_resume_runs_skipped"] += k
+                return self._shard_decode(
+                    enc, dims, take_e_p, take_c_p, leftover_p, P
+                )
+            finally:
+                self.ledger.end_solve()
+
+        return finish
 
 
 def _unpack_words(words: np.ndarray, width: int) -> np.ndarray:

@@ -151,3 +151,16 @@ def run_lcp(prev: tuple, cur: tuple) -> int:
     while k < n and prev[k] == cur[k]:
         k += 1
     return k
+
+
+def run_block_identity(ident: tuple, n_shards: int, block: int) -> tuple:
+    """Per-mesh-block slices of a run_identity() tuple: block d of a sharded
+    solve covers real runs [d*block, min((d+1)*block, len(ident))) of the
+    scan order (encode.mesh_run_blocks keeps blocks contiguous; padding
+    rides at the tail). The block boundaries are where the sharded path's
+    block-boundary carries — its per-device checkpoints — are recorded, so
+    shard resume (backend._plan_shard_resume) compares identities block by
+    block with the same (snum, group, count) triples plain resume uses."""
+    return tuple(
+        ident[d * block : (d + 1) * block] for d in range(n_shards)
+    )

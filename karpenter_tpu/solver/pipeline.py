@@ -220,6 +220,39 @@ class SolveService:
             "resume_hit_rate": float(getattr(inner, "resume_hit_rate", 0.0)),
         }
 
+    def shard_stats(self) -> Dict[str, float]:
+        """Mesh-sharded solve counters of the owned backend (zeros when the
+        backend has none, or shards are off) — the ISSUE 7 bench keys. The
+        per-device upload figure divides the partitioned h2d bytes by the
+        mesh width the backend actually built (SPEC.md "Sharding
+        semantics")."""
+        inner = self.solver
+        stats = getattr(inner, "stats", None) or {}
+        ledger = getattr(inner, "ledger", None)
+        mesh = None
+        shard_mesh = getattr(inner, "_shard_mesh", None)
+        if callable(shard_mesh):
+            try:
+                mesh = shard_mesh()
+            except Exception:
+                mesh = None
+        n_dev = int(mesh.devices.size) if mesh is not None else 1
+        per_dev = 0.0
+        fn = getattr(ledger, "shard_upload_bytes_per_device", None)
+        if callable(fn):
+            per_dev = float(fn(n_dev))
+        return {
+            "mesh_devices": n_dev if mesh is not None else 0,
+            "sharded_solves": int(stats.get("sharded_solves", 0)),
+            "shard_fixup_runs": int(stats.get("shard_fixup_runs", 0)),
+            "sharded_fallbacks": int(stats.get("sharded_fallbacks", 0)),
+            "shard_resume_solves": int(stats.get("shard_resume_solves", 0)),
+            "shard_resume_runs_skipped": int(
+                stats.get("shard_resume_runs_skipped", 0)
+            ),
+            "shard_upload_bytes_per_device": per_dev,
+        }
+
     def decode_stats(self) -> Dict[str, float]:
         """On-device decode + relax-ladder counters of the owned backend
         (zeros when the backend has none) — the ISSUE 6 bench keys."""

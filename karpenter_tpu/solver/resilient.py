@@ -436,7 +436,9 @@ class ResilientSolver(Solver):
         """Walk the chain; every rung's result faces the same gate."""
         # a replay must never trust device-resident state left by the
         # failed / gate-rejected solve — drop the arena first (argument
-        # buffers, checkpoint ring, AND resident relax-ladder rung tables)
+        # buffers, checkpoint ring, resident relax-ladder rung tables, AND
+        # the mesh-sharded residency: per-device argument shards plus the
+        # block-boundary carries that act as per-device checkpoint rings)
         # so the next device solve re-uploads from scratch (solver/arena.py)
         inv = getattr(self.inner, "invalidate_arena", None)
         if inv is not None:

@@ -284,6 +284,13 @@ def _gbit_word(g, W):
 DELTA_HEADER_WORDS = 3  # [overflow_flag, entry_count, uniq_meta_count] i32
 DELTA_ENTRY_U16 = 2  # (code, count) uint16 per entry word; code = e | E+m
 
+# Mesh-sharded solve (ffd_solve_sharded): the padded run axis Sp is always a
+# multiple of this (backend buckets S with mult=floor=16), so any power-of-2
+# mesh up to 16 devices divides it into equal contiguous blocks with NO
+# resharding padding. encode.mesh_run_blocks relies on it; pinned by
+# tests/test_arg_spec_drift.py.
+SHARD_BLOCK_MULT = 16
+
 
 def compact_takes(take_e, take_c, cap: int):
     """[Sp,E]/[Sp,M] dense takes -> run-major packed nonzero entries.
@@ -2106,3 +2113,113 @@ def ffd_solve_ladder(
         run_ladder=run_ladder,
     )
     return out
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_claims", "emit_takes", "zone_engine")
+)
+def ffd_solve_sharded(
+    run_group,  # [Nd, Sblk] i32 — contiguous run blocks, one per mesh device
+    run_count,  # [Nd, Sblk] i32
+    group_req,
+    group_compat_t,
+    group_zc_bits,
+    group_pool,
+    group_pair_nok,
+    group_device,
+    type_alloc,
+    type_charge,
+    offer_zc_bits,
+    pool_type,
+    pool_zc_bits,
+    pool_daemon,
+    pool_limit,
+    pool_usage0,
+    node_free,
+    node_compat,
+    q_member,
+    q_owner,
+    q_kind,
+    q_cap,
+    node_q_member,
+    node_q_owner,
+    v_member,
+    v_owner,
+    v_kind,
+    v_cap,
+    v_primary,
+    v_aff,
+    v_count0,
+    node_zone,
+    zone_col_mask,
+    node_dom2,
+    col_axis,
+    group_daxis,
+    *,
+    max_claims: int,
+    emit_takes: bool = True,
+    zone_engine: bool = True,
+) -> FFDOutput:
+    """Block-local FFD scans over mesh-partitioned run blocks, one lane per
+    device. The tensor contract is the frozen ARG_SPEC 36 — identical names
+    and order to ffd_solve — with ONLY the two run arrays carrying a leading
+    block axis [Nd, Sblk] (encode.mesh_run_blocks); the other 34 broadcast
+    unbatched into every lane. Each lane runs the SAME traced scan body as
+    the one-device solve from the initial carry (state0), so a lane's output
+    is bit-identical to ffd_solve over its block in isolation. Placement is
+    computation-follows-data: the backend device_puts the block axis with a
+    NamedSharding over the mesh's "shards" axis and the broadcast args
+    replicated, so each device scans exactly its own block with no
+    collectives inside the solve. The carry exchange that stitches lanes
+    into the sequential result — associative combine over FFDState plus
+    fix-up replay of blocks whose placement changes under the true prefix
+    carry (via ffd_resume, the universal escape hatch) — is host-side in
+    backend._sharded_finish; see SPEC.md "Sharding semantics". Returns
+    FFDOutput with a leading [Nd] axis on every leaf (state.used becomes
+    [Nd] — per-lane claim slots, each lane numbering from 0)."""
+
+    def lane(rg, rc):
+        out, _ = _ffd_scan(
+            rg,
+            rc,
+            group_req,
+            group_compat_t,
+            group_zc_bits,
+            group_pool,
+            group_pair_nok,
+            group_device,
+            type_alloc,
+            type_charge,
+            offer_zc_bits,
+            pool_type,
+            pool_zc_bits,
+            pool_daemon,
+            pool_limit,
+            pool_usage0,
+            node_free,
+            node_compat,
+            q_member,
+            q_owner,
+            q_kind,
+            q_cap,
+            node_q_member,
+            node_q_owner,
+            v_member,
+            v_owner,
+            v_kind,
+            v_cap,
+            v_primary,
+            v_aff,
+            v_count0,
+            node_zone,
+            zone_col_mask,
+            node_dom2,
+            col_axis,
+            group_daxis,
+            max_claims=max_claims,
+            emit_takes=emit_takes,
+            zone_engine=zone_engine,
+        )
+        return out
+
+    return jax.vmap(lane)(run_group, run_count)

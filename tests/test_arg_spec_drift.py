@@ -146,6 +146,62 @@ def test_ladder_entry_point_shares_the_tensor_contract():
     )
 
 
+# -- mesh-sharded solve (ISSUE 7) ---------------------------------------------
+
+
+def test_sharded_entry_point_shares_the_tensor_contract():
+    """ffd_solve_sharded takes the SAME 36 positional tensors as ffd_solve
+    (run_group/run_count carry a leading [Nd] block axis but keep their
+    names and positions), statics trailing — so the sharded dispatch can
+    splice the arena's resident args[2:] and the AOT prewarm can bind
+    positionally, exactly like every other entry point."""
+    params = list(
+        inspect.signature(ffd.ffd_solve_sharded.__wrapped__).parameters
+    )
+    tensor = [p for p in params if p not in STATICS]
+    assert tuple(tensor) == ffd.ARG_SPEC, (
+        "ffd_solve_sharded's tensor params drifted from ffd.ARG_SPEC"
+    )
+    assert params == tensor + list(STATICS), (
+        f"ffd_solve_sharded: statics must trail as ({', '.join(STATICS)})"
+    )
+
+
+def test_shard_block_alignment_is_pinned():
+    """The run-axis bucket multiple IS the shard-block alignment contract:
+    backend buckets Sp with mult=floor=16, so every power-of-2 mesh up to
+    16 devices divides the padded run axis into equal contiguous blocks
+    with no resharding padding (encode.mesh_run_blocks relies on it, and
+    backend._shard_mesh caps the mesh width at it)."""
+    assert ffd.SHARD_BLOCK_MULT == 16
+    for n in (1, 2, 4, 8, 16):
+        assert ffd.SHARD_BLOCK_MULT % n == 0
+
+
+def test_mesh_run_blocks_wire_layout():
+    """Per-shard wire layout: blocks are CONTIGUOUS row-major slices of the
+    scan order — block d of the [Nd, Sblk] upload is runs
+    [d*Sblk, (d+1)*Sblk) exactly, so the stitch's left-to-right carry
+    exchange walks the same order the one-device scan does. Non-dividing
+    shard counts must refuse, not truncate."""
+    import numpy as np
+    import pytest
+
+    from karpenter_tpu.solver.encode import UnpackableInput, mesh_run_blocks
+
+    rg = np.arange(32, dtype=np.int32)
+    rc = (np.arange(32, dtype=np.int32) % 5) + 1
+    for nd in (1, 2, 4, 8, 16):
+        bg, bc = mesh_run_blocks(rg, rc, nd)
+        assert bg.shape == (nd, 32 // nd) and bc.shape == (nd, 32 // nd)
+        assert (bg.reshape(-1) == rg).all() and (bc.reshape(-1) == rc).all()
+        assert bg.flags["C_CONTIGUOUS"] and bc.flags["C_CONTIGUOUS"]
+    with pytest.raises(UnpackableInput):
+        mesh_run_blocks(rg, rc, 3)
+    with pytest.raises(UnpackableInput):
+        mesh_run_blocks(rg, rc, 0)
+
+
 def test_claim_delta_wire_layout_is_pinned():
     """backend._pack_dispatch's unpack slices the flat delta buffer by these
     constants; ffd's compaction writes it. Either side drifting silently

@@ -1,0 +1,188 @@
+"""Mesh-sharded FFD solve: decision identity with the one-device scan.
+
+ISSUE 7 acceptance: partitioning the run axis across a device mesh
+(TPUSolver(shards=N), solver/backend.py _sharded_solve_async) must be
+BIT-IDENTICAL in decisions to the single-device scan — the carry-exchange
+stitch either proves a block non-interacting and combines it additively, or
+replays it sequentially from the true prefix carry; either way the result
+is the sequential result. Covered here on the CPU virtual mesh (conftest
+forces --xla_force_host_platform_device_count=8):
+
+- randomized fleet parity across mesh sizes {1, 2, 4, 8}, fresh and with
+  existing nodes;
+- composition with the relax ladder (preference fleets) and with
+  checkpointed suffix resume (append-tail re-solves hit the block-boundary
+  carries);
+- the forced-fallback class: fleets whose carry combine is inexpressible
+  (zone/capacity-type domain engine, V > 0) decline INTO the counted
+  fallback and still decide identically via the single-device path.
+"""
+
+import random
+
+import pytest
+
+from karpenter_tpu.api import wellknown as wk
+from karpenter_tpu.api.objects import ObjectMeta, Pod, TopologySpreadConstraint
+from karpenter_tpu.provisioning.scheduler import SolverInput
+from karpenter_tpu.solver.backend import TPUSolver
+from karpenter_tpu.utils.resources import Resources
+
+from tests.test_zone_device import ZONES, mknode, mkpod, pool
+
+MESH_SIZES = (1, 2, 4, 8)
+
+
+def _mkpod(name, cpu, mem, **kw):
+    return Pod(meta=ObjectMeta(name=name, uid=name),
+               requests=Resources.parse({"cpu": cpu, "memory": mem}), **kw)
+
+
+def _random_fleet(rng, n):
+    """Mixed fleet: enough distinct signatures that the run axis splits
+    across every mesh size, sizes spanning several instance types."""
+    cpus = ["250m", "500m", "1", "1500m", "2", "3", "4", "6"]
+    mems = ["512Mi", "1Gi", "2Gi", "4Gi", "8Gi"]
+    return [
+        _mkpod(f"p{i:03d}", rng.choice(cpus), rng.choice(mems))
+        for i in range(n)
+    ]
+
+
+def _assert_same(a, b, tag=""):
+    assert a.placements == b.placements, f"{tag}: placements diverge"
+    assert set(a.errors) == set(b.errors), f"{tag}: errors diverge"
+    assert len(a.claims) == len(b.claims), f"{tag}: claim count diverges"
+    for i, (ca, cb) in enumerate(zip(a.claims, b.claims)):
+        assert ca.pod_uids == cb.pod_uids, f"{tag}: claim {i} pods"
+        assert ca.nodepool == cb.nodepool, f"{tag}: claim {i} pool"
+        assert sorted(ca.instance_type_names) == sorted(
+            cb.instance_type_names
+        ), f"{tag}: claim {i} types"
+
+
+class TestShardedParity:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_randomized_fleet_parity_across_mesh_sizes(self, seed):
+        rng = random.Random(seed)
+        pods = _random_fleet(rng, 90 + 10 * seed)
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()],
+                          zones=ZONES)
+        base = TPUSolver().solve(inp)
+        for n in MESH_SIZES:
+            s = TPUSolver(shards=n)
+            _assert_same(s.solve(inp), base, f"seed={seed} shards={n}")
+            if n >= 2:
+                # the mesh path must have actually served the solve — a
+                # silent decline would make this parity proof vacuous
+                assert s.stats["sharded_solves"] == 1, s.stats
+                assert s.stats["sharded_fallbacks"] == 0, s.stats
+            else:
+                assert s.stats["sharded_solves"] == 0, s.stats
+
+    def test_parity_with_existing_nodes(self):
+        rng = random.Random(7)
+        pods = _random_fleet(rng, 80)
+        nodes = [mknode(f"n{i}", ZONES[i % 3]) for i in range(5)]
+        inp = SolverInput(pods=pods, nodes=nodes, nodepools=[pool()],
+                          zones=ZONES)
+        base = TPUSolver().solve(inp)
+        for n in (2, 8):
+            s = TPUSolver(shards=n)
+            _assert_same(s.solve(inp), base, f"nodes shards={n}")
+            assert s.stats["sharded_solves"] == 1, s.stats
+
+    def test_fixup_replay_fires_on_interacting_blocks(self):
+        """One pool, many mutually-poured specs: later blocks' pods fit the
+        prefix's open claims, so the stitch must REPLAY (not accept) — the
+        fix-up counter proves the trigger logic saw the interaction."""
+        pods = [_mkpod(f"p{i:03d}", f"{2000 - i * 20}m", "1Gi")
+                for i in range(48)]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()],
+                          zones=ZONES)
+        base = TPUSolver().solve(inp)
+        s = TPUSolver(shards=8)
+        _assert_same(s.solve(inp), base, "fixup")
+        assert s.stats["sharded_solves"] == 1, s.stats
+        assert s.stats["shard_fixup_runs"] > 0, s.stats
+
+
+class TestShardedComposition:
+    def test_suffix_resume_composes_with_sharding(self):
+        """Append-tail re-solve: the second solve resumes from a recorded
+        block-boundary carry (the per-device checkpoint), replays only the
+        changed tail blocks, and still matches the single-device scan."""
+        pods = [_mkpod(f"p{i:03d}", f"{4000 - i * 50}m", "1Gi")
+                for i in range(60)]
+        inp1 = SolverInput(pods=pods, nodes=[], nodepools=[pool()],
+                          zones=ZONES)
+        # grow the LAST run's count only: same groups, same Sp bucket, so
+        # the run-identity prefix covers 7 of 8 blocks
+        pods2 = pods + [_mkpod(f"z{i}", f"{4000 - 59 * 50}m", "1Gi")
+                        for i in range(3)]
+        inp2 = SolverInput(pods=pods2, nodes=[], nodepools=[pool()],
+                           zones=ZONES)
+        s = TPUSolver(shards=8)
+        _assert_same(s.solve(inp1), TPUSolver().solve(inp1), "resume warm")
+        _assert_same(s.solve(inp2), TPUSolver().solve(inp2), "resume tail")
+        assert s.stats["shard_resume_solves"] == 1, s.stats
+        assert s.stats["shard_resume_runs_skipped"] > 0, s.stats
+
+    def test_relax_fleet_parity_under_shards(self):
+        """Respect-mode preference fleets: the relax loop's materialized
+        solves route through the same sharded seam; zone-preference
+        materializations carry V > 0 signatures and must decline into the
+        counted fallback while deciding identically."""
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.ZONE_LABEL,
+            label_selector={"app": "w"}, when_unsatisfiable="ScheduleAnyway",
+        )
+        pods = [mkpod(f"r{i:02d}", cpu="2", mem="4Gi", labels={"app": "w"},
+                      topology_spread=[tsc]) for i in range(12)]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()],
+                          zones=ZONES)
+        base = TPUSolver().solve(inp)
+        s = TPUSolver(shards=8)
+        _assert_same(s.solve(inp), base, "relax")
+
+
+class TestShardedFallback:
+    def test_inexpressible_carry_declines_and_counts(self):
+        """Zone-spread fleet (V > 0): the carry combine is inexpressible, so
+        the sharded path must decline up front, count the fallback, and let
+        the single-device kernel serve the solve — identical decisions."""
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.ZONE_LABEL,
+            label_selector={"app": "w"},
+        )
+        pods = [mkpod(f"v{i}", cpu="2", mem="4Gi", labels={"app": "w"},
+                      topology_spread=[tsc]) for i in range(9)]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()],
+                          zones=ZONES)
+        base = TPUSolver().solve(inp)
+        s = TPUSolver(shards=8)
+        _assert_same(s.solve(inp), base, "V-decline")
+        assert s.stats["sharded_fallbacks"] >= 1, s.stats
+        assert s.stats["sharded_solves"] == 0, s.stats
+        assert s.stats["device_solves"] == 1, s.stats
+
+    def test_tiny_fleet_declines_below_mesh_width(self):
+        """Fewer real runs than devices: nothing to partition — decline
+        (counted) and solve single-device."""
+        pods = [_mkpod(f"t{i}", "1", "1Gi") for i in range(6)]  # one run
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()],
+                          zones=ZONES)
+        base = TPUSolver().solve(inp)
+        s = TPUSolver(shards=8)
+        _assert_same(s.solve(inp), base, "tiny")
+        assert s.stats["sharded_fallbacks"] >= 1, s.stats
+        assert s.stats["sharded_solves"] == 0, s.stats
+
+    def test_shards_off_never_touches_the_mesh_path(self):
+        s = TPUSolver()  # shards=0 default
+        pods = _random_fleet(random.Random(11), 40)
+        s.solve(SolverInput(pods=pods, nodes=[], nodepools=[pool()],
+                            zones=ZONES))
+        assert s.stats["sharded_solves"] == 0
+        assert s.stats["sharded_fallbacks"] == 0
+        assert s._shard_mesh() is None

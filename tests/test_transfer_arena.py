@@ -174,6 +174,37 @@ def test_fallback_replay_invalidates_arena():
     _assert_same(recovered, warm, "recovered")
 
 
+def test_fallback_replay_invalidates_shard_residency():
+    """Multi-device (virtual mesh) case of the invalidation contract: a
+    failed dispatch must drop the per-device argument shards AND the
+    block-boundary carries — the sharded path's per-device checkpoint
+    rings — before the fallback replay, and the recovered device solve
+    re-establishes both from scratch."""
+    inner = TPUSolver(shards=8)
+    rs = ResilientSolver(inner, fallbacks=[ReferenceSolver()],
+                         clock=FakeClock())
+    inp = _inp(40, specs=20)  # 20 runs: enough to split across the mesh
+    warm = rs.solve(inp)
+    assert inner.stats["sharded_solves"] >= 1, inner.stats
+    assert inner.arena._shards  # block-boundary carries recorded
+    assert inner.arena._buckets  # per-device argument residency established
+
+    plan = faults.FaultPlan(seed=0)
+    plan.fail_n("solver.device_dispatch", 1)
+    with faults.active(plan):
+        replayed = rs.solve(inp)
+    assert plan.fired["solver.device_dispatch"] == 1
+    assert inner.arena.stats["invalidations"] >= 1
+    assert not inner.arena._shards  # per-device checkpoint rings dropped
+    assert not inner.arena._buckets  # per-device argument shards dropped
+    _assert_same(replayed, warm, "sharded fallback-replay")
+
+    # device recovered: the next sharded solve re-uploads and re-records
+    recovered = rs.solve(inp)
+    _assert_same(recovered, warm, "sharded recovered")
+    assert inner.arena._shards
+
+
 def test_explicit_invalidate_is_safe_anytime():
     s = TPUSolver()
     s.invalidate_arena()  # empty arena: no-op beyond the counter
