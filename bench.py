@@ -1042,6 +1042,164 @@ def _sharded_metrics(timeout_s: float = None) -> dict:
         return {}
 
 
+# ---------------------------------------------------------------- churn soak
+
+
+def _soak_solver_cls():
+    """Host-side fleet owner for the churn soak: the python oracle plus the
+    wedge-class fault sites TPUSolver checks (solver.device_hang /
+    device_lost), so an injected wedge parks this owner's dispatcher exactly
+    the way a hung device call would — the fleet mechanics under test
+    (canary miss -> fence -> requeue) are platform-independent."""
+    from karpenter_tpu import faults
+    from karpenter_tpu.solver.backend import ReferenceSolver
+
+    class _SoakSolver(ReferenceSolver):
+        def __init__(self):
+            self.fault_tag = None
+
+        def solve(self, inp):
+            faults.check("solver.device_hang", tag=self.fault_tag)
+            faults.check("solver.device_lost", tag=self.fault_tag)
+            return super().solve(inp)
+
+    return _SoakSolver
+
+
+def _soak_run(duration_steps: int = 40, wedge_at_step: int = 12,
+              fleet_size: int = 2, arrivals_per_step: int = 3,
+              canary_deadline_s: float = 0.5, fence_after_misses: int = 1,
+              num_pods: int = 40, backend: str = "reference") -> dict:
+    """ISSUE 8 churn-soak: a sustained trace of disruption-class solves
+    through a SolverFleet with a backend wedge (solver.device_hang on
+    owner-0) injected mid-run. The fleet must fence the wedged owner off a
+    canary deadline miss, re-route every in-flight solve, and keep serving —
+    soak_dropped_solves counts tickets that never resolved OR resolved with
+    an error after the full drain, and MUST be 0. failover_recovery_ms is
+    wedge injection -> first solve completed on a surviving owner post-fence.
+    Importable (tests/test_solver_fleet.py smoke) and driven by
+    --soak-suite / _soak_metrics()."""
+    from karpenter_tpu import faults
+    from karpenter_tpu.solver.fleet import SolverFleet
+    from karpenter_tpu.solver.pipeline import DISRUPTION
+
+    if backend == "tpu":
+        from karpenter_tpu.solver.backend import TPUSolver
+
+        def factory(i):
+            return TPUSolver(max_claims=1024)
+    else:
+        cls = _soak_solver_cls()
+
+        def factory(i):
+            return cls()
+
+    # churn: a few distinct surge shapes cycled across steps (pod-count
+    # deltas defeat any exact-hit caching, as real arrival churn would)
+    churn = [build_input(num_pods + 7 * k) for k in range(3)]
+    canary = build_input(2)
+    fleet = SolverFleet(
+        solver_factory=factory,
+        size=fleet_size,
+        canary_input_fn=lambda: canary,
+        canary_deadline_s=canary_deadline_s,
+        fence_after_misses=fence_after_misses,
+        fence_drain_s=0.1,
+        # no mid-soak recovery probing: the run measures a clean failover,
+        # not a flapping owner (recovery has its own test coverage)
+        recovery_probe_s=3600.0,
+    )
+    plan = faults.FaultPlan(seed=8)
+    wedge = None
+    tickets = []
+    t_wedge = t_recovered = None
+    failed = 0
+    t0 = time.monotonic()
+    try:
+        with faults.active(plan):
+            for step in range(duration_steps):
+                if step == wedge_at_step:
+                    wedge = plan.wedge("solver.device_hang", tag="owner-0")
+                    t_wedge = time.monotonic()
+                for a in range(arrivals_per_step):
+                    tickets.append(fleet.submit(
+                        churn[(step + a) % len(churn)], kind=DISRUPTION))
+                fleet.probe_once()
+                if (t_wedge is not None and t_recovered is None
+                        and fleet.fleet_stats["failovers"] >= 1):
+                    # fence landed: time the first post-fence solve that
+                    # completes on a surviving owner
+                    probe = fleet.submit(churn[0], kind=DISRUPTION)
+                    probe.result(timeout=30)
+                    t_recovered = time.monotonic()
+                    tickets.append(probe)
+            # full drain: every ticket the soak ever issued must resolve
+            for t in tickets:
+                try:
+                    t.result(timeout=60)
+                except Exception:  # noqa: BLE001 — counted as dropped below
+                    failed += 1
+        elapsed = time.monotonic() - t0
+        dropped = fleet.unresolved()
+        stats = dict(fleet.stats)
+    finally:
+        if wedge is not None:
+            wedge.release()
+        fleet.close()
+    return {
+        "soak_total_solves": len(tickets),
+        "soak_dropped_solves": dropped + failed,
+        "soak_failovers": stats["failovers"],
+        "soak_requeued_solves": stats["requeued"],
+        "soak_oracle_degraded": stats["oracle_degraded"],
+        "solves_per_sec": round(len(tickets) / max(elapsed, 1e-9), 2),
+        "failover_recovery_ms": round(
+            (t_recovered - t_wedge) * 1000, 1
+        ) if (t_recovered is not None and t_wedge is not None) else -1.0,
+        "soak_wall_s": round(elapsed, 2),
+        "soak_backend": backend,
+    }
+
+
+def _soak_metrics(backend: str = "reference") -> dict:
+    """Fleet churn-soak keys for the run JSON and every host-only marker
+    branch (ISSUE 8 acceptance: soak_dropped_solves reported, must be 0)."""
+    try:
+        out = _soak_run(backend=backend)
+        print(
+            f"[bench] soak ({out['soak_backend']}): "
+            f"{out['soak_total_solves']} solves @ "
+            f"{out['solves_per_sec']:.1f}/s — failovers={out['soak_failovers']} "
+            f"requeued={out['soak_requeued_solves']} "
+            f"recovery={out['failover_recovery_ms']:.0f}ms "
+            f"dropped={out['soak_dropped_solves']}",
+            file=sys.stderr,
+        )
+        return out
+    except Exception as e:  # noqa: BLE001 — the marker line must still emit
+        print(f"[bench] soak metrics failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return {}
+
+
+def bench_soak_suite() -> None:
+    """CLI entry (--soak-suite): run the churn soak standalone and print ONE
+    JSON line tagged soak_suite."""
+    out = _soak_run(
+        duration_steps=int(os.environ.get("KTPU_SOAK_STEPS", "60")),
+        arrivals_per_step=int(os.environ.get("KTPU_SOAK_ARRIVALS", "4")),
+        backend=os.environ.get("KTPU_SOAK_BACKEND", "reference"),
+    )
+    assert out["soak_dropped_solves"] == 0, out
+    print(json.dumps({
+        "metric": "soak_solves_per_sec",
+        "value": out["solves_per_sec"],
+        "unit": "solves/s",
+        "soak_suite": True,
+        **out,
+    }))
+
+
 def bench_encode_only(num_pods: int = 50_000) -> None:
     """CPU micro-bench of the HOST encode path alone (no device, no jax
     backend init): fresh full encode vs exact-key hit vs steady-state
@@ -1107,6 +1265,9 @@ def main() -> None:
     if "--sharded-suite" in sys.argv[1:]:
         bench_sharded_suite()
         return
+    if "--soak-suite" in sys.argv[1:]:
+        bench_soak_suite()
+        return
     # JAX_PLATFORMS pinned to host-only platforms means no accelerator can
     # EVER appear — the 4-attempt probe/backoff loop (~13 min) would be pure
     # waste. Fail fast with a reason distinct from a tunnel outage.
@@ -1118,7 +1279,7 @@ def main() -> None:
             "encode micro-bench)",
             extra={**_host_only_metrics(), **_host_only_pipeline_metrics(),
                    **_resume_metrics(), **_decode_relax_metrics(),
-                   **_sharded_metrics()},
+                   **_sharded_metrics(), **_soak_metrics()},
         )
         return
     plat = wait_for_backend()
@@ -1135,7 +1296,7 @@ def main() -> None:
             "(probe hang/failure after retries)",
             extra={**_host_only_metrics(), **_host_only_pipeline_metrics(),
                    **_resume_metrics(), **_decode_relax_metrics(),
-                   **_sharded_metrics()},
+                   **_sharded_metrics(), **_soak_metrics()},
         )
         return
     if plat.startswith("cpu"):
@@ -1146,7 +1307,7 @@ def main() -> None:
             f"only host backend available ({plat})",
             extra={**_host_only_metrics(), **_host_only_pipeline_metrics(),
                    **_resume_metrics(), **_decode_relax_metrics(),
-                   **_sharded_metrics()},
+                   **_sharded_metrics(), **_soak_metrics()},
         )
         return
 
@@ -1392,6 +1553,12 @@ def _run(plat: str) -> None:
     # virtual mesh, so a single-chip round still reports the sharded keys
     sharded_keys = _sharded_metrics()
 
+    # ---- fleet churn soak (ISSUE 8): fence/failover under a wedged owner.
+    # Host-backend owners on purpose: the chip already proved its latency
+    # above, and a soak that wedged a REAL device dispatch would park a
+    # thread inside a live XLA call for the rest of the bench.
+    soak_keys = _soak_metrics()
+
     print(
         json.dumps(
             {
@@ -1444,6 +1611,9 @@ def _run(plat: str) -> None:
                 # efficiency, and the per-device share of the packed delta
                 # upload (~1/8 of the replicated-args baseline)
                 **sharded_keys,
+                # fleet churn soak (ISSUE 8): fence + requeue under a wedged
+                # owner — soak_dropped_solves MUST be 0
+                **soak_keys,
                 "decode_bytes_per_solve": round(
                     e2e_solver.ledger.decode_bytes_per_solve, 1
                 ),

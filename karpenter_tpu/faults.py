@@ -15,8 +15,21 @@ Sites wired into production code:
 - ``solver.device_dispatch`` — TPUSolver._dispatch, before the kernel call
   (covers the initial dispatch AND overflow-retry redispatches).
 - ``solver.decode``         — TPUSolver device-result decode, after fetch.
+- ``solver.device_hang``    — TPUSolver dispatch path; wedge-class: a
+  scripted `Wedge` BLOCKS the calling thread (a hung XLA dispatch, not a
+  raised one) until the test releases it.
+- ``solver.device_lost``    — TPUSolver dispatch path; raises `DeviceLost`
+  (the runtime reported the device gone, unrecoverable by retry).
+- ``solver.arena_corrupt``  — TPUSolver device-adopt path, before the arena
+  residency is trusted; raises `ArenaCorrupt` (device buffers unusable —
+  the arena must be invalidated and re-adopted).
 - ``cloud.create``          — KwokCloud.create_fleet, before the launch.
 - ``store.update``          — Store.update, before persistence.
+
+Sites on the solver dispatch path accept an optional `tag` so a fleet of
+several solver instances can wedge ONE owner: `plan.wedge(site, tag="owner-0")`
+fires only for the solver whose `fault_tag` is "owner-0"; an untagged script
+fires for every caller of the site.
 
 The check is a no-op module-level None test when no plan is active, so the
 hot paths pay one attribute load in production.
@@ -46,6 +59,9 @@ from typing import Callable, Dict, Optional
 SITES = (
     "solver.device_dispatch",
     "solver.decode",
+    "solver.device_hang",
+    "solver.device_lost",
+    "solver.arena_corrupt",
     "cloud.create",
     "store.update",
 )
@@ -59,8 +75,49 @@ class DeviceError(FaultError):
     """A transient device/runtime failure (XLA error, OOM, dead tunnel)."""
 
 
+class DeviceLost(DeviceError):
+    """The runtime reported the device gone — retrying on it is hopeless."""
+
+
+class ArenaCorrupt(DeviceError):
+    """Device-resident arena buffers are unusable; residency must be
+    invalidated and re-adopted before the next dispatch can trust them."""
+
+
 class DecodeError(FaultError, ValueError):
     """A deterministic garbage-decode failure (classified as an encode bug)."""
+
+
+class Wedge:
+    """A wedge-class outcome: check() BLOCKS (outside the plan lock) until
+    release()d, then proceeds normally — modelling a dispatch that HANGS
+    rather than raises. Sticky: the same Wedge keeps blocking every check
+    that draws it until released. Counters let tests assert how many
+    threads actually hit the wedge."""
+
+    def __init__(self, name: str = "wedge"):
+        self.name = name
+        self._released = threading.Event()
+        self._lock = threading.Lock()
+        self.blocked = 0  # threads that entered the wedge
+        self.wedged = 0  # threads currently parked in it
+
+    def __call__(self) -> None:
+        with self._lock:
+            self.blocked += 1
+            self.wedged += 1
+        try:
+            self._released.wait()
+        finally:
+            with self._lock:
+                self.wedged -= 1
+
+    def release(self) -> None:
+        """Un-hang: every parked thread (and all future checks) proceed."""
+        self._released.set()
+
+    def released(self) -> bool:
+        return self._released.is_set()
 
 
 class FaultPlan:
@@ -75,7 +132,10 @@ class FaultPlan:
     def __init__(self, seed: int = 0):
         self.seed = seed
         self._rng = random.Random(seed)
-        self._scripts: Dict[str, deque] = defaultdict(deque)
+        # scripts/wedges are keyed by (site, tag); tag None is the untagged
+        # (fires-for-everyone) slot, so existing call sites are unchanged
+        self._scripts: Dict[tuple, deque] = defaultdict(deque)
+        self._wedges: Dict[tuple, Wedge] = {}
         self._maybe: Dict[str, tuple] = {}  # site -> (p, exc)
         self._lock = threading.Lock()
         self.calls: Dict[str, int] = defaultdict(int)  # checks per site
@@ -83,15 +143,26 @@ class FaultPlan:
 
     # -- scripting ----------------------------------------------------------
 
-    def script(self, site: str, *outcomes) -> "FaultPlan":
-        """Append explicit outcomes consumed one per check, in order."""
-        self._scripts[site].extend(outcomes)
+    def script(self, site: str, *outcomes, tag: Optional[str] = None) -> "FaultPlan":
+        """Append explicit outcomes consumed one per check, in order. With
+        `tag`, the outcomes fire only for checks carrying that tag (one
+        solver instance in a fleet)."""
+        self._scripts[(site, tag)].extend(outcomes)
         return self
 
-    def fail_n(self, site: str, n: int, exc=None) -> "FaultPlan":
+    def fail_n(self, site: str, n: int, exc=None, tag: Optional[str] = None) -> "FaultPlan":
         """Site fails the next `n` checks, then recovers (script suffix)."""
         exc = exc if exc is not None else DeviceError(f"injected fault at {site}")
-        return self.script(site, *([exc] * n))
+        return self.script(site, *([exc] * n), tag=tag)
+
+    def wedge(self, site: str, tag: Optional[str] = None) -> Wedge:
+        """Wedge the site: every check (matching `tag`, if given) BLOCKS
+        until the returned Wedge is release()d. Sticky, not consumed —
+        models a hung device, detected only by a liveness deadline."""
+        w = Wedge(name=f"{site}@{tag}" if tag else site)
+        with self._lock:
+            self._wedges[(site, tag)] = w
+        return w
 
     def maybe(self, site: str, p: float, exc=None) -> "FaultPlan":
         """Fail each UNSCRIPTED check with probability `p` (seeded RNG, so a
@@ -102,14 +173,33 @@ class FaultPlan:
 
     # -- consumption --------------------------------------------------------
 
-    def check(self, site: str) -> None:
+    def check(self, site: str, tag: Optional[str] = None) -> None:
         with self._lock:
             self.calls[site] += 1
-            out = self._scripts[site].popleft() if self._scripts[site] else None
-            if out is None and site in self._maybe:
+            if tag is not None:
+                self.calls[f"{site}@{tag}"] += 1
+            wedge = self._wedges.get((site, tag))
+            if wedge is None and tag is not None:
+                wedge = self._wedges.get((site, None))
+            if wedge is not None and wedge.released():
+                wedge = None  # un-wedged: the site behaves again
+            out = None
+            for key in ((site, tag), (site, None)) if tag is not None else ((site, None),):
+                if self._scripts[key]:
+                    out = self._scripts[key].popleft()
+                    break
+            if out is None and wedge is None and site in self._maybe:
                 p, exc = self._maybe[site]
                 if self._rng.random() < p:
                     out = exc
+        if wedge is not None:
+            # block OUTSIDE the plan lock: other sites keep injecting while
+            # this thread hangs, exactly like a real wedged dispatch
+            with self._lock:
+                self.fired[site] += 1
+                if tag is not None:
+                    self.fired[f"{site}@{tag}"] += 1
+            wedge()
         if out is None or out == "ok":
             return
         if callable(out) and not (isinstance(out, type) and issubclass(out, BaseException)):
@@ -117,15 +207,17 @@ class FaultPlan:
             return
         with self._lock:
             self.fired[site] += 1
+            if tag is not None:
+                self.fired[f"{site}@{tag}"] += 1
         if isinstance(out, type):
             raise out(f"injected fault at {site}")
         # re-instantiate so each fire raises a fresh exception object
         raise type(out)(*out.args)
 
-    def pending(self, site: str) -> int:
+    def pending(self, site: str, tag: Optional[str] = None) -> int:
         """Scripted outcomes not yet consumed (test bookkeeping)."""
         with self._lock:
-            return len(self._scripts[site])
+            return len(self._scripts[(site, tag)])
 
 
 # -- global activation seam (production sites consult this) ------------------
@@ -149,7 +241,7 @@ def active(plan: FaultPlan):
         use(prev)
 
 
-def check(site: str) -> None:
+def check(site: str, tag: Optional[str] = None) -> None:
     """Production-site hook: free when no plan is active."""
     if _ACTIVE is not None:
-        _ACTIVE.check(site)
+        _ACTIVE.check(site, tag=tag)

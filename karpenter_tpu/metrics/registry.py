@@ -413,6 +413,52 @@ SOLVE_COALESCED = REGISTRY.register(
         ("kind",),
     )
 )
+# -- solver fleet (solver/fleet.py; ISSUE 8 — same naming rule as the
+#    resume / decode / shard series: no _tpu segment) -------------------------
+
+FLEET_HEALTHY = REGISTRY.register(
+    Gauge(
+        "karpenter_solver_fleet_healthy",
+        "Healthy (unfenced) solve owners: the unlabeled series carries the "
+        "fleet-wide count, the owner-labeled series carries each owner's "
+        "0/1 health bit",
+        ("owner",),
+    )
+)
+FLEET_FAILOVER = REGISTRY.register(
+    Counter(
+        "karpenter_solver_failover_total",
+        "Owner fencing events: the canary watchdog (or a breaker trip) "
+        "declared an owner unhealthy and re-routed its work",
+        ("owner",),
+    )
+)
+FLEET_REQUEUED = REGISTRY.register(
+    Counter(
+        "karpenter_solver_requeued_solves_total",
+        "In-flight or queued solves re-routed off a fenced owner onto a "
+        "healthy owner or degraded to the oracle (none dropped, none run "
+        "twice — first-wins ticket delivery)",
+        ("target",),
+    )
+)
+FLEET_CANARY_LATENCY = REGISTRY.register(
+    Histogram(
+        "karpenter_solver_canary_latency_seconds",
+        "Liveness-probe canary solve latency per owner (a miss — deadline "
+        "expiry — records a breaker failure instead of observing here)",
+        ("owner",),
+    )
+)
+SOLVER_DEADLINE_LEAKED_THREADS = REGISTRY.register(
+    Gauge(
+        "karpenter_solver_deadline_leaked_threads",
+        "resilient-solve watchdog threads whose post-deadline device call "
+        "never returned (still alive after the bounded join) — a rising "
+        "value means a backend is wedging, not just slow",
+    )
+)
+
 PROBE_BATCH_SIZE = REGISTRY.register(
     Histogram(
         "karpenter_tpu_disruption_probe_batch_size",

@@ -97,6 +97,12 @@ def main(argv=None) -> int:
         solver_deadline_s=o.solver_deadline_s,
         breaker_threshold=o.solver_breaker_threshold,
         breaker_probe_s=o.solver_breaker_probe_s,
+        solver_pipeline=o.solver_pipeline,
+        pipeline_depth=o.pipeline_depth,
+        probe_batch_max=o.probe_batch_max,
+        solver_fleet_size=o.solver_fleet_size,
+        canary_interval_s=o.canary_interval_s,
+        fence_after_misses=o.fence_after_misses,
     )
     serve_endpoints(o.metrics_port, o.health_probe_port,
                     enable_profiling=o.enable_profiling)

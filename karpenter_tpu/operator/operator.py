@@ -82,6 +82,10 @@ def new_kwok_operator(
     solver_pipeline: bool = True,
     pipeline_depth: int = 2,
     probe_batch_max: int = 512,
+    solver_fleet_size: int = 1,
+    canary_interval_s: float = 5.0,
+    fence_after_misses: int = 2,
+    canary_deadline_s: float = 5.0,
 ) -> Operator:
     store = shared_store if shared_store is not None else st.Store()
     # the operator's clock is authoritative for every age stamp, including a
@@ -136,7 +140,50 @@ def new_kwok_operator(
             clock=clock,
         )
     solve_service = None
-    if solver_pipeline:
+    if solver_pipeline and solver_fleet_size >= 2:
+        # solver fleet (solver/fleet.py): N independently health-checked
+        # owners behind the SolveService surface — owner 0 is the solver
+        # configured above; the other owners get a fresh backend of the
+        # same kind (own ArgumentArena residency = own virtual host-mesh
+        # slot), each behind its own resilience wrap when enabled
+        from ..solver.fleet import SolverFleet, default_canary_input
+
+        base_solver = solver
+
+        def _owner_solver(i: int):
+            if i == 0:
+                return base_solver
+            inner = base_solver
+            while hasattr(inner, "__dict__") and "inner" in inner.__dict__:
+                inner = inner.inner
+            try:
+                fresh: Solver = type(inner)()
+            except Exception:  # noqa: BLE001 — degrade to the oracle owner
+                fresh = ReferenceSolver()
+            if resilient:
+                from ..solver.resilient import ResilientSolver
+
+                fresh = ResilientSolver(
+                    fresh,
+                    deadline_s=solver_deadline_s or None,
+                    breaker_threshold=breaker_threshold,
+                    breaker_probe_s=breaker_probe_s,
+                    clock=clock,
+                )
+            return fresh
+
+        solve_service = SolverFleet(
+            _owner_solver,
+            size=solver_fleet_size,
+            depth=pipeline_depth,
+            clock=clock,
+            canary_input_fn=lambda: default_canary_input(types),
+            canary_interval_s=canary_interval_s,
+            canary_deadline_s=canary_deadline_s,
+            fence_after_misses=fence_after_misses,
+            start_monitor=True,
+        )
+    elif solver_pipeline:
         # one owner for the device solve seam: controller solves queue
         # through the service's three-stage pipeline (encode ∥ compute ∥
         # decode), provisioning snapshots coalesce, and disruption probes

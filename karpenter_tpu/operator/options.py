@@ -79,6 +79,16 @@ class Options:
     # carry (all O(n) candidate prefixes batch when they fit; fleets up to
     # ~probe_batch_max² resolve in two dispatches)
     probe_batch_max: int = 512
+    # solver fleet (solver/fleet.py): N independently health-checked device
+    # owners with breaker-driven failover; 1 = no fleet, the single
+    # SolveService path (the default — fleet mode is for multi-device or
+    # reliability-critical deployments)
+    solver_fleet_size: int = 1
+    # seconds between liveness-canary passes over the fleet's owners
+    canary_interval_s: float = 5.0
+    # consecutive canary deadline misses before an owner is fenced and its
+    # work re-routed (the fleet breaker's threshold)
+    fence_after_misses: int = 2
     # per-solve deadline on the device path, seconds; 0 = no deadline
     solver_deadline_s: float = 0.0
     # breaker opens after this many consecutive device-path failures
@@ -170,6 +180,30 @@ def parse(argv: Optional[Sequence[str]] = None, cls=Options) -> Options:
             "refusing to start: --resume-checkpoint-interval must be >= 1 "
             f"(got {interval}); it is the number of FFD scan steps between "
             "checkpoint-ring snapshots (operator/options.py)"
+        )
+    # fleet knob sanity (same fail-closed rule as the resume interval): a
+    # zero/negative fleet size or fence threshold would wedge routing deep
+    # inside the first failover instead of at startup with a clear message
+    fleet_size = getattr(out, "solver_fleet_size", None)
+    if fleet_size is not None and int(fleet_size) < 1:
+        raise SystemExit(
+            "refusing to start: --solver-fleet-size must be >= 1 "
+            f"(got {fleet_size}); 1 disables the fleet (single owner), "
+            ">= 2 enables health-probed failover (solver/fleet.py)"
+        )
+    misses = getattr(out, "fence_after_misses", None)
+    if misses is not None and int(misses) < 1:
+        raise SystemExit(
+            "refusing to start: --fence-after-misses must be >= 1 "
+            f"(got {misses}); it is the consecutive canary-miss count that "
+            "fences a solver owner (solver/fleet.py)"
+        )
+    interval_s = getattr(out, "canary_interval_s", None)
+    if interval_s is not None and float(interval_s) <= 0:
+        raise SystemExit(
+            "refusing to start: --canary-interval-s must be > 0 "
+            f"(got {interval_s}); it is the liveness-probe period of the "
+            "solver fleet watchdog (solver/fleet.py)"
         )
     # decode/ladder knob sanity: these gate correctness-critical solver
     # paths, so a typo'd env value ("ture", "on") must not silently become

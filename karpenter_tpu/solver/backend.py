@@ -762,6 +762,10 @@ class TPUSolver(Solver):
         self.resume = bool(resume) and arena
         self.ckpt_every = max(1, int(ckpt_every))
         self.ckpt_slots = max(1, int(ckpt_slots))
+        # fault-injection identity: a fleet names each owner's solver so a
+        # chaos plan can wedge ONE owner (faults.check tag= on the wedge-
+        # class sites); None = untagged, matches only untagged scripts
+        self.fault_tag: Optional[str] = None
 
     def _shard_mesh(self):
         """Lazy mesh for mesh-sharded provisioning solves: the largest
@@ -1604,6 +1608,13 @@ class TPUSolver(Solver):
             host_args, dims, prov = host_kernel_args(enc, self._bucket)
         except UnpackableInput:
             return None  # Z*C > 32 — replay on fallback
+        # wedge-class chaos sites (ISSUE 8): device_hang BLOCKS the calling
+        # (dispatcher) thread — a hung XLA dispatch, detectable only by a
+        # liveness deadline; device_lost raises DeviceLost (unrecoverable
+        # by retry on this owner). Both run before any ledger/arena state
+        # changes so a wedged solve leaves residency untouched.
+        faults.check("solver.device_hang", tag=self.fault_tag)
+        faults.check("solver.device_lost", tag=self.fault_tag)
         if self.shards >= 2:
             # mesh-sharded run-axis solve; declines (inexpressible carry
             # combine, no usable mesh, stitch overflow) fall through to the
@@ -1616,6 +1627,11 @@ class TPUSolver(Solver):
         # result byte lands in one per-solve record (solver/arena.py)
         self.ledger.begin_solve()
         if self.arena is not None:
+            # arena_corrupt chaos site: fires BEFORE residency is trusted —
+            # the raised ArenaCorrupt classifies as a device error, the
+            # resilience layer invalidates the arena, and the replay (or the
+            # re-routed owner) pays one full re-adoption upload
+            faults.check("solver.arena_corrupt", tag=self.fault_tag)
             # device-resident arena: only stale entries upload, packed into
             # ONE buffer; an exact encode-cache hit uploads nothing at all
             args = self.arena.adopt(host_args, prov)

@@ -1,0 +1,617 @@
+"""Solver fleet: N health-probed SolveService owners behind one seam.
+
+`SolveService` (pipeline.py) owns exactly one device — which makes one wedged
+backend a single point of failure for every solve in the process (the bench
+has demonstrated the failure mode twice: a hung TPU probe took the whole perf
+suite down). `SolverFleet` fronts N owners — one per device, or per virtual
+host-mesh slot when fewer than two real devices are visible — behind the same
+submit()/submit_fn()/close() surface the controllers already speak:
+
+    ┌────────────────────────────── SolverFleet ──────────────────────────────┐
+    │  submit()/submit_fn()          canary watchdog          requeue/oracle  │
+    │        │                             │                        ▲         │
+    │  ┌─────▼─────┐  ┌───────────┐  ┌─────▼─────┐                  │         │
+    │  │ owner-0   │  │ owner-1   │  │ owner-N   │   fence ─────────┘         │
+    │  │ solver    │  │ solver    │  │ solver    │                            │
+    │  │ arena     │  │ arena     │  │ arena     │   each owner: its own      │
+    │  │ service   │  │ service   │  │ service   │   CircuitBreaker, its own  │
+    │  │ breaker   │  │ breaker   │  │ breaker   │   ArgumentArena residency  │
+    │  └───────────┘  └───────────┘  └───────────┘                            │
+    └─────────────────────────────────────────────────────────────────────────┘
+
+Liveness is probed, not assumed: a periodic tiny canary solve with a hard
+real-time deadline runs against every healthy owner (watchdog thread, or
+`probe_once()` driven directly by tests — no sleeps). A canary MISS — the
+ticket not resolving inside the deadline — is what a *hung* dispatch looks
+like from outside: no exception ever surfaces, so raised-error machinery
+(resilient.py) never sees it. Misses feed the owner's fleet-level
+`CircuitBreaker`; after `fence_after_misses` consecutive misses the owner is
+FENCED:
+
+1. the owner's service is stopped with a short drain (pipeline.py stop():
+   every ticket it ever issued resolves — queued fail fast, in-flight get the
+   drain window, wedged ones are force-resolved);
+2. the owner's arena residency is invalidated (a wedged solve leaves device
+   state unknowable — the owner re-adopts from scratch if it ever recovers);
+3. every not-yet-resolved request is re-routed IN ORIGINAL SUBMISSION ORDER
+   to a healthy owner (provisioning re-coalesces there: state_rev/Superseded
+   semantics survive the re-route) or — when no healthy owner remains —
+   input-carrying requests degrade to the python oracle. First-wins ticket
+   delivery (pipeline.py) guarantees no request is dropped and none is acted
+   on twice, even when a force-resolve races a late real decode.
+
+A fenced owner is probed for recovery on its breaker's half-open schedule
+(injected clock): a direct canary solve on a sacrificial thread — never on a
+shared dispatcher — with the same hard deadline. Success un-fences the owner
+behind a FRESH SolveService (the old dispatcher may still be parked inside
+the hung XLA call; it is abandoned as a daemon).
+
+Fleet state is exported as karpenter_solver_fleet_healthy (unlabeled total +
+per-owner 0/1), karpenter_solver_failover_total,
+karpenter_solver_requeued_solves_total, and
+karpenter_solver_canary_latency_seconds. SPEC.md "Failover semantics" is the
+contract; tests/test_solver_fleet.py drives every path via faults.py
+wedge-class sites (solver.device_hang / device_lost / arena_corrupt).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..metrics.registry import (
+    FLEET_CANARY_LATENCY,
+    FLEET_FAILOVER,
+    FLEET_HEALTHY,
+    FLEET_REQUEUED,
+)
+from .backend import ReferenceSolver, Solver
+from .pipeline import (
+    DISRUPTION,
+    PROVISIONING,
+    ServiceStopped,
+    SolveService,
+    SolveTicket,
+    Superseded,
+)
+from .resilient import OPEN, CircuitBreaker
+
+log = logging.getLogger("karpenter_tpu")
+
+
+def _set_fault_tag(solver, name: str) -> None:
+    """Stamp the chaos-injection tag on the innermost solver that actually
+    reads one (TPUSolver.fault_tag) — resilience wrappers delegate attribute
+    READS to their inner solver, so setting on the wrapper would shadow."""
+    obj = solver
+    while obj is not None:
+        d = getattr(obj, "__dict__", None) or {}
+        if "fault_tag" in d:
+            obj.fault_tag = name
+            return
+        obj = d.get("inner")
+
+
+def default_canary_input(instance_types: Optional[Sequence] = None):
+    """A minimal one-pod SolverInput for liveness probes. With no catalog
+    given, a tiny generated slice is used (lazy — never on import)."""
+    from ..api import wellknown as wk
+    from ..api.objects import ObjectMeta, Pod
+    from ..provisioning.scheduler import NodePoolSpec, SolverInput
+    from ..scheduling.requirements import IN, Requirement, Requirements
+    from ..utils.resources import Resources
+
+    if instance_types is None:
+        from ..catalog.catalog import CatalogSpec, generate
+
+        instance_types = generate(CatalogSpec())
+    types = list(instance_types)[:4]
+    zones = tuple(sorted({o.zone for it in types for o in it.offerings}))
+    reqs = Requirements.of(
+        Requirement.create(wk.NODEPOOL_LABEL, IN, ["fleet-canary"])
+    )
+    pod = Pod(
+        meta=ObjectMeta(name="fleet-canary", uid="fleet-canary"),
+        requests=Resources.parse({"cpu": "100m", "memory": "64Mi"}),
+    )
+    np = NodePoolSpec(
+        name="fleet-canary", weight=0, requirements=reqs, taints=[],
+        instance_types=types,
+    )
+    return SolverInput(pods=[pod], nodes=[], nodepools=[np], zones=zones)
+
+
+class _FleetBreaker(CircuitBreaker):
+    """Per-owner fencing breaker. Does NOT export to the global
+    karpenter_tpu_solver_breaker_state gauge — that series belongs to the
+    per-request resilience breaker; fleet health has its own gauge."""
+
+    def _export(self) -> None:  # noqa: D102 — deliberate no-op
+        pass
+
+
+class _FleetEntry:
+    """One logical fleet request across any number of owner re-routes."""
+
+    __slots__ = ("ticket", "inp", "fn", "kind", "rev", "owner", "owner_ticket",
+                 "requeues")
+
+    def __init__(self, ticket: SolveTicket, inp=None, fn=None,
+                 kind: str = PROVISIONING, rev=None):
+        self.ticket = ticket
+        self.inp = inp
+        self.fn = fn
+        self.kind = kind
+        self.rev = rev
+        self.owner: Optional["FleetOwner"] = None
+        self.owner_ticket: Optional[SolveTicket] = None
+        self.requeues = 0
+
+
+class FleetOwner:
+    """One device owner: solver + pipelined service + fencing breaker."""
+
+    def __init__(self, index: int, solver: Solver, service: SolveService,
+                 breaker: CircuitBreaker):
+        self.index = index
+        self.name = f"owner-{index}"
+        self.solver = solver
+        self.service = service
+        self.breaker = breaker
+        self.fenced = False
+        self.fence_count = 0
+        # owner-ticket -> _FleetEntry, insertion-ordered: the fence loop
+        # replays survivors in original submission order so provisioning
+        # revisions re-coalesce correctly on the new owner
+        self.outstanding: "OrderedDict[SolveTicket, _FleetEntry]" = OrderedDict()
+
+
+class SolverFleet:
+    """N independently health-checked SolveService owners behind the
+    SolveService surface the provisioner / disruption controller / bench
+    already use (submit, submit_fn, occupancy, queue_depth, stats,
+    resume/shard/decode_stats, close)."""
+
+    def __init__(
+        self,
+        solver_factory: Callable[[int], Solver],
+        size: int = 2,
+        depth: int = 2,
+        clock=time.monotonic,
+        canary_input_fn: Optional[Callable] = None,
+        canary_interval_s: float = 5.0,
+        canary_deadline_s: float = 5.0,
+        fence_after_misses: int = 2,
+        recovery_probe_s: float = 30.0,
+        fence_drain_s: float = 0.25,
+        instance_types: Optional[Sequence] = None,
+        start_monitor: bool = False,
+    ):
+        self.size = max(1, int(size))
+        self.depth = depth
+        self.clock = clock
+        self.canary_interval_s = float(canary_interval_s)
+        self.canary_deadline_s = float(canary_deadline_s)
+        self.fence_after_misses = max(1, int(fence_after_misses))
+        self.recovery_probe_s = float(recovery_probe_s)
+        self.fence_drain_s = float(fence_drain_s)
+        self._canary_input_fn = canary_input_fn or (
+            lambda: default_canary_input(instance_types)
+        )
+        self._canary_cache = None
+        self._oracle = ReferenceSolver()
+        self._lock = threading.Lock()
+        self._rr = 0  # round-robin cursor for disruption-class routing
+        self._closing = False
+        self._open: set = set()  # _FleetEntry not yet resolved
+        # Superseded deliveries whose superseding owner-ticket is mid-
+        # placement (coalescing fires INSIDE service.submit, before _place
+        # can register the new entry): (stale_entry, superseding_owner_ticket)
+        self._superseded_waiting: list = []
+        self.fleet_stats: Dict[str, int] = {
+            "fleet_submitted": 0,
+            "requeued": 0,
+            "oracle_degraded": 0,
+            "failovers": 0,
+            "recoveries": 0,
+            "canary_probes": 0,
+            "canary_misses": 0,
+        }
+        self.owners: List[FleetOwner] = []
+        for i in range(self.size):
+            solver = solver_factory(i)
+            _set_fault_tag(solver, f"owner-{i}")
+            self.owners.append(FleetOwner(
+                i, solver,
+                SolveService(solver, depth=depth, clock=clock),
+                _FleetBreaker(
+                    threshold=self.fence_after_misses,
+                    probe_interval_s=self.recovery_probe_s,
+                    clock=clock,
+                ),
+            ))
+        self._export_health()
+        self._stop_event = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        if start_monitor:
+            self.start()
+
+    # -- submission (SolveService surface) -----------------------------------
+
+    def submit(self, inp, kind: str = PROVISIONING, rev=None) -> SolveTicket:
+        if rev is None:
+            rev = getattr(inp, "state_rev", None)
+        with self._lock:
+            if self._closing:
+                raise ServiceStopped("solver fleet is closed")
+        ticket = SolveTicket(kind, rev=rev)
+        entry = _FleetEntry(ticket, inp=inp, kind=kind, rev=rev)
+        with self._lock:
+            self._open.add(entry)
+            self.fleet_stats["fleet_submitted"] += 1
+        self._place(entry)
+        return ticket
+
+    def submit_fn(self, dispatch_fn: Callable, kind: str = DISRUPTION) -> SolveTicket:
+        with self._lock:
+            if self._closing:
+                raise ServiceStopped("solver fleet is closed")
+        ticket = SolveTicket(kind)
+        entry = _FleetEntry(ticket, fn=dispatch_fn, kind=kind)
+        with self._lock:
+            self._open.add(entry)
+            self.fleet_stats["fleet_submitted"] += 1
+        self._place(entry)
+        return ticket
+
+    # -- routing / re-routing -------------------------------------------------
+
+    def _pick_owner(self, kind: str) -> Optional[FleetOwner]:
+        with self._lock:
+            healthy = [o for o in self.owners if not o.fenced]
+            if not healthy:
+                return None
+            if kind == PROVISIONING:
+                # all provisioning rides the primary (lowest-index healthy)
+                # owner so snapshot coalescing sees every revision
+                return healthy[0]
+            o = healthy[self._rr % len(healthy)]
+            self._rr += 1
+            return o
+
+    def _place(self, entry: _FleetEntry, requeued: bool = False) -> None:
+        while True:
+            owner = self._pick_owner(entry.kind)
+            if owner is None:
+                if requeued and entry.fn is None:
+                    FLEET_REQUEUED.inc(target="oracle")
+                self._degrade(entry)
+                return
+            try:
+                if entry.fn is not None:
+                    ot = owner.service.submit_fn(entry.fn, kind=entry.kind)
+                else:
+                    ot = owner.service.submit(entry.inp, kind=entry.kind,
+                                              rev=entry.rev)
+            except ServiceStopped:
+                continue  # owner fenced between pick and submit; re-pick
+            with self._lock:
+                fenced_after = owner.fenced
+                if not fenced_after:
+                    entry.owner = owner
+                    entry.owner_ticket = ot
+                    owner.outstanding[ot] = entry
+                # flush Superseded deliveries parked on the owner ticket this
+                # submit just created (their coalescing callback ran inside
+                # service.submit, before the mapping above existed)
+                flushes = [e for (e, by_ot) in self._superseded_waiting
+                           if by_ot is ot]
+                if flushes:
+                    self._superseded_waiting = [
+                        (e, by_ot) for (e, by_ot) in self._superseded_waiting
+                        if by_ot is not ot
+                    ]
+            for stale in flushes:
+                self._resolve(stale, error=Superseded(by=entry.ticket))
+            if fenced_after:
+                # a fence raced this placement: its requeue snapshot cannot
+                # have seen the entry, so this callback owns the re-route
+                ot.on_done(lambda t, o=owner, e=entry:
+                           self._on_owner_done(o, e, t, force_reroute=True))
+            else:
+                ot.on_done(lambda t, o=owner, e=entry:
+                           self._on_owner_done(o, e, t))
+            if requeued:
+                FLEET_REQUEUED.inc(target="owner")
+            return
+
+    def _degrade(self, entry: _FleetEntry) -> None:
+        """No healthy owner: inputs replay on the python oracle (decision-
+        compatible by construction — it IS the fallback ladder's last rung);
+        device-bound closures cannot (their dispatch is bound to a specific
+        owner's device state) and resolve ServiceStopped."""
+        if entry.fn is not None:
+            self._resolve(entry, error=ServiceStopped(
+                "no healthy solver owner for device-bound work"
+            ))
+            return
+        with self._lock:
+            self.fleet_stats["oracle_degraded"] += 1
+        try:
+            res = self._oracle.solve(entry.inp)
+        except Exception as e:  # noqa: BLE001 — delivered to the caller
+            self._resolve(entry, error=e)
+            return
+        self._resolve(entry, result=res)
+
+    def _reroute(self, entry: _FleetEntry) -> None:
+        entry.requeues += 1
+        with self._lock:
+            self.fleet_stats["requeued"] += 1
+        self._place(entry, requeued=True)
+
+    def _resolve(self, entry: _FleetEntry, result=None,
+                 error: Optional[BaseException] = None) -> None:
+        delivered = entry.ticket._deliver(result=result, error=error)
+        if delivered:
+            with self._lock:
+                self._open.discard(entry)
+
+    def _on_owner_done(self, owner: FleetOwner, entry: _FleetEntry,
+                       ticket: SolveTicket, force_reroute: bool = False) -> None:
+        with self._lock:
+            owner.outstanding.pop(ticket, None)
+        if entry.ticket.done():
+            return
+        err = ticket.error()
+        if err is None:
+            self._resolve(entry, result=ticket.result())
+            return
+        if isinstance(err, Superseded):
+            # map the superseding OWNER ticket back to its fleet ticket. The
+            # coalescing delivery fires INSIDE service.submit — on the thread
+            # running _place, BEFORE it can register the new owner ticket —
+            # so a missed lookup usually means "mid-placement": park the
+            # delivery and let _place flush it once the mapping exists.
+            with self._lock:
+                by_entry = owner.outstanding.get(err.by) if err.by is not None else None
+                if by_entry is None and err.by is not None and not self._closing:
+                    self._superseded_waiting.append((entry, err.by))
+                    return
+            self._resolve(entry, error=Superseded(
+                by=by_entry.ticket if by_entry is not None else None
+            ))
+            return
+        if isinstance(err, ServiceStopped):
+            if self._closing:
+                self._resolve(entry, error=err)
+            elif force_reroute or not owner.fenced:
+                # spontaneous stop, or a fence whose snapshot missed this
+                # entry — the callback owns the re-route
+                self._reroute(entry)
+            # else: the fence loop re-routes it (ordered requeue)
+            return
+        self._resolve(entry, error=err)
+
+    # -- fencing / recovery ---------------------------------------------------
+
+    def _fence(self, owner: FleetOwner, reason: str) -> None:
+        with self._lock:
+            if owner.fenced or self._closing:
+                return
+            owner.fenced = True
+            owner.fence_count += 1
+            self.fleet_stats["failovers"] += 1
+            survivors = list(owner.outstanding.values())
+            owner.outstanding.clear()
+        FLEET_FAILOVER.inc(owner=owner.name)
+        log.warning(
+            "solver fleet: FENCING %s (%s) — stopping its service, "
+            "re-routing %d outstanding request(s)",
+            owner.name, reason, len(survivors),
+        )
+        self._export_health()
+        # stop() resolves every ticket the owner's service ever issued:
+        # queued fail fast, in-flight get the drain window, wedged ones are
+        # force-resolved (ServiceStopped) — nothing can strand
+        owner.service.stop(drain_s=self.fence_drain_s)
+        # a wedged/failed solve leaves device residency unknowable: drop it
+        # so a recovered owner re-adopts from scratch (SPEC.md "Failover
+        # semantics" / arena re-adoption)
+        inv = getattr(owner.solver, "invalidate_arena", None)
+        if inv is not None:
+            try:
+                inv()
+            except Exception:  # noqa: BLE001 — best-effort on a dead owner
+                pass
+        for entry in survivors:  # original submission order
+            if not entry.ticket.done():
+                self._reroute(entry)
+
+    def _unfence(self, owner: FleetOwner) -> None:
+        # the old service's dispatcher may still be parked inside the hung
+        # XLA call — abandon it (daemon) behind a fresh service
+        owner.service = SolveService(owner.solver, depth=self.depth,
+                                     clock=self.clock)
+        with self._lock:
+            owner.fenced = False
+            self.fleet_stats["recoveries"] += 1
+        log.info("solver fleet: %s recovered — un-fenced behind a fresh "
+                 "service (arena re-adopts on first dispatch)", owner.name)
+        self._export_health()
+
+    # -- liveness probing -----------------------------------------------------
+
+    def _canary_input(self):
+        if self._canary_cache is None:
+            self._canary_cache = self._canary_input_fn()
+        return self._canary_cache
+
+    def _probe_healthy(self, owner: FleetOwner) -> str:
+        """Tiny canary solve through the owner's own pipeline with a hard
+        REAL-TIME deadline: a wedged dispatcher never resolves the ticket,
+        which is precisely the hang signature no exception path can see."""
+        with self._lock:
+            self.fleet_stats["canary_probes"] += 1
+        t0 = time.monotonic()
+        try:
+            ticket = owner.service.submit(self._canary_input(), kind=DISRUPTION)
+            ticket.result(timeout=self.canary_deadline_s)
+        except TimeoutError:
+            with self._lock:
+                self.fleet_stats["canary_misses"] += 1
+            owner.breaker.record_failure()
+            log.warning(
+                "solver fleet: canary MISS on %s (%d consecutive; fence at %d)",
+                owner.name, owner.breaker.consecutive_failures,
+                self.fence_after_misses,
+            )
+            if owner.breaker.state == OPEN:
+                self._fence(owner, reason="canary deadline misses")
+                return "fenced"
+            return "miss"
+        except Exception as e:  # noqa: BLE001 — a raising canary is a miss too
+            with self._lock:
+                self.fleet_stats["canary_misses"] += 1
+            owner.breaker.record_failure()
+            log.warning("solver fleet: canary ERROR on %s: %s", owner.name, e)
+            if owner.breaker.state == OPEN:
+                self._fence(owner, reason=f"canary errors ({type(e).__name__})")
+                return "fenced"
+            return "miss"
+        owner.breaker.record_success()
+        FLEET_CANARY_LATENCY.observe(time.monotonic() - t0, owner=owner.name)
+        return "ok"
+
+    def _probe_fenced(self, owner: FleetOwner) -> str:
+        """Half-open recovery probe (injected-clock schedule): a DIRECT
+        canary solve on a sacrificial thread — never a shared dispatcher —
+        so a still-wedged owner costs one daemon thread, not a pipeline."""
+        if not owner.breaker.allow():
+            return "fenced"
+        box: dict = {}
+        done = threading.Event()
+        inp = self._canary_input()
+
+        def run():
+            try:
+                box["result"] = owner.solver.solve(inp)
+            except BaseException as e:  # noqa: BLE001 — probe verdict below
+                box["error"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"fleet-probe-{owner.name}")
+        t.start()
+        if not done.wait(self.canary_deadline_s) or "error" in box:
+            owner.breaker.record_failure()  # half-open -> re-open
+            return "fenced"
+        owner.breaker.record_success()
+        self._unfence(owner)
+        return "recovered"
+
+    def probe_once(self) -> Dict[str, str]:
+        """One canary pass over every owner. Called by the watchdog thread
+        on its interval, or directly by tests (clock-injected, no sleeps
+        beyond the canary deadline itself). Returns owner -> verdict."""
+        verdicts: Dict[str, str] = {}
+        for owner in self.owners:
+            if self._closing:
+                break
+            with self._lock:
+                fenced = owner.fenced
+            verdicts[owner.name] = (
+                self._probe_fenced(owner) if fenced else self._probe_healthy(owner)
+            )
+        return verdicts
+
+    def _monitor_loop(self) -> None:
+        while not self._stop_event.wait(self.canary_interval_s):
+            try:
+                self.probe_once()
+            except Exception:  # noqa: BLE001 — the watchdog must survive
+                log.exception("solver fleet: canary pass crashed")
+
+    def start(self) -> None:
+        """Start the background watchdog (daemon). Idempotent."""
+        if self._monitor is not None:
+            return
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, daemon=True, name="fleet-canary"
+        )
+        self._monitor.start()
+
+    # -- health / introspection (SolveService surface) ------------------------
+
+    def _export_health(self) -> None:
+        with self._lock:
+            healthy = sum(1 for o in self.owners if not o.fenced)
+            bits = [(o.name, 0.0 if o.fenced else 1.0) for o in self.owners]
+        FLEET_HEALTHY.set(float(healthy))
+        for name, bit in bits:
+            FLEET_HEALTHY.set(bit, owner=name)
+
+    def healthy_owners(self) -> int:
+        with self._lock:
+            return sum(1 for o in self.owners if not o.fenced)
+
+    def unresolved(self) -> int:
+        """Fleet tickets not yet resolved (the soak harness's dropped-solve
+        detector reads this after a full drain: it must be 0)."""
+        with self._lock:
+            return sum(1 for e in self._open if not e.ticket.done())
+
+    @property
+    def solver(self) -> Solver:
+        """The primary owner's solver (SolveService-surface compatibility:
+        introspection reads through `service.solver`)."""
+        return self.owners[0].solver
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        agg: Dict[str, int] = {}
+        for o in self.owners:
+            for k, v in o.service.stats.items():
+                agg[k] = agg.get(k, 0) + v
+        with self._lock:
+            agg.update(self.fleet_stats)
+            agg["healthy_owners"] = sum(1 for o in self.owners if not o.fenced)
+            agg["open"] = len(self._open)
+        return agg
+
+    def occupancy(self) -> float:
+        return max(o.service.occupancy() for o in self.owners)
+
+    def queue_depth(self) -> int:
+        return sum(o.service.queue_depth() for o in self.owners)
+
+    def resume_stats(self) -> Dict[str, float]:
+        return self.owners[0].service.resume_stats()
+
+    def shard_stats(self) -> Dict[str, float]:
+        return self.owners[0].service.shard_stats()
+
+    def decode_stats(self) -> Dict[str, float]:
+        return self.owners[0].service.decode_stats()
+
+    def close(self) -> None:
+        """Stop the watchdog and every owner; every fleet ticket resolves
+        (ServiceStopped for anything not already delivered)."""
+        with self._lock:
+            if self._closing:
+                return
+            self._closing = True
+        self._stop_event.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5)
+        for owner in self.owners:
+            owner.service.stop(drain_s=self.fence_drain_s)
+        with self._lock:
+            leftover = list(self._open)
+            self._open.clear()
+        for entry in leftover:
+            entry.ticket._deliver(error=ServiceStopped("solver fleet closed"))

@@ -68,30 +68,63 @@ class Superseded(Exception):
 
 
 class ServiceStopped(Exception):
-    """The service was closed before this request could run."""
+    """The service was stopped before this request could run (terminal:
+    the ticket resolves with this error rather than stranding a waiter)."""
 
 
 class SolveTicket:
     """Caller-side handle for a submitted request. result() blocks until the
-    decode stage delivers (or re-raises the request's failure)."""
+    decode stage delivers (or re-raises the request's failure).
+
+    Delivery is first-wins: once resolved, later deliveries are ignored —
+    so a force-resolve racing a late decode can never overwrite a real
+    result, and a requeued request can never double-act."""
 
     def __init__(self, kind: str, rev=None):
         self.kind = kind
         self.rev = rev
         self._event = threading.Event()
+        self._lock = threading.Lock()
         self._result = None
         self._error: Optional[BaseException] = None
+        self._callbacks = []
 
-    def _deliver(self, result=None, error: Optional[BaseException] = None) -> None:
-        self._result = result
-        self._error = error
-        self._event.set()
+    def _deliver(self, result=None, error: Optional[BaseException] = None) -> bool:
+        """Resolve the ticket. Returns True if THIS call delivered, False if
+        the ticket was already resolved (the late delivery is dropped)."""
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self._result = result
+            self._error = error
+            callbacks, self._callbacks = self._callbacks, []
+            self._event.set()
+        for cb in callbacks:
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001 — observer must not break delivery
+                pass
+        return True
+
+    def on_done(self, cb: Callable[["SolveTicket"], None]) -> None:
+        """Invoke cb(ticket) at delivery (immediately if already resolved).
+        Used by the fleet layer to forward owner-ticket results without a
+        watcher thread per request."""
+        with self._lock:
+            if not self._event.is_set():
+                self._callbacks.append(cb)
+                return
+        cb(self)
 
     def done(self) -> bool:
         return self._event.is_set()
 
     def superseded(self) -> bool:
         return isinstance(self._error, Superseded)
+
+    def error(self) -> Optional[BaseException]:
+        """The resolution error, if any (None while unresolved / on success)."""
+        return self._error
 
     def result(self, timeout: Optional[float] = None):
         if not self._event.wait(timeout):
@@ -123,6 +156,7 @@ class SolveService:
         self._cv = threading.Condition()
         self._pending: Dict[str, deque] = {PROVISIONING: deque(), DISRUPTION: deque()}
         self._inflight: deque = deque()  # (_Request, finish_fn)
+        self._active: set = set()  # tickets popped from pending, unresolved
         self._last_kind = DISRUPTION  # provisioning gets the first slot
         self._stopped = False
         self.stats: Dict[str, int] = {
@@ -272,15 +306,37 @@ class SolveService:
 
     def close(self) -> None:
         """Stop accepting work; fail queued (undispatched) requests with
-        ServiceStopped; let in-flight requests drain."""
+        ServiceStopped; let in-flight requests drain (up to 30s)."""
+        self.stop(drain_s=30.0)
+
+    def stop(self, drain_s: float = 30.0) -> None:
+        """Terminal stop: no ticket issued by this service is ever left
+        unresolved. Queued (undispatched) requests fail with ServiceStopped
+        immediately; in-flight requests get `drain_s` seconds to deliver
+        their real result; anything still unresolved after the drain window
+        (a wedged dispatch or decode) is force-resolved with ServiceStopped.
+        First-wins delivery makes the force-resolve safe against a late
+        decode racing it — whichever lands first is the resolution."""
         with self._cv:
             self._stopped = True
             for q in self._pending.values():
                 while q:
-                    q.popleft().ticket._deliver(error=ServiceStopped())
+                    if q.popleft().ticket._deliver(error=ServiceStopped(
+                        "solve service stopped before this request dispatched"
+                    )):
+                        self.stats["failed"] += 1
             self._cv.notify_all()
         for t in (self._dispatcher, self._decoder):
-            t.join(timeout=30)
+            t.join(timeout=drain_s)
+        with self._cv:
+            stranded = [tk for tk in self._active if not tk.done()]
+            self._active.clear()
+        for tk in stranded:
+            if tk._deliver(error=ServiceStopped(
+                "solve service stopped while this request was in flight"
+            )):
+                with self._cv:
+                    self.stats["failed"] += 1
 
     # -- pipeline stages -----------------------------------------------------
 
@@ -325,6 +381,7 @@ class SolveService:
                     return
                 req = self._next_request_locked()
                 self._dispatching += 1
+                self._active.add(req.ticket)
             # encode + dispatch OUTSIDE the lock: this is the stage-1 host
             # work that overlaps stage-2 device compute and stage-3 decode
             try:
@@ -344,6 +401,7 @@ class SolveService:
                 with self._cv:
                     self.stats["failed"] += 1
                     self._dispatching -= 1
+                    self._active.discard(req.ticket)
                     self._cv.notify_all()
                 req.ticket._deliver(error=e)
                 continue
@@ -388,5 +446,6 @@ class SolveService:
                 req.ticket._deliver(result=result)
             with self._cv:
                 self._decoding -= 1
+                self._active.discard(req.ticket)
                 self._mark_idle_locked()
                 self._cv.notify_all()

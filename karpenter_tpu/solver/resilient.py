@@ -48,7 +48,11 @@ import time
 from typing import Dict, List, Optional, Sequence
 
 from ..faults import DeviceError, FaultError
-from ..metrics.registry import SOLVER_BREAKER_STATE, SOLVER_FALLBACK
+from ..metrics.registry import (
+    SOLVER_BREAKER_STATE,
+    SOLVER_DEADLINE_LEAKED_THREADS,
+    SOLVER_FALLBACK,
+)
 from ..utils.resources import PODS
 from .backend import AsyncSolve, ReferenceSolver, Solver
 from .encode import quantize_input
@@ -321,6 +325,12 @@ class ResilientSolver(Solver):
             "gate_rejections": 0,
             "breaker_short_circuits": 0,
         }
+        # post-deadline stragglers: abandoned device calls that never
+        # returned. Tracked (not just detached) so a wedging backend shows
+        # up as a non-zero gauge instead of silent thread accumulation.
+        self._strays: List[threading.Thread] = []
+        self._strays_lock = threading.Lock()
+        self._leak_logged = False
 
     def __getattr__(self, name):
         # delegation AFTER normal lookup fails: stats/warmup/prewarm_aot/
@@ -412,13 +422,54 @@ class ResilientSolver(Solver):
         t.start()
         if not done.wait(remaining):
             # abandon the straggler: a hung XLA call cannot be cancelled, but
-            # it must not hold the control loop hostage
+            # it must not hold the control loop hostage. One short bounded
+            # join gives an almost-done call its exit; anything still alive
+            # after that is accounted as a leaked thread.
+            t.join(timeout=0.05)
+            if t.is_alive():
+                self._track_stray(t)
             raise SolveTimeout(
                 f"solve exceeded deadline {self.deadline_s}s (device call abandoned)"
             )
+        self._reap_strays()
         if "error" in box:
             raise box["error"]
         return box["result"]
+
+    def _track_stray(self, t: threading.Thread) -> None:
+        with self._strays_lock:
+            self._strays.append(t)
+            self._strays = [s for s in self._strays if s.is_alive()]
+            n = len(self._strays)
+            first = not self._leak_logged and n > 0
+            if first:
+                self._leak_logged = True
+        if first:
+            log.warning(
+                "resilient-solve deadline leaked a device thread (%r never "
+                "returned after its %.1fs deadline) — the backend is wedged, "
+                "not slow; further leaks update "
+                "karpenter_solver_deadline_leaked_threads without re-logging",
+                t.name, self.deadline_s,
+            )
+        SOLVER_DEADLINE_LEAKED_THREADS.set(n)
+
+    def _reap_strays(self) -> None:
+        """Prune stragglers that eventually returned (their late result was
+        discarded); the gauge tracks only the still-wedged ones."""
+        with self._strays_lock:
+            if not self._strays:
+                return
+            self._strays = [s for s in self._strays if s.is_alive()]
+            n = len(self._strays)
+        SOLVER_DEADLINE_LEAKED_THREADS.set(n)
+
+    @property
+    def leaked_threads(self) -> int:
+        """Stragglers currently alive past their deadline (bench/test seam)."""
+        with self._strays_lock:
+            self._strays = [s for s in self._strays if s.is_alive()]
+            return len(self._strays)
 
     def _handle_failure(self, inp, exc: BaseException):
         reason = classify_failure(exc)

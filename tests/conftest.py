@@ -23,3 +23,30 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import threading  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _no_leaked_nondaemon_threads():
+    """Assert no NON-DAEMON thread outlives a test module.
+
+    Dispatcher/decoder/watchdog threads (solver/pipeline.py, solver/fleet.py,
+    solver/resilient.py) are all daemons by contract — a non-daemon survivor
+    means some code path spawned an unjoinable thread that would hang
+    interpreter shutdown. Daemon stragglers (abandoned wedged dispatches) are
+    allowed: they are exactly what the leaked-thread gauge accounts for.
+    """
+    before = {t.ident for t in threading.enumerate()}
+    yield
+    leaked = [
+        t for t in threading.enumerate()
+        if t.is_alive() and not t.daemon and t is not threading.main_thread()
+        and t.ident not in before
+    ]
+    assert not leaked, (
+        "non-daemon thread(s) leaked by this test module: "
+        + ", ".join(repr(t.name) for t in leaked)
+    )

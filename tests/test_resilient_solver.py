@@ -612,3 +612,121 @@ def test_repair_breaker_gauge_sets_and_clears():
         ))
     rc.reconcile()
     assert REPAIR_BREAKER_OPEN.value() == 0.0
+
+
+# -- half-open probe vs concurrent submit through the pipeline (ISSUE 8) -----
+
+
+def test_half_open_probe_races_concurrent_submit_through_pipeline():
+    """The breaker's half-open admission happens at DISPATCH time on the
+    pipeline's dispatcher thread. While the single probe solve is still in
+    flight (held on a gate — no sleeps, FakeClock drives the schedule), a
+    second request dispatched behind it must be short-circuited to the
+    fallback, not admitted as a second probe; the probe's success then
+    closes the breaker for traffic after both."""
+    import threading
+
+    from karpenter_tpu.solver.pipeline import DISRUPTION, SolveService
+
+    probe_started = threading.Event()
+    release_probe = threading.Event()
+
+    class TripsThenGates(Solver):
+        def __init__(self):
+            self.calls = 0
+
+        def solve(self, inp):
+            self.calls += 1
+            if self.calls <= 2:
+                raise faults.DeviceError(f"dead {self.calls}")
+            probe_started.set()
+            assert release_probe.wait(10), "probe gate never released"
+            return ReferenceSolver().solve(inp)
+
+    clock = FakeClock()
+    inner = TripsThenGates()
+    rs = ResilientSolver(inner, fallbacks=[ReferenceSolver()],
+                         breaker_threshold=2, breaker_probe_s=30.0,
+                         clock=clock)
+    svc = SolveService(rs, depth=2, clock=clock)
+    inp = _inp([mkpod("a")])
+    try:
+        # trip: two device failures through the pipeline open the breaker
+        for t in [svc.submit(inp, kind=DISRUPTION) for _ in range(2)]:
+            t.result(timeout=30)
+        assert rs.breaker.state == "open"
+        assert inner.calls == 2
+        clock.advance(31)  # probe interval elapsed: next allow() half-opens
+
+        # spy on allow(): the concurrent submit's rejection is the race's
+        # observable moment (it happens on the dispatcher thread)
+        short_circuited = threading.Event()
+        orig_allow = rs.breaker.allow
+
+        def spy_allow():
+            ok = orig_allow()
+            if not ok:
+                short_circuited.set()
+            return ok
+
+        rs.breaker.allow = spy_allow
+        before_sc = SOLVER_FALLBACK.value(reason="breaker_open")
+        t_probe = svc.submit(inp, kind=DISRUPTION)
+        assert probe_started.wait(10), "half-open probe never dispatched"
+        assert rs.breaker.state == "half-open"
+        t_racer = svc.submit(inp, kind=DISRUPTION)  # races the open probe
+        assert short_circuited.wait(10), "concurrent submit not rejected"
+        release_probe.set()
+        res_probe = t_probe.result(timeout=30)
+        res_racer = t_racer.result(timeout=30)
+        # exactly one probe reached the device; the racer was served by the
+        # fallback; the successful probe closed the breaker
+        assert inner.calls == 3
+        assert rs.breaker.state == "closed"
+        assert SOLVER_FALLBACK.value(reason="breaker_open") == before_sc + 1
+        assert rs.resilient_stats["breaker_short_circuits"] == 1
+        assert res_probe.placements["a"][0] == "claim"
+        assert res_racer.placements["a"][0] == "claim"
+    finally:
+        release_probe.set()
+        svc.close()
+
+
+# -- deadline-leaked stray threads are tracked and reaped (ISSUE 8) ----------
+
+
+def test_deadline_leaked_thread_gauge_tracks_and_reaps():
+    """thread-mode deadline: a dispatch that outlives its deadline is
+    abandoned but ACCOUNTED — the stray is tracked on the gauge until it
+    finally dies, and a later healthy solve reaps it back to zero."""
+    import threading
+
+    from karpenter_tpu.metrics.registry import SOLVER_DEADLINE_LEAKED_THREADS
+
+    release = threading.Event()
+
+    class HangsOnce(Solver):
+        def __init__(self):
+            self.calls = 0
+
+        def solve(self, inp):
+            self.calls += 1
+            if self.calls == 1:
+                assert release.wait(10), "test never released the hung solve"
+            return ReferenceSolver().solve(inp)
+
+    rs = ResilientSolver(HangsOnce(), fallbacks=[ReferenceSolver()],
+                         deadline_s=0.05, deadline_mode="thread")
+    inp = _inp([mkpod("a")])
+    res = rs.solve(inp)  # deadline trips; the dispatch thread is abandoned
+    assert res.placements["a"][0] == "claim"  # fallback served it
+    assert rs.leaked_threads == 1
+    assert SOLVER_DEADLINE_LEAKED_THREADS.value() == 1.0
+    stray = rs._strays[0]
+    release.set()
+    stray.join(timeout=10)
+    assert not stray.is_alive()
+    res2 = rs.solve(inp)  # healthy solve reaps the dead stray
+    assert res2.placements["a"][0] == "claim"
+    assert rs.leaked_threads == 0
+    assert SOLVER_DEADLINE_LEAKED_THREADS.value() == 0.0

@@ -218,3 +218,35 @@ def test_dead_device_mid_pipeline_drains_onto_fallback_ladder():
         assert svc.stats["failed"] == 0
     finally:
         svc.close()
+
+
+# ------------------------------------------- stop(): nothing blocks forever
+
+
+def test_stop_resolves_every_ticket_even_with_wedged_dispatch():
+    """ISSUE 8 satellite: stop() is the fleet's fencing primitive, so its
+    contract is absolute — EVERY ticket the service ever issued resolves,
+    even when the dispatcher is parked inside a dispatch that never returns
+    (the gate is deliberately never released). Queued tickets fail fast with
+    ServiceStopped; the wedged in-flight one is force-resolved after the
+    drain window. No ticket.result() may block past its timeout."""
+    solver = GatedAsyncSolver()
+    svc = SolveService(solver, depth=1)
+    t1 = svc.submit(mkinput("w1"), kind=DISRUPTION)
+    assert solver.dispatching.wait(10)
+    t2 = svc.submit(mkinput("w2"), kind=DISRUPTION)  # queued behind the wedge
+    t3 = svc.submit(mkinput("w3"), kind=PROVISIONING)
+    svc.stop(drain_s=0.1)  # wedge never releases: drain expires, force-resolve
+    for t in (t1, t2, t3):
+        with pytest.raises(ServiceStopped):
+            t.result(timeout=5)
+    assert svc.stats["failed"] >= 3
+    with pytest.raises(ServiceStopped):
+        svc.submit(mkinput("w4"))
+    # the wedged dispatch eventually returns on the abandoned daemon thread;
+    # its late delivery loses first-wins and must not flip the ticket
+    err_before = t1.error()
+    solver.gate.set()
+    assert solver.gate.is_set()
+    assert isinstance(t1.error(), ServiceStopped)
+    assert t1.error() is err_before

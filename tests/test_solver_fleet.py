@@ -1,0 +1,415 @@
+"""SolverFleet semantics: routing, canary fencing, requeue, recovery, chaos.
+
+The fleet (solver/fleet.py) fronts N SolveService owners behind the single-
+service surface; these tests pin its contract: healthy-path parity and
+provisioning coalescing survive the extra layer, a wedged owner — a HUNG
+dispatch, injected via the faults.py wedge-class sites, never a raised one —
+is fenced within `fence_after_misses` canary intervals, every in-flight and
+queued request re-routes to a healthy owner (or the oracle) without a drop
+or a double-act, and a released wedge recovers the owner through the
+breaker's half-open probe behind a fresh service. All clock-injected; the
+only real-time waits are the canary deadlines themselves (sub-second).
+"""
+
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu import faults
+from karpenter_tpu.metrics.registry import (
+    FLEET_FAILOVER,
+    FLEET_HEALTHY,
+    FLEET_REQUEUED,
+)
+from karpenter_tpu.provisioning.scheduler import SolverInput
+from karpenter_tpu.solver.backend import ReferenceSolver, TPUSolver
+from karpenter_tpu.solver.fleet import SolverFleet
+from karpenter_tpu.solver.pipeline import (
+    DISRUPTION,
+    PROVISIONING,
+    ServiceStopped,
+    Superseded,
+)
+from karpenter_tpu.solver.resilient import ResilientSolver
+
+from tests.test_batched_consolidation import ZONES, mkpod, pool
+from tests.test_e2e_kwok import FakeClock
+
+
+def mkinput(pod_name="a", cpu="250m"):
+    return SolverInput(
+        pods=[mkpod(pod_name, cpu=cpu)], nodes=[], nodepools=[pool()], zones=ZONES
+    )
+
+
+class TaggedOracle(ReferenceSolver):
+    """Oracle-speed solver that honours the wedge-class fault sites the way
+    TPUSolver does (tagged device_hang/device_lost checks on the dispatch
+    path), so fleet fencing is testable without device solves."""
+
+    def __init__(self):
+        super().__init__()
+        self.fault_tag = None
+        self.solve_count = 0
+
+    def solve(self, inp):
+        faults.check("solver.device_hang", tag=self.fault_tag)
+        faults.check("solver.device_lost", tag=self.fault_tag)
+        self.solve_count += 1
+        return super().solve(inp)
+
+
+def mkfleet(size=2, fence_after_misses=2, canary_deadline_s=0.25,
+            recovery_probe_s=10.0, clock=None, factory=None):
+    clock = clock or FakeClock()
+    solvers = []
+
+    def _factory(i):
+        s = (factory or (lambda _i: TaggedOracle()))(i)
+        solvers.append(s)
+        return s
+
+    fleet = SolverFleet(
+        _factory, size=size, clock=clock,
+        canary_input_fn=lambda: mkinput("fleet-canary", cpu="100m"),
+        canary_deadline_s=canary_deadline_s,
+        fence_after_misses=fence_after_misses,
+        recovery_probe_s=recovery_probe_s,
+        fence_drain_s=0.1,
+    )
+    return fleet, solvers, clock
+
+
+# ---------------------------------------------------------------- healthy path
+
+
+def test_fleet_parity_and_stats_surface():
+    fleet, solvers, _ = mkfleet(size=2)
+    try:
+        direct = ReferenceSolver().solve(mkinput("par"))
+        via = fleet.submit(mkinput("par"), kind=PROVISIONING).result(timeout=10)
+        assert via.placements == direct.placements
+        assert via.errors == direct.errors
+        assert len(via.claims) == len(direct.claims)
+        assert fleet.healthy_owners() == 2
+        assert fleet.probe_once() == {"owner-0": "ok", "owner-1": "ok"}
+        st = fleet.stats
+        assert st["fleet_submitted"] == 1
+        assert st["healthy_owners"] == 2
+        assert st["open"] == 0
+        assert fleet.queue_depth() == 0
+        assert 0.0 <= fleet.occupancy() <= 1.0
+        for fn in (fleet.resume_stats, fleet.shard_stats, fleet.decode_stats):
+            assert isinstance(fn(), dict)
+    finally:
+        fleet.close()
+
+
+def test_provisioning_coalesces_on_primary_owner():
+    """state_rev/Superseded semantics survive the fleet layer: all
+    provisioning rides the primary owner, so a newer snapshot still
+    supersedes every queued stale one."""
+    gate = threading.Event()
+    started = threading.Event()
+
+    class Gated(TaggedOracle):
+        # async seam blocking in DISPATCH (the GatedAsyncSolver idiom): the
+        # owner's dispatcher parks on the gate, so later submissions stay
+        # queued (coalescible) instead of dispatching immediately
+        def solve_async(self, inp):
+            from karpenter_tpu.solver.backend import AsyncSolve
+
+            if inp.pods[0].meta.name == "hold":
+                started.set()
+                assert gate.wait(10)
+            return AsyncSolve(lambda: TaggedOracle.solve(self, inp))
+
+    fleet, _, _ = mkfleet(size=2, factory=lambda i: Gated())
+    try:
+        t0 = fleet.submit(mkinput("hold"), kind=PROVISIONING, rev=("r", 0))
+        assert started.wait(10)
+        t1 = fleet.submit(mkinput("q1"), kind=PROVISIONING, rev=("r", 1))
+        t2 = fleet.submit(mkinput("q2"), kind=PROVISIONING, rev=("r", 2))
+        assert t1.done() and t1.superseded()
+        with pytest.raises(Superseded) as ei:
+            t1.result()
+        # the superseding handle maps back to the FLEET ticket
+        assert ei.value.by is t2
+        gate.set()
+        assert t0.result(timeout=10) is not None
+        assert t2.result(timeout=10) is not None
+    finally:
+        gate.set()
+        fleet.close()
+
+
+def test_fleet_close_resolves_every_ticket():
+    fleet, _, _ = mkfleet(size=2)
+    t = fleet.submit(mkinput("x"), kind=PROVISIONING)
+    t.result(timeout=10)
+    fleet.close()
+    with pytest.raises(ServiceStopped):
+        fleet.submit(mkinput("y"))
+    assert fleet.unresolved() == 0
+
+
+# ---------------------------------------------------------------- fencing
+
+
+def test_canary_misses_fence_within_threshold():
+    fleet, solvers, _ = mkfleet(size=2, fence_after_misses=2)
+    plan = faults.FaultPlan(seed=3)
+    wedge = plan.wedge("solver.device_hang", tag="owner-0")
+    failovers0 = FLEET_FAILOVER.value(owner="owner-0")
+    try:
+        with faults.active(plan):
+            v1 = fleet.probe_once()
+            assert v1["owner-0"] == "miss" and v1["owner-1"] == "ok"
+            assert fleet.healthy_owners() == 2  # one miss is not a fence
+            v2 = fleet.probe_once()
+            assert v2["owner-0"] == "fenced"
+            assert fleet.healthy_owners() == 1
+        assert FLEET_FAILOVER.value(owner="owner-0") == failovers0 + 1
+        assert FLEET_HEALTHY.value() == 1.0
+        assert FLEET_HEALTHY.value(owner="owner-0") == 0.0
+        assert FLEET_HEALTHY.value(owner="owner-1") == 1.0
+        # subsequent work routes to the healthy owner; the wedged owner
+        # never executed a single solve (its canaries are parked in the wedge)
+        assert fleet.submit(mkinput("after")).result(timeout=10) is not None
+        assert solvers[0].solve_count == 0
+    finally:
+        wedge.release()
+        fleet.close()
+
+
+def test_wedged_inflight_requeues_without_drop_or_double_act():
+    """A solve hung INSIDE a wedged owner re-routes on fence and completes
+    exactly once: the wedge later releases, the stale owner-side result is
+    dropped by first-wins delivery, and the solver that actually served the
+    request is the healthy one."""
+    fleet, solvers, _ = mkfleet(size=2, fence_after_misses=1)
+    plan = faults.FaultPlan(seed=3)
+    wedge = plan.wedge("solver.device_hang", tag="owner-0")
+    requeued0 = FLEET_REQUEUED.value(target="owner")
+    try:
+        with faults.active(plan):
+            t = fleet.submit(mkinput("inflight"), kind=PROVISIONING)
+            deadline = time.monotonic() + 5
+            while wedge.wedged == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert wedge.wedged >= 1  # the dispatch is parked in the wedge
+            assert fleet.probe_once()["owner-0"] == "fenced"
+            res = t.result(timeout=10)
+            assert res.claims and res.claims[0].pod_uids == ["inflight"]
+        assert FLEET_REQUEUED.value(target="owner") >= requeued0 + 1
+        # release the wedge: the abandoned dispatch finishes late and its
+        # delivery is DROPPED (first-wins) — no double-act
+        wedge.release()
+        time.sleep(0.2)
+        assert solvers[1].solve_count >= 1
+        assert fleet.unresolved() == 0
+        assert fleet.stats["requeued"] >= 1
+    finally:
+        wedge.release()
+        fleet.close()
+
+
+def test_all_owners_fenced_degrades_to_oracle():
+    fleet, solvers, _ = mkfleet(size=2, fence_after_misses=1)
+    plan = faults.FaultPlan(seed=3)
+    wedge = plan.wedge("solver.device_hang")  # untagged: every owner wedges
+    try:
+        with faults.active(plan):
+            v = fleet.probe_once()
+            assert set(v.values()) == {"fenced"}
+            assert fleet.healthy_owners() == 0
+            # inputs degrade to the oracle — decisions still flow
+            res = fleet.submit(mkinput("degraded")).result(timeout=10)
+            assert res.claims and res.claims[0].pod_uids == ["degraded"]
+            # device-bound closures cannot replay on the oracle
+            with pytest.raises(ServiceStopped):
+                fleet.submit_fn(lambda: (lambda: "x"), kind=DISRUPTION).result(timeout=10)
+        assert fleet.stats["oracle_degraded"] >= 1
+        assert fleet.unresolved() == 0
+    finally:
+        wedge.release()
+        fleet.close()
+
+
+def test_device_lost_canary_errors_also_fence():
+    """A raising canary (DeviceLost — the runtime reported the device gone)
+    counts as a miss: raised and hung failures share the fencing path."""
+    fleet, _, _ = mkfleet(size=2, fence_after_misses=2)
+    plan = faults.FaultPlan(seed=3).script(
+        "solver.device_lost", faults.DeviceLost, faults.DeviceLost,
+        tag="owner-1",
+    )
+    try:
+        with faults.active(plan):
+            assert fleet.probe_once()["owner-1"] == "miss"
+            assert fleet.probe_once()["owner-1"] == "fenced"
+            assert fleet.healthy_owners() == 1
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------- recovery
+
+
+def test_half_open_recovery_unfences_behind_fresh_service():
+    fleet, solvers, clock = mkfleet(size=2, fence_after_misses=1,
+                                    recovery_probe_s=10.0)
+    plan = faults.FaultPlan(seed=3)
+    wedge = plan.wedge("solver.device_hang", tag="owner-0")
+    try:
+        with faults.active(plan):
+            assert fleet.probe_once()["owner-0"] == "fenced"
+            old_service = fleet.owners[0].service
+            # breaker still open on the injected clock: no probe yet
+            assert fleet.probe_once()["owner-0"] == "fenced"
+            # still wedged at half-open time: probe fails, stays fenced
+            clock.advance(11)
+            assert fleet.probe_once()["owner-0"] == "fenced"
+            # released + next half-open window: recovery
+            wedge.release()
+            clock.advance(11)
+            assert fleet.probe_once()["owner-0"] == "recovered"
+        assert fleet.healthy_owners() == 2
+        assert fleet.owners[0].service is not old_service  # fresh pipeline
+        # the recovered owner serves provisioning again (primary routing)
+        res = fleet.submit(mkinput("back")).result(timeout=10)
+        assert res.claims
+        assert fleet.stats["recoveries"] == 1
+    finally:
+        wedge.release()
+        fleet.close()
+
+
+def test_fenced_owner_arena_invalidated_for_readoption():
+    """Fencing a TPU-backed owner drops its arena residency, so a recovered
+    owner re-adopts from scratch (one full packed upload) instead of
+    trusting buffers a wedged solve may have left mid-write."""
+    fleet, solvers, clock = mkfleet(
+        size=2, fence_after_misses=1, canary_deadline_s=5.0,
+        factory=lambda i: TPUSolver(),
+    )
+    plan = faults.FaultPlan(seed=3)
+    wedge = plan.wedge("solver.device_hang", tag="owner-0")
+    try:
+        # warm owner-0's arena with a real device solve
+        res = fleet.submit(mkinput("warm"), kind=PROVISIONING).result(timeout=120)
+        assert res.claims
+        arena = fleet.owners[0].solver.arena
+        inv0 = arena.stats["invalidations"]
+        full0 = arena.stats["full_uploads"]
+        with faults.active(plan):
+            assert fleet.probe_once()["owner-0"] == "fenced"
+        assert arena.stats["invalidations"] == inv0 + 1
+        wedge.release()
+        clock.advance(11)
+        # the recovery canary itself is the first post-invalidate device
+        # solve: it must pay a FULL re-adoption upload (no stale residency)
+        assert fleet.probe_once()["owner-0"] == "recovered"
+        res = fleet.submit(mkinput("readopt"), kind=PROVISIONING).result(timeout=120)
+        assert res.claims
+        assert arena.stats["full_uploads"] >= full0 + 1
+    finally:
+        wedge.release()
+        fleet.close()
+
+
+def test_arena_corrupt_fault_replays_on_fallback():
+    """solver.arena_corrupt fires before residency is trusted: the per-
+    request resilience layer classifies it as a device error, invalidates
+    the arena, and the replay repairs residency — no fleet involvement
+    needed for a RAISED fault."""
+    rs = ResilientSolver(TPUSolver(), fallbacks=[ReferenceSolver()])
+    plan = faults.FaultPlan(seed=3).script(
+        "solver.arena_corrupt", faults.ArenaCorrupt
+    )
+    with faults.active(plan):
+        res = rs.solve(mkinput("corrupt"))
+    assert res.claims and res.claims[0].pod_uids == ["corrupt"]
+    assert plan.fired["solver.arena_corrupt"] == 1
+    assert rs.resilient_stats["fallback"] == 1
+    # replay after the plan: residency re-adopts and the device path works
+    res2 = rs.solve(mkinput("after-corrupt"))
+    assert res2.claims
+
+
+# ---------------------------------------------------------------- chaos
+
+
+@pytest.mark.chaos
+def test_chaos_wedge_mid_trace_decisions_identical_to_healthy_run():
+    """ISSUE 8 acceptance: solver.device_hang injected on owner 0 mid-trace.
+    The fleet fences it within fence_after_misses canary intervals, every
+    in-flight and subsequent solve completes on another owner (or the
+    oracle), and the decision sequence is IDENTICAL to a healthy
+    single-owner run of the same trace."""
+    inputs = [mkinput(f"c{i}", cpu=f"{200 + 50 * i}m") for i in range(6)]
+
+    # healthy single-owner baseline
+    baseline = [ReferenceSolver().solve(inp) for inp in inputs]
+
+    fleet, solvers, _ = mkfleet(size=2, fence_after_misses=2)
+    plan = faults.FaultPlan(seed=11)
+    results = {}
+    wedge = None
+    try:
+        with faults.active(plan):
+            # pre-wedge: two healthy solves (disruption class: round-robins
+            # across owners, so both serve traffic before the wedge)
+            for i in (0, 1):
+                results[i] = fleet.submit(inputs[i], kind=DISRUPTION).result(timeout=10)
+            # wedge lands mid-trace: c2 hangs inside owner-0's dispatcher
+            wedge = plan.wedge("solver.device_hang", tag="owner-0")
+            tickets = {i: fleet.submit(inputs[i], kind=DISRUPTION) for i in (2, 3)}
+            deadline = time.monotonic() + 5
+            while wedge.wedged == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert wedge.wedged >= 1
+            # fence within fence_after_misses canary intervals
+            fleet.probe_once()
+            verdicts = fleet.probe_once()
+            assert verdicts["owner-0"] == "fenced"
+            assert fleet.healthy_owners() == 1
+            # in-flight + queued complete on the surviving owner
+            for i, t in tickets.items():
+                results[i] = t.result(timeout=10)
+            # post-fence trace continues
+            for i in (4, 5):
+                results[i] = fleet.submit(inputs[i], kind=DISRUPTION).result(timeout=10)
+        # decisions identical to the healthy single-owner run
+        for i, base in enumerate(baseline):
+            got = results[i]
+            assert got.placements == base.placements, f"trace step {i}"
+            assert got.errors == base.errors, f"trace step {i}"
+            assert [c.pod_uids for c in got.claims] == [
+                c.pod_uids for c in base.claims
+            ], f"trace step {i}"
+        assert fleet.unresolved() == 0  # nothing dropped
+        assert fleet.stats["failovers"] == 1
+    finally:
+        if wedge is not None:
+            wedge.release()
+        fleet.close()
+
+
+# ---------------------------------------------------------------- soak smoke
+
+
+@pytest.mark.slow
+def test_soak_suite_smoke_short_trace():
+    """Satellite: the bench's churn-soak harness on a short trace — steady
+    solves, one injected wedge, zero dropped solves."""
+    import bench
+
+    out = bench._soak_run(duration_steps=12, wedge_at_step=4, fleet_size=2,
+                          canary_deadline_s=0.25, arrivals_per_step=2)
+    assert out["soak_dropped_solves"] == 0
+    assert out["soak_total_solves"] >= 12
+    assert out["soak_failovers"] >= 1
+    assert out["solves_per_sec"] > 0
+    assert out["failover_recovery_ms"] >= 0
