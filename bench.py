@@ -1042,6 +1042,144 @@ def _sharded_metrics(timeout_s: float = None) -> dict:
         return {}
 
 
+# ----------------------------------------------------------------- gang suite
+
+
+def _gang_input(n_nodes: int = 8, victims_per_node: int = 4,
+                n_high: int = 24, n_gangs: int = 8, gang_size: int = 4):
+    """Mixed-priority + gang fleet with preemption contention, existing
+    nodes only (no nodepools): low-priority victims hold most of the
+    capacity, a high-priority singleton surge must preempt to land, and the
+    gang wave oversubscribes what's left so a measurable fraction rolls
+    back atomically."""
+    from karpenter_tpu.api import wellknown as wk
+    from karpenter_tpu.api.objects import ObjectMeta, Pod
+    from karpenter_tpu.provisioning.scheduler import (
+        BoundPodRef, ExistingNode, SolverInput,
+    )
+    from karpenter_tpu.utils.resources import PODS, Resources
+
+    nodes = []
+    for e in range(n_nodes):
+        victims = [
+            BoundPodRef(
+                uid=f"victim-{e}-{v}", priority=0,
+                requests=Resources.parse({"cpu": "1", "memory": "1Gi"}),
+            )
+            for v in range(victims_per_node)
+        ]
+        free = Resources.parse({"cpu": "2", "memory": "4Gi"})
+        free[PODS] = 100
+        nodes.append(ExistingNode(
+            id=f"node-{e}",
+            labels={wk.ZONE_LABEL: f"zone-{e % 2}",
+                    wk.HOSTNAME_LABEL: f"node-{e}"},
+            taints=[], free=free, bound_pods=victims,
+        ))
+    pods = []
+    # one doomed gang above everything: 8-cpu members no node can host, so
+    # every solve exercises the verdict -> rollback -> re-solve round
+    for r in range(gang_size):
+        pods.append(Pod(
+            meta=ObjectMeta(
+                name=f"doomed-{r}", uid=f"doomed-{r}",
+                labels={wk.GANG_LABEL: "job-doomed",
+                        wk.GANG_SIZE_LABEL: str(gang_size)},
+            ),
+            requests=Resources.parse({"cpu": "8", "memory": "1Gi"}),
+            priority=200,
+        ))
+    # gang wave lands first (highest surviving priority), fits in free
+    for g in range(n_gangs):
+        for r in range(gang_size):
+            pods.append(Pod(
+                meta=ObjectMeta(
+                    name=f"gang{g}-{r}", uid=f"gang{g}-{r}",
+                    labels={wk.GANG_LABEL: f"job-{g:02d}",
+                            wk.GANG_SIZE_LABEL: str(gang_size)},
+                ),
+                requests=Resources.parse({"cpu": "250m", "memory": "256Mi"}),
+                priority=150,
+            ))
+    # singleton surge below the gangs: overflows the remaining free capacity,
+    # so the tail must preempt the priority-0 victims to plan a landing
+    for i in range(n_high):
+        pods.append(Pod(
+            meta=ObjectMeta(name=f"hi-{i:03d}", uid=f"hi-{i:03d}"),
+            requests=Resources.parse({"cpu": "1", "memory": "1Gi"}),
+            priority=100,
+        ))
+    return SolverInput(pods=pods, nodes=nodes, nodepools=[],
+                       zones=("zone-0", "zone-1"))
+
+
+def _gang_run(iters: int = 20) -> dict:
+    """ISSUE 9 gang/preemption suite: the class-aware solve seam
+    (solver/scheduling_class.py around the python oracle — the decision math
+    is planner-parity-tested, so host numbers characterize the subsystem)
+    over a contended mixed-priority + gang fleet. Emits the per-solve wall
+    with the preemption pass engaged (preemption_solve_p99_ms), the fraction
+    of gangs that committed atomically (gang_commit_rate), and planned
+    evictions per solve (preemptions_per_solve)."""
+    from karpenter_tpu.solver.backend import ReferenceSolver
+    from karpenter_tpu.solver.scheduling_class import ClassAwareSolver
+
+    inp = _gang_input()
+    solver = ClassAwareSolver(ReferenceSolver())
+    times = []
+    for _ in range(max(iters, 2)):
+        t0 = time.perf_counter()
+        res = solver.solve(inp)
+        times.append((time.perf_counter() - t0) * 1000)
+    n = len(times)
+    placed = solver.class_stats["gangs_placed"]
+    unsched = solver.class_stats["gangs_unschedulable"]
+    assert solver.class_stats["class_solves"] == n, solver.class_stats
+    return {
+        "preemption_solve_p99_ms": round(float(np.percentile(times, 99)), 2),
+        "preemption_solve_p50_ms": round(float(np.percentile(times, 50)), 2),
+        "gang_commit_rate": round(placed / max(placed + unsched, 1), 3),
+        "preemptions_per_solve": round(solver.class_stats["preemptions"] / n, 2),
+        "gang_rounds_per_solve": round(solver.class_stats["gang_rounds"] / n, 2),
+        "gang_evictions_last_solve": len(res.evictions),
+        "gangs_unschedulable_last_solve": len(res.gangs_unschedulable),
+        "class_declines_total": solver.class_stats["declines"],
+    }
+
+
+def _gang_metrics() -> dict:
+    """Scheduling-class keys for the run JSON and every host-only marker
+    branch (ISSUE 9 acceptance: the three headline keys always report)."""
+    try:
+        out = _gang_run()
+        print(
+            f"[bench] gang suite: preemption p99={out['preemption_solve_p99_ms']}ms "
+            f"commit_rate={out['gang_commit_rate']} "
+            f"preemptions/solve={out['preemptions_per_solve']}",
+            file=sys.stderr,
+        )
+        return out
+    except Exception as e:  # noqa: BLE001 — the marker line must still emit
+        print(f"[bench] gang metrics failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return {}
+
+
+def bench_gang_suite() -> None:
+    """CLI entry (--gang-suite): run the scheduling-class suite standalone
+    and print ONE JSON line tagged gang_suite."""
+    out = _gang_run(iters=int(os.environ.get("KTPU_GANG_ITERS", "20")))
+    assert out["preemptions_per_solve"] > 0, out
+    assert 0 < out["gang_commit_rate"] <= 1, out
+    print(json.dumps({
+        "metric": "preemption_solve_p99_ms",
+        "value": out["preemption_solve_p99_ms"],
+        "unit": "ms",
+        "gang_suite": True,
+        **out,
+    }))
+
+
 # ---------------------------------------------------------------- churn soak
 
 
@@ -1268,6 +1406,9 @@ def main() -> None:
     if "--soak-suite" in sys.argv[1:]:
         bench_soak_suite()
         return
+    if "--gang-suite" in sys.argv[1:]:
+        bench_gang_suite()
+        return
     # JAX_PLATFORMS pinned to host-only platforms means no accelerator can
     # EVER appear — the 4-attempt probe/backoff loop (~13 min) would be pure
     # waste. Fail fast with a reason distinct from a tunnel outage.
@@ -1279,7 +1420,8 @@ def main() -> None:
             "encode micro-bench)",
             extra={**_host_only_metrics(), **_host_only_pipeline_metrics(),
                    **_resume_metrics(), **_decode_relax_metrics(),
-                   **_sharded_metrics(), **_soak_metrics()},
+                   **_sharded_metrics(), **_soak_metrics(),
+                   **_gang_metrics()},
         )
         return
     plat = wait_for_backend()
@@ -1296,7 +1438,8 @@ def main() -> None:
             "(probe hang/failure after retries)",
             extra={**_host_only_metrics(), **_host_only_pipeline_metrics(),
                    **_resume_metrics(), **_decode_relax_metrics(),
-                   **_sharded_metrics(), **_soak_metrics()},
+                   **_sharded_metrics(), **_soak_metrics(),
+                   **_gang_metrics()},
         )
         return
     if plat.startswith("cpu"):
@@ -1307,7 +1450,8 @@ def main() -> None:
             f"only host backend available ({plat})",
             extra={**_host_only_metrics(), **_host_only_pipeline_metrics(),
                    **_resume_metrics(), **_decode_relax_metrics(),
-                   **_sharded_metrics(), **_soak_metrics()},
+                   **_sharded_metrics(), **_soak_metrics(),
+                   **_gang_metrics()},
         )
         return
 
@@ -1559,6 +1703,10 @@ def _run(plat: str) -> None:
     # thread inside a live XLA call for the rest of the bench.
     soak_keys = _soak_metrics()
 
+    # ---- scheduling classes (ISSUE 9): preemption + gang commit under
+    # contention — host seam on purpose, same rationale as the soak above
+    gang_keys = _gang_metrics()
+
     print(
         json.dumps(
             {
@@ -1614,6 +1762,9 @@ def _run(plat: str) -> None:
                 # fleet churn soak (ISSUE 8): fence + requeue under a wedged
                 # owner — soak_dropped_solves MUST be 0
                 **soak_keys,
+                # scheduling classes (ISSUE 9): preemption latency, atomic
+                # gang commit rate, evictions planned per solve
+                **gang_keys,
                 "decode_bytes_per_solve": round(
                     e2e_solver.ledger.decode_bytes_per_solve, 1
                 ),

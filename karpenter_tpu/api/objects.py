@@ -220,6 +220,30 @@ class Pod:
     def bound(self) -> bool:
         return self.node_name is not None
 
+    def gang(self) -> Optional[Tuple[str, int, int]]:
+        """(gang_id, size, min_ranks) from the gang labels, or None. A
+        malformed size/min-ranks label (non-integer, < 1) voids the gang —
+        the pod schedules as an ordinary singleton rather than wedging a
+        whole gang on a typo. min_ranks defaults to size and is clamped to
+        it (a gang can never need more placements than members)."""
+        gid = self.meta.labels.get(wk.GANG_LABEL)
+        if not gid:
+            return None
+        try:
+            size = int(self.meta.labels.get(wk.GANG_SIZE_LABEL, ""))
+        except ValueError:
+            return None
+        if size < 1:
+            return None
+        raw = self.meta.labels.get(wk.GANG_MIN_RANKS_LABEL)
+        try:
+            min_ranks = min(size, int(raw)) if raw is not None else size
+        except ValueError:
+            min_ranks = size
+        if min_ranks < 1:
+            return None
+        return (gid, size, min_ranks)
+
 
 @dataclass
 class Node:

@@ -30,6 +30,20 @@ CAPACITY_TYPE_ON_DEMAND = "on-demand"
 CAPACITY_TYPE_SPOT = "spot"
 CAPACITY_TYPE_RESERVED = "reserved"
 
+# Gang (co-scheduling) labels — LABELS, not annotations, deliberately: labels
+# ride the pod's solver signature (api/objects._POD_SIG_FIELDS via `meta`), so
+# a gang edit invalidates exactly the affected encode-cache runs with no extra
+# cache plumbing. A gang is the set of pending pods sharing a GANG_LABEL
+# value; GANG_SIZE_LABEL declares the member count the gang needs and
+# GANG_MIN_RANKS_LABEL (optional, default = size) the minimum members that
+# must place for the gang to commit. GANG_TOPOLOGY_LABEL (optional; one of
+# TOPOLOGY_KEYS) asks for rank-aware co-location: members gain a preferred
+# self-affinity on that key, relaxed by the ordinary preference ladder.
+GANG_LABEL = "scheduling.karpenter.sh/gang"
+GANG_SIZE_LABEL = "scheduling.karpenter.sh/gang-size"
+GANG_MIN_RANKS_LABEL = "scheduling.karpenter.sh/gang-min-ranks"
+GANG_TOPOLOGY_LABEL = "scheduling.karpenter.sh/gang-topology"
+
 # Annotations
 DO_NOT_DISRUPT_ANNOTATION = "karpenter.sh/do-not-disrupt"
 POD_DELETION_COST_ANNOTATION = "controller.kubernetes.io/pod-deletion-cost"

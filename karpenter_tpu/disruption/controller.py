@@ -124,12 +124,12 @@ class DisruptionController:
         self.stats: Dict[str, int] = {}
         # TPU backend: evaluate candidate subsets as one vmapped batch
         # (solver/tpu/consolidate.py); sequential path remains ground truth
-        from ..solver.backend import TPUSolver
+        from ..solver.backend import TPUSolver, concrete_backend
 
         self._batched = None
-        # unwrap a ResilientSolver shell: the batched evaluator keys off the
-        # concrete device backend underneath
-        inner = getattr(solver, "inner", solver)
+        # unwrap the wrapper chain (resilience, scheduling classes, ...): the
+        # batched evaluator keys off the concrete device backend at the bottom
+        inner = concrete_backend(solver)
         if isinstance(inner, TPUSolver):
             from .batched import BatchedConsolidationEvaluator
 

@@ -73,3 +73,17 @@ def disrupted(node_name: str, reason: str) -> Event:
 
 def interrupted(claim_name: str, kind: str) -> Event:
     return Event("nodeclaims", claim_name, "Warning", "Interrupted", f"Interruption: {kind}")
+
+
+def preempted(pod_name: str, node_name: str, by_pod: str) -> Event:
+    return Event(
+        "pods", pod_name, "Normal", "Preempted",
+        f"Preempted from {node_name} by higher-priority pod {by_pod}",
+    )
+
+
+def gang_unschedulable(pod_name: str, gang_id: str) -> Event:
+    return Event(
+        "pods", pod_name, "Warning", "GangUnschedulable",
+        f"Gang {gang_id} rolled back: fewer than min-ranks members could schedule",
+    )

@@ -266,6 +266,37 @@ SOLVER_FALLBACK = REGISTRY.register(
         ("reason",),
     )
 )
+# -- scheduling-class series (solver/scheduling_class.py). No _tpu segment:
+#    the subsystem is backend-neutral (same counts on oracle/host/device) ----
+
+SOLVER_PREEMPTIONS = REGISTRY.register(
+    Counter(
+        "karpenter_solver_preemptions_total",
+        "Evictions planned by the preemption pass (victims of strictly-"
+        "higher-priority pending pods; executed by provisioning/preemption.py)",
+    )
+)
+SOLVER_GANGS_PLACED = REGISTRY.register(
+    Counter(
+        "karpenter_solver_gangs_placed_total",
+        "Gangs that committed atomically (>= min-ranks members placed)",
+    )
+)
+SOLVER_GANGS_UNSCHEDULABLE = REGISTRY.register(
+    Counter(
+        "karpenter_solver_gangs_unschedulable_total",
+        "Gangs rolled back whole (fewer than min-ranks members could place)",
+    )
+)
+SOLVER_PRIORITY_INVERSIONS = REGISTRY.register(
+    Counter(
+        "karpenter_solver_priority_inversions_total",
+        "Unplaced pods that lost a committed slot to a strictly-lower-"
+        "priority pod — structurally impossible under priority-major order; "
+        "parity tests assert this stays 0",
+    )
+)
+
 SOLVER_BREAKER_STATE = REGISTRY.register(
     Gauge(
         "karpenter_tpu_solver_breaker_state",

@@ -103,6 +103,8 @@ def main(argv=None) -> int:
         solver_fleet_size=o.solver_fleet_size,
         canary_interval_s=o.canary_interval_s,
         fence_after_misses=o.fence_after_misses,
+        solver_preemption=o.solver_preemption,
+        solver_gang=o.solver_gang,
     )
     serve_endpoints(o.metrics_port, o.health_probe_port,
                     enable_profiling=o.enable_profiling)

@@ -51,6 +51,8 @@ class Operator:
     solver: Solver
     interruption_queue: InterruptionQueue = field(default_factory=InterruptionQueue)
     solve_service: Optional[object] = None  # solver/pipeline.py SolveService
+    recorder: Optional[object] = None  # events/recorder.py Recorder
+    preemption: Optional[object] = None  # provisioning/preemption.py
 
 
 def new_kwok_operator(
@@ -86,6 +88,8 @@ def new_kwok_operator(
     canary_interval_s: float = 5.0,
     fence_after_misses: int = 2,
     canary_deadline_s: float = 5.0,
+    solver_preemption: bool = True,
+    solver_gang: bool = True,
 ) -> Operator:
     store = shared_store if shared_store is not None else st.Store()
     # the operator's clock is authoritative for every age stamp, including a
@@ -139,6 +143,18 @@ def new_kwok_operator(
             breaker_probe_s=breaker_probe_s,
             clock=clock,
         )
+    # scheduling classes (solver/scheduling_class.py): configure the module
+    # knobs, then wrap the solver seam — OUTSIDE the resilience wrap (a
+    # device failure inside a class re-solve still walks the fallback chain)
+    # and INSIDE the pipeline/fleet (the service sees one Solver). With both
+    # knobs off the wrapper is skipped entirely; with them on it is still
+    # provably inert on priority-flat, gang-free batches (verbatim
+    # delegation, including the inner async seam).
+    from ..solver import scheduling_class as sc
+
+    sc.configure(preemption=solver_preemption, gang=solver_gang)
+    if solver_preemption or solver_gang:
+        solver = sc.ClassAwareSolver(solver)
     solve_service = None
     if solver_pipeline and solver_fleet_size >= 2:
         # solver fleet (solver/fleet.py): N independently health-checked
@@ -170,6 +186,9 @@ def new_kwok_operator(
                     breaker_probe_s=breaker_probe_s,
                     clock=clock,
                 )
+            if solver_preemption or solver_gang:
+                # failover owners carry the same class semantics as owner 0
+                fresh = sc.ClassAwareSolver(fresh)
             return fresh
 
         solve_service = SolverFleet(
@@ -191,6 +210,11 @@ def new_kwok_operator(
         from ..solver.pipeline import SolveService
 
         solve_service = SolveService(solver, depth=pipeline_depth, clock=clock)
+    from ..events.recorder import Recorder
+    from ..provisioning.preemption import PreemptionController
+
+    recorder = Recorder(clock=clock)
+    preemption = PreemptionController(store, recorder=recorder)
     provisioner = Provisioner(
         store,
         cluster,
@@ -201,6 +225,8 @@ def new_kwok_operator(
         clock=clock,
         preference_policy=preference_policy,
         solve_service=solve_service,
+        preemption=preemption,
+        recorder=recorder,
     )
     from ..controllers.volume import VolumeTopologyController
 
@@ -252,6 +278,7 @@ def new_kwok_operator(
         RegistrationController(store, clock=clock),
         InitializationController(store, clock=clock),
         Binder(store, cluster),
+        preemption,
         TerminationController(store, cloud_provider, clock=clock),
         LivenessController(store, clock=clock),
         ExpirationController(store, clock=clock),
@@ -335,4 +362,6 @@ def new_kwok_operator(
         solver=solver,
         interruption_queue=queue,
         solve_service=solve_service,
+        recorder=recorder,
+        preemption=preemption,
     )

@@ -68,6 +68,14 @@ class Options:
     # committing the first rung at which each failing pod places; false =
     # the host redispatches once per dropped preference (_relax_solve loop)
     solver_relax_ladder: bool = True
+    # scheduling classes (solver/scheduling_class.py): preemption plans
+    # evictions of strictly-lower-priority bound pods for unplaced pending
+    # pods; gang makes GANG_LABEL co-scheduling atomic (all-or-nothing with
+    # rollback). Both default on and are provably inert on priority-flat,
+    # gang-free fleets (the class sort keys and the solve passes only engage
+    # when the batch carries >1 distinct priority or a gang).
+    solver_preemption: bool = True
+    solver_gang: bool = True
     # pipelined solve service (solver/pipeline.py): one device owner, host
     # encode / device compute / host decode of independent solves overlap,
     # provisioning snapshots coalesce on newer cluster-state revisions;
@@ -209,7 +217,10 @@ def parse(argv: Optional[Sequence[str]] = None, cls=Options) -> Options:
     # paths, so a typo'd env value ("ture", "on") must not silently become
     # False and mask the fast path being off in prod — fail closed like the
     # resume interval above instead of inheriting bool()'s permissiveness.
-    for name in ("solver_device_decode", "solver_relax_ladder"):
+    for name in (
+        "solver_device_decode", "solver_relax_ladder",
+        "solver_preemption", "solver_gang",
+    ):
         if not hasattr(out, name):
             continue
         env = os.environ.get(_env_name(name))

@@ -1,0 +1,80 @@
+"""Preemption executor: planned evictions -> pod evictions.
+
+The solver PLANS preemptions (solver/scheduling_class.py emits
+SolverResult.evictions — victim uid, node, and the pending pod the capacity
+is for); this controller EXECUTES them through the same store/binder path
+every other pod transition takes: the victim is unbound (node_name cleared,
+phase back to Pending) and a Preempted event records why. The freed capacity
+shows up in cluster state on the next snapshot, the pending pod lands there
+on a later provisioner/binder reconcile, and the victim re-queues as an
+ordinary pending pod — exactly Kubernetes' asynchronous preemption shape
+(convergence over reconciles, not within one solve).
+
+Stale plans drop harmlessly: an eviction row is executed only if the victim
+is still bound to the planned node and still strictly lower priority than
+the pod it yields to (the world may have moved between solve and execute —
+the pod finished, moved, or priorities changed). Dropped rows are not
+retried; the next solve re-plans against current state.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from ..controllers import store as st
+from ..events import recorder as ev
+from ..provisioning.scheduler import Eviction
+
+log = logging.getLogger("karpenter_tpu")
+
+
+class PreemptionController:
+    name = "preemption"
+
+    def __init__(self, store: st.Store, recorder: Optional[ev.Recorder] = None):
+        self.store = store
+        self.recorder = recorder
+        self._queue: List[Eviction] = []
+        self.executed = 0
+        self.dropped_stale = 0
+
+    def submit(self, evictions: List[Eviction]) -> None:
+        """Queue a solve's planned evictions for the next reconcile tick."""
+        self._queue.extend(evictions)
+
+    def reconcile(self) -> bool:
+        if not self._queue:
+            return False
+        plan, self._queue = self._queue, []
+        by_uid = {p.meta.uid: p for p in self.store.list(st.PODS)}
+        preemptors = by_uid  # pending pods live in the same table
+        did = False
+        for row in plan:
+            victim = by_uid.get(row.pod_uid)
+            if (
+                victim is None
+                or victim.node_name != row.node_id
+                or victim.meta.deleting
+            ):
+                self.dropped_stale += 1
+                continue
+            beneficiary = preemptors.get(row.for_pod)
+            if beneficiary is not None and beneficiary.priority <= victim.priority:
+                # priorities moved since the plan: no longer a preemption
+                self.dropped_stale += 1
+                continue
+            victim.node_name = None
+            victim.phase = "Pending"
+            self.store.update(st.PODS, victim)
+            self.executed += 1
+            did = True
+            if self.recorder is not None:
+                self.recorder.publish(
+                    ev.preempted(victim.meta.name, row.node_id, row.for_pod)
+                )
+            log.info(
+                "preempted pod %s from %s for higher-priority pod %s",
+                victim.meta.name, row.node_id, row.for_pod,
+            )
+        return did

@@ -38,6 +38,8 @@ class Provisioner:
         clock=time.monotonic,
         preference_policy: str = "Respect",
         solve_service=None,
+        preemption=None,
+        recorder=None,
     ):
         self.store = store
         self.cluster = cluster
@@ -51,6 +53,11 @@ class Provisioner:
         # it so provisioning snapshots coalesce and interleave fairly with
         # disruption probes; None = call the solver seam directly
         self._solve_service = solve_service
+        # scheduling-class outputs (solver/scheduling_class.py): planned
+        # evictions hand off to the PreemptionController; gang verdicts and
+        # preemptions surface as pod events through the recorder
+        self._preemption = preemption
+        self._recorder = recorder
         self._first_seen: Optional[float] = None
         self._last_count = 0
         self._claim_seq = 0
@@ -212,9 +219,23 @@ class Provisioner:
             nodepools = self._nodepools()
         PROVISIONER_SCHEDULING_DURATION.observe(time.perf_counter() - t0)
         did = False
+        # gang membership: claims carrying a gang member batch all-or-nothing
+        # — a rejected claim rolls back the gang's already-created siblings
+        # (deleted before launch; the termination path GCs them) instead of
+        # leaving the gang half-provisioned
+        gang_of = {
+            p.meta.uid: p.gang()[0] for p in pending if p.gang() is not None
+        }
+        gang_claims: Dict[str, List[str]] = {}
+        failed_gangs: set = set()
         for claim_res in result.claims:
             np_obj = nodepools.get(claim_res.nodepool)
             if np_obj is None:
+                continue
+            claim_gangs = {
+                gang_of[uid] for uid in claim_res.pod_uids if uid in gang_of
+            }
+            if claim_gangs & failed_gangs:
                 continue
             name = self._next_claim_name(claim_res.nodepool)
             reqs = type(claim_res.requirements)(claim_res.requirements)
@@ -262,9 +283,35 @@ class Provisioner:
                 logging.getLogger("karpenter_tpu").warning(
                     "nodeclaim %s rejected: %s", name, e
                 )
+                if claim_gangs:
+                    # all-or-nothing: strike the gangs this claim carried and
+                    # delete their already-created sibling claims
+                    failed_gangs |= claim_gangs
+                    for gid in claim_gangs:
+                        for sib in gang_claims.pop(gid, []):
+                            try:
+                                self.store.delete(st.NODECLAIMS, sib)
+                            except Exception:
+                                pass
                 continue
+            for gid in claim_gangs:
+                gang_claims.setdefault(gid, []).append(name)
             did = True
         for uid, placement in result.placements.items():
             if placement[0] == "node":
                 self.cluster.nominate(placement[1])
+        # scheduling-class handoff: evictions execute through the preemption
+        # controller; gang verdicts surface as pod events
+        if result.evictions and self._preemption is not None:
+            self._preemption.submit(result.evictions)
+        unplaced_gangs = set(result.gangs_unschedulable) | failed_gangs
+        if unplaced_gangs and self._recorder is not None:
+            from ..events import recorder as ev
+
+            for p in pending:
+                g = p.gang()
+                if g is not None and g[0] in unplaced_gangs:
+                    self._recorder.publish(
+                        ev.gang_unschedulable(p.meta.name, g[0])
+                    )
         return did

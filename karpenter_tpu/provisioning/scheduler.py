@@ -27,6 +27,20 @@ from ..utils.resources import PODS, Resources
 
 
 @dataclass
+class BoundPodRef:
+    """Preemption-relevant view of one bound pod: enough to plan an eviction
+    (who, how important, how much capacity it returns) without carrying the
+    Pod object into the solver."""
+
+    uid: str
+    priority: int
+    requests: Resources
+    # False for pods the preemption planner must never evict: do-not-disrupt
+    # annotated, DaemonSet-owned, or already terminating.
+    evictable: bool = True
+
+
+@dataclass
 class ExistingNode:
     """A schedulable existing node or in-flight NodeClaim."""
 
@@ -36,6 +50,9 @@ class ExistingNode:
     free: Resources  # allocatable minus bound pods/daemonsets
     pod_labels: List[Dict[str, str]] = field(default_factory=list)  # bound pods (for topo/affinity)
     schedulable: bool = True
+    # bound-pod refs for the preemption planner (solver/scheduling_class.py);
+    # empty is always safe — the node simply offers no reclaimable capacity
+    bound_pods: List[BoundPodRef] = field(default_factory=list)
 
 
 @dataclass
@@ -86,10 +103,26 @@ class ClaimResult:
 
 
 @dataclass
+class Eviction:
+    """One planned preemption: evict `pod_uid` (bound on `node_id`) so the
+    strictly-higher-priority pending pod `for_pod` can land there on a later
+    reconcile. The solver plans; provisioning/preemption.py executes."""
+
+    node_id: str
+    pod_uid: str
+    victim_priority: int
+    for_pod: str
+
+
+@dataclass
 class SolverResult:
     placements: Dict[str, Tuple[str, object]]  # pod uid -> ("node", id) | ("claim", idx)
     claims: List[ClaimResult]
     errors: Dict[str, str]
+    # scheduling-class outputs (solver/scheduling_class.py); default-empty so
+    # every pre-existing constructor call and consumer stays valid
+    evictions: List[Eviction] = field(default_factory=list)
+    gangs_unschedulable: List[str] = field(default_factory=list)
 
 
 # ---------------------------------------------------------------------------
@@ -116,11 +149,42 @@ def ffd_sort(pods: Sequence[Pod]) -> List[Pod]:
     path scans O(distinct specs) steps instead of O(pods) when differently-
     constrained pods interleave by uid.
 
+    Scheduling classes (SPEC.md "Priority, preemption & gang semantics")
+    prepend two keys — priority descending, then gang id lexicographic
+    (non-gang pods carry "" and sort first within a priority) — but ONLY
+    when the batch actually carries more than one distinct priority or any
+    gang. A flat fleet takes the exact pre-class code path, so the class
+    machinery is provably inert there (the lexsort keys would be constant
+    anyway; skipping them keeps even the float of the key-build identical).
+
     Vectorized (numpy lexsort + stable regroup): the per-solve sort is an
     O(pods) host cost on the end-to-end Solve() seam, so no Python-level
     comparison runs; semantics are identical to the sequential spec above
     (tests/test_solver_parity.py covers the interleaved-tie cases)."""
     return ffd_sort_with_sigs(pods)[0]
+
+
+def _class_keys(pods: Sequence[Pod]):
+    """(neg_prio[int64], gang_rank[int64]) when class-aware ordering must
+    engage, else None. Gang ranks are the lexicographic ranks of the gang-id
+    strings with "" (no gang) ranked 0, so ascending rank == ascending lex
+    order and non-gang pods precede gangs within a priority level."""
+    import numpy as np
+
+    from ..solver import scheduling_class as sc  # lazy: avoid import cycle
+
+    n = len(pods)
+    use_prio = sc.PRIORITY_ENABLED
+    use_gang = sc.GANG_ENABLED
+    if not use_prio and not use_gang:
+        return None
+    prios = np.fromiter((p.priority for p in pods), np.int64, n)
+    gids = [(p.gang() or ("", 0, 0))[0] if use_gang else "" for p in pods]
+    if (not use_prio or (prios == prios[0]).all()) and not any(gids):
+        return None
+    neg_prio = -prios if use_prio else np.zeros(n, np.int64)
+    _, gang_rank = np.unique(np.array(gids, dtype=object), return_inverse=True)
+    return neg_prio, gang_rank.astype(np.int64)
 
 
 def ffd_sort_with_sigs(pods: Sequence[Pod], presorted: bool = False):
@@ -146,12 +210,28 @@ def ffd_sort_with_sigs(pods: Sequence[Pod], presorted: bool = False):
     neg_mem = np.fromiter((k[1] for k in keys), np.int64, n)
     uids = np.array([k[2] for k in keys], dtype=object)
     sigs, interned = sig_nums(pods)
-    # primary sort: the full ffd_key (-cpu, -mem, uid)
-    order0 = np.lexsort((uids, neg_mem, neg_cpu))
-    cpu_s, mem_s, sig_s = neg_cpu[order0], neg_mem[order0], sigs[order0]
-    # equal-(cpu,mem) block ids over the sorted sequence
-    blk = np.zeros(n, np.int64)
-    blk[1:] = np.cumsum((np.diff(cpu_s) != 0) | (np.diff(mem_s) != 0))
+    cls = _class_keys(pods)
+    if cls is None:
+        # primary sort: the full ffd_key (-cpu, -mem, uid)
+        order0 = np.lexsort((uids, neg_mem, neg_cpu))
+        cpu_s, mem_s, sig_s = neg_cpu[order0], neg_mem[order0], sigs[order0]
+        # equal-(cpu,mem) block ids over the sorted sequence
+        blk = np.zeros(n, np.int64)
+        blk[1:] = np.cumsum((np.diff(cpu_s) != 0) | (np.diff(mem_s) != 0))
+    else:
+        # class-aware order: (priority desc, gang_id, existing FFD key) —
+        # same lexsort, two more significant keys; signature regrouping must
+        # not cross a priority or gang boundary, so those keys join the
+        # equal-block condition too
+        neg_prio, gang_rank = cls
+        order0 = np.lexsort((uids, neg_mem, neg_cpu, gang_rank, neg_prio))
+        cpu_s, mem_s, sig_s = neg_cpu[order0], neg_mem[order0], sigs[order0]
+        prio_s, gang_s = neg_prio[order0], gang_rank[order0]
+        blk = np.zeros(n, np.int64)
+        blk[1:] = np.cumsum(
+            (np.diff(cpu_s) != 0) | (np.diff(mem_s) != 0)
+            | (np.diff(prio_s) != 0) | (np.diff(gang_s) != 0)
+        )
     # regroup within each block by signature first-appearance: stable argsort
     # on the first sorted-position of each (block, signature) pair — constant
     # within a pair, and always inside the pair's block, so blocks never mix

@@ -51,6 +51,22 @@ class Solver(abc.ABC):
         ...
 
 
+def concrete_backend(solver):
+    """The concrete executor at the bottom of a wrapper chain (resilient /
+    class-aware / fleet layers all delegate via `.inner` or `.solver`).
+    Wrappers' `__getattr__` passthrough makes hasattr unusable here — only
+    attributes in the instance __dict__ count as real links."""
+    seen = set()
+    while id(solver) not in seen:
+        seen.add(id(solver))
+        d = getattr(solver, "__dict__", {})
+        nxt = d.get("inner") or d.get("solver")
+        if nxt is None or isinstance(nxt, (str, bytes)):
+            break
+        solver = nxt
+    return solver
+
+
 class ReferenceSolver(Solver):
     def solve(self, inp: SolverInput) -> SolverResult:
         # each CONCRETE executor counts itself exactly once per logical

@@ -283,6 +283,20 @@ class EncodedInput:
     group_daxis: Optional[np.ndarray] = None  # [G] i32 axis per group
     node_dom2: Optional[np.ndarray] = None  # [E] i32 second-axis column (-1)
 
+    # scheduling-class tensors (SPEC.md "Priority, preemption & gang
+    # semantics"; ffd.CLASS_ARG_SPEC): per-run dense priority rank (higher
+    # priority ⇒ higher rank — lossless for the strict-order comparisons
+    # preemption makes), per-run gang index (-1 = no gang) into the per-gang
+    # tables, and the per-gang declared size / minimum ranks. These ride a
+    # SIDE table, not ffd.ARG_SPEC: the base scan is class-blind (priority
+    # already orders the runs), so the frozen 36-tensor contract — arena
+    # residency, AOT shapes, resume/ladder/sharded splices — stays intact.
+    run_prio16: Optional[np.ndarray] = None  # [S] uint16
+    run_gang: Optional[np.ndarray] = None  # [S] int32 (-1 = none)
+    gang_size: Optional[np.ndarray] = None  # [NG] int32
+    gang_min_ranks: Optional[np.ndarray] = None  # [NG] int32
+    gang_ids: Optional[List[str]] = None  # NG axis values, lex order
+
     # revision stamp of the encode core this input was assembled around
     # (_EncodeCore.core_rev): same stamp ⇒ byte-identical core tables.
     # backend.host_kernel_args derives per-entry provenance tokens from it
@@ -585,6 +599,16 @@ class _EncodeCore:
     # donor's). (core_rev, table name) is the provenance token the argument
     # arena / device-conversion caches key on. -1 = no provenance.
     core_rev: int = -1
+    # scheduling-class tables: priority and gang labels are INSIDE the pod
+    # signature, so these are pure functions of the distinct-signature
+    # sequence like every other [G] table — try_patch shares them verbatim,
+    # and a priority/gang edit changes the affected snums, invalidating
+    # exactly the runs it touches (encode_cache.run_identity).
+    group_prio16: Optional[np.ndarray] = None  # [G] uint16 dense rank
+    group_gang: Optional[np.ndarray] = None  # [G] int32 (-1 = none)
+    gang_size: Optional[np.ndarray] = None  # [NG] int32
+    gang_min_ranks: Optional[np.ndarray] = None  # [NG] int32
+    gang_ids: Optional[List[str]] = None  # NG axis, lex order
 
 
 _CORE_CACHE: Dict[tuple, tuple] = {}
@@ -1171,6 +1195,32 @@ def _build_core(
                 if k in reqs:
                     fallback[g] = True
 
+    # ---- scheduling-class tables (priority ranks + gang membership) --------
+    # Group representatives are exact: priority and the gang labels ride the
+    # pod signature, so every pod in a group agrees on them.
+    n_groups = len(group_pods)
+    g_prios = np.fromiter((gp[0].priority for gp in group_pods), np.int64,
+                          n_groups)
+    group_prio16 = np.searchsorted(np.unique(g_prios), g_prios).astype(np.uint16)
+    g_gangs = [gp[0].gang() for gp in group_pods]
+    gang_ids = sorted({g[0] for g in g_gangs if g is not None})
+    gang_rank = {gid: i for i, gid in enumerate(gang_ids)}
+    group_gang = np.fromiter(
+        (gang_rank[g[0]] if g is not None else -1 for g in g_gangs),
+        np.int32, n_groups,
+    )
+    # a gang id declared with conflicting size/min-ranks across groups takes
+    # the MAX of each (conservative: harder to commit, never a partial gang)
+    gang_size = np.zeros(len(gang_ids), np.int32)
+    gang_min_ranks = np.zeros(len(gang_ids), np.int32)
+    for g in g_gangs:
+        if g is None:
+            continue
+        i = gang_rank[g[0]]
+        gang_size[i] = max(gang_size[i], g[1])
+        gang_min_ranks[i] = max(gang_min_ranks[i], g[2])
+    gang_min_ranks = np.minimum(gang_min_ranks, gang_size)
+
     return _EncodeCore(
         zones=zones,
         cts=cts,
@@ -1221,6 +1271,11 @@ def _build_core(
         group_snums=group_snums if sigs_interned else (),
         sig_epoch=_SIG_EPOCH if sigs_interned else -1,
         core_rev=_fresh_core_rev(),
+        group_prio16=group_prio16,
+        group_gang=group_gang,
+        gang_size=gang_size,
+        gang_min_ranks=gang_min_ranks,
+        gang_ids=gang_ids,
     )
 
 
@@ -1442,4 +1497,15 @@ def _encode_with_nodes(core: _EncodeCore, inp: SolverInput) -> EncodedInput:
         node_dom2=node_dom2,
         core_rev=core.core_rev,
         group_snums=core.group_snums,
+        run_prio16=(
+            core.group_prio16[core.run_group]
+            if core.group_prio16 is not None else None
+        ),
+        run_gang=(
+            core.group_gang[core.run_group]
+            if core.group_gang is not None else None
+        ),
+        gang_size=core.gang_size,
+        gang_min_ranks=core.gang_min_ranks,
+        gang_ids=core.gang_ids,
     )

@@ -215,3 +215,62 @@ class NativeSolver(Solver):
         self.stats["native_solves"] += 1
         SOLVER_SOLVES.inc(backend="native")
         return result
+
+
+# ---------------------------------------------------------------------------
+# Scheduling classes: host reference planners (ISSUE 9)
+# ---------------------------------------------------------------------------
+#
+# Bit-identical numpy mirrors of the device side kernels in tpu/ffd.py
+# (gang_commit / preemption_plan) — the "native host" leg of the 3-way
+# parity surface. solver/scheduling_class.py selects these when the inner
+# backend is the native core (or as the fallback planner when jax is
+# unavailable); tests/test_scheduling_class.py asserts exact equality of
+# every output against both the device kernels and the python oracle.
+
+
+def gang_commit_host(run_placed, run_gang, gang_size, gang_min_ranks):
+    """numpy mirror of ffd.gang_commit: per-gang placed counts by segment
+    sum over runs, committed iff placed >= min_ranks (> 0)."""
+    import numpy as np
+
+    ng = int(np.asarray(gang_size).shape[0])
+    run_gang = np.asarray(run_gang, dtype=np.int64)
+    placed = np.zeros(ng, np.int32)
+    hot = run_gang >= 0
+    np.add.at(placed, run_gang[hot],
+              np.asarray(run_placed, dtype=np.int32)[hot])
+    min_ranks = np.asarray(gang_min_ranks, dtype=np.int32)
+    commit = (placed >= min_ranks) & (min_ranks > 0)
+    return commit, placed
+
+
+def preemption_plan_host(node_free, victim_prio, victim_req, victim_ok,
+                         node_ok, need, pod_prio):
+    """numpy mirror of ffd.preemption_plan: first node (ascending) whose
+    free capacity plus the minimal eligible-victim prefix (victims arrive
+    pre-sorted by ascending (priority, uid)) covers `need`. Returns
+    (node_idx, victim_mask [E, Vm] bool)."""
+    import numpy as np
+
+    node_free = np.asarray(node_free, dtype=np.int64)
+    victim_prio = np.asarray(victim_prio, dtype=np.int64)
+    victim_req = np.asarray(victim_req, dtype=np.int64)
+    victim_ok = np.asarray(victim_ok, dtype=bool)
+    node_ok = np.asarray(node_ok, dtype=bool)
+    need = np.asarray(need, dtype=np.int64)
+    E, Vm = victim_prio.shape
+    eligible = victim_ok & (victim_prio < int(pod_prio))
+    reclaim = np.where(eligible[:, :, None], victim_req, 0)
+    cum = node_free[:, None, :] + np.cumsum(reclaim, axis=1)
+    fit0 = np.all(node_free >= need[None, :], axis=1)
+    fit_at = np.all(cum >= need[None, None, :], axis=2)
+    any_fit = node_ok & (fit0 | fit_at.any(axis=1))
+    if not any_fit.any():
+        return -1, np.zeros((E, Vm), dtype=bool)
+    node_idx = int(np.argmax(any_fit))
+    take = np.zeros((E, Vm), dtype=bool)
+    if not fit0[node_idx]:
+        kmin = int(np.argmax(fit_at[node_idx]))
+        take[node_idx] = eligible[node_idx] & (np.arange(Vm) <= kmin)
+    return node_idx, take

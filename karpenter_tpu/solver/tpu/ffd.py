@@ -2223,3 +2223,144 @@ def ffd_solve_sharded(
         return out
 
     return jax.vmap(lane)(run_group, run_count)
+
+
+# ---------------------------------------------------------------------------
+# Scheduling classes: priority preemption + atomic gangs (ISSUE 9)
+# ---------------------------------------------------------------------------
+#
+# The base scan stays CLASS-BLIND on purpose: priority-major, gang-contiguous
+# run ordering is applied by the host sort (provisioning/scheduler.py
+# ffd_sort_with_sigs), so ffd_solve's frozen ARG_SPEC — and with it the
+# arena's residency partition, the AOT shape table, and the resume / ladder /
+# sharded splices — is untouched. The class semantics that cannot be
+# expressed as ordering (reclaiming capacity from lower-priority placements,
+# all-or-nothing gang verdicts) run as SIDE KERNELS over the CLASS_ARG_SPEC
+# tensors below, orchestrated per solve by solver/scheduling_class.py with
+# bit-identical host references in solver/native.py.
+
+# Side-table tensor names (encode.EncodedInput carries them; the drift test
+# pins the kernel signatures against this table the same way ARG_SPEC pins
+# ffd_solve's).
+CLASS_ARG_SPEC = (
+    "run_prio16",  # [S] uint16 — dense priority rank per run (higher = more important)
+    "run_gang",  # [S] int32 — gang index per run, -1 = no gang
+    "gang_size",  # [NG] int32 — declared member count per gang
+    "gang_min_ranks",  # [NG] int32 — members that must place for commit
+)
+
+# Eviction-table wire format: the preemption planner's output rides the same
+# packed-uint16 discipline as the claim delta (DELTA_* above) — a small
+# header then fixed-width u16 rows — so the decode path's transfer ledger
+# and overflow carve-out apply unchanged. Header: [overflow, entry_count].
+# Each entry is (node_idx, victim_idx) as two uint16 words; indices that do
+# not fit uint16 set the overflow flag and the solve declines to the host
+# fallback (counted), exactly like the claim delta's wide re-fetch.
+EVICT_HEADER_WORDS = 2
+EVICT_ENTRY_U16 = 2
+
+
+class GangStage(NamedTuple):
+    """Gang staging carry: the FFDState snapshot taken BEFORE a gang's runs
+    enter the scan (`base`), the gang index being staged, and the member
+    placements accumulated so far. Atomic commit = keep scanning past the
+    gang; rollback = resume the scan from `base` with the gang's runs
+    stripped (the checkpoint-ring resume machinery replays exactly this
+    suffix). Host-orchestrated: solver/scheduling_class.py carries one of
+    these per open gang; the drift test pins the layout."""
+
+    base: FFDState  # pre-gang scan carry (or the ring snapshot nearest it)
+    gang: jax.Array  # int32 scalar — gang index being staged
+    members_placed: jax.Array  # int32 scalar — members placed so far
+
+
+@functools.partial(jax.jit)
+def gang_commit(run_placed, run_gang, gang_size, gang_min_ranks):
+    """Atomic gang verdict over a finished scan: per-gang placed counts via
+    segment-sum of the per-run placed counts, committed iff at least
+    min_ranks members placed. Returns (commit [NG] bool, placed [NG] i32).
+    Bit-identical host references: native.gang_commit_host (numpy) and
+    scheduling_class._gang_commit_py (oracle loop)."""
+    ng = gang_size.shape[0]
+    seg = jnp.where(run_gang >= 0, run_gang, ng)  # park non-gang runs
+    placed = jnp.zeros(ng + 1, jnp.int32).at[seg].add(
+        run_placed.astype(jnp.int32)
+    )[:ng]
+    commit = (placed >= gang_min_ranks) & (gang_min_ranks > 0)
+    return commit, placed
+
+
+@functools.partial(jax.jit)
+def preemption_plan(node_free, victim_prio, victim_req, victim_ok, node_ok,
+                    need, pod_prio):
+    """Plan one preemption: find the first node (ascending index) where the
+    free capacity plus the capacity reclaimed from a minimal prefix of its
+    eligible victims covers `need`, and the victim mask realizing it.
+
+    Victims arrive PRE-SORTED per node by ascending (priority rank, uid) —
+    the host builds the tensors (scheduling_class.build_victim_tensors), so
+    all three implementations walk the identical order. Eligibility is
+    strict: victim_ok AND victim_prio < pod_prio. Ineligible victims
+    contribute zero, so the running cumulative at position v is exactly the
+    reclaim of the eligible prefix through v; the chosen prefix is the
+    shortest one that fits (fit at k stays fit at k+1 — reclaim only grows).
+
+    Shapes: node_free [E,R] i32, victim_prio [E,Vm] i32, victim_req
+    [E,Vm,R] i32, victim_ok [E,Vm] bool, node_ok [E] bool, need [R] i32,
+    pod_prio i32 scalar. Returns (node_idx i32, -1 = no plan; victim_mask
+    [E,Vm] bool, hot only on the chosen node's row)."""
+    E, Vm = victim_prio.shape
+    eligible = victim_ok & (victim_prio < pod_prio)
+    reclaim = jnp.where(eligible[:, :, None], victim_req, 0)
+    cum = node_free[:, None, :] + jnp.cumsum(reclaim, axis=1)  # [E,Vm,R]
+    fit0 = jnp.all(node_free >= need[None, :], axis=1)  # [E] free alone fits
+    fit_at = jnp.all(cum >= need[None, None, :], axis=2)  # [E,Vm]
+    any_fit = node_ok & (fit0 | jnp.any(fit_at, axis=1))
+    node_idx = jnp.where(
+        jnp.any(any_fit), jnp.argmax(any_fit).astype(jnp.int32), jnp.int32(-1)
+    )
+    # minimal prefix end per node: first position where the cumulative fits
+    # (argmax of the monotone fit row); masked to the chosen node, and empty
+    # when its free capacity alone fits
+    kmin = jnp.argmax(fit_at, axis=1)  # [E]
+    take = (
+        eligible
+        & (jnp.arange(Vm)[None, :] <= kmin[:, None])
+        & ~fit0[:, None]
+        & (jnp.arange(E)[:, None] == node_idx)
+        & (node_idx >= 0)
+    )
+    return node_idx, take
+
+
+def pack_evictions(entries):
+    """Pack (node_idx, victim_idx) rows into the uint16 eviction table
+    (EVICT_HEADER_WORDS then EVICT_ENTRY_U16 words per row). Overflow —
+    any index above uint16 — sets header[0] and packs no rows: the caller
+    must decline to the host fallback, mirroring the claim delta's wide
+    re-fetch carve-out. Host-side helper (numpy), shared by every backend
+    so the wire bytes are identical regardless of which planner ran."""
+    n = len(entries)
+    overflow = any(e >= 2**16 or v >= 2**16 for e, v in entries)
+    if overflow:
+        return np.asarray([1, 0], dtype=np.uint16)
+    buf = np.zeros(EVICT_HEADER_WORDS + EVICT_ENTRY_U16 * n, dtype=np.uint16)
+    buf[0] = 0
+    buf[1] = n
+    for i, (e, v) in enumerate(entries):
+        buf[EVICT_HEADER_WORDS + 2 * i] = e
+        buf[EVICT_HEADER_WORDS + 2 * i + 1] = v
+    return buf
+
+
+def unpack_evictions(buf):
+    """Inverse of pack_evictions: (overflow, [(node_idx, victim_idx), ...])."""
+    buf = np.asarray(buf, dtype=np.uint16)
+    overflow = bool(buf[0])
+    n = int(buf[1])
+    rows = [
+        (int(buf[EVICT_HEADER_WORDS + 2 * i]),
+         int(buf[EVICT_HEADER_WORDS + 2 * i + 1]))
+        for i in range(n)
+    ]
+    return overflow, rows

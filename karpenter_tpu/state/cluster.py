@@ -23,7 +23,7 @@ from typing import Dict, List, Optional, Tuple
 from ..api import wellknown as wk
 from ..api.objects import Node, NodeClaim, Pod
 from ..controllers import store as st
-from ..provisioning.scheduler import ExistingNode
+from ..provisioning.scheduler import BoundPodRef, ExistingNode
 from ..utils.resources import PODS, Resources
 
 
@@ -214,6 +214,24 @@ class Cluster:
                     taints=taints,
                     free=free,
                     pod_labels=[dict(p.meta.labels) for p in pods],
+                    bound_pods=[
+                        BoundPodRef(
+                            uid=p.meta.uid,
+                            priority=p.priority,
+                            requests=p.requests,
+                            # never evict: do-not-disrupt, DaemonSets (their
+                            # capacity doesn't free — they reschedule right
+                            # back), or pods already on the way out
+                            evictable=(
+                                p.meta.annotations.get(
+                                    wk.DO_NOT_DISRUPT_ANNOTATION
+                                ) != "true"
+                                and p.owner_kind != "DaemonSet"
+                                and not p.meta.deleting
+                            ),
+                        )
+                        for p in pods
+                    ],
                 )
             )
         out.sort(key=lambda n: n.id)

@@ -233,3 +233,71 @@ def test_delta_capacity_properties():
         assert u % backend.DELTA_UNIQ_QUANTUM == 0 and u >= backend.DELTA_UNIQ_QUANTUM
     # Mb is a hard ceiling: can't have more distinct meta rows than claims
     assert backend.delta_uniq_capacity(10_000, 8) == backend.DELTA_UNIQ_QUANTUM
+
+
+# -- scheduling classes (ISSUE 9) ---------------------------------------------
+
+
+def test_arg_spec_stays_frozen_at_36():
+    """The class tensors ride the CLASS_ARG_SPEC side table, NOT ffd.ARG_SPEC
+    — the 36-tensor contract (arena residency, AOT shapes, resume/ladder/
+    sharded splices) must not widen for priority/gang support."""
+    assert len(ffd.ARG_SPEC) == 36
+    assert not set(ffd.CLASS_ARG_SPEC) & set(ffd.ARG_SPEC)
+
+
+def test_class_side_table_matches_encode_fields():
+    """CLASS_ARG_SPEC names are 1:1 with EncodedInput's class fields, and the
+    gang tables pair off [NG]-shaped: run_prio16/run_gang are per-run [S],
+    gang_size/gang_min_ranks per-gang."""
+    import dataclasses
+
+    from karpenter_tpu.solver.encode import EncodedInput
+
+    assert ffd.CLASS_ARG_SPEC == (
+        "run_prio16", "run_gang", "gang_size", "gang_min_ranks"
+    )
+    enc_fields = {f.name for f in dataclasses.fields(EncodedInput)}
+    assert set(ffd.CLASS_ARG_SPEC) <= enc_fields
+
+
+def test_gang_kernel_signatures():
+    """The planner kernels take the class tensors in CLASS_ARG_SPEC order —
+    run-level tensors first, gang tables trailing — so every caller
+    (scheduling_class planner legs, native host mirror) can splice the
+    encode side table positionally."""
+    params = list(inspect.signature(ffd.gang_commit.__wrapped__).parameters)
+    assert params == ["run_placed", "run_gang", "gang_size", "gang_min_ranks"]
+    params = list(
+        inspect.signature(ffd.preemption_plan.__wrapped__).parameters
+    )
+    assert params == [
+        "node_free", "victim_prio", "victim_req", "victim_ok",
+        "node_ok", "need", "pod_prio",
+    ]
+
+
+def test_eviction_table_wire_layout_is_pinned():
+    """pack_evictions/unpack_evictions share this layout with the claim-delta
+    discipline: uint16 words, header [overflow, entry_count], 2 words per
+    entry (node_idx, victim_idx); overflow = counted decline."""
+    assert ffd.EVICT_HEADER_WORDS == 2, (
+        "eviction header is [overflow, entry_count]"
+    )
+    assert ffd.EVICT_ENTRY_U16 == 2, (
+        "each eviction entry is (node_idx, victim_idx) as two uint16 words"
+    )
+    buf = ffd.pack_evictions([(3, 1), (0, 7)])
+    assert buf.dtype.name == "uint16"
+    overflow, rows = ffd.unpack_evictions(buf)
+    assert not overflow and rows == [(3, 1), (0, 7)]
+    overflow, rows = ffd.unpack_evictions(ffd.pack_evictions([(2**16, 0)]))
+    assert overflow and rows == []
+
+
+def test_gang_stage_carry_layout():
+    """GangStage is the staged-commit carry: the base FFDState plus the gang
+    id being staged and its running member count. A field added to FFDState
+    flows through `base` automatically; adding one HERE without updating the
+    merge/rollback seam would silently truncate the rollback."""
+    assert ffd.GangStage._fields == ("base", "gang", "members_placed")

@@ -1,0 +1,813 @@
+"""Scheduling classes: priority, preemption, and gang scheduling (ISSUE 9).
+
+Covers the solver/scheduling_class.py subsystem end to end:
+
+- canonical ordering (priority-major, gang-contiguous) and its exact
+  off-path inertness (flat batches / knobs off delegate verbatim),
+- bit-identical three-legged planner parity (python oracle vs numpy host
+  mirror vs jitted device kernels) on randomized tensors,
+- atomic gang semantics (all-or-nothing rollback, min-ranks partial
+  commit, claim-budget decline, malformed labels degrade to singletons),
+- preemption semantics (strictly-lower-priority victims, minimal prefix
+  ascending (priority, uid), evictable gating, counted declines),
+- full-stack 3-way decision parity on randomized mixed-priority + gang
+  fleets with preemption contention, including TPU path variants
+  (relax ladder / suffix resume / mesh sharding on|off),
+- operator knobs and startup validation,
+- kwok e2e: gang surge converges with no gang partially placed, and a
+  planned preemption executes through the controller into pod evictions,
+- fleet failover soak: a gang trace through SolverFleet with a mid-trace
+  wedge drops no solves and never lands a partial gang.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import wellknown as wk
+from karpenter_tpu.api.objects import (
+    NodeClaimTemplate,
+    NodePool,
+    ObjectMeta,
+    Pod,
+)
+from karpenter_tpu.catalog.catalog import CatalogSpec, generate
+from karpenter_tpu.controllers import store as st
+from karpenter_tpu.metrics.registry import SOLVER_PRIORITY_INVERSIONS
+from karpenter_tpu.operator import options as opts
+from karpenter_tpu.operator.operator import new_kwok_operator
+from karpenter_tpu.provisioning.scheduler import (
+    BoundPodRef,
+    Eviction,
+    ExistingNode,
+    NodePoolSpec,
+    SolverInput,
+    ffd_key,
+    ffd_sort,
+)
+from karpenter_tpu.scheduling.requirements import IN, Requirement, Requirements
+from karpenter_tpu.solver import scheduling_class as sc
+from karpenter_tpu.solver.backend import ReferenceSolver, TPUSolver, concrete_backend
+from karpenter_tpu.solver.encode import quantize_input
+from karpenter_tpu.solver.native import NativeSolver
+from karpenter_tpu.utils.resources import PODS, Resources
+
+CATALOG = generate(CatalogSpec())
+ZONES = ("zone-1a", "zone-1b", "zone-1c")
+
+
+@pytest.fixture(autouse=True)
+def _class_knobs():
+    """Every test starts and ends with the default-on knobs."""
+    sc.configure(preemption=True, gang=True)
+    yield
+    sc.configure(preemption=True, gang=True)
+
+
+def pool(name="default", weight=0, types=None, limits=None):
+    return NodePoolSpec(
+        name=name, weight=weight,
+        requirements=Requirements.of(Requirement.create(wk.NODEPOOL_LABEL, IN, [name])),
+        taints=[], instance_types=types if types is not None else CATALOG,
+        limits=limits or Resources(),
+    )
+
+
+def mkpod(name, cpu="1", mem="1Gi", labels=None, priority=0, **kw):
+    return Pod(
+        meta=ObjectMeta(name=name, uid=name, labels=labels or {}),
+        requests=Resources.parse({"cpu": cpu, "memory": mem}),
+        priority=priority,
+        **kw,
+    )
+
+
+def gang_labels(gid, size, min_ranks=None, topology=None):
+    labels = {wk.GANG_LABEL: gid, wk.GANG_SIZE_LABEL: str(size)}
+    if min_ranks is not None:
+        labels[wk.GANG_MIN_RANKS_LABEL] = str(min_ranks)
+    if topology is not None:
+        labels[wk.GANG_TOPOLOGY_LABEL] = topology
+    return labels
+
+
+def victim(uid, priority=0, cpu="1", mem="1Gi", evictable=True):
+    return BoundPodRef(
+        uid=uid, priority=priority,
+        requests=Resources.parse({"cpu": cpu, "memory": mem}),
+        evictable=evictable,
+    )
+
+
+def mknode(name, cpu="2", mem="4Gi", victims=(), zone="zone-1a", schedulable=True):
+    free = Resources.parse({"cpu": cpu, "memory": mem})
+    free[PODS] = 100
+    return ExistingNode(
+        id=name,
+        labels={wk.ZONE_LABEL: zone, wk.HOSTNAME_LABEL: name},
+        taints=[], free=free, schedulable=schedulable,
+        bound_pods=list(victims),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Ordering: priority-major, gang-contiguous, flat == pre-class
+# ---------------------------------------------------------------------------
+
+
+class TestOrdering:
+    def test_priority_major(self):
+        lo = [mkpod(f"lo{i}", cpu="4", priority=0) for i in range(3)]
+        hi = [mkpod(f"hi{i}", cpu="1", priority=100) for i in range(3)]
+        out = ffd_sort(lo + hi)
+        # every high-priority pod precedes every low one, despite smaller size
+        assert [p.meta.uid for p in out[:3]] == ["hi0", "hi1", "hi2"]
+        assert all(p.priority == 0 for p in out[3:])
+
+    def test_gang_contiguous_after_singletons(self):
+        g = [mkpod(f"g{i}", cpu="1", labels=gang_labels("job-a", 3)) for i in range(3)]
+        s = [mkpod(f"s{i}", cpu="2") for i in range(2)]
+        out = [p.meta.uid for p in ffd_sort(g + s)]
+        # same priority level: non-gang pods rank first (gang rank 0 = ""),
+        # then the gang runs contiguously
+        assert out == ["s0", "s1", "g0", "g1", "g2"]
+
+    def test_flat_batch_is_pre_class_order(self):
+        random.seed(3)
+        pods = [
+            mkpod(f"p{i:02d}", cpu=f"{random.choice([100, 500, 1000, 2000])}m",
+                  mem=f"{random.choice([128, 512, 1024])}Mi")
+            for i in range(25)
+        ]
+        out = ffd_sort(pods)
+        assert [p.meta.uid for p in out] == [
+            p.meta.uid for p in sorted(pods, key=ffd_key)
+        ]
+
+    def test_knobs_off_restore_flat_order(self):
+        pods = [mkpod("a", cpu="1", priority=0), mkpod("b", cpu="4", priority=100),
+                mkpod("c", cpu="2", labels=gang_labels("g", 1), priority=0)]
+        sc.configure(preemption=False, gang=False)
+        out = [p.meta.uid for p in ffd_sort(pods)]
+        assert out == [p.meta.uid for p in sorted(pods, key=ffd_key)]
+
+
+# ---------------------------------------------------------------------------
+# Off-path inertness
+# ---------------------------------------------------------------------------
+
+
+class TestInertness:
+    def _flat_input(self):
+        pods = [mkpod(f"p{i}", cpu="500m") for i in range(8)]
+        return SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+
+    def test_flat_fleet_delegates_verbatim(self):
+        inp = quantize_input(self._flat_input())
+        caw = sc.ClassAwareSolver(ReferenceSolver())
+        got = caw.solve(inp)
+        want = ReferenceSolver().solve(inp)
+        assert caw.class_stats["class_solves"] == 0
+        assert got.placements == want.placements
+        assert got.errors == want.errors
+        assert got.evictions == [] and got.gangs_unschedulable == []
+
+    def test_priorities_without_victims_stay_inert(self):
+        # priority-diverse pending pods but no evictable bound pod below the
+        # top priority: ordering engages, the passes do not
+        pods = [mkpod("hi", priority=100), mkpod("lo", priority=0)]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        caw = sc.ClassAwareSolver(ReferenceSolver())
+        caw.solve(quantize_input(inp))
+        assert caw.class_stats["class_solves"] == 0
+
+    def test_knobs_off_inert_with_classes_present(self):
+        sc.configure(preemption=False, gang=False)
+        pods = [mkpod("hi", priority=100),
+                mkpod("g0", labels=gang_labels("job", 2)),
+                mkpod("g1", labels=gang_labels("job", 2))]
+        nodes = [mknode("n0", cpu="0", mem="0Mi", victims=[victim("v0", 0)])]
+        inp = quantize_input(
+            SolverInput(pods=pods, nodes=nodes, nodepools=[pool()], zones=ZONES)
+        )
+        caw = sc.ClassAwareSolver(ReferenceSolver())
+        got = caw.solve(inp)
+        want = ReferenceSolver().solve(inp)
+        assert caw.class_stats["class_solves"] == 0
+        assert got.placements == want.placements
+        assert got.errors == want.errors
+        assert got.evictions == []
+
+    def test_tpu_flat_delegation_bit_identical(self):
+        inp = self._flat_input()
+        caw = sc.ClassAwareSolver(TPUSolver())
+        got = caw.solve(inp)
+        want = TPUSolver().solve(inp)
+        assert got.placements == want.placements
+        assert set(got.errors) == set(want.errors)
+        # wrapper attribute discipline: the concrete backend's stats dict is
+        # still readable through the chain (tests/bench depend on it)
+        assert caw.stats is caw.inner.stats
+        assert caw.stats["device_solves"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Planner parity: oracle vs host vs device, bit-identical
+# ---------------------------------------------------------------------------
+
+
+class TestPlannerParity:
+    def test_select_planner(self):
+        assert sc.select_planner(ReferenceSolver()) == "oracle"
+        assert sc.select_planner(NativeSolver()) == "host"
+        assert sc.select_planner(TPUSolver()) == "device"
+        # through a wrapper chain, the concrete backend decides
+        assert sc.select_planner(sc.ClassAwareSolver(TPUSolver())) == "device"
+        assert type(concrete_backend(sc.ClassAwareSolver(NativeSolver()))).__name__ == "NativeSolver"
+
+    def test_gang_commit_three_legs_randomized(self):
+        rng = random.Random(90)
+        for trial in range(25):
+            ng = rng.randint(1, 5)
+            s = rng.randint(1, 30)
+            run_placed = [rng.randint(0, 1) for _ in range(s)]
+            run_gang = [rng.randint(-1, ng - 1) for _ in range(s)]
+            gang_size = [rng.randint(1, 6) for _ in range(ng)]
+            gang_min_ranks = [rng.randint(0, gang_size[i]) for i in range(ng)]
+            legs = {
+                name: fns[0](run_placed, run_gang, gang_size, gang_min_ranks)
+                for name, fns in sc.PLANNERS.items()
+            }
+            ref_commit, ref_placed = legs["oracle"]
+            for name, (commit, placed) in legs.items():
+                assert np.array_equal(np.asarray(commit), np.asarray(ref_commit)), (
+                    f"trial {trial}: {name} commit diverges"
+                )
+                assert np.array_equal(np.asarray(placed), np.asarray(ref_placed)), (
+                    f"trial {trial}: {name} placed diverges"
+                )
+
+    def test_preemption_plan_three_legs_randomized(self):
+        rng = random.Random(91)
+        for trial in range(40):
+            E = rng.randint(1, 6)
+            Vm = rng.randint(1, 5)
+            R = rng.randint(1, 3)
+            node_free = [[rng.randint(0, 5) for _ in range(R)] for _ in range(E)]
+            victim_prio = [[rng.randint(0, 5) for _ in range(Vm)] for _ in range(E)]
+            victim_req = [[[rng.randint(0, 3) for _ in range(R)] for _ in range(Vm)]
+                          for _ in range(E)]
+            victim_ok = [[rng.random() < 0.7 for _ in range(Vm)] for _ in range(E)]
+            node_ok = [rng.random() < 0.8 for _ in range(E)]
+            need = [rng.randint(1, 6) for _ in range(R)]
+            pod_prio = rng.randint(0, 6)
+            legs = {
+                name: fns[1](node_free, victim_prio, victim_req, victim_ok,
+                             node_ok, need, pod_prio)
+                for name, fns in sc.PLANNERS.items()
+            }
+            ref_e, ref_mask = legs["oracle"]
+            for name, (e, mask) in legs.items():
+                assert int(e) == int(ref_e), (
+                    f"trial {trial}: {name} node {e} != oracle {ref_e}"
+                )
+                assert np.array_equal(np.asarray(mask), np.asarray(ref_mask)), (
+                    f"trial {trial}: {name} mask diverges"
+                )
+
+    def test_preemption_plan_free_fit_needs_no_eviction(self):
+        for name, (_gc, plan) in sc.PLANNERS.items():
+            e, mask = plan([[5, 5]], [[0]], [[[1, 1]]], [[True]], [True], [2, 2], 9)
+            assert int(e) == 0 and not np.asarray(mask).any(), name
+
+    def test_preemption_plan_no_eligible_node(self):
+        for name, (_gc, plan) in sc.PLANNERS.items():
+            e, mask = plan([[0, 0]], [[0]], [[[1, 1]]], [[True]], [False], [2, 2], 9)
+            assert int(e) == -1 and not np.asarray(mask).any(), name
+
+
+# ---------------------------------------------------------------------------
+# Gang atomicity (orchestrator over the python oracle)
+# ---------------------------------------------------------------------------
+
+
+class TestGangAtomicity:
+    def test_gang_fits_all_members_placed(self):
+        pods = [mkpod(f"g{i}", cpu="500m", labels=gang_labels("job", 4)) for i in range(4)]
+        caw = sc.ClassAwareSolver(ReferenceSolver())
+        res = caw.solve(quantize_input(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        ))
+        assert all(f"g{i}" in res.placements for i in range(4))
+        assert res.gangs_unschedulable == []
+        assert caw.class_stats["gangs_placed"] == 1
+
+    def test_gang_rollback_strips_every_member(self):
+        # node fits 2 of 3 members; min_ranks defaults to size -> rollback,
+        # and the freed slots go to the lower-priority singleton
+        node = mknode("n0", cpu="2", mem="4Gi")
+        pods = [mkpod(f"g{i}", cpu="1", labels=gang_labels("job", 3), priority=50)
+                for i in range(3)]
+        pods.append(mkpod("single", cpu="1", priority=0))
+        caw = sc.ClassAwareSolver(ReferenceSolver())
+        res = caw.solve(quantize_input(
+            SolverInput(pods=pods, nodes=[node], nodepools=[], zones=ZONES)
+        ))
+        assert res.gangs_unschedulable == ["job"]
+        assert not any(f"g{i}" in res.placements for i in range(3))
+        for i in range(3):
+            assert "unschedulable" in res.errors[f"g{i}"]
+        assert res.placements["single"] == ("node", "n0")
+        assert caw.class_stats["gangs_unschedulable"] == 1
+        assert caw.class_stats["gang_rounds"] == 1
+
+    def test_min_ranks_partial_commit(self):
+        node = mknode("n0", cpu="2", mem="4Gi")
+        pods = [mkpod(f"g{i}", cpu="1", labels=gang_labels("job", 3, min_ranks=2))
+                for i in range(3)]
+        caw = sc.ClassAwareSolver(ReferenceSolver())
+        res = caw.solve(quantize_input(
+            SolverInput(pods=pods, nodes=[node], nodepools=[], zones=ZONES)
+        ))
+        # two members reach min_ranks: the gang commits, the third pod keeps
+        # its ordinary capacity error
+        assert res.gangs_unschedulable == []
+        placed = [i for i in range(3) if f"g{i}" in res.placements]
+        assert len(placed) == 2
+        assert caw.class_stats["gangs_placed"] == 1
+
+    def test_oversized_gang_declines_and_strips(self, monkeypatch):
+        monkeypatch.setattr(sc, "GANG_CLAIM_BUDGET", 2)
+        pods = [mkpod(f"g{i}", cpu="100m", labels=gang_labels("big", 3)) for i in range(3)]
+        pods.append(mkpod("single", cpu="100m"))
+        caw = sc.ClassAwareSolver(ReferenceSolver())
+        res = caw.solve(quantize_input(
+            SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        ))
+        assert res.gangs_unschedulable == ["big"]
+        # all-or-nothing holds even for the up-front decline: no member of
+        # the declined gang may keep a placement
+        assert not any(f"g{i}" in res.placements for i in range(3))
+        assert "single" in res.placements
+        assert caw.class_stats["declines"] == 1
+
+    def test_malformed_gang_labels_void_gang(self):
+        labels = {wk.GANG_LABEL: "job", wk.GANG_SIZE_LABEL: "banana"}
+        p = mkpod("p", labels=labels)
+        assert p.gang() is None
+        caw = sc.ClassAwareSolver(ReferenceSolver())
+        res = caw.solve(quantize_input(
+            SolverInput(pods=[p], nodes=[], nodepools=[pool()], zones=ZONES)
+        ))
+        # voided gang == flat batch: the wrapper never engages
+        assert caw.class_stats["class_solves"] == 0
+        assert "p" in res.placements
+
+
+# ---------------------------------------------------------------------------
+# Preemption semantics (orchestrator over the python oracle)
+# ---------------------------------------------------------------------------
+
+
+class TestPreemptionSemantics:
+    def test_minimal_prefix_lowest_priority_first(self):
+        node = mknode("n0", cpu="0", mem="0Mi", victims=[
+            victim("v-c", priority=3), victim("v-a", priority=1), victim("v-b", priority=2),
+        ])
+        p = mkpod("hi", cpu="2", mem="2Gi", priority=100)
+        caw = sc.ClassAwareSolver(ReferenceSolver())
+        res = caw.solve(quantize_input(
+            SolverInput(pods=[p], nodes=[node], nodepools=[], zones=ZONES)
+        ))
+        # two 1-cpu victims cover the 2-cpu need: the two LOWEST priorities
+        # evict, the third survives; the pending pod waits for the next
+        # reconcile (never placed in the same solve)
+        assert [(e.pod_uid, e.victim_priority) for e in res.evictions] == [
+            ("v-a", 1), ("v-b", 2),
+        ]
+        assert all(e.node_id == "n0" and e.for_pod == "hi" for e in res.evictions)
+        assert "hi" not in res.placements and "hi" in res.errors
+        assert caw.class_stats["preemptions"] == 2
+
+    def test_equal_priority_never_engages(self):
+        node = mknode("n0", cpu="0", mem="0Mi", victims=[victim("v", priority=100)])
+        p = mkpod("hi", cpu="1", priority=100)
+        caw = sc.ClassAwareSolver(ReferenceSolver())
+        res = caw.solve(quantize_input(
+            SolverInput(pods=[p], nodes=[node], nodepools=[], zones=ZONES)
+        ))
+        assert caw.class_stats["class_solves"] == 0
+        assert res.evictions == []
+
+    def test_insufficient_eligible_victims_plan_nothing(self):
+        # one strictly-lower victim is not enough for the 2-cpu need; the
+        # equal-priority one is ineligible -> no partial eviction plan
+        node = mknode("n0", cpu="0", mem="0Mi", victims=[
+            victim("v-lo", priority=1), victim("v-eq", priority=100),
+        ])
+        p = mkpod("hi", cpu="2", mem="2Gi", priority=100)
+        caw = sc.ClassAwareSolver(ReferenceSolver())
+        res = caw.solve(quantize_input(
+            SolverInput(pods=[p], nodes=[node], nodepools=[], zones=ZONES)
+        ))
+        assert caw.class_stats["class_solves"] == 1
+        assert res.evictions == []
+
+    def test_unevictable_victims_are_skipped(self):
+        n0 = mknode("n0", cpu="0", mem="0Mi",
+                    victims=[victim("v-pinned", priority=0, evictable=False)])
+        n1 = mknode("n1", cpu="0", mem="0Mi", victims=[victim("v-free", priority=0)])
+        p = mkpod("hi", cpu="1", priority=100)
+        caw = sc.ClassAwareSolver(ReferenceSolver())
+        res = caw.solve(quantize_input(
+            SolverInput(pods=[p], nodes=[n0, n1], nodepools=[], zones=ZONES)
+        ))
+        assert [e.pod_uid for e in res.evictions] == ["v-free"]
+        assert res.evictions[0].node_id == "n1"
+
+    def test_topology_interaction_declines_counted(self):
+        # a gang topology label injects a preferred affinity term, which the
+        # preemption pass treats as an active topology engine -> whole-pass
+        # decline (counted), zero evictions
+        node = mknode("n0", cpu="2", mem="4Gi", victims=[victim("v", priority=0)])
+        pods = [
+            mkpod(f"g{i}", cpu="1", priority=100,
+                  labels=gang_labels("job", 2, topology=wk.ZONE_LABEL))
+            for i in range(2)
+        ]
+        # the gang commits (fits in free); this lower-priority singleton is
+        # the preemption candidate, but the gang's injected affinity terms
+        # make the whole pass decline
+        pods.append(mkpod("hi", cpu="1", priority=50))
+        caw = sc.ClassAwareSolver(ReferenceSolver())
+        res = caw.solve(quantize_input(
+            SolverInput(pods=pods, nodes=[node], nodepools=[], zones=ZONES)
+        ))
+        assert res.evictions == []
+        assert caw.class_stats["declines"] >= 1
+
+    def test_eviction_budget_declines_counted(self, monkeypatch):
+        monkeypatch.setattr(sc, "MAX_EVICTIONS_PER_SOLVE", 0)
+        node = mknode("n0", cpu="0", mem="0Mi", victims=[victim("v", priority=0)])
+        p = mkpod("hi", cpu="1", priority=100)
+        caw = sc.ClassAwareSolver(ReferenceSolver())
+        res = caw.solve(quantize_input(
+            SolverInput(pods=[p], nodes=[node], nodepools=[], zones=ZONES)
+        ))
+        assert res.evictions == []
+        assert caw.class_stats["declines"] == 1
+
+    def test_free_tables_charged_with_own_placements(self):
+        # two 1-cpu high-priority pods, node with 1 cpu free and one victim:
+        # the first pod consumes the free cpu IN THIS SOLVE, so the second
+        # must plan an eviction — without post-solve charging both would see
+        # the same free capacity and nobody would preempt
+        node = mknode("n0", cpu="1", mem="2Gi", victims=[victim("v", priority=0)])
+        pods = [mkpod("hi-a", cpu="1", priority=100), mkpod("hi-b", cpu="1", priority=100)]
+        caw = sc.ClassAwareSolver(ReferenceSolver())
+        res = caw.solve(quantize_input(
+            SolverInput(pods=pods, nodes=[node], nodepools=[], zones=ZONES)
+        ))
+        assert len(res.evictions) == 1
+        assert res.evictions[0].pod_uid == "v"
+
+
+# ---------------------------------------------------------------------------
+# Full-stack 3-way parity: oracle / host / device, class passes engaged
+# ---------------------------------------------------------------------------
+
+
+def _claims_sig(res):
+    return [
+        (c.nodepool, sorted(c.instance_type_names), list(c.pod_uids))
+        for c in res.claims
+    ]
+
+
+def assert_class_parity(inp: SolverInput):
+    """Decision-identical results from the class wrapper over all three
+    backends, plus the zero-priority-inversions acceptance gate."""
+    inv0 = SOLVER_PRIORITY_INVERSIONS.value()
+    legs = {
+        "oracle": sc.ClassAwareSolver(ReferenceSolver()).solve(quantize_input(inp)),
+        "host": sc.ClassAwareSolver(NativeSolver()).solve(inp),
+        "device": sc.ClassAwareSolver(TPUSolver()).solve(inp),
+    }
+    ref = legs["oracle"]
+    for name, got in legs.items():
+        assert got.placements == ref.placements, f"{name}: placements diverge"
+        assert set(got.errors) == set(ref.errors), f"{name}: errors diverge"
+        assert _claims_sig(got) == _claims_sig(ref), f"{name}: claims diverge"
+        assert got.evictions == ref.evictions, f"{name}: evictions diverge"
+        assert got.gangs_unschedulable == ref.gangs_unschedulable, (
+            f"{name}: gang verdicts diverge"
+        )
+    assert SOLVER_PRIORITY_INVERSIONS.value() == inv0, "priority inversion detected"
+    return ref
+
+
+def _random_fleet(seed: int) -> SolverInput:
+    rng = random.Random(seed)
+    nodes = []
+    for e in range(rng.randint(2, 4)):
+        victims = [
+            victim(f"v-{e}-{v}", priority=rng.choice([0, 5]),
+                   cpu=rng.choice(["500m", "1"]), mem=rng.choice(["512Mi", "1Gi"]),
+                   evictable=rng.random() < 0.8)
+            for v in range(rng.randint(0, 4))
+        ]
+        nodes.append(mknode(
+            f"n{e}", cpu=str(rng.choice([0, 1, 2])), mem=rng.choice(["1Gi", "4Gi"]),
+            victims=victims, zone=rng.choice(ZONES),
+        ))
+    pods = []
+    for i in range(rng.randint(5, 12)):
+        pods.append(mkpod(
+            f"p{i:02d}", cpu=rng.choice(["250m", "500m", "1", "2"]),
+            mem=rng.choice(["256Mi", "512Mi", "1Gi"]),
+            priority=rng.choice([0, 10, 100]),
+        ))
+    for g in range(rng.randint(0, 3)):
+        size = rng.randint(2, 4)
+        min_ranks = size if rng.random() < 0.5 else rng.randint(1, size)
+        for r in range(size):
+            pods.append(mkpod(
+                f"gang{g}-{r}", cpu=rng.choice(["500m", "1"]), mem="512Mi",
+                labels=gang_labels(f"job-{g}", size, min_ranks=min_ranks),
+                priority=rng.choice([50, 100]),
+            ))
+    nodepools = [pool()] if rng.random() < 0.5 else []
+    return SolverInput(pods=pods, nodes=nodes, nodepools=nodepools, zones=ZONES)
+
+
+class TestFullStackParity:
+    def test_randomized_mixed_fleets(self):
+        for seed in range(8):
+            assert_class_parity(_random_fleet(seed))
+
+    def test_preemption_contention_parity(self):
+        nodes = [
+            mknode(f"n{e}", cpu="0", mem="0Mi", victims=[
+                victim(f"v-{e}-{v}", priority=v, cpu="1", mem="1Gi") for v in range(3)
+            ])
+            for e in range(3)
+        ]
+        pods = [mkpod(f"hi{i}", cpu="1", mem="1Gi", priority=100) for i in range(6)]
+        res = assert_class_parity(
+            SolverInput(pods=pods, nodes=nodes, nodepools=[], zones=ZONES)
+        )
+        assert res.evictions, "contention scenario must plan evictions"
+
+    def test_gang_and_preemption_together_parity(self):
+        nodes = [mknode(f"n{e}", cpu="2", mem="4Gi",
+                        victims=[victim(f"v-{e}", priority=0, cpu="1")])
+                 for e in range(2)]
+        pods = [mkpod(f"g{r}", cpu="1", labels=gang_labels("job", 3), priority=50)
+                for r in range(3)]
+        pods += [mkpod(f"hi{i}", cpu="2", mem="2Gi", priority=100) for i in range(3)]
+        assert_class_parity(
+            SolverInput(pods=pods, nodes=nodes, nodepools=[], zones=ZONES)
+        )
+
+    def test_tpu_variants_decision_identical(self):
+        inp = _random_fleet(42)
+        ref = sc.ClassAwareSolver(ReferenceSolver()).solve(quantize_input(inp))
+        variants = {
+            "resume+ladder": TPUSolver(resume=True, relax_ladder=True),
+            "no-resume,no-ladder": TPUSolver(resume=False, relax_ladder=False),
+            "host-decode": TPUSolver(device_decode=False),
+            "mesh-sharded": TPUSolver(shards=2),
+        }
+        for name, solver in variants.items():
+            got = sc.ClassAwareSolver(solver).solve(inp)
+            assert got.placements == ref.placements, name
+            assert set(got.errors) == set(ref.errors), name
+            assert got.evictions == ref.evictions, name
+            assert got.gangs_unschedulable == ref.gangs_unschedulable, name
+
+
+# ---------------------------------------------------------------------------
+# Operator knobs + events
+# ---------------------------------------------------------------------------
+
+
+class TestOperatorKnobs:
+    def test_defaults_on(self):
+        o = opts.parse([])
+        assert o.solver_preemption is True
+        assert o.solver_gang is True
+
+    def test_flags_off(self):
+        o = opts.parse(["--solver-preemption", "false", "--solver-gang", "no"])
+        assert o.solver_preemption is False
+        assert o.solver_gang is False
+
+    def test_env_typo_fails_closed(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SOLVER_PREEMPTION", "ture")
+        with pytest.raises(SystemExit):
+            opts.parse([])
+
+    @staticmethod
+    def _chain_types(solver):
+        out, seen = [], set()
+        while solver is not None and id(solver) not in seen:
+            seen.add(id(solver))
+            out.append(type(solver).__name__)
+            d = getattr(solver, "__dict__", {})
+            nxt = d.get("inner") or d.get("solver")
+            solver = nxt if not isinstance(nxt, (str, bytes)) else None
+        return out
+
+    def test_operator_wires_class_wrapper_default_on(self):
+        op = new_kwok_operator()
+        assert "ClassAwareSolver" in self._chain_types(op.solver)
+        assert op.preemption is not None and op.recorder is not None
+
+    def test_operator_knobs_off_no_wrapper(self):
+        op = new_kwok_operator(solver_preemption=False, solver_gang=False)
+        assert "ClassAwareSolver" not in self._chain_types(op.solver)
+        assert sc.PRIORITY_ENABLED is False and sc.GANG_ENABLED is False
+
+
+class TestEvents:
+    def test_preempted_event_shape(self):
+        from karpenter_tpu.events import recorder as ev
+
+        e = ev.preempted("victim", "node-1", "winner")
+        assert (e.kind, e.type, e.reason) == ("pods", "Normal", "Preempted")
+        assert "node-1" in e.message and "winner" in e.message
+
+    def test_gang_unschedulable_event_shape(self):
+        from karpenter_tpu.events import recorder as ev
+
+        e = ev.gang_unschedulable("g0", "job-a")
+        assert (e.kind, e.type, e.reason) == ("pods", "Warning", "GangUnschedulable")
+        assert "job-a" in e.message
+
+
+# ---------------------------------------------------------------------------
+# kwok e2e: gang surge + executed preemption
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def mkpool(name="default", limits=None):
+    from karpenter_tpu.api.objects import Disruption
+
+    return NodePool(
+        meta=ObjectMeta(name=name),
+        template=NodeClaimTemplate(),
+        disruption=Disruption(consolidation_policy="WhenEmptyOrUnderutilized",
+                              consolidate_after_s=0.0),
+        limits=limits or Resources(),
+    )
+
+
+@pytest.fixture
+def op():
+    clock = FakeClock()
+    o = new_kwok_operator(clock=clock)
+    o.clock = clock
+    return o
+
+
+class TestKwokE2E:
+    def test_gang_surge_converges_no_partial_gang(self, op):
+        op.store.create(st.NODEPOOLS, mkpool())
+        sizes = {}
+        for g in range(3):
+            gid = f"job-{g}"
+            sizes[gid] = 3
+            for r in range(3):
+                op.store.create(st.PODS, mkpod(
+                    f"{gid}-{r}", cpu="500m", mem="512Mi",
+                    labels=gang_labels(gid, 3), priority=100,
+                ))
+        # a gang no instance type can host: must stay entirely unbound
+        sizes["job-doomed"] = 2
+        for r in range(2):
+            op.store.create(st.PODS, mkpod(
+                f"job-doomed-{r}", cpu="999", labels=gang_labels("job-doomed", 2),
+                priority=100,
+            ))
+        op.manager.settle()
+        pods = op.store.list(st.PODS)
+        bound_by_gang = {}
+        for p in pods:
+            gid = p.meta.labels.get(wk.GANG_LABEL)
+            if gid:
+                bound_by_gang.setdefault(gid, []).append(p.node_name is not None)
+        for gid, flags in bound_by_gang.items():
+            n_bound = sum(flags)
+            assert n_bound in (0, sizes[gid]), f"gang {gid} partially placed: {n_bound}"
+        assert sum(bound_by_gang["job-doomed"]) == 0
+        assert all(sum(bound_by_gang[f"job-{g}"]) == 3 for g in range(3))
+        assert any(
+            e.reason == "GangUnschedulable" for e in op.recorder.events()
+        ), "doomed gang must surface an event"
+
+    def test_preemption_executes_and_high_priority_lands(self, op):
+        op.store.create(st.NODEPOOLS, mkpool())
+        for i in range(4):
+            op.store.create(st.PODS, mkpod(f"lo{i}", cpu="500m", mem="512Mi", priority=0))
+        op.manager.settle()
+        nodes = op.store.list(st.NODES)
+        assert len(nodes) >= 1
+        target = nodes[0].meta.name
+        # remove the nodepool: existing capacity is now the ONLY option, so
+        # the high-priority arrival must preempt to land
+        op.store.delete(st.NODEPOOLS, "default")
+        free = next(
+            n for n in op.cluster.existing_nodes_for_scheduler() if n.id == target
+        ).free
+        fill_m = int(free.get_("cpu"))
+        if fill_m > 0:
+            op.store.create(st.PODS, mkpod("filler", cpu=f"{fill_m}m", mem="1Mi", priority=0))
+            op.manager.settle()
+        hi = mkpod("hi", cpu="1", mem="512Mi", priority=1000)
+        op.store.create(st.PODS, hi)
+        op.manager.settle()
+        pods = {p.meta.uid: p for p in op.store.list(st.PODS)}
+        assert pods["hi"].node_name == target, "high-priority pod must land on the node"
+        assert op.preemption.executed >= 1
+        assert any(e.reason == "Preempted" for e in op.recorder.events())
+
+
+# ---------------------------------------------------------------------------
+# Fleet failover soak with gangs in flight (chaos acceptance)
+# ---------------------------------------------------------------------------
+
+
+class TestFleetGangSoak:
+    def test_gang_trace_survives_mid_trace_wedge(self):
+        import bench
+        from karpenter_tpu import faults
+        from karpenter_tpu.solver.fleet import SolverFleet
+        from karpenter_tpu.solver.pipeline import DISRUPTION
+
+        soak_cls = bench._soak_solver_cls()
+
+        def factory(i):
+            return sc.ClassAwareSolver(soak_cls())
+
+        inp = bench._gang_input(n_nodes=4, victims_per_node=2, n_high=6,
+                                n_gangs=3, gang_size=3)
+        gang_sizes = {"job-doomed": 3, **{f"job-{g:02d}": 3 for g in range(3)}}
+        canary = bench.build_input(2)
+        fleet = SolverFleet(
+            solver_factory=factory, size=2,
+            canary_input_fn=lambda: canary, canary_deadline_s=0.5,
+            fence_after_misses=1, fence_drain_s=0.1, recovery_probe_s=3600.0,
+        )
+        plan = faults.FaultPlan(seed=9)
+        wedge = None
+        tickets = []
+        failed = 0
+        try:
+            with faults.active(plan):
+                for step in range(8):
+                    if step == 3:
+                        wedge = plan.wedge("solver.device_hang", tag="owner-0")
+                    for _ in range(2):
+                        tickets.append(fleet.submit(inp, kind=DISRUPTION))
+                    fleet.probe_once()
+                results = []
+                for t in tickets:
+                    try:
+                        results.append(t.result(timeout=60))
+                    except Exception:  # noqa: BLE001 — counted as dropped
+                        failed += 1
+            dropped = fleet.unresolved()
+            stats = dict(fleet.stats)
+        finally:
+            if wedge is not None:
+                wedge.release()
+            fleet.close()
+        assert failed + dropped == 0, "soak dropped solves"
+        assert stats["failovers"] >= 1, "wedge must force a failover"
+        # atomicity through failover: no result may carry a partial gang
+        member_uids = {
+            uid: p.meta.labels[wk.GANG_LABEL]
+            for p in inp.pods for uid in [p.meta.uid]
+            if wk.GANG_LABEL in p.meta.labels
+        }
+        for res in results:
+            placed_per_gang = {}
+            for uid in res.placements:
+                gid = member_uids.get(uid)
+                if gid:
+                    placed_per_gang[gid] = placed_per_gang.get(gid, 0) + 1
+            for gid, n in placed_per_gang.items():
+                assert n == gang_sizes[gid], f"partial gang {gid}: {n}"
+            assert sum(1 for u in res.placements if member_uids.get(u) == "job-doomed") == 0
