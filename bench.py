@@ -613,6 +613,132 @@ def _host_only_metrics(num_pods: int = 2_000) -> dict:
         return {}
 
 
+def _trace_stage_metrics(num_pods: int = 2_000) -> dict:
+    """ISSUE 10 span-derived stage breakdown + tracing-cost guards.
+
+    (a) Tracing OFF (the production default until --solver-tracing wires it)
+        must be inert: 10k span() entries allocate NOTHING — the off path is
+        one module-global read returning a shared null context — guarded
+        with sys.getallocatedblocks, gc paused so collector churn can't
+        alias the count.
+    (b) The stage-breakdown keys (encode/upload/dispatch/fetch/decode/
+        stitch splits) come from the solve's own span tree, not ad-hoc
+        perf_counter pairs around call sites — one instrumentation source
+        for bench, /debug/trace, and karpenter_solver_stage_seconds. The
+        span-derived whole-solve duration must agree with a legacy
+        perf_counter wall timer around the same solves within 10%.
+    (c) trace_overhead_pct: per-span cost (measured attached, the expensive
+        path) x spans-per-solve, relative to the solve wall — asserted
+        < 2% so tracing stays affordable enough to leave on.
+    """
+    try:
+        import gc
+        from collections import defaultdict
+
+        from karpenter_tpu.obs import trace as obstrace
+        from karpenter_tpu.solver.backend import TPUSolver
+
+        # -- (a) off-path inertness ----------------------------------------
+        obstrace.configure(enabled=False)
+        for _ in range(64):  # warm bytecode/inline caches out of the window
+            with obstrace.span("bench.noop"):
+                pass
+        gc.collect()
+        gc.disable()
+        try:
+            b0 = sys.getallocatedblocks()
+            for _ in range(10_000):
+                with obstrace.span("bench.noop"):
+                    pass
+            alloc_blocks = sys.getallocatedblocks() - b0
+        finally:
+            gc.enable()
+        assert alloc_blocks < 50, (
+            f"tracing-off span() allocated {alloc_blocks} blocks over 10k calls"
+        )
+
+        inp = build_input(num_pods)
+        solver = TPUSolver(max_claims=1024)
+        solver.solve(inp)  # cold: compile + arena upload off the window
+
+        obstrace.configure(enabled=True, ring=64)
+        try:
+            # -- (b) span tree vs legacy wall timer, same solves -----------
+            iters = 5
+            legacy_ms = []
+            for _ in range(iters):
+                tr = obstrace.begin("bench")
+                t0 = time.perf_counter()
+                with obstrace.attached(tr):
+                    solver.solve(inp)
+                legacy_ms.append((time.perf_counter() - t0) * 1000)
+                obstrace.finish(tr, "ok")
+            stage_samples = defaultdict(list)
+            solve_ms = []
+            spans_per_solve = 0
+            for tr in obstrace.recent(iters):
+                snap = tr.snapshot()
+                spans_per_solve = max(spans_per_solve, len(snap["spans"]))
+                for sp in snap["spans"]:
+                    if sp["t1"] is None:
+                        continue
+                    dur = (sp["t1"] - sp["t0"]) * 1000
+                    if sp["name"] == "solve":
+                        solve_ms.append(dur)
+                    else:
+                        stage_samples[sp["name"]].append(dur)
+            legacy_p50 = float(np.percentile(np.asarray(legacy_ms), 50))
+            span_p50 = float(np.percentile(np.asarray(solve_ms), 50))
+            assert abs(span_p50 - legacy_p50) <= 0.10 * legacy_p50, (
+                f"span-derived solve {span_p50:.2f}ms vs legacy timer "
+                f"{legacy_p50:.2f}ms diverged > 10%"
+            )
+            stages = {
+                f"stage_{name.split('.')[-1]}_ms": round(
+                    float(np.percentile(np.asarray(v), 50)), 3
+                )
+                for name, v in sorted(stage_samples.items())
+            }
+
+            # -- (c) tracing overhead, analytic upper bound ----------------
+            # per-span cost noise-free beats differencing two solve p50s
+            # whose run-to-run jitter dwarfs a <2% effect
+            tr = obstrace.begin("bench")
+            with obstrace.attached(tr):
+                t0 = time.perf_counter()
+                for _ in range(5_000):
+                    with obstrace.span("bench.tick"):
+                        pass
+                span_cost_ms = (time.perf_counter() - t0) / 5_000 * 1000
+            obstrace.finish(tr, "ok")
+            overhead_pct = 100.0 * spans_per_solve * span_cost_ms / legacy_p50
+            assert overhead_pct < 2.0, (
+                f"tracing overhead {overhead_pct:.2f}% >= 2% "
+                f"({spans_per_solve} spans x {span_cost_ms * 1000:.1f}us "
+                f"over a {legacy_p50:.1f}ms solve)"
+            )
+        finally:
+            obstrace.configure(enabled=False)
+        print(
+            f"[bench] trace stages ({num_pods} pods): "
+            + " ".join(f"{k[6:-3]}={v}ms" for k, v in stages.items())
+            + f" | solve span={span_p50:.1f}ms legacy={legacy_p50:.1f}ms "
+            f"overhead={overhead_pct:.3f}% off-path-allocs={alloc_blocks}",
+            file=sys.stderr,
+        )
+        return {
+            **stages,
+            "solve_span_p50_ms": round(span_p50, 2),
+            "trace_overhead_pct": round(overhead_pct, 4),
+            "trace_spans_per_solve": spans_per_solve,
+            "trace_off_alloc_blocks": int(alloc_blocks),
+        }
+    except Exception as e:  # noqa: BLE001 — the marker line must still emit
+        print(f"[bench] trace stage metrics failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return {}
+
+
 def _host_only_pipeline_metrics(n_nodes: int = 400, n_candidates: int = 100) -> dict:
     """ISSUE-4 pipeline/probe metrics measured on the host backend. Dispatch
     counts, decision parity, and coalescing semantics are platform-
@@ -1421,7 +1547,7 @@ def main() -> None:
             extra={**_host_only_metrics(), **_host_only_pipeline_metrics(),
                    **_resume_metrics(), **_decode_relax_metrics(),
                    **_sharded_metrics(), **_soak_metrics(),
-                   **_gang_metrics()},
+                   **_gang_metrics(), **_trace_stage_metrics()},
         )
         return
     plat = wait_for_backend()
@@ -1439,7 +1565,7 @@ def main() -> None:
             extra={**_host_only_metrics(), **_host_only_pipeline_metrics(),
                    **_resume_metrics(), **_decode_relax_metrics(),
                    **_sharded_metrics(), **_soak_metrics(),
-                   **_gang_metrics()},
+                   **_gang_metrics(), **_trace_stage_metrics()},
         )
         return
     if plat.startswith("cpu"):
@@ -1451,7 +1577,7 @@ def main() -> None:
             extra={**_host_only_metrics(), **_host_only_pipeline_metrics(),
                    **_resume_metrics(), **_decode_relax_metrics(),
                    **_sharded_metrics(), **_soak_metrics(),
-                   **_gang_metrics()},
+                   **_gang_metrics(), **_trace_stage_metrics()},
         )
         return
 
@@ -1707,6 +1833,10 @@ def _run(plat: str) -> None:
     # contention — host seam on purpose, same rationale as the soak above
     gang_keys = _gang_metrics()
 
+    # ---- solve tracing (ISSUE 10): span-derived stage splits, the
+    # off-path zero-allocation guard, and the <2% overhead bound
+    trace_keys = _trace_stage_metrics()
+
     print(
         json.dumps(
             {
@@ -1765,6 +1895,10 @@ def _run(plat: str) -> None:
                 # scheduling classes (ISSUE 9): preemption latency, atomic
                 # gang commit rate, evictions planned per solve
                 **gang_keys,
+                # solve tracing (ISSUE 10): span-derived stage breakdown
+                # (one instrumentation source with /debug/trace and the
+                # stage-seconds histogram) + overhead/inertness guards
+                **trace_keys,
                 "decode_bytes_per_solve": round(
                     e2e_solver.ledger.decode_bytes_per_solve, 1
                 ),

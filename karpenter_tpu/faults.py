@@ -56,6 +56,8 @@ from collections import defaultdict, deque
 from contextlib import contextmanager
 from typing import Callable, Dict, Optional
 
+from .obs import trace as obstrace
+
 SITES = (
     "solver.device_dispatch",
     "solver.decode",
@@ -199,6 +201,10 @@ class FaultPlan:
                 self.fired[site] += 1
                 if tag is not None:
                     self.fired[f"{site}@{tag}"] += 1
+            # tag the fault site on the solve's span tree BEFORE parking:
+            # the flight-recorder dump of the ensuing fence shows where the
+            # wedged thread is stuck
+            obstrace.annotate(fault_site=site, fault_kind="wedge")
             wedge()
         if out is None or out == "ok":
             return
@@ -209,6 +215,7 @@ class FaultPlan:
             self.fired[site] += 1
             if tag is not None:
                 self.fired[f"{site}@{tag}"] += 1
+        obstrace.annotate(fault_site=site, fault_kind="raise")
         if isinstance(out, type):
             raise out(f"injected fault at {site}")
         # re-instantiate so each fire raises a fresh exception object

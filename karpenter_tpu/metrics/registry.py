@@ -490,6 +490,31 @@ SOLVER_DEADLINE_LEAKED_THREADS = REGISTRY.register(
     )
 )
 
+# -- tracing / flight recorder (obs/ — ISSUE 10; same naming rule as the
+#    fleet series: no _tpu segment, spans are backend-neutral) ----------------
+
+SOLVER_STAGE_SECONDS = REGISTRY.register(
+    Histogram(
+        "karpenter_solver_stage_seconds",
+        "Per-stage solve latency derived from trace spans (obs/trace.py): "
+        "one observation per closed span at trace finish, labeled by span "
+        "name (pipeline.queue / pipeline.dispatch / backend.encode / "
+        "backend.upload / backend.dispatch / backend.fetch / backend.decode "
+        "/ pipeline.decode / ...) — bench.py's stage breakdown reads the "
+        "same spans, so bench and production measure the same thing",
+        ("stage",),
+    )
+)
+FLIGHT_RECORDER_DUMPS = REGISTRY.register(
+    Counter(
+        "karpenter_solver_flight_dumps_total",
+        "Flight-recorder crash dumps written, by trigger (fleet_fence / "
+        "breaker_open / invariant_gate) — obs/recorder.py; throttled "
+        "repeats do not count",
+        ("reason",),
+    )
+)
+
 PROBE_BATCH_SIZE = REGISTRY.register(
     Histogram(
         "karpenter_tpu_disruption_probe_batch_size",

@@ -1,0 +1,14 @@
+"""Observability runtime: request-scoped tracing + flight recorder.
+
+Zero-dependency. `trace` is the span/tracer core (solve_id correlation,
+per-thread context, ring buffer of finished traces), `recorder` the
+crash-dump flight recorder, `export` the Chrome-trace/Perfetto JSON
+exporter, `logjson` the solve_id-keyed structured log formatter.
+
+The module is inert until `trace.configure(enabled=True)`: every
+production hook is a no-op returning a shared null object — no
+allocation, no lock — so the tracing-off path costs one module-global
+read per span site (bench.py guards this with `trace_overhead_pct`).
+"""
+
+from . import export, logjson, recorder, trace  # noqa: F401

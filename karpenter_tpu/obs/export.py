@@ -1,0 +1,52 @@
+"""Chrome-trace (Perfetto-loadable) JSON export of solve traces.
+
+Emits the Trace Event Format's "X" (complete) events — one per closed
+span — and "B" (begin, no end) events for spans still open, so a wedged
+solve renders as an unterminated bar. Span timestamps are monotonic;
+the export anchors them to wall time once (`anchor`) so absolute times
+in the UI are meaningful. Threads map to Perfetto tracks via `tid` +
+thread-name metadata events; the solve_id, span tree (parent ids) and
+every span attribute ride in `args`.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+
+def chrome_trace(traces, anchor: Optional[Dict[str, float]] = None) -> dict:
+    """Convert Trace objects (finished or partial) to a Chrome-trace dict.
+    `anchor` maps monotonic->wall once per export; defaults to now."""
+    if anchor is None:
+        anchor = {"monotonic": time.monotonic(), "wall": time.time()}
+    off_us = (anchor["wall"] - anchor["monotonic"]) * 1e6
+    events: List[dict] = []
+    tids: Dict[str, int] = {}
+    for tr in traces:
+        snap = tr.snapshot() if hasattr(tr, "snapshot") else tr
+        for sp in snap["spans"]:
+            tid = tids.setdefault(sp["thread"], len(tids) + 1)
+            args = dict(sp["attrs"])
+            args.update(
+                solve_id=snap["solve_id"], span_id=sp["span_id"],
+                parent_id=sp["parent_id"], status=sp["status"],
+            )
+            if snap["links"]:
+                args["links"] = snap["links"]
+            ev = {
+                "name": sp["name"], "cat": snap["kind"], "pid": 1,
+                "tid": tid, "ts": sp["t0"] * 1e6 + off_us, "args": args,
+            }
+            if sp["t1"] is not None:
+                ev["ph"] = "X"
+                ev["dur"] = (sp["t1"] - sp["t0"]) * 1e6
+            else:
+                ev["ph"] = "B"  # still open: a wedged / in-flight span
+            events.append(ev)
+    for name, tid in tids.items():
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+            "args": {"name": name},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -1,0 +1,39 @@
+"""Structured logging: one-line JSON records keyed by solve_id.
+
+Opt-in via `--log-format=json` (operator/options.py). The formatter
+joins logs to traces on the same correlation token two ways: an
+explicit `extra={"solve_id": ...}` on the record wins; otherwise the
+calling thread's attached trace (obs/trace.py context) supplies it —
+which covers the pipeline dispatcher/decoder and resilience log sites
+for free, since they already run inside `attached(trace)` blocks.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import traceback
+
+from . import trace as _trace
+
+
+class JsonLogFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(record.created, 3),
+            "iso": time.strftime("%Y-%m-%dT%H:%M:%S",
+                                 time.localtime(record.created)),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "thread": record.threadName,
+            "msg": record.getMessage(),
+        }
+        solve_id = getattr(record, "solve_id", None) or _trace.current_solve_id()
+        if solve_id is not None:
+            out["solve_id"] = solve_id
+        if record.exc_info:
+            out["exc"] = "".join(
+                traceback.format_exception(*record.exc_info)
+            ).rstrip()
+        return json.dumps(out, default=repr)

@@ -1,0 +1,118 @@
+"""Flight recorder: crash-dump the last N solve traces on failure.
+
+The tracer's finished-trace ring (trace.py) plus the still-active
+partial traces ARE the flight record — this module snapshots them to a
+JSON file when something goes wrong enough that the evidence is about
+to be destroyed:
+
+- a fleet owner is fenced (fleet.py `_fence` — the fence stops the
+  owner's service and force-resolves its tickets, erasing the wedged
+  solve's live state);
+- the per-request circuit breaker opens (resilient.py — the device
+  path is about to be bypassed entirely);
+- the invariant gate rejects a result (resilient.py — a garbage decode
+  was caught; the inputs that produced it are in the trace attributes).
+
+Each dump carries the trace snapshots (including the wedged solve's
+PARTIAL span tree — open spans have `t1: null`), the recent canary
+verdict history, and the trigger's tags (owner, fault site, violation
+count). Dumps are throttled per reason (`min_interval_s`) so a crash
+loop cannot fill the disk; the most recent dump's metadata is kept on
+`last_dump` and surfaced through the operator's health endpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+from ..metrics.registry import FLIGHT_RECORDER_DUMPS
+from . import trace as _trace
+
+log = logging.getLogger("karpenter_tpu")
+
+
+class FlightRecorder:
+    def __init__(self, dir: Optional[str] = None, capacity: int = 32,
+                 min_interval_s: float = 30.0, clock=time.monotonic):
+        self.dir = dir or tempfile.gettempdir()
+        self.capacity = max(1, int(capacity))
+        self.min_interval_s = float(min_interval_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._last_by_reason: Dict[str, float] = {}
+        self._canary: deque = deque(maxlen=64)
+        self.dumps = 0
+        self.throttled = 0
+        self.last_dump: Optional[Dict[str, object]] = None
+
+    def note_canary(self, owner: str, verdict: str,
+                    latency_s: Optional[float] = None) -> None:
+        """Record a liveness-probe verdict (ring of the last 64): the dump
+        shows what the watchdog saw in the run-up to a fence."""
+        self._canary.append({
+            "wall": time.time(), "owner": owner, "verdict": verdict,
+            "latency_s": latency_s,
+        })
+
+    def dump(self, reason: str, tags: Optional[Dict[str, object]] = None
+             ) -> Optional[str]:
+        """Write the flight record; returns the path, or None when the
+        per-reason throttle suppressed it."""
+        now = self.clock()
+        with self._lock:
+            last = self._last_by_reason.get(reason)
+            if last is not None and now - last < self.min_interval_s:
+                self.throttled += 1
+                return None
+            self._last_by_reason[reason] = now
+            self._seq += 1
+            seq = self._seq
+        traces = _trace.recent(self.capacity)
+        partial = _trace.active_traces()
+        payload = {
+            "reason": reason,
+            "tags": {k: _trace._jsonable(v) for k, v in (tags or {}).items()},
+            "wall_time": time.time(),
+            "monotonic": time.monotonic(),
+            "canary_history": list(self._canary),
+            "partial_traces": [t.snapshot() for t in partial],
+            "traces": [t.snapshot() for t in traces],
+        }
+        path = os.path.join(
+            self.dir, f"karpenter-flightrec-{os.getpid()}-{seq:03d}-{reason}.json"
+        )
+        try:
+            with open(path, "w") as f:
+                json.dump(payload, f, indent=1)
+        except OSError as e:  # noqa: PERF203 — a dump must never crash a fence
+            log.error("flight recorder: dump to %s failed: %s", path, e)
+            return None
+        with self._lock:
+            self.dumps += 1
+            self.last_dump = {
+                "reason": reason, "path": path, "wall_time": payload["wall_time"],
+                "traces": len(traces), "partial_traces": len(partial),
+            }
+        FLIGHT_RECORDER_DUMPS.inc(reason=reason)
+        log.warning(
+            "flight recorder: dumped %d finished + %d partial trace(s) to %s "
+            "(reason: %s)", len(traces), len(partial), path, reason,
+        )
+        return path
+
+    def health(self) -> Dict[str, object]:
+        """Summary surfaced by the operator's health endpoint."""
+        with self._lock:
+            return {
+                "dumps": self.dumps,
+                "throttled": self.throttled,
+                "last_dump": dict(self.last_dump) if self.last_dump else None,
+            }

@@ -1,0 +1,360 @@
+"""Span/Tracer core: one solve = one span tree across every thread.
+
+A `Trace` is minted at ticket creation (SolveService.submit /
+SolverFleet.submit / provisioner.reconcile) and carries a `solve_id`
+correlation token. The minting layer OWNS completion (it calls
+`finish()` at ticket delivery); every other layer only ATTACHES: the
+pipeline dispatcher/decoder threads, the fleet placement path, the
+resilience wrappers and the backend all run inside `attached(trace)`
+blocks, so their `span()` calls nest under the one root — one solve
+yields one rooted span tree no matter how many threads touched it.
+
+Threading model: span creation appends under the trace's own lock;
+the per-thread context is a plain list on a `threading.local`. The
+finished-trace ring is a `deque(maxlen=N)` — appends are single
+bytecode ops under the GIL, so readers (the /debug/trace exporter, the
+flight recorder) never block a solve.
+
+Off path: `configure(enabled=False)` (the import-time default) makes
+`span()` return a shared null context manager and `begin()` return
+None — no allocation anywhere on the solve path. `span()` also
+returns the null object when the calling thread has no attached trace,
+so direct `solver.solve()` calls outside a ticket stay untraced rather
+than producing orphan fragments.
+
+Timestamps are `time.monotonic()` — durations are exact; the exporter
+anchors them to wall time once per export.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from ..metrics.registry import SOLVER_STAGE_SECONDS
+
+_ENABLED = False
+_LOCK = threading.Lock()
+_SEQ = itertools.count(1)
+_ACTIVE: "Dict[str, Trace]" = {}  # solve_id -> unfinished trace
+_ACTIVE_MAX = 256  # wedged-forever traces evict oldest-first past this
+_RING: deque = deque(maxlen=64)  # finished traces, oldest evicted
+_RECORDER = None  # FlightRecorder (recorder.py) or None
+_TLS = threading.local()  # .stack: [(trace, span), ...]
+
+
+class Span:
+    """One timed operation inside a trace. `end()` is idempotent and
+    callable from any thread (cross-thread spans: pipeline.queue starts
+    on the submitting thread and ends on the dispatcher)."""
+
+    __slots__ = ("span_id", "parent_id", "name", "t0", "t1", "thread",
+                 "status", "attrs")
+
+    def __init__(self, span_id: int, parent_id: Optional[int], name: str):
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.t0 = time.monotonic()
+        self.t1: Optional[float] = None
+        self.thread = threading.current_thread().name
+        self.status = "open"
+        self.attrs: Dict[str, object] = {}
+
+    def set(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    def end(self, status: str = "ok") -> None:
+        if self.t1 is None:
+            self.t1 = time.monotonic()
+            self.status = status
+
+    @property
+    def duration_s(self) -> Optional[float]:
+        return None if self.t1 is None else self.t1 - self.t0
+
+    def snapshot(self) -> Dict[str, object]:
+        return {
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "t0": self.t0,
+            "t1": self.t1,
+            "thread": self.thread,
+            "status": self.status,
+            "attrs": {k: _jsonable(v) for k, v in self.attrs.items()},
+        }
+
+
+class Trace:
+    """All spans of one solve_id, rooted at the `solve` span created by
+    `begin()`. Links (e.g. requeued_from) record cross-owner history
+    that is not itself a timed operation."""
+
+    __slots__ = ("solve_id", "kind", "spans", "links", "root", "status",
+                 "done", "created_wall", "_lock")
+
+    def __init__(self, solve_id: str, kind: str):
+        self.solve_id = solve_id
+        self.kind = kind
+        self._lock = threading.Lock()
+        self.spans: List[Span] = []
+        self.links: Dict[str, List[str]] = {}
+        self.status = "open"
+        self.done = False
+        self.created_wall = time.time()
+        self.root = self.start_span("solve", parent=None)
+
+    def start_span(self, name: str, parent: Optional[Span]) -> Span:
+        with self._lock:
+            sp = Span(len(self.spans) + 1,
+                      parent.span_id if parent is not None else None, name)
+            self.spans.append(sp)
+        return sp
+
+    def add_link(self, key: str, value: str) -> None:
+        with self._lock:
+            self.links.setdefault(key, []).append(value)
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            spans = [sp.snapshot() for sp in self.spans]
+            links = {k: list(v) for k, v in self.links.items()}
+        return {
+            "solve_id": self.solve_id,
+            "kind": self.kind,
+            "status": self.status,
+            "done": self.done,
+            "created_wall": self.created_wall,
+            "links": links,
+            "spans": spans,
+        }
+
+
+def _jsonable(v):
+    return v if isinstance(v, (str, int, float, bool, type(None))) else repr(v)
+
+
+# -- configuration -------------------------------------------------------------
+
+
+def configure(enabled: bool = True, ring: int = 64, recorder=None) -> None:
+    """(Re)configure the runtime; resets the ring and active set — call
+    once at operator boot, or per-test for isolation."""
+    global _ENABLED, _RING, _RECORDER
+    with _LOCK:
+        _ENABLED = bool(enabled)
+        _RING = deque(maxlen=max(1, int(ring)))
+        _ACTIVE.clear()
+        _RECORDER = recorder
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def recorder():
+    return _RECORDER
+
+
+# -- trace lifecycle (owned by the minting layer) ------------------------------
+
+
+def begin(kind: str = "solve", solve_id: Optional[str] = None) -> Optional[Trace]:
+    """Mint a trace + its root span. Returns None when tracing is off."""
+    if not _ENABLED:
+        return None
+    sid = solve_id or f"s{next(_SEQ):06d}"
+    tr = Trace(sid, kind)
+    with _LOCK:
+        _ACTIVE[sid] = tr
+        # bound the active set: a trace wedged forever (never finished)
+        # must not leak — evict oldest-first into the ring as "abandoned"
+        while len(_ACTIVE) > _ACTIVE_MAX:
+            oldest = next(iter(_ACTIVE))
+            stale = _ACTIVE.pop(oldest)
+            stale.status, stale.done = "abandoned", True
+            _RING.append(stale)
+    return tr
+
+
+def adopt_or_begin(kind: str):
+    """(trace, owned): reuse the calling thread's attached trace (a layer
+    above already minted it — it owns completion), else mint one here."""
+    cur = current_trace()
+    if cur is not None:
+        return cur, False
+    tr = begin(kind)
+    return tr, tr is not None
+
+
+def finish(trace: Optional[Trace], status: str = "ok") -> None:
+    """Complete a trace: close its root, move it active -> ring, feed the
+    per-stage latency histograms. Idempotent; None-safe."""
+    if trace is None or trace.done:
+        return
+    trace.root.end(status)
+    trace.status = status
+    trace.done = True
+    with _LOCK:
+        _ACTIVE.pop(trace.solve_id, None)
+        _RING.append(trace)
+    for sp in list(trace.spans):
+        if sp.t1 is not None:
+            SOLVER_STAGE_SECONDS.observe(sp.t1 - sp.t0, stage=sp.name)
+
+
+def status_of(error: Optional[BaseException]) -> str:
+    """Map a ticket resolution error to a trace status."""
+    if error is None:
+        return "ok"
+    name = type(error).__name__
+    if name == "Superseded":
+        return "superseded"
+    if name == "ServiceStopped":
+        return "stopped"
+    return "error"
+
+
+# -- per-thread context --------------------------------------------------------
+
+
+def _stack() -> list:
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+class _NullCtx:
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullCtx()
+
+
+class _Attach:
+    __slots__ = ("_trace",)
+
+    def __init__(self, trace: Trace):
+        self._trace = trace
+
+    def __enter__(self):
+        _stack().append((self._trace, self._trace.root))
+        return self._trace
+
+    def __exit__(self, *exc):
+        _stack().pop()
+        return False
+
+
+def attached(trace: Optional[Trace]):
+    """Enter `trace`'s context on this thread: span() calls nest under
+    its root until exit. None-safe (no-op context)."""
+    if trace is None:
+        return _NULL
+    return _Attach(trace)
+
+
+class _SpanCtx:
+    __slots__ = ("_name", "_span")
+
+    def __init__(self, name: str):
+        self._name = name
+        self._span = None
+
+    def __enter__(self):
+        stack = _stack()
+        trace, parent = stack[-1]
+        self._span = trace.start_span(self._name, parent)
+        stack.append((trace, self._span))
+        return self._span
+
+    def __exit__(self, et, ev, tb):
+        _stack().pop()
+        self._span.end("error" if et is not None else "ok")
+        return False
+
+
+def span(name: str):
+    """Context manager for a child span of the thread's current span.
+    Returns the shared null context (zero allocation) when tracing is
+    off or the thread has no attached trace."""
+    if not _ENABLED:
+        return _NULL
+    if not getattr(_TLS, "stack", None):
+        return _NULL
+    return _SpanCtx(name)
+
+
+def current() -> Optional[Span]:
+    st = getattr(_TLS, "stack", None)
+    return st[-1][1] if st else None
+
+
+def current_trace() -> Optional[Trace]:
+    st = getattr(_TLS, "stack", None)
+    return st[-1][0] if st else None
+
+
+def current_solve_id() -> Optional[str]:
+    st = getattr(_TLS, "stack", None)
+    return st[-1][0].solve_id if st else None
+
+
+def annotate(**attrs) -> None:
+    """Set attributes on the current span (no-op outside a trace)."""
+    st = getattr(_TLS, "stack", None)
+    if st:
+        st[-1][1].attrs.update(attrs)
+
+
+def event(name: str, **attrs) -> None:
+    """Instantaneous marker span under the current span (no-op outside
+    a trace) — requeue links, fault fires."""
+    st = getattr(_TLS, "stack", None)
+    if not st:
+        return
+    trace, parent = st[-1]
+    sp = trace.start_span(name, parent)
+    sp.attrs.update(attrs)
+    sp.end()
+
+
+# -- export / recorder feeds ---------------------------------------------------
+
+
+def recent(n: Optional[int] = None) -> List[Trace]:
+    """Last `n` finished traces, oldest first."""
+    with _LOCK:
+        out = list(_RING)
+    return out if n is None else out[-int(n):]
+
+
+def active_traces() -> List[Trace]:
+    """Unfinished traces (partial span trees — what a wedge looks like)."""
+    with _LOCK:
+        return list(_ACTIVE.values())
+
+
+def dump(reason: str, **tags) -> Optional[str]:
+    """Trigger a flight-recorder dump (no-op when none is configured)."""
+    rec = _RECORDER
+    if rec is None:
+        return None
+    return rec.dump(reason, tags=tags)
+
+
+def note_canary(owner: str, verdict: str, latency_s: Optional[float] = None) -> None:
+    rec = _RECORDER
+    if rec is not None:
+        rec.note_canary(owner, verdict, latency_s)

@@ -21,6 +21,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..controllers import store as st
 from ..metrics.registry import REGISTRY
+from ..obs import trace as obstrace
+from ..obs.export import chrome_trace
+from ..obs.logjson import JsonLogFormatter
+from ..obs.recorder import FlightRecorder
 from ..solver.backend import ReferenceSolver, TPUSolver
 from . import options as opts
 from .operator import new_kwok_operator
@@ -29,7 +33,7 @@ from .operator import new_kwok_operator
 def serve_endpoints(port: int, health_port: int, enable_profiling: bool = False):
     """Prometheus metrics + health probes (operator manager equivalents);
     /debug/pprof/* sampling profiler behind --enable-profiling
-    (settings.md:23)."""
+    (settings.md:23); /debug/trace Chrome-trace export of recent solves."""
 
     class MetricsHandler(BaseHTTPRequestHandler):
         def do_GET(self):
@@ -40,9 +44,35 @@ def serve_endpoints(port: int, health_port: int, enable_profiling: bool = False)
                 self.end_headers()
                 self.wfile.write(body)
             elif self.path in ("/healthz", "/readyz"):
+                rec = obstrace.recorder()
+                body = json.dumps({
+                    "status": "ok",
+                    "flight_recorder": rec.health() if rec is not None else None,
+                }).encode()
                 self.send_response(200)
+                self.send_header("Content-Type", "application/json")
                 self.end_headers()
-                self.wfile.write(b"ok")
+                self.wfile.write(body)
+            elif self.path.startswith("/debug/trace"):
+                # Perfetto-loadable dump of the last N finished traces plus
+                # every still-open (in-flight or wedged) solve
+                _, _, query = self.path.partition("?")
+                last = None
+                for part in query.split("&"):
+                    if part.startswith("last="):
+                        try:
+                            last = max(1, int(part.split("=", 1)[1]))
+                        except ValueError:
+                            self.send_response(400)
+                            self.end_headers()
+                            self.wfile.write(b"bad last\n")
+                            return
+                traces = obstrace.recent(last) + obstrace.active_traces()
+                body = json.dumps(chrome_trace(traces)).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
             elif self.path.startswith("/debug/pprof/") and enable_profiling:
                 from . import profiling
 
@@ -69,6 +99,14 @@ def main(argv=None) -> int:
     logging.basicConfig(
         level=getattr(logging, o.log_level.upper(), logging.INFO),
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    if o.log_format == "json":
+        for h in logging.getLogger().handlers:
+            h.setFormatter(JsonLogFormatter())
+    obstrace.configure(
+        enabled=o.solver_tracing,
+        ring=o.trace_ring_size,
+        recorder=FlightRecorder(dir=o.flight_recorder_dir or None),
     )
     log = logging.getLogger("karpenter_tpu")
     solver = (

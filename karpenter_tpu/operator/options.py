@@ -35,8 +35,22 @@ class Options:
     health_probe_port: int = 8081
     # behavior
     log_level: str = "info"
+    # log record shape: "text" (stdlib default) or "json" — one JSON object
+    # per line, keyed by the solve's correlation token when one is ambient
+    # (obs/logjson.py)
+    log_format: str = "text"
     preference_policy: str = "Respect"  # settings.md:38
     enable_profiling: bool = False  # /debug/pprof/* (settings.md:23)
+    # end-to-end solve tracing (obs/trace.py): span trees across
+    # provisioner -> pipeline -> fleet -> backend, exported at /debug/trace
+    # (Chrome-trace JSON) and feeding karpenter_solver_stage_seconds; the
+    # off path is a shared no-op context (proven inert in bench.py)
+    solver_tracing: bool = True
+    # finished traces kept for /debug/trace and flight-recorder dumps
+    trace_ring_size: int = 64
+    # flight-recorder dump directory (invariant-gate reject / breaker open /
+    # fleet fence write crash evidence here); empty = the system temp dir
+    flight_recorder_dir: str = ""
     feature_gates: str = ""
     leader_elect: bool = True
     # solver backend: tpu | reference
@@ -212,6 +226,20 @@ def parse(argv: Optional[Sequence[str]] = None, cls=Options) -> Options:
             "refusing to start: --canary-interval-s must be > 0 "
             f"(got {interval_s}); it is the liveness-probe period of the "
             "solver fleet watchdog (solver/fleet.py)"
+        )
+    fmt = getattr(out, "log_format", None)
+    if fmt is not None and fmt not in ("text", "json"):
+        raise SystemExit(
+            "refusing to start: --log-format must be 'text' or 'json' "
+            f"(got {fmt!r}); json emits one object per line keyed by "
+            "solve_id (obs/logjson.py)"
+        )
+    ring = getattr(out, "trace_ring_size", None)
+    if ring is not None and int(ring) < 1:
+        raise SystemExit(
+            "refusing to start: --trace-ring-size must be >= 1 "
+            f"(got {ring}); it bounds the finished-trace ring backing "
+            "/debug/trace and flight-recorder dumps (obs/trace.py)"
         )
     # decode/ladder knob sanity: these gate correctness-critical solver
     # paths, so a typo'd env value ("ture", "on") must not silently become

@@ -27,16 +27,34 @@ from typing import Tuple
 
 SAMPLE_INTERVAL_S = 0.01  # 100 Hz, pprof's default sampling rate
 
+# one CPU profile at a time: two concurrent sampling loops would double the
+# profiler's own overhead AND each would see the other's loop as the hottest
+# frame — the handler returns 429 instead of queuing
+_PROFILE_LOCK = threading.Lock()
 
-def sample_profile(seconds: float, interval_s: float = SAMPLE_INTERVAL_S) -> str:
-    """Sample all thread stacks for `seconds`; flat report by self-samples."""
+
+def sample_profile(seconds: float, interval_s: float = SAMPLE_INTERVAL_S,
+                   clock=time.monotonic, sleep=time.sleep) -> str:
+    """Sample all thread stacks for `seconds`; flat report by self-samples.
+
+    The schedule is drift-free: each tick sleeps toward an ABSOLUTE deadline
+    (`start + tick * interval_s`), so per-tick work (walking every thread's
+    stack) doesn't stretch the effective period — a naive `sleep(interval)`
+    after each pass samples at interval + walk_cost, silently under-reporting
+    busy processes exactly when profiling them matters most.
+    """
     seconds = max(0.1, min(float(seconds), 60.0))
     me = threading.get_ident()
     self_hits: Counter = Counter()
     incl_hits: Counter = Counter()
     n_samples = 0
-    deadline = time.monotonic() + seconds
-    while time.monotonic() < deadline:
+    start = clock()
+    deadline = start + seconds
+    tick = 0
+    while True:
+        now = clock()
+        if now >= deadline:
+            break
         for tid, frame in sys._current_frames().items():
             if tid == me:
                 continue  # the profiler's own sampling loop is noise
@@ -53,7 +71,11 @@ def sample_profile(seconds: float, interval_s: float = SAMPLE_INTERVAL_S) -> str
                     incl_hits[key] += 1
                     seen.add(key)
                 frame = frame.f_back
-        time.sleep(interval_s)
+        tick += 1
+        next_at = start + tick * interval_s
+        now = clock()
+        if next_at > now:
+            sleep(next_at - now)
     lines = [
         f"# sampling profile: {seconds:.1f}s @ {1 / interval_s:.0f}Hz, "
         f"{n_samples} thread-samples",
@@ -89,7 +111,12 @@ def handle(path: str, query: str) -> Tuple[int, str]:
                     seconds = float(part.split("=", 1)[1])
                 except ValueError:
                     return 400, "bad seconds\n"
-        return 200, sample_profile(seconds)
+        if not _PROFILE_LOCK.acquire(blocking=False):
+            return 429, "profile already in progress\n"
+        try:
+            return 200, sample_profile(seconds)
+        finally:
+            _PROFILE_LOCK.release()
     if path == "/debug/pprof/stacks":
         return 200, dump_stacks()
     return 404, "unknown profile endpoint\n"

@@ -14,6 +14,8 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
+from ..metrics.registry import ICE_CACHE_SIZE
+
 DEFAULT_TTL_S = 180.0  # 3m, cache.go:29
 
 
@@ -35,6 +37,7 @@ class UnavailableOfferings:
                 self._clock() + self._ttl
             )
             self.seq_num += 1
+            ICE_CACHE_SIZE.set(float(len(self._entries)))
 
     def is_unavailable(self, capacity_type: str, instance_type: str, zone: str) -> bool:
         with self._lock:
@@ -56,6 +59,7 @@ class UnavailableOfferings:
                 del self._entries[k]
             if dead:
                 self.seq_num += 1
+                ICE_CACHE_SIZE.set(float(len(self._entries)))
 
     def count(self) -> int:
         with self._lock:

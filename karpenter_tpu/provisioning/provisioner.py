@@ -17,7 +17,12 @@ from ..api import wellknown as wk
 from ..api.objects import NodeClaim, NodePool, ObjectMeta, Pod
 from ..cloudprovider.types import CloudProvider
 from ..controllers import store as st
-from ..metrics.registry import PROVISIONER_SCHEDULING_DURATION, SCHEDULER_QUEUE_DEPTH
+from ..metrics.registry import (
+    PODS_UNSCHEDULABLE,
+    PROVISIONER_SCHEDULING_DURATION,
+    SCHEDULER_QUEUE_DEPTH,
+)
+from ..obs import trace as obstrace
 from ..scheduling.requirements import IN, Requirement
 from ..solver.backend import Solver
 from ..state.cluster import Cluster
@@ -157,20 +162,28 @@ class Provisioner:
     def reconcile(self) -> bool:
         pending = self.cluster.pending_pods()
         SCHEDULER_QUEUE_DEPTH.set(len(pending))
+        PODS_UNSCHEDULABLE.set(float(len(pending)), state="pending")
         if not self._batch_ready(pending):
             return False
         self._first_seen = None
         t0 = time.perf_counter()
-        inp = self.build_input(pending)
+        # mint the solve's trace HERE — the provisioner is the top of the
+        # span tree; the service/fleet/backend layers below adopt it
+        _tr = obstrace.begin("provisioning")
+        with obstrace.attached(_tr):
+            obstrace.annotate(pending_pods=len(pending))
+            with obstrace.span("provision.build_input"):
+                inp = self.build_input(pending)
         try:
             if self._solve_service is not None:
                 # pipelined path: the service owns the device — this snapshot
                 # queues behind (and fairly interleaves with) disruption
                 # probes, and a newer snapshot submitted while this one is
                 # still queued supersedes it (Superseded below)
-                ticket = self._solve_service.submit(
-                    inp, kind="provisioning", rev=inp.state_rev
-                )
+                with obstrace.attached(_tr):
+                    ticket = self._solve_service.submit(
+                        inp, kind="provisioning", rev=inp.state_rev
+                    )
                 nodepools = self._nodepools()
                 result = ticket.result()
             else:
@@ -179,11 +192,13 @@ class Provisioner:
                     # async seam: kernel + link transfer run while the
                     # claim-creation lookups below are prepared on host
                     # (backend.AsyncSolve)
-                    handle = solve_async(inp)
-                    nodepools = self._nodepools()
-                    result = handle.result()
+                    with obstrace.attached(_tr):
+                        handle = solve_async(inp)
+                        nodepools = self._nodepools()
+                        result = handle.result()
                 else:
-                    result = self.solver.solve(inp)
+                    with obstrace.attached(_tr):
+                        result = self.solver.solve(inp)
                     nodepools = self._nodepools()
         except Exception as e:
             from ..solver.pipeline import Superseded
@@ -192,6 +207,7 @@ class Provisioner:
                 # a newer cluster snapshot's solve covers this batch; acting
                 # on the stale result would double-provision — defer and let
                 # the next tick pick up whatever that solve leaves pending
+                obstrace.finish(_tr, "superseded")
                 return False
             # a solver exception must degrade, not abort the batch: the
             # configured solver (even ResilientSolver, if its whole chain is
@@ -210,13 +226,18 @@ class Provisioner:
                 "batch on the reference oracle", e,
             )
             try:
-                result = ReferenceSolver().solve(inp)
+                with obstrace.attached(_tr), obstrace.span("provision.oracle_replay"):
+                    result = ReferenceSolver().solve(inp)
             except Exception:
                 logging.getLogger("karpenter_tpu").exception(
                     "oracle replay failed too; deferring batch to next tick"
                 )
+                obstrace.finish(_tr, "error")
                 return False
+            obstrace.finish(_tr, "oracle_replay")
+            _tr = None  # already finished
             nodepools = self._nodepools()
+        obstrace.finish(_tr, "ok")
         PROVISIONER_SCHEDULING_DURATION.observe(time.perf_counter() - t0)
         did = False
         # gang membership: claims carrying a gang member batch all-or-nothing

@@ -55,6 +55,7 @@ from ..metrics.registry import (
     SOLVER_UPLOAD_ARRAYS,
     SOLVER_UPLOAD_BYTES,
 )
+from ..obs import trace as obstrace
 
 _LEDGER_FIELDS = ("h2d_bytes", "h2d_arrays", "h2d_msgs", "d2h_bytes",
                   "d2h_msgs", "h2d_shard_bytes")
@@ -103,6 +104,9 @@ class TransferLedger:
                 self.total[k] += v
 
     def record_adopt(self, outcome: str) -> None:
+        # encode-cache hit class rides on the solve's span tree (the
+        # dispatcher is inside backend.upload when adoption happens)
+        obstrace.annotate(arena=outcome)
         with self._lock:
             self.outcomes[outcome] += 1
 
@@ -142,6 +146,8 @@ class TransferLedger:
         SOLVER_UPLOAD_ARRAYS.set(snap["h2d_arrays"])
         SOLVER_ARENA_HIT_RATE.set(self.arena_hit_rate)
         SOLVER_DECODE_BYTES.set(snap["d2h_bytes"])
+        obstrace.annotate(upload_bytes=snap["h2d_bytes"],
+                          d2h_bytes=snap["d2h_bytes"])
         return snap
 
     def snapshot(self) -> Dict[str, object]:

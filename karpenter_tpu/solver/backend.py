@@ -41,6 +41,7 @@ from ..metrics.registry import (
     SOLVER_SOLVES,
     SOLVER_WIDE_REFETCH,
 )
+from ..obs import trace as obstrace
 from ..utils.resources import PODS, Resources
 from .encode import EncodedInput, UnpackableInput, encode, quantize_input
 
@@ -72,7 +73,8 @@ class ReferenceSolver(Solver):
         # each CONCRETE executor counts itself exactly once per logical
         # solve; delegation layers count nothing (no double counting)
         SOLVER_SOLVES.inc(backend="oracle")
-        return canonicalize_placements(inp, Scheduler(inp).solve())
+        with obstrace.span("backend.oracle"):
+            return canonicalize_placements(inp, Scheduler(inp).solve())
 
 
 def canonicalize_placements(inp: SolverInput, res: SolverResult) -> SolverResult:
@@ -868,7 +870,8 @@ class TPUSolver(Solver):
             return AsyncSolve(
                 lambda: self._relax_solve(qinp, relax_plan, order, dropped, first)
             )
-        enc = encode(qinp)
+        with obstrace.span("backend.encode"):
+            enc = encode(qinp)
         if (
             enc.group_fallback.any()
             or enc.has_topology
@@ -917,7 +920,8 @@ class TPUSolver(Solver):
             for p in order
         ]
         minp = dataclasses.replace(qinp, pods=pods2, presorted=True)
-        enc = encode(minp)
+        with obstrace.span("backend.encode"):
+            enc = encode(minp)
         if (
             enc.group_fallback.any()
             or enc.has_topology
@@ -1067,7 +1071,8 @@ class TPUSolver(Solver):
         if not ghosts:
             return None
         minp = dataclasses.replace(qinp, pods=pods0 + ghosts, presorted=True)
-        enc = encode(minp)
+        with obstrace.span("backend.encode"):
+            enc = encode(minp)
         if (
             enc.group_fallback.any()
             or enc.has_topology
@@ -1107,17 +1112,21 @@ class TPUSolver(Solver):
         except UnpackableInput:
             return None
         self.ledger.begin_solve()
-        if self.arena is not None:
-            args = self.arena.adopt(host_args, prov)
-        else:
-            args = _device_args(host_args, prov, ledger=self.ledger)
-        Sp = int(host_args[0].shape[0])
-        lad_host = np.full((Sp, Lp), -1, np.int32)
-        lad_host[:S_orig] = ladder_rows
-        dev_lad = self._ladder_arg(host_args, lad_host)
+        with obstrace.span("backend.upload"):
+            if self.arena is not None:
+                args = self.arena.adopt(host_args, prov)
+            else:
+                args = _device_args(host_args, prov, ledger=self.ledger)
+            Sp = int(host_args[0].shape[0])
+            lad_host = np.full((Sp, Lp), -1, np.int32)
+            lad_host[:S_orig] = ladder_rows
+            dev_lad = self._ladder_arg(host_args, lad_host)
         M0 = initial_claim_bucket(n_orig, self.max_claims)
-        flat_dev, unpack, _ = self._ladder_kernel(enc2, dev_lad, args, M0,
-                                                  n_orig)
+        obstrace.annotate(ladder=True, ladder_rungs=int(Lmax),
+                          claim_bucket=M0)
+        with obstrace.span("backend.dispatch"):
+            flat_dev, unpack, _ = self._ladder_kernel(enc2, dev_lad, args, M0,
+                                                      n_orig)
         return {
             "enc": enc2,
             "args": args,
@@ -1637,22 +1646,24 @@ class TPUSolver(Solver):
             # single-device path below — trivially decision-identical
             sharded = self._sharded_solve_async(enc, host_args, dims, prov)
             if sharded is not None:
+                obstrace.annotate(sharded=True)
                 return sharded
         # transfer ledger window: every host→device byte of this solve
         # (arena packed upload OR per-array conversions) and every fetched
         # result byte lands in one per-solve record (solver/arena.py)
         self.ledger.begin_solve()
-        if self.arena is not None:
-            # arena_corrupt chaos site: fires BEFORE residency is trusted —
-            # the raised ArenaCorrupt classifies as a device error, the
-            # resilience layer invalidates the arena, and the replay (or the
-            # re-routed owner) pays one full re-adoption upload
-            faults.check("solver.arena_corrupt", tag=self.fault_tag)
-            # device-resident arena: only stale entries upload, packed into
-            # ONE buffer; an exact encode-cache hit uploads nothing at all
-            args = self.arena.adopt(host_args, prov)
-        else:
-            args = _device_args(host_args, prov, ledger=self.ledger)
+        with obstrace.span("backend.upload"):
+            if self.arena is not None:
+                # arena_corrupt chaos site: fires BEFORE residency is trusted —
+                # the raised ArenaCorrupt classifies as a device error, the
+                # resilience layer invalidates the arena, and the replay (or
+                # the re-routed owner) pays one full re-adoption upload
+                faults.check("solver.arena_corrupt", tag=self.fault_tag)
+                # device-resident arena: only stale entries upload, packed
+                # into ONE buffer; an exact encode-cache hit uploads nothing
+                args = self.arena.adopt(host_args, prov)
+            else:
+                args = _device_args(host_args, prov, ledger=self.ledger)
         S, E, T, G = dims["S"], dims["E"], dims["T"], dims["G"]
         Z, C = dims["Z"], dims["C"]
         total_pods = int(sum(len(p) for p in enc.group_pods))
@@ -1664,123 +1675,134 @@ class TPUSolver(Solver):
         # same resident device args — no re-upload.
         M0 = initial_claim_bucket(total_pods, self.max_claims)
         plan = self._plan_resume(enc, host_args, M0, S)
-        if plan is not None:
-            flat_dev, unpack, out, ring = self._dispatch_resume(
-                enc, args, host_args, plan, M0, S, total_pods=total_pods
-            )
-        else:
-            flat_dev, unpack, out, ring = self._dispatch(
-                enc, args, M0, harvest=True, total_pods=total_pods
-            )
+        obstrace.annotate(claim_bucket=M0, total_pods=total_pods,
+                          resume=plan is not None,
+                          resume_k=plan["k"] if plan is not None else 0)
+        with obstrace.span("backend.dispatch"):
+            if plan is not None:
+                flat_dev, unpack, out, ring = self._dispatch_resume(
+                    enc, args, host_args, plan, M0, S, total_pods=total_pods
+                )
+            else:
+                flat_dev, unpack, out, ring = self._dispatch(
+                    enc, args, M0, harvest=True, total_pods=total_pods
+                )
 
         def finish() -> Optional[SolverResult]:
             try:
                 M = M0
                 cur_plan, cur_out, cur_ring = plan, out, ring
-                flat, up = np.asarray(flat_dev), unpack
-                self.ledger.record_fetch(flat.nbytes)
-                while True:
-                    f = up(flat)
-                    used = int(f["used"])
-                    if used < M:
-                        break
-                    if cur_plan is not None:
-                        # a resumed dispatch saturated its claim slots; the
-                        # donor record's M no longer matches, so the retry
-                        # replays COLD at the doubled bucket (still against
-                        # the arena-resident args — no re-upload)
-                        cur_plan = None
-                    if M >= self.max_claims:
-                        return None  # true overflow — replay on fallback
-                    M = min(M * 2, self.max_claims)
-                    fd, up, cur_out, cur_ring = self._dispatch(
-                        enc, args, M, harvest=True, total_pods=total_pods
-                    )
-                    flat = np.asarray(fd)
+                with obstrace.span("backend.fetch"):
+                    flat, up = np.asarray(flat_dev), unpack
                     self.ledger.record_fetch(flat.nbytes)
+                    while True:
+                        f = up(flat)
+                        used = int(f["used"])
+                        if used < M:
+                            break
+                        if cur_plan is not None:
+                            # a resumed dispatch saturated its claim slots;
+                            # the donor record's M no longer matches, so the
+                            # retry replays COLD at the doubled bucket (still
+                            # against the arena-resident args — no re-upload)
+                            cur_plan = None
+                        if M >= self.max_claims:
+                            return None  # true overflow — replay on fallback
+                        M = min(M * 2, self.max_claims)
+                        fd, up, cur_out, cur_ring = self._dispatch(
+                            enc, args, M, harvest=True, total_pods=total_pods
+                        )
+                        flat = np.asarray(fd)
+                        self.ledger.record_fetch(flat.nbytes)
+                    obstrace.annotate(fetch_bytes=int(flat.nbytes),
+                                      claim_bucket_final=M)
                 faults.check("solver.decode")
-                c_mask = _unpack_words(f["c_mask_words"], T)
-                c_zone, c_ct = unpack_zc_bits(f["c_zc_bits"], Z, C)
-                c_gmask = _unpack_gmask(f["c_gbits"], G)
-                if "entries" in f:
-                    # delta-decoded fetch: the take tables never crossed the
-                    # link. A resumed dispatch splices the donor's recorded
-                    # dense prefix rows in as triples (suffix runs shift by
-                    # k); decode_delta rebuilds decode()'s exact codes
-                    # stream from the merged entry set.
-                    Ep_ = f["Ep"]
+                with obstrace.span("backend.decode"):
+                    c_mask = _unpack_words(f["c_mask_words"], T)
+                    c_zone, c_ct = unpack_zc_bits(f["c_zc_bits"], Z, C)
+                    c_gmask = _unpack_gmask(f["c_gbits"], G)
+                    if "entries" in f:
+                        # delta-decoded fetch: the take tables never crossed
+                        # the link. A resumed dispatch splices the donor's
+                        # recorded dense prefix rows in as triples (suffix
+                        # runs shift by k); decode_delta rebuilds decode()'s
+                        # exact codes stream from the merged entry set.
+                        Ep_ = f["Ep"]
+                        if cur_plan is not None:
+                            with obstrace.span("backend.stitch"):
+                                k = cur_plan["k"]
+                                rec = cur_plan["rec"]
+                                pre = _entries_from_dense(
+                                    rec["take_e"][:k], rec["take_c"][:k], Ep_
+                                )
+                                suf = f["entries"].astype(np.int64)
+                                suf[:, 0] += k
+                                entries_p = np.concatenate([pre, suf])
+                                leftover_p = np.concatenate(
+                                    [rec["leftover"][:k], f["leftover"][: S - k]]
+                                )
+                            self.stats["resume_solves"] += 1
+                            self.stats["resume_runs_skipped"] += k
+                            SOLVER_RUNS_SKIPPED.inc(k)
+                        else:
+                            entries_p = f["entries"]
+                            leftover_p = f["leftover"][:S]
+                        c_cum = _claim_cum_from_entries(
+                            enc, entries_p, f["c_pool"], Ep_, M
+                        )
+                        res = decode_delta(enc, entries_p, leftover_p, E, Ep_,
+                                           c_mask, c_zone, c_ct, f["c_pool"],
+                                           c_gmask, c_cum, used)
+                        if self.resume:
+                            # the resume donor record stays DENSE (its
+                            # stitching contract predates the delta path);
+                            # reconstruct the rows host-side — same bytes a
+                            # dense fetch carries
+                            take_e_p, take_c_p = _dense_from_entries(
+                                entries_p, S, Ep_, M
+                            )
+                            self._record_checkpoint(
+                                enc, host_args, M, S, cur_plan, cur_out,
+                                cur_ring, take_e_p, take_c_p, leftover_p,
+                            )
+                        SOLVER_RESUME_HIT_RATE.set(self.resume_hit_rate)
+                        return res
                     if cur_plan is not None:
-                        k = cur_plan["k"]
-                        rec = cur_plan["rec"]
-                        pre = _entries_from_dense(
-                            rec["take_e"][:k], rec["take_c"][:k], Ep_
-                        )
-                        suf = f["entries"].astype(np.int64)
-                        suf[:, 0] += k
-                        entries_p = np.concatenate([pre, suf])
-                        leftover_p = np.concatenate(
-                            [rec["leftover"][:k], f["leftover"][: S - k]]
-                        )
+                        # suffix dispatch: rows [0:k] of the full take tables
+                        # are the donor record's (decision-identical by
+                        # construction — the checkpoint IS the carry after
+                        # those rows), rows [k:S] come from this dispatch.
+                        # State outputs (c_*) need no stitching: the suffix's
+                        # final state equals a full replay's.
+                        with obstrace.span("backend.stitch"):
+                            k = cur_plan["k"]
+                            rec = cur_plan["rec"]
+                            take_e_p = np.concatenate(
+                                [rec["take_e"][:k], f["take_e"][: S - k]]
+                            )
+                            take_c_p = np.concatenate(
+                                [rec["take_c"][:k], f["take_c"][: S - k]]
+                            )
+                            leftover_p = np.concatenate(
+                                [rec["leftover"][:k], f["leftover"][: S - k]]
+                            )
                         self.stats["resume_solves"] += 1
                         self.stats["resume_runs_skipped"] += k
                         SOLVER_RUNS_SKIPPED.inc(k)
                     else:
-                        entries_p = f["entries"]
+                        take_e_p = f["take_e"][:S]
+                        take_c_p = f["take_c"][:S]
                         leftover_p = f["leftover"][:S]
-                    c_cum = _claim_cum_from_entries(
-                        enc, entries_p, f["c_pool"], Ep_, M
+                    res = decode(enc, take_e_p[:, :E], take_c_p,
+                                 leftover_p, c_mask,
+                                 c_zone, c_ct, f["c_pool"], c_gmask,
+                                 f["c_cum"], used)
+                    self._record_checkpoint(
+                        enc, host_args, M, S, cur_plan, cur_out, cur_ring,
+                        take_e_p, take_c_p, leftover_p,
                     )
-                    res = decode_delta(enc, entries_p, leftover_p, E, Ep_,
-                                       c_mask, c_zone, c_ct, f["c_pool"],
-                                       c_gmask, c_cum, used)
-                    if self.resume:
-                        # the resume donor record stays DENSE (its stitching
-                        # contract predates the delta path); reconstruct the
-                        # rows host-side — same bytes a dense fetch carries
-                        take_e_p, take_c_p = _dense_from_entries(
-                            entries_p, S, Ep_, M
-                        )
-                        self._record_checkpoint(
-                            enc, host_args, M, S, cur_plan, cur_out,
-                            cur_ring, take_e_p, take_c_p, leftover_p,
-                        )
                     SOLVER_RESUME_HIT_RATE.set(self.resume_hit_rate)
                     return res
-                if cur_plan is not None:
-                    # suffix dispatch: rows [0:k] of the full take tables are
-                    # the donor record's (decision-identical by construction —
-                    # the checkpoint IS the carry after those rows), rows
-                    # [k:S] come from this dispatch. State outputs (c_*) need
-                    # no stitching: the suffix's final state equals a full
-                    # replay's.
-                    k = cur_plan["k"]
-                    rec = cur_plan["rec"]
-                    take_e_p = np.concatenate(
-                        [rec["take_e"][:k], f["take_e"][: S - k]]
-                    )
-                    take_c_p = np.concatenate(
-                        [rec["take_c"][:k], f["take_c"][: S - k]]
-                    )
-                    leftover_p = np.concatenate(
-                        [rec["leftover"][:k], f["leftover"][: S - k]]
-                    )
-                    self.stats["resume_solves"] += 1
-                    self.stats["resume_runs_skipped"] += k
-                    SOLVER_RUNS_SKIPPED.inc(k)
-                else:
-                    take_e_p = f["take_e"][:S]
-                    take_c_p = f["take_c"][:S]
-                    leftover_p = f["leftover"][:S]
-                res = decode(enc, take_e_p[:, :E], take_c_p,
-                             leftover_p, c_mask,
-                             c_zone, c_ct, f["c_pool"], c_gmask, f["c_cum"],
-                             used)
-                self._record_checkpoint(
-                    enc, host_args, M, S, cur_plan, cur_out, cur_ring,
-                    take_e_p, take_c_p, leftover_p,
-                )
-                SOLVER_RESUME_HIT_RATE.set(self.resume_hit_rate)
-                return res
             finally:
                 self.ledger.end_solve()
 

@@ -68,6 +68,7 @@ from ..metrics.registry import (
     FLEET_HEALTHY,
     FLEET_REQUEUED,
 )
+from ..obs import trace as obstrace
 from .backend import ReferenceSolver, Solver
 from .pipeline import (
     DISRUPTION,
@@ -132,12 +133,17 @@ class _FleetBreaker(CircuitBreaker):
     def _export(self) -> None:  # noqa: D102 — deliberate no-op
         pass
 
+    def _on_open(self, failures: int) -> None:  # noqa: D102 — deliberate no-op
+        # the fence path writes its own flight record (reason=fleet_fence)
+        # with richer tags; a second breaker_open dump would be noise
+        pass
+
 
 class _FleetEntry:
     """One logical fleet request across any number of owner re-routes."""
 
     __slots__ = ("ticket", "inp", "fn", "kind", "rev", "owner", "owner_ticket",
-                 "requeues")
+                 "requeues", "trace")
 
     def __init__(self, ticket: SolveTicket, inp=None, fn=None,
                  kind: str = PROVISIONING, rev=None):
@@ -149,6 +155,25 @@ class _FleetEntry:
         self.owner: Optional["FleetOwner"] = None
         self.owner_ticket: Optional[SolveTicket] = None
         self.requeues = 0
+        # one trace per LOGICAL request: it survives owner re-routes (each
+        # placement attaches it, so the new owner's spans join the same tree)
+        self.trace = None
+
+
+def _mint_fleet_trace(entry: _FleetEntry) -> None:
+    """Mint (or adopt, when the provisioner already opened one on this
+    thread) the trace for a logical fleet request. When owned here, its
+    completion is tied to FLEET-ticket delivery — owner tickets come and go
+    across re-routes without finishing the tree."""
+    tr, owned = obstrace.adopt_or_begin(entry.kind)
+    if tr is None:
+        return
+    entry.trace = tr
+    entry.ticket.solve_id = tr.solve_id
+    if owned:
+        entry.ticket.on_done(
+            lambda t, _tr=tr: obstrace.finish(_tr, obstrace.status_of(t.error()))
+        )
 
 
 class FleetOwner:
@@ -249,6 +274,7 @@ class SolverFleet:
                 raise ServiceStopped("solver fleet is closed")
         ticket = SolveTicket(kind, rev=rev)
         entry = _FleetEntry(ticket, inp=inp, kind=kind, rev=rev)
+        _mint_fleet_trace(entry)
         with self._lock:
             self._open.add(entry)
             self.fleet_stats["fleet_submitted"] += 1
@@ -261,6 +287,7 @@ class SolverFleet:
                 raise ServiceStopped("solver fleet is closed")
         ticket = SolveTicket(kind)
         entry = _FleetEntry(ticket, fn=dispatch_fn, kind=kind)
+        _mint_fleet_trace(entry)
         with self._lock:
             self._open.add(entry)
             self.fleet_stats["fleet_submitted"] += 1
@@ -291,11 +318,17 @@ class SolverFleet:
                 self._degrade(entry)
                 return
             try:
-                if entry.fn is not None:
-                    ot = owner.service.submit_fn(entry.fn, kind=entry.kind)
-                else:
-                    ot = owner.service.submit(entry.inp, kind=entry.kind,
-                                              rev=entry.rev)
+                # attach the logical request's trace so the owner's service
+                # ADOPTS it (pipeline._mint_trace) instead of minting anew —
+                # re-routes keep extending one tree
+                with obstrace.attached(entry.trace):
+                    obstrace.event("fleet.place", owner=owner.name,
+                                   requeues=entry.requeues)
+                    if entry.fn is not None:
+                        ot = owner.service.submit_fn(entry.fn, kind=entry.kind)
+                    else:
+                        ot = owner.service.submit(entry.inp, kind=entry.kind,
+                                                  rev=entry.rev)
             except ServiceStopped:
                 continue  # owner fenced between pick and submit; re-pick
             with self._lock:
@@ -341,7 +374,8 @@ class SolverFleet:
         with self._lock:
             self.fleet_stats["oracle_degraded"] += 1
         try:
-            res = self._oracle.solve(entry.inp)
+            with obstrace.attached(entry.trace), obstrace.span("fleet.oracle"):
+                res = self._oracle.solve(entry.inp)
         except Exception as e:  # noqa: BLE001 — delivered to the caller
             self._resolve(entry, error=e)
             return
@@ -349,6 +383,15 @@ class SolverFleet:
 
     def _reroute(self, entry: _FleetEntry) -> None:
         entry.requeues += 1
+        old = entry.owner.name if entry.owner is not None else None
+        if entry.trace is not None:
+            # trace-level provenance: the span tree continues on a new owner;
+            # the link records which owner's fence orphaned it
+            entry.trace.add_link("requeued_from", old)
+        log.info(
+            "solver fleet: requeue #%d (from %s)", entry.requeues, old,
+            extra={"solve_id": entry.ticket.solve_id},
+        )
         with self._lock:
             self.fleet_stats["requeued"] += 1
         self._place(entry, requeued=True)
@@ -414,6 +457,11 @@ class SolverFleet:
             owner.name, reason, len(survivors),
         )
         self._export_health()
+        # flight-record BEFORE stop(): stop force-resolves the wedged solve's
+        # ticket, which finishes (and thereby closes) its trace — the dump
+        # must capture the partial span tree while it is still partial
+        obstrace.dump("fleet_fence", owner=owner.name, fence_reason=reason,
+                      fence_count=owner.fence_count, requeued=len(survivors))
         # stop() resolves every ticket the owner's service ever issued:
         # queued fail fast, in-flight get the drain window, wedged ones are
         # force-resolved (ServiceStopped) — nothing can strand
@@ -524,9 +572,12 @@ class SolverFleet:
                 break
             with self._lock:
                 fenced = owner.fenced
-            verdicts[owner.name] = (
+            t0 = time.monotonic()
+            verdict = (
                 self._probe_fenced(owner) if fenced else self._probe_healthy(owner)
             )
+            obstrace.note_canary(owner.name, verdict, time.monotonic() - t0)
+            verdicts[owner.name] = verdict
         return verdicts
 
     def _monitor_loop(self) -> None:

@@ -51,6 +51,7 @@ from ..metrics.registry import (
     SOLVE_PIPELINE_DEPTH,
     SOLVE_PIPELINE_OCCUPANCY,
 )
+from ..obs import trace as obstrace
 
 PROVISIONING = "provisioning"
 DISRUPTION = "disruption"
@@ -83,6 +84,9 @@ class SolveTicket:
     def __init__(self, kind: str, rev=None):
         self.kind = kind
         self.rev = rev
+        # tracing correlation token, minted (or adopted from the submitting
+        # layer's trace) at ticket creation; None when tracing is off
+        self.solve_id: Optional[str] = None
         self._event = threading.Event()
         self._lock = threading.Lock()
         self._result = None
@@ -135,13 +139,33 @@ class SolveTicket:
 
 
 class _Request:
-    __slots__ = ("ticket", "inp", "fn", "rev")
+    __slots__ = ("ticket", "inp", "fn", "rev", "trace", "queue_span")
 
-    def __init__(self, ticket: SolveTicket, inp=None, fn=None, rev=None):
+    def __init__(self, ticket: SolveTicket, inp=None, fn=None, rev=None,
+                 trace=None, queue_span=None):
         self.ticket = ticket
         self.inp = inp
         self.fn = fn  # generic device work: fn() dispatches, returns finish()
         self.rev = rev
+        self.trace = trace  # obs.trace.Trace carried across both workers
+        self.queue_span = queue_span  # started at submit, ended at dispatch pop
+
+
+def _mint_trace(ticket: SolveTicket, kind: str):
+    """Adopt the submitting thread's trace (fleet/provisioner minted it and
+    owns completion) or mint one owned by this service: its completion is
+    tied to ticket delivery. Returns (trace, queue_span)."""
+    tr, owned = obstrace.adopt_or_begin(kind)
+    if tr is None:
+        return None, None
+    ticket.solve_id = tr.solve_id
+    if owned:
+        ticket.on_done(
+            lambda t, _tr=tr: obstrace.finish(_tr, obstrace.status_of(t.error()))
+        )
+    # cross-thread span: opens on the submitting thread, closed by the
+    # dispatcher when it pops the request — queue wait is its own stage
+    return tr, tr.start_span("pipeline.queue", parent=tr.root)
 
 
 class SolveService:
@@ -197,6 +221,9 @@ class SolveService:
         with self._cv:
             if self._stopped:
                 raise ServiceStopped("solve service is closed")
+            # mint AFTER the stopped check: a rejected submit must not leak
+            # an owned trace into the active set (its ticket never delivers)
+            tr, qspan = _mint_trace(ticket, kind)
             if kind == PROVISIONING:
                 q = self._pending[PROVISIONING]
                 while q:
@@ -204,7 +231,9 @@ class SolveService:
                     self.stats["coalesced"] += 1
                     SOLVE_COALESCED.inc(kind=kind)
                     stale.ticket._deliver(error=Superseded(by=ticket))
-            self._pending[kind].append(_Request(ticket, inp=inp, rev=rev))
+            self._pending[kind].append(
+                _Request(ticket, inp=inp, rev=rev, trace=tr, queue_span=qspan)
+            )
             self.stats["submitted"] += 1
             self._cv.notify_all()
         return ticket
@@ -220,7 +249,10 @@ class SolveService:
         with self._cv:
             if self._stopped:
                 raise ServiceStopped("solve service is closed")
-            self._pending[kind].append(_Request(ticket, fn=dispatch_fn))
+            tr, qspan = _mint_trace(ticket, kind)
+            self._pending[kind].append(
+                _Request(ticket, fn=dispatch_fn, trace=tr, queue_span=qspan)
+            )
             self.stats["submitted"] += 1
             self._cv.notify_all()
         return ticket
@@ -321,7 +353,10 @@ class SolveService:
             self._stopped = True
             for q in self._pending.values():
                 while q:
-                    if q.popleft().ticket._deliver(error=ServiceStopped(
+                    req = q.popleft()
+                    if req.queue_span is not None:
+                        req.queue_span.end("stopped")
+                    if req.ticket._deliver(error=ServiceStopped(
                         "solve service stopped before this request dispatched"
                     )):
                         self.stats["failed"] += 1
@@ -382,21 +417,25 @@ class SolveService:
                 req = self._next_request_locked()
                 self._dispatching += 1
                 self._active.add(req.ticket)
+            if req.queue_span is not None:
+                req.queue_span.end()
             # encode + dispatch OUTSIDE the lock: this is the stage-1 host
             # work that overlaps stage-2 device compute and stage-3 decode
             try:
-                if req.fn is not None:
-                    finish = req.fn()
-                else:
-                    solve_async = getattr(self.solver, "solve_async", None)
-                    if solve_async is not None:
-                        finish = solve_async(req.inp).result
+                with obstrace.attached(req.trace), \
+                        obstrace.span("pipeline.dispatch"):
+                    if req.fn is not None:
+                        finish = req.fn()
                     else:
-                        # backend without an async seam (reference oracle):
-                        # the whole solve runs at decode, stage overlap
-                        # degrades gracefully to FIFO
-                        inp = req.inp
-                        finish = lambda _inp=inp: self.solver.solve(_inp)
+                        solve_async = getattr(self.solver, "solve_async", None)
+                        if solve_async is not None:
+                            finish = solve_async(req.inp).result
+                        else:
+                            # backend without an async seam (reference
+                            # oracle): the whole solve runs at decode, stage
+                            # overlap degrades gracefully to FIFO
+                            inp = req.inp
+                            finish = lambda _inp=inp: self.solver.solve(_inp)
             except BaseException as e:  # noqa: BLE001 — delivered to caller
                 with self._cv:
                     self.stats["failed"] += 1
@@ -435,7 +474,9 @@ class SolveService:
                 SOLVE_PIPELINE_DEPTH.set(len(self._inflight))
                 self._cv.notify_all()  # a dispatch slot just freed
             try:
-                result = finish()
+                with obstrace.attached(req.trace), \
+                        obstrace.span("pipeline.decode"):
+                    result = finish()
             except BaseException as e:  # noqa: BLE001 — delivered to caller
                 with self._cv:
                     self.stats["failed"] += 1

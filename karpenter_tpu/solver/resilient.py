@@ -53,6 +53,7 @@ from ..metrics.registry import (
     SOLVER_DEADLINE_LEAKED_THREADS,
     SOLVER_FALLBACK,
 )
+from ..obs import trace as obstrace
 from ..utils.resources import PODS
 from .backend import AsyncSolve, ReferenceSolver, Solver
 from .encode import quantize_input
@@ -249,8 +250,10 @@ class CircuitBreaker:
             self._export()
 
     def record_failure(self) -> None:
+        opened = False
         with self._lock:
             self._consecutive_failures += 1
+            failures = self._consecutive_failures
             if self._state == HALF_OPEN or (
                 self._state == CLOSED
                 and self._consecutive_failures >= self.threshold
@@ -262,9 +265,19 @@ class CircuitBreaker:
                         "probe in %.0fs",
                         self._consecutive_failures, self.probe_interval_s,
                     )
+                    opened = True
                 self._state = OPEN
                 self._opened_at = self.clock()
             self._export()
+        if opened:
+            # outside the lock: the hook writes a flight-recorder file
+            self._on_open(failures)
+
+    def _on_open(self, failures: int) -> None:
+        """CLOSED/HALF_OPEN -> OPEN transition hook: the device path is
+        about to be bypassed entirely — flight-record the evidence now."""
+        obstrace.dump("breaker_open", failures=failures,
+                      threshold=self.threshold)
 
 
 # -- the wrapper --------------------------------------------------------------
@@ -347,6 +360,7 @@ class ResilientSolver(Solver):
         if not self.breaker.allow():
             self.resilient_stats["breaker_short_circuits"] += 1
             SOLVER_FALLBACK.inc(reason="breaker_open")
+            obstrace.annotate(breaker="open", breaker_short_circuit=True)
             return AsyncSolve(lambda: self._fallback_solve(inp))
         self.resilient_stats["device_path"] += 1
         t0 = self.clock()
@@ -372,11 +386,19 @@ class ResilientSolver(Solver):
                     res = self._wait(lambda: self.inner.solve(inp), t0)
             except Exception as e:  # noqa: BLE001 — classified
                 return self._handle_failure(inp, e)
-            violations = check_invariants(quantize_input(inp), res)
+            with obstrace.span("resilient.gate"):
+                violations = check_invariants(quantize_input(inp), res)
             if violations:
                 self.resilient_stats["gate_rejections"] += 1
                 self.breaker.record_failure()
                 SOLVER_FALLBACK.inc(reason="invariant_gate")
+                obstrace.annotate(gate_rejected=True,
+                                  gate_violations=len(violations))
+                obstrace.dump(
+                    "invariant_gate", backend=type(self.inner).__name__,
+                    violations=len(violations), first=violations[0],
+                    solve_id=obstrace.current_solve_id(),
+                )
                 log.error(
                     "solver invariant gate REJECTED a %s result (%d "
                     "violations, e.g. %s) — replaying on fallback chain",
@@ -473,6 +495,7 @@ class ResilientSolver(Solver):
 
     def _handle_failure(self, inp, exc: BaseException):
         reason = classify_failure(exc)
+        obstrace.annotate(failure_class=reason, failure=type(exc).__name__)
         self.breaker.record_failure()
         SOLVER_FALLBACK.inc(reason=reason)
         log.warning(
@@ -496,22 +519,25 @@ class ResilientSolver(Solver):
             inv()
         self.resilient_stats["fallback"] += 1
         last_violations: List[str] = []
-        for fb in self.fallbacks:
-            try:
-                res = fb.solve(inp)
-            except Exception as e:  # noqa: BLE001 — try the next rung
-                SOLVER_FALLBACK.inc(reason="fallback_error")
-                log.error("fallback %s failed: %s", type(fb).__name__, e)
-                continue
-            last_violations = check_invariants(quantize_input(inp), res)
-            if not last_violations:
-                return res
-            SOLVER_FALLBACK.inc(reason="invariant_gate")
-            log.error(
-                "invariant gate rejected fallback %s result (%s)",
-                type(fb).__name__, last_violations[0],
+        with obstrace.span("resilient.fallback"):
+            for fb in self.fallbacks:
+                obstrace.annotate(rung=type(fb).__name__)
+                try:
+                    res = fb.solve(inp)
+                except Exception as e:  # noqa: BLE001 — try the next rung
+                    SOLVER_FALLBACK.inc(reason="fallback_error")
+                    log.error("fallback %s failed: %s", type(fb).__name__, e)
+                    continue
+                last_violations = check_invariants(quantize_input(inp), res)
+                if not last_violations:
+                    return res
+                SOLVER_FALLBACK.inc(reason="invariant_gate")
+                log.error(
+                    "invariant gate rejected fallback %s result (%s)",
+                    type(fb).__name__, last_violations[0],
+                )
+            raise InvariantViolation(
+                "every rung of the fallback chain failed or violated invariants: "
+                + (last_violations[0] if last_violations
+                   else "no rung produced a result")
             )
-        raise InvariantViolation(
-            "every rung of the fallback chain failed or violated invariants: "
-            + (last_violations[0] if last_violations else "no rung produced a result")
-        )

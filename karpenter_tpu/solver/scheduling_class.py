@@ -67,6 +67,7 @@ from ..provisioning.scheduler import (
     SolverResult,
     ffd_sort,
 )
+from ..obs import trace as obstrace
 from ..scheduling.requirements import Requirements
 from ..utils.resources import PODS
 
@@ -314,7 +315,8 @@ class ClassAwareSolver:
     def solve(self, inp: SolverInput) -> SolverResult:
         if not self._engaged(inp):
             return self.inner.solve(inp)
-        return self._solve_class(inp)
+        with obstrace.span("class.solve"):
+            return self._solve_class(inp)
 
     def solve_async(self, inp: SolverInput):
         if not self._engaged(inp):
@@ -322,7 +324,13 @@ class ClassAwareSolver:
             if sa is not None:
                 return sa(inp)
             return _Deferred(lambda: self.inner.solve(inp))
-        return _Deferred(lambda: self._solve_class(inp))
+
+        def run():
+            # deferred: runs on the decoder thread, inside its attached trace
+            with obstrace.span("class.solve"):
+                return self._solve_class(inp)
+
+        return _Deferred(run)
 
     # -- class passes --------------------------------------------------------
 
@@ -411,6 +419,10 @@ class ClassAwareSolver:
         if inversions:
             self.class_stats["priority_inversions"] += inversions
             SOLVER_PRIORITY_INVERSIONS.inc(inversions)
+        obstrace.annotate(
+            gangs_unschedulable=len(set(gangs_unschedulable)),
+            preemptions=len(evictions),
+        )
         return dataclasses.replace(
             res,
             errors=errors,

@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Tuple
 from ..api import wellknown as wk
 from ..api.objects import Node, NodeClaim, Pod
 from ..controllers import store as st
+from ..metrics.registry import CLUSTER_STATE_NODE_COUNT
 from ..provisioning.scheduler import BoundPodRef, ExistingNode
 from ..utils.resources import PODS, Resources
 
@@ -162,6 +163,7 @@ class Cluster:
         for name, n in nodes.items():
             if name not in claimed_nodes:
                 out.append(StateNode(node=n, claim=None))
+        CLUSTER_STATE_NODE_COUNT.set(float(len(out)))
         return out
 
     def bound_pods(self) -> Dict[str, List[Pod]]:

@@ -1,0 +1,84 @@
+"""Static metrics-drift check: code and registry cannot diverge silently.
+
+Two directions:
+- every `karpenter_*` series literal mentioned anywhere in the package
+  must be a REGISTERED series (or a documented allowance) — a typo'd or
+  renamed metric name in a log line, docstring, or dashboard hint rots
+  quietly otherwise;
+- every registered series must be REFERENCED outside registry.py — a
+  metric nobody sets/increments is a dead series that dashboards will
+  chart as flatlines forever (the bug class that left
+  karpenter_cluster_state_node_count dark for five PRs).
+"""
+
+import pathlib
+import re
+
+from karpenter_tpu.metrics import registry as reg
+
+PKG = pathlib.Path(reg.__file__).resolve().parents[1]  # karpenter_tpu/
+LITERAL = re.compile(r"\bkarpenter_[a-z0-9_]+\b")
+
+# Non-series mentions the literal scan is allowed to hit:
+ALLOWED = {
+    # the package's own name (logger names, module docstrings)
+    "karpenter_tpu",
+    # reference metric we intentionally do NOT export: the in-process
+    # store is synced by construction (state/cluster.py module docstring)
+    "karpenter_cluster_state_synced",
+}
+# exposition-format suffixes a literal may carry on a registered base name
+SUFFIXES = ("_bucket", "_sum", "_count", "_total")
+
+
+def _package_sources():
+    for p in sorted(PKG.rglob("*.py")):
+        if p.name == "registry.py":
+            continue
+        yield p, p.read_text()
+
+
+def test_every_metric_literal_is_registered():
+    registered = {m.name for m in reg.REGISTRY.metrics}
+    bad = []
+    for path, src in _package_sources():
+        for lit in set(LITERAL.findall(src)):
+            if lit in registered or lit in ALLOWED:
+                continue
+            base = next((lit[: -len(s)] for s in SUFFIXES
+                         if lit.endswith(s) and lit[: -len(s)] in registered),
+                        None)
+            if base is not None:
+                continue
+            # doc-style prefix mention ("karpenter_tpu_solver_upload_*")
+            if lit.endswith("_") and any(n.startswith(lit) for n in registered):
+                continue
+            bad.append(f"{path.relative_to(PKG.parent)}: {lit}")
+    assert not bad, "unregistered metric literals:\n" + "\n".join(bad)
+
+
+def test_no_dead_series():
+    """Every registered metric's binding name appears in at least one
+    module outside registry.py (the code references metrics through the
+    registry's module-level bindings, so a binding nobody imports is a
+    series nobody feeds)."""
+    bindings = {
+        var: m.name
+        for var, m in vars(reg).items()
+        if isinstance(m, reg._Metric)
+    }
+    # every registered metric object must have a module-level binding —
+    # an anonymous registration would be invisible to this check
+    bound = set(id(m) for m in vars(reg).values() if isinstance(m, reg._Metric))
+    unbound = [m.name for m in reg.REGISTRY.metrics if id(m) not in bound]
+    assert not unbound, f"registered without a module binding: {unbound}"
+
+    corpus = "\n".join(src for _, src in _package_sources())
+    dead = [f"{var} ({name})" for var, name in bindings.items()
+            if var not in corpus]
+    assert not dead, "dead series (registered, never referenced):\n" + "\n".join(dead)
+
+
+def test_registered_names_unique():
+    names = [m.name for m in reg.REGISTRY.metrics]
+    assert len(names) == len(set(names)), "duplicate series registered"

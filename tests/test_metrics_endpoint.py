@@ -1,0 +1,113 @@
+"""Operator endpoint conformance: /metrics exposition format and the
+/debug/trace Chrome-trace export.
+
+The /metrics checks pin the Prometheus text-format contract a scraper
+relies on: the versioned content-type, HELP/TYPE preceding every
+series' samples, one TYPE per series, and sample names that belong to
+the declared series (histogram _bucket/_sum/_count included). The
+/debug/trace checks pin what Perfetto needs to load the dump: JSON
+content-type, a traceEvents list, and complete ("X") events carrying
+the solve_id correlation args.
+"""
+
+import json
+import re
+import urllib.error
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.metrics.registry import REGISTRY, SOLVER_STAGE_SECONDS
+from karpenter_tpu.obs import trace as obstrace
+from karpenter_tpu.operator.__main__ import serve_endpoints
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = serve_endpoints(0, 0, enable_profiling=False)
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10
+        ) as r:
+            return r.status, r.headers.get("Content-Type", ""), r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, "", ""
+
+
+def test_metrics_content_type_and_structure(server):
+    SOLVER_STAGE_SECONDS.observe(0.01, stage="backend.encode")  # non-empty
+    status, ctype, body = _get(server, "/metrics")
+    assert status == 200
+    assert ctype == "text/plain; version=0.0.4"
+    assert body.endswith("\n")
+
+    help_seen, type_seen, current = set(), {}, None
+    for line in body.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            name = line.split()[2]
+            help_seen.add(name)
+            current = None
+        elif line.startswith("# TYPE "):
+            _, _, name, kind = line.split(None, 3)
+            assert kind in ("counter", "gauge", "histogram"), line
+            assert name not in type_seen, f"duplicate TYPE for {name}"
+            assert name in help_seen, f"TYPE before HELP for {name}"
+            type_seen[name] = kind
+            current = name
+        else:
+            m = re.match(r"([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})? [^ ]+$", line)
+            assert m, f"malformed sample line: {line!r}"
+            sample = m.group(1)
+            assert current is not None, f"sample before any TYPE: {line!r}"
+            if type_seen[current] == "histogram":
+                assert sample in (
+                    current + "_bucket", current + "_sum", current + "_count"
+                ), f"sample {sample} outside histogram {current}"
+            else:
+                assert sample == current, (
+                    f"sample {sample} under TYPE {current}"
+                )
+    # every registered series declared a TYPE (samples may be empty, the
+    # HELP/TYPE header must not be)
+    assert type_seen.keys() == {m.name for m in REGISTRY.metrics}
+
+
+def test_debug_trace_endpoint_chrome_loadable(server):
+    obstrace.configure(enabled=True, ring=16)
+    try:
+        tr = obstrace.begin("provisioning")
+        with obstrace.attached(tr):
+            with obstrace.span("pipeline.dispatch"):
+                obstrace.annotate(pending_pods=2)
+        obstrace.finish(tr, "ok")
+        status, ctype, body = _get(server, "/debug/trace?last=5")
+        assert status == 200
+        assert ctype == "application/json"
+        doc = json.loads(body)
+        assert isinstance(doc["traceEvents"], list)
+        solve = [e for e in doc["traceEvents"]
+                 if e.get("name") == "solve"
+                 and e["args"]["solve_id"] == tr.solve_id]
+        assert solve and solve[0]["ph"] == "X" and solve[0]["dur"] >= 0
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert "pipeline.dispatch" in names
+        assert "thread_name" in names  # Perfetto track metadata
+        status, _, _ = _get(server, "/debug/trace?last=bogus")
+        assert status == 400
+    finally:
+        obstrace.configure(enabled=False)
+
+
+def test_healthz_carries_flight_recorder_summary(server):
+    status, ctype, body = _get(server, "/healthz")
+    assert status == 200 and ctype == "application/json"
+    out = json.loads(body)
+    assert out["status"] == "ok"
+    assert "flight_recorder" in out

@@ -1,0 +1,318 @@
+"""End-to-end solve tracing (obs/ — ISSUE 10): span-tree shape, trace
+completeness across the pipeline and fleet layers, flight-recorder dumps
+on fence, and the solve_id-keyed JSON log formatter.
+
+The load-bearing contract: ONE ticket = ONE rooted span tree, no matter
+how many threads (submitter, dispatcher, decoder, fleet watchdog) touched
+the solve, and no orphan spans — every span's parent_id resolves inside
+its own trace. A wedged solve must survive as a PARTIAL tree (open spans,
+fault_site tagged) inside the fence's flight-recorder dump, then finish
+"ok" after the requeue with a requeued_from link naming the fenced owner.
+"""
+
+import glob
+import json
+import logging
+import os
+import random
+import threading
+
+import pytest
+
+from karpenter_tpu import faults
+from karpenter_tpu.obs import trace as obstrace
+from karpenter_tpu.obs.export import chrome_trace
+from karpenter_tpu.obs.logjson import JsonLogFormatter
+from karpenter_tpu.obs.recorder import FlightRecorder
+from karpenter_tpu.solver.backend import ReferenceSolver
+from karpenter_tpu.solver.pipeline import (
+    DISRUPTION,
+    PROVISIONING,
+    SolveService,
+    Superseded,
+)
+
+from tests.test_solver_fleet import TaggedOracle, mkfleet, mkinput
+
+
+@pytest.fixture
+def tracing(tmp_path):
+    """Enabled tracing with a per-test flight recorder; always restores
+    the import-time default (disabled, no recorder) afterwards."""
+    rec = FlightRecorder(dir=str(tmp_path), min_interval_s=0.0)
+    obstrace.configure(enabled=True, ring=128, recorder=rec)
+    try:
+        yield rec
+    finally:
+        obstrace.configure(enabled=False, recorder=None)
+
+
+def _assert_rooted(snap):
+    """One root, every other span's parent_id resolves in-trace."""
+    ids = {sp["span_id"] for sp in snap["spans"]}
+    roots = [sp for sp in snap["spans"] if sp["parent_id"] is None]
+    assert len(roots) == 1, snap
+    assert roots[0]["name"] == "solve"
+    for sp in snap["spans"]:
+        if sp["parent_id"] is not None:
+            assert sp["parent_id"] in ids, f"orphan span {sp}"
+
+
+# ------------------------------------------------------------------ primitives
+
+
+def test_span_tree_basics(tracing):
+    tr = obstrace.begin("provisioning")
+    assert tr.solve_id.startswith("s")
+    with obstrace.attached(tr):
+        assert obstrace.current_solve_id() == tr.solve_id
+        with obstrace.span("outer") as outer:
+            obstrace.annotate(k=1)
+            with obstrace.span("inner"):
+                pass
+            obstrace.event("marker", why="test")
+    tr.add_link("requeued_from", "owner-9")
+    obstrace.finish(tr, "ok")
+    obstrace.finish(tr, "error")  # idempotent: first status wins
+
+    snap = tr.snapshot()
+    _assert_rooted(snap)
+    by_name = {sp["name"]: sp for sp in snap["spans"]}
+    assert by_name["outer"]["parent_id"] == by_name["solve"]["span_id"]
+    assert by_name["inner"]["parent_id"] == by_name["outer"]["span_id"]
+    assert by_name["marker"]["parent_id"] == by_name["outer"]["span_id"]
+    assert by_name["outer"]["attrs"] == {"k": 1}
+    assert snap["links"] == {"requeued_from": ["owner-9"]}
+    assert snap["status"] == "ok" and snap["done"]
+    assert outer.duration_s >= 0
+    assert tr in obstrace.recent()
+    assert tr not in obstrace.active_traces()
+
+
+def test_disabled_and_unattached_paths_are_null():
+    obstrace.configure(enabled=False)
+    assert obstrace.begin("solve") is None
+    with obstrace.span("x") as sp:
+        assert sp is None
+    obstrace.annotate(k=1)  # no-op, no crash
+    obstrace.event("e")
+    obstrace.finish(None)
+    assert obstrace.dump("nothing") is None
+    obstrace.configure(enabled=True)
+    try:
+        # enabled but thread unattached: still the shared null context —
+        # direct solver.solve() calls outside a ticket produce no orphans
+        with obstrace.span("x") as sp:
+            assert sp is None
+        assert obstrace.current_trace() is None
+    finally:
+        obstrace.configure(enabled=False)
+
+
+def test_status_of_maps_ticket_errors():
+    class Superseded(Exception):
+        pass
+
+    class ServiceStopped(Exception):
+        pass
+
+    assert obstrace.status_of(None) == "ok"
+    assert obstrace.status_of(Superseded()) == "superseded"
+    assert obstrace.status_of(ServiceStopped()) == "stopped"
+    assert obstrace.status_of(ValueError("x")) == "error"
+
+
+def test_active_set_bounded_by_eviction(tracing):
+    for _ in range(obstrace._ACTIVE_MAX + 10):
+        obstrace.begin("solve")
+    assert len(obstrace.active_traces()) <= obstrace._ACTIVE_MAX
+    assert any(t.status == "abandoned" for t in obstrace.recent())
+
+
+# ------------------------------------------------------- pipeline completeness
+
+
+def test_single_pipeline_solve_one_rooted_tree(tracing):
+    svc = SolveService(ReferenceSolver(), depth=2)
+    try:
+        tk = svc.submit(mkinput("one"), kind=DISRUPTION)
+        tk.result(timeout=10)
+    finally:
+        svc.close()
+    traces = [t for t in obstrace.recent() if t.solve_id == tk.solve_id]
+    assert len(traces) == 1
+    snap = traces[0].snapshot()
+    _assert_rooted(snap)
+    names = {sp["name"] for sp in snap["spans"]}
+    assert {"pipeline.queue", "pipeline.dispatch", "pipeline.decode"} <= names
+    assert snap["status"] == "ok"
+    # the tree genuinely crossed threads (submit vs dispatcher/decoder)
+    assert len({sp["thread"] for sp in snap["spans"]}) >= 2
+    assert not obstrace.active_traces()
+
+
+def test_randomized_pipeline_fleet_trace_completeness(tracing):
+    """Randomized solves through BOTH layers: every ticket yields exactly
+    one rooted tree, superseded/stopped included, and no trace leaks in
+    the active set once everything resolved."""
+    rng = random.Random(7)
+    svc = SolveService(ReferenceSolver(), depth=2)
+    fleet, _solvers, _clock = mkfleet(size=2)
+    tickets = []
+    try:
+        for i in range(24):
+            inp = mkinput(f"p{i}", cpu=rng.choice(["100m", "250m", "500m"]))
+            if rng.random() < 0.5:
+                if rng.random() < 0.4:
+                    tickets.append(svc.submit(inp, kind=PROVISIONING, rev=i))
+                else:
+                    tickets.append(svc.submit(inp, kind=DISRUPTION))
+            else:
+                tickets.append(fleet.submit(inp, kind=DISRUPTION))
+        for tk in tickets:
+            try:
+                tk.result(timeout=20)
+            except Superseded:
+                pass
+    finally:
+        svc.close()
+        fleet.close()
+
+    finished = {t.solve_id: t for t in obstrace.recent()}
+    assert not obstrace.active_traces(), "traces leaked in the active set"
+    seen_statuses = set()
+    for tk in tickets:
+        assert tk.solve_id in finished, f"ticket {tk.solve_id} has no trace"
+        snap = finished[tk.solve_id].snapshot()
+        _assert_rooted(snap)
+        assert snap["done"]
+        seen_statuses.add(snap["status"])
+    assert len({tk.solve_id for tk in tickets}) == len(tickets)
+    assert "ok" in seen_statuses
+    # the Chrome export of the whole run is loadable and keeps every
+    # event correlated to its solve
+    doc = chrome_trace(list(finished.values()))
+    doc = json.loads(json.dumps(doc))  # round-trips as pure JSON
+    assert all(e["args"]["solve_id"] in finished
+               for e in doc["traceEvents"] if e["ph"] != "M")
+
+
+# ------------------------------------------- wedge -> fence -> dump -> requeue
+
+
+def test_fence_dumps_wedged_solve_then_requeue_finishes_tree(tracing, tmp_path):
+    plan = faults.FaultPlan()
+    wedge = plan.wedge("solver.device_hang", tag="owner-0")
+    with faults.active(plan):
+        fleet, _solvers, _clock = mkfleet(size=2)
+        try:
+            tk = fleet.submit(mkinput("wedged"))
+            v1 = fleet.probe_once()
+            v2 = fleet.probe_once()
+            assert v1["owner-0"] == "miss" and v2["owner-0"] == "fenced", (v1, v2)
+            tk.result(timeout=20)  # requeued onto owner-1 and delivered
+        finally:
+            wedge.release()
+            fleet.close()
+
+    dumps = glob.glob(os.path.join(str(tmp_path), "*fleet_fence*"))
+    assert len(dumps) >= 1
+    d = json.load(open(dumps[0]))
+    assert d["reason"] == "fleet_fence"
+    assert d["tags"]["owner"] == "owner-0"
+    assert d["tags"]["requeued"] >= 1
+    assert len(d["canary_history"]) >= 2
+    # the wedged solve is in the dump as a PARTIAL tree: root still open,
+    # the parked stage tagged with the fault site
+    partial = [t for t in d["partial_traces"] if t["solve_id"] == tk.solve_id]
+    assert partial, d["partial_traces"]
+    snap = partial[0]
+    _assert_rooted(snap)
+    assert any(sp["t1"] is None for sp in snap["spans"]), "nothing open"
+    assert any(sp["attrs"].get("fault_site") == "solver.device_hang"
+               for sp in snap["spans"])
+    # after the requeue the SAME trace finished ok, carrying the history
+    done = [t for t in obstrace.recent() if t.solve_id == tk.solve_id]
+    assert len(done) == 1
+    assert done[0].status == "ok"
+    assert done[0].links.get("requeued_from") == ["owner-0"]
+    # flight-recorder health surfaced the dump
+    health = tracing.health()
+    assert health["dumps"] >= 1
+    assert health["last_dump"]["reason"] == "fleet_fence"
+
+
+def test_wedged_fleet_trace_annotates_fault_before_parking(tracing):
+    """The fault site lands on the span tree BEFORE the thread parks, so
+    active_traces() shows where a still-wedged solve is stuck (what the
+    dump captures mid-fence)."""
+    plan = faults.FaultPlan()
+    wedge = plan.wedge("solver.device_hang")
+    oracle = TaggedOracle()
+    done = threading.Event()
+    tr = obstrace.begin("disruption")
+
+    def run():
+        with faults.active(plan):
+            with obstrace.attached(tr), obstrace.span("pipeline.decode"):
+                oracle.solve(mkinput("stuck"))
+        done.set()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    try:
+        for _ in range(200):
+            snap = tr.snapshot()
+            hit = [sp for sp in snap["spans"]
+                   if sp["attrs"].get("fault_site") == "solver.device_hang"]
+            if hit:
+                break
+            import time
+            time.sleep(0.01)
+        assert hit and hit[0]["t1"] is None
+        assert tr in obstrace.active_traces()
+    finally:
+        wedge.release()
+        done.wait(10)
+        obstrace.finish(tr, "ok")
+
+
+# ---------------------------------------------------------- JSON log formatter
+
+
+def _format(record_args, extra=None):
+    rec = logging.LogRecord("karpenter_tpu", logging.INFO, __file__, 1,
+                            record_args, (), None)
+    for k, v in (extra or {}).items():
+        setattr(rec, k, v)
+    return json.loads(JsonLogFormatter().format(rec))
+
+
+def test_json_formatter_explicit_solve_id_wins(tracing):
+    out = _format("fenced owner", extra={"solve_id": "s000042"})
+    assert out["solve_id"] == "s000042"
+    assert out["msg"] == "fenced owner"
+    assert out["level"] == "info" and out["logger"] == "karpenter_tpu"
+
+
+def test_json_formatter_picks_up_ambient_trace(tracing):
+    tr = obstrace.begin("provisioning")
+    with obstrace.attached(tr):
+        out = _format("inside the solve")
+    obstrace.finish(tr, "ok")
+    assert out["solve_id"] == tr.solve_id
+    # outside any trace the key is simply absent, not null
+    out = _format("background housekeeping")
+    assert "solve_id" not in out
+
+
+def test_json_formatter_exception_lines():
+    try:
+        raise RuntimeError("boom")
+    except RuntimeError:
+        import sys
+        rec = logging.LogRecord("karpenter_tpu", logging.ERROR, __file__, 1,
+                                "solve failed", (), sys.exc_info())
+    out = json.loads(JsonLogFormatter().format(rec))
+    assert "RuntimeError: boom" in out["exc"]
+    assert "\n" not in json.dumps(out["msg"])  # one record = one line

@@ -1,13 +1,14 @@
 """--enable-profiling endpoints (the pprof analog, settings.md:23):
 sampling profile + all-thread stack dump on the metrics port, 404 when the
-flag is off."""
+flag is off, drift-free sampling schedule, one profile at a time (429)."""
 
 import threading
 import time
 import urllib.request
 
+from karpenter_tpu.operator import profiling
 from karpenter_tpu.operator.__main__ import serve_endpoints
-from karpenter_tpu.operator.profiling import dump_stacks, sample_profile
+from karpenter_tpu.operator.profiling import dump_stacks, handle, sample_profile
 
 
 def _get(port, path):
@@ -38,6 +39,55 @@ def test_sample_profile_sees_other_threads():
 def test_stack_dump_lists_threads():
     out = dump_stacks()
     assert "--- thread" in out
+
+
+def test_sampling_schedule_is_drift_free():
+    """Each tick sleeps toward an ABSOLUTE deadline (start + tick*interval),
+    so per-tick stack-walk cost compresses the next sleep instead of
+    stretching the effective period. With a fake clock charging 4ms of walk
+    cost per 10ms tick, a naive sleep(interval) loop would take ~14ms/tick
+    and land ~7 ticks in 0.1s; the compensated schedule keeps all 10."""
+    WALK_COST = 0.004
+
+    class Clock:
+        def __init__(self):
+            self.now = 0.0
+            self.reads = 0
+
+        def __call__(self):
+            # charge the walk cost on the post-sample read: the loop reads
+            # the clock once entering the tick and once before sleeping
+            self.reads += 1
+            if self.reads % 2 == 0:
+                self.now += WALK_COST
+            return self.now
+
+    clk = Clock()
+    sleeps = []
+
+    def slp(dt):
+        sleeps.append(dt)
+        clk.now += dt
+
+    report = sample_profile(0.1, interval_s=0.01, clock=clk, sleep=slp)
+    assert "thread-samples" in report
+    # full tick count despite the per-tick cost...
+    assert len(sleeps) >= 9, sleeps
+    # ...because every sleep was shortened to absorb the walk cost
+    assert all(dt <= 0.01 - WALK_COST + 1e-9 for dt in sleeps), sleeps
+    assert all(dt > 0 for dt in sleeps)
+
+
+def test_concurrent_profile_rejected_with_429():
+    assert profiling._PROFILE_LOCK.acquire(blocking=False)
+    try:
+        status, body = handle("/debug/pprof/profile", "seconds=0.1")
+        assert status == 429
+        assert body == "profile already in progress\n"
+    finally:
+        profiling._PROFILE_LOCK.release()
+    status, body = handle("/debug/pprof/profile", "seconds=0.1")
+    assert status == 200 and "thread-samples" in body
 
 
 def test_endpoints_gated_on_flag():
