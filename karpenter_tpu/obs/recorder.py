@@ -57,10 +57,11 @@ class FlightRecorder:
                     latency_s: Optional[float] = None) -> None:
         """Record a liveness-probe verdict (ring of the last 64): the dump
         shows what the watchdog saw in the run-up to a fence."""
-        self._canary.append({
-            "wall": time.time(), "owner": owner, "verdict": verdict,
-            "latency_s": latency_s,
-        })
+        with self._lock:
+            self._canary.append({
+                "wall": time.time(), "owner": owner, "verdict": verdict,
+                "latency_s": latency_s,
+            })
 
     def dump(self, reason: str, tags: Optional[Dict[str, object]] = None
              ) -> Optional[str]:
@@ -75,24 +76,29 @@ class FlightRecorder:
             self._last_by_reason[reason] = now
             self._seq += 1
             seq = self._seq
-        traces = _trace.recent(self.capacity)
-        partial = _trace.active_traces()
-        payload = {
-            "reason": reason,
-            "tags": {k: _trace._jsonable(v) for k, v in (tags or {}).items()},
-            "wall_time": time.time(),
-            "monotonic": time.monotonic(),
-            "canary_history": list(self._canary),
-            "partial_traces": [t.snapshot() for t in partial],
-            "traces": [t.snapshot() for t in traces],
-        }
+            canary = list(self._canary)
         path = os.path.join(
             self.dir, f"karpenter-flightrec-{os.getpid()}-{seq:03d}-{reason}.json"
         )
+        # payload construction included: the triggers (fence, breaker open,
+        # gate reject) are recovery paths — snapshotting live traces from
+        # other threads must never be able to abort them
         try:
+            traces = _trace.recent(self.capacity)
+            partial = _trace.active_traces()
+            payload = {
+                "reason": reason,
+                "tags": {k: _trace._jsonable(v)
+                         for k, v in (tags or {}).items()},
+                "wall_time": time.time(),
+                "monotonic": time.monotonic(),
+                "canary_history": canary,
+                "partial_traces": [t.snapshot() for t in partial],
+                "traces": [t.snapshot() for t in traces],
+            }
             with open(path, "w") as f:
                 json.dump(payload, f, indent=1)
-        except OSError as e:  # noqa: PERF203 — a dump must never crash a fence
+        except Exception as e:  # noqa: BLE001 — a dump must never crash a fence
             log.error("flight recorder: dump to %s failed: %s", path, e)
             return None
         with self._lock:

@@ -29,12 +29,15 @@ anchors them to wall time once per export.
 from __future__ import annotations
 
 import itertools
+import logging
 import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional
 
 from ..metrics.registry import SOLVER_STAGE_SECONDS
+
+log = logging.getLogger("karpenter_tpu")
 
 _ENABLED = False
 _LOCK = threading.Lock()
@@ -52,9 +55,10 @@ class Span:
     on the submitting thread and ends on the dispatcher)."""
 
     __slots__ = ("span_id", "parent_id", "name", "t0", "t1", "thread",
-                 "status", "attrs")
+                 "status", "attrs", "_lk")
 
-    def __init__(self, span_id: int, parent_id: Optional[int], name: str):
+    def __init__(self, span_id: int, parent_id: Optional[int], name: str,
+                 lock: Optional[threading.RLock] = None):
         self.span_id = span_id
         self.parent_id = parent_id
         self.name = name
@@ -63,9 +67,14 @@ class Span:
         self.thread = threading.current_thread().name
         self.status = "open"
         self.attrs: Dict[str, object] = {}
+        # the owning trace's lock: attrs writes and snapshot reads both
+        # take it, so a reader (flight-recorder dump, /debug/trace) never
+        # iterates a dict mid-mutation
+        self._lk = lock if lock is not None else threading.RLock()
 
     def set(self, **attrs) -> None:
-        self.attrs.update(attrs)
+        with self._lk:
+            self.attrs.update(attrs)
 
     def end(self, status: str = "ok") -> None:
         if self.t1 is None:
@@ -77,6 +86,8 @@ class Span:
         return None if self.t1 is None else self.t1 - self.t0
 
     def snapshot(self) -> Dict[str, object]:
+        with self._lk:
+            attrs = dict(self.attrs)
         return {
             "span_id": self.span_id,
             "parent_id": self.parent_id,
@@ -85,7 +96,7 @@ class Span:
             "t1": self.t1,
             "thread": self.thread,
             "status": self.status,
-            "attrs": {k: _jsonable(v) for k, v in self.attrs.items()},
+            "attrs": {k: _jsonable(v) for k, v in attrs.items()},
         }
 
 
@@ -100,7 +111,9 @@ class Trace:
     def __init__(self, solve_id: str, kind: str):
         self.solve_id = solve_id
         self.kind = kind
-        self._lock = threading.Lock()
+        # reentrant: Trace.snapshot holds it while Span.snapshot (same
+        # lock, shared with every span) re-acquires for the attrs copy
+        self._lock = threading.RLock()
         self.spans: List[Span] = []
         self.links: Dict[str, List[str]] = {}
         self.status = "open"
@@ -111,7 +124,8 @@ class Trace:
     def start_span(self, name: str, parent: Optional[Span]) -> Span:
         with self._lock:
             sp = Span(len(self.spans) + 1,
-                      parent.span_id if parent is not None else None, name)
+                      parent.span_id if parent is not None else None, name,
+                      lock=self._lock)
             self.spans.append(sp)
         return sp
 
@@ -315,7 +329,7 @@ def annotate(**attrs) -> None:
     """Set attributes on the current span (no-op outside a trace)."""
     st = getattr(_TLS, "stack", None)
     if st:
-        st[-1][1].attrs.update(attrs)
+        st[-1][1].set(**attrs)
 
 
 def event(name: str, **attrs) -> None:
@@ -326,7 +340,7 @@ def event(name: str, **attrs) -> None:
         return
     trace, parent = st[-1]
     sp = trace.start_span(name, parent)
-    sp.attrs.update(attrs)
+    sp.set(**attrs)
     sp.end()
 
 
@@ -347,11 +361,21 @@ def active_traces() -> List[Trace]:
 
 
 def dump(reason: str, **tags) -> Optional[str]:
-    """Trigger a flight-recorder dump (no-op when none is configured)."""
+    """Trigger a flight-recorder dump (no-op when none is configured).
+    Never raises: the triggers are recovery paths (fleet fence, breaker
+    open, gate reject) whose forward progress must not depend on
+    diagnostics succeeding."""
     rec = _RECORDER
     if rec is None:
         return None
-    return rec.dump(reason, tags=tags)
+    try:
+        return rec.dump(reason, tags=tags)
+    except Exception:  # noqa: BLE001 — diagnostics must never abort recovery
+        log.exception(
+            "trace: flight-recorder dump failed (reason: %s) — continuing",
+            reason,
+        )
+        return None
 
 
 def note_canary(owner: str, verdict: str, latency_s: Optional[float] = None) -> None:

@@ -459,9 +459,16 @@ class SolverFleet:
         self._export_health()
         # flight-record BEFORE stop(): stop force-resolves the wedged solve's
         # ticket, which finishes (and thereby closes) its trace — the dump
-        # must capture the partial span tree while it is still partial
-        obstrace.dump("fleet_fence", owner=owner.name, fence_reason=reason,
-                      fence_count=owner.fence_count, requeued=len(survivors))
+        # must capture the partial span tree while it is still partial.
+        # Guarded: a failed dump must not leave the owner fenced with its
+        # service running and survivors never re-routed
+        try:
+            obstrace.dump("fleet_fence", owner=owner.name, fence_reason=reason,
+                          fence_count=owner.fence_count,
+                          requeued=len(survivors))
+        except Exception:  # noqa: BLE001 — diagnostics never abort the fence
+            log.exception("solver fleet: flight-recorder dump failed while "
+                          "fencing %s — continuing recovery", owner.name)
         # stop() resolves every ticket the owner's service ever issued:
         # queued fail fast, in-flight get the drain window, wedged ones are
         # force-resolved (ServiceStopped) — nothing can strand

@@ -230,6 +230,8 @@ class SolveService:
                     stale = q.popleft()
                     self.stats["coalesced"] += 1
                     SOLVE_COALESCED.inc(kind=kind)
+                    if stale.queue_span is not None:
+                        stale.queue_span.end("superseded")
                     stale.ticket._deliver(error=Superseded(by=ticket))
             self._pending[kind].append(
                 _Request(ticket, inp=inp, rev=rev, trace=tr, queue_span=qspan)

@@ -129,6 +129,53 @@ def test_active_set_bounded_by_eviction(tracing):
     assert any(t.status == "abandoned" for t in obstrace.recent())
 
 
+def test_concurrent_annotate_never_breaks_snapshot(tracing):
+    """annotate() inserts span attrs while another thread snapshots the
+    trace (flight-recorder dump of active_traces, GET /debug/trace) —
+    snapshot must never iterate a dict mid-mutation. The writer is
+    BOUNDED (fixed insert count) and the reader loops until it finishes:
+    snapshot cost grows with the dict, so an unbounded writer livelocks
+    a single-core box."""
+    tr = obstrace.begin("solve")
+    done = threading.Event()
+
+    def writer():
+        with obstrace.attached(tr):
+            for i in range(50_000):
+                obstrace.annotate(**{f"k{i}": i})  # fresh key = dict resize
+        done.set()
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        while not done.is_set():
+            tr.snapshot()  # pre-fix: RuntimeError (dict changed size)
+        tr.snapshot()
+    finally:
+        done.set()
+        t.join(10)
+        obstrace.finish(tr, "ok")
+
+
+def test_dump_failure_never_escapes(tracing):
+    """A trace whose snapshot blows up mid-dump must not propagate out of
+    obstrace.dump(): its callers are recovery paths (fence, breaker open,
+    gate reject) whose forward progress can't depend on diagnostics."""
+
+    class _Evil:
+        solve_id = "evil"
+
+        def snapshot(self):
+            raise RuntimeError("dictionary changed size during iteration")
+
+    obstrace._ACTIVE["evil"] = _Evil()
+    try:
+        assert obstrace.dump("fleet_fence", owner="owner-0") is None
+    finally:
+        obstrace._ACTIVE.pop("evil", None)
+    assert tracing.health()["dumps"] == 0
+
+
 # ------------------------------------------------------- pipeline completeness
 
 
@@ -240,6 +287,66 @@ def test_fence_dumps_wedged_solve_then_requeue_finishes_tree(tracing, tmp_path):
     health = tracing.health()
     assert health["dumps"] >= 1
     assert health["last_dump"]["reason"] == "fleet_fence"
+
+
+def test_fence_survives_recorder_failure(tmp_path):
+    """A diagnostics failure mid-fence must not strand survivors: the
+    wedged owner's service is still stopped and its outstanding requests
+    still re-routed even when the flight-recorder dump itself raises
+    (pre-fix: the exception escaped after fenced=True, so the ticket
+    blocked forever and re-entering _fence early-returned)."""
+
+    class ExplodingRecorder(FlightRecorder):
+        def dump(self, reason, tags=None):
+            raise RuntimeError("boom while building the dump payload")
+
+    obstrace.configure(enabled=True, ring=128,
+                       recorder=ExplodingRecorder(dir=str(tmp_path)))
+    plan = faults.FaultPlan()
+    wedge = plan.wedge("solver.device_hang", tag="owner-0")
+    try:
+        with faults.active(plan):
+            fleet, _solvers, _clock = mkfleet(size=2)
+            try:
+                tk = fleet.submit(mkinput("wedged"))
+                v1 = fleet.probe_once()
+                v2 = fleet.probe_once()
+                assert v1["owner-0"] == "miss" and v2["owner-0"] == "fenced"
+                assert tk.result(timeout=20) is not None  # requeued, not stranded
+            finally:
+                wedge.release()
+                fleet.close()
+    finally:
+        obstrace.configure(enabled=False, recorder=None)
+
+
+def test_superseded_request_closes_queue_span(tracing):
+    """The coalesce path ends the stale request's pipeline.queue span
+    ('superseded'), so its trace never exports an unterminated event."""
+    from tests.test_solve_pipeline import GatedAsyncSolver
+
+    solver = GatedAsyncSolver()
+    svc = SolveService(solver, depth=2)
+    try:
+        t1 = svc.submit(mkinput("p1"), kind=PROVISIONING)
+        assert solver.dispatching.wait(10)  # p1 popped: no longer coalescible
+        t2 = svc.submit(mkinput("p2"), kind=PROVISIONING)
+        t3 = svc.submit(mkinput("p3"), kind=PROVISIONING)  # supersedes t2
+        assert t2.done() and t2.superseded()
+        solver.gate.set()
+        t1.result(timeout=10)
+        t3.result(timeout=10)
+    finally:
+        solver.gate.set()
+        svc.close()
+
+    done = {t.solve_id: t for t in obstrace.recent()}
+    snap = done[t2.solve_id].snapshot()
+    assert snap["status"] == "superseded"
+    qspans = [sp for sp in snap["spans"] if sp["name"] == "pipeline.queue"]
+    assert len(qspans) == 1
+    assert qspans[0]["t1"] is not None, "queue span left open"
+    assert qspans[0]["status"] == "superseded"
 
 
 def test_wedged_fleet_trace_annotates_fault_before_parking(tracing):
