@@ -515,6 +515,51 @@ FLIGHT_RECORDER_DUMPS = REGISTRY.register(
     )
 )
 
+# -- tenancy (solver/tenancy.py; ISSUE 11 — same naming rule as the fleet /
+#    trace series: no _tpu segment, the mux is backend-neutral) ---------------
+
+TENANT_QUEUE_DEPTH = REGISTRY.register(
+    Gauge(
+        "karpenter_solver_tenant_queue_depth",
+        "Solve requests held at the tenant mux (admitted, not yet forwarded "
+        "to the owner pool), per tenant — the WFQ backlog",
+        ("tenant",),
+    )
+)
+TENANT_ADMISSION_REJECTS = REGISTRY.register(
+    Counter(
+        "karpenter_solver_tenant_admission_rejects_total",
+        "Submissions refused by per-tenant queue-depth admission control "
+        "(typed TenantAdmissionReject returned to the caller), per tenant",
+        ("tenant",),
+    )
+)
+TENANT_BREAKER_STATE = REGISTRY.register(
+    Gauge(
+        "karpenter_solver_tenant_breaker_state",
+        "Per-tenant circuit breaker state: 0=closed, 1=half-open, 2=open — "
+        "an open tenant breaker routes only THAT tenant to its oracle rung, "
+        "never fencing a shared owner",
+        ("tenant",),
+    )
+)
+TENANT_SOLVE_SECONDS = REGISTRY.register(
+    Histogram(
+        "karpenter_solver_tenant_solve_seconds",
+        "End-to-end solve latency through the tenant mux (submit to ticket "
+        "resolution, queueing included), per tenant",
+        ("tenant",),
+    )
+)
+TENANT_DEGRADED = REGISTRY.register(
+    Counter(
+        "karpenter_solver_tenant_degraded_total",
+        "Solves served by a tenant's OWN oracle-fallback ladder because its "
+        "breaker was open or its device-path attempt failed, per tenant",
+        ("tenant",),
+    )
+)
+
 PROBE_BATCH_SIZE = REGISTRY.register(
     Histogram(
         "karpenter_tpu_disruption_probe_batch_size",

@@ -32,6 +32,11 @@ class JsonLogFormatter(logging.Formatter):
         solve_id = getattr(record, "solve_id", None) or _trace.current_solve_id()
         if solve_id is not None:
             out["solve_id"] = solve_id
+        # tenancy (solver/tenancy.py): same two-way join as solve_id — an
+        # explicit extra wins, else the attached trace's tenant stamp
+        tenant_id = getattr(record, "tenant_id", None) or _trace.current_tenant_id()
+        if tenant_id is not None:
+            out["tenant_id"] = tenant_id
         if record.exc_info:
             out["exc"] = "".join(
                 traceback.format_exception(*record.exc_info)

@@ -86,6 +86,16 @@ class FlightRecorder:
         try:
             traces = _trace.recent(self.capacity)
             partial = _trace.active_traces()
+            # tenancy attribution (solver/tenancy.py): which tenants' solves
+            # are in this record — lets an operator triage a fence/breaker
+            # dump straight to the affected cluster(s) without walking spans
+            tenants: Dict[str, Dict[str, int]] = {}
+            for t in traces + partial:
+                tid = t.tenant_id
+                if tid is None:
+                    continue
+                ent = tenants.setdefault(tid, {"finished": 0, "partial": 0})
+                ent["partial" if not t.done else "finished"] += 1
             payload = {
                 "reason": reason,
                 "tags": {k: _trace._jsonable(v)
@@ -93,6 +103,7 @@ class FlightRecorder:
                 "wall_time": time.time(),
                 "monotonic": time.monotonic(),
                 "canary_history": canary,
+                "tenants": tenants,
                 "partial_traces": [t.snapshot() for t in partial],
                 "traces": [t.snapshot() for t in traces],
             }

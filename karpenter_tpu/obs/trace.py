@@ -105,12 +105,15 @@ class Trace:
     `begin()`. Links (e.g. requeued_from) record cross-owner history
     that is not itself a timed operation."""
 
-    __slots__ = ("solve_id", "kind", "spans", "links", "root", "status",
-                 "done", "created_wall", "_lock")
+    __slots__ = ("solve_id", "kind", "tenant_id", "spans", "links", "root",
+                 "status", "done", "created_wall", "_lock")
 
     def __init__(self, solve_id: str, kind: str):
         self.solve_id = solve_id
         self.kind = kind
+        # tenancy attribution (solver/tenancy.py): set once by the minting
+        # layer via set_tenant(); read by logjson/recorder/debug exports
+        self.tenant_id: Optional[str] = None
         # reentrant: Trace.snapshot holds it while Span.snapshot (same
         # lock, shared with every span) re-acquires for the attrs copy
         self._lock = threading.RLock()
@@ -140,6 +143,7 @@ class Trace:
         return {
             "solve_id": self.solve_id,
             "kind": self.kind,
+            "tenant_id": self.tenant_id,
             "status": self.status,
             "done": self.done,
             "created_wall": self.created_wall,
@@ -323,6 +327,21 @@ def current_trace() -> Optional[Trace]:
 def current_solve_id() -> Optional[str]:
     st = getattr(_TLS, "stack", None)
     return st[-1][0].solve_id if st else None
+
+
+def current_tenant_id() -> Optional[str]:
+    st = getattr(_TLS, "stack", None)
+    return st[-1][0].tenant_id if st else None
+
+
+def set_tenant(trace: Optional[Trace], tenant_id: Optional[str]) -> None:
+    """Stamp tenant attribution on a trace + its root span. Called by the
+    minting layer (pipeline/fleet submit, TenantMux); None-safe both ways
+    so the single-tenant path allocates nothing extra."""
+    if trace is None or tenant_id is None:
+        return
+    trace.tenant_id = tenant_id
+    trace.root.set(tenant_id=tenant_id)
 
 
 def annotate(**attrs) -> None:

@@ -143,6 +143,9 @@ def main(argv=None) -> int:
         fence_after_misses=o.fence_after_misses,
         solver_preemption=o.solver_preemption,
         solver_gang=o.solver_gang,
+        solver_tenants=o.solver_tenants,
+        tenant_weights=o.tenant_weights,
+        tenant_max_queue_depth=o.tenant_max_queue_depth,
     )
     serve_endpoints(o.metrics_port, o.health_probe_port,
                     enable_profiling=o.enable_profiling)

@@ -51,6 +51,7 @@ class Operator:
     solver: Solver
     interruption_queue: InterruptionQueue = field(default_factory=InterruptionQueue)
     solve_service: Optional[object] = None  # solver/pipeline.py SolveService
+    tenant_mux: Optional[object] = None  # solver/tenancy.py TenantMux
     recorder: Optional[object] = None  # events/recorder.py Recorder
     preemption: Optional[object] = None  # provisioning/preemption.py
 
@@ -90,6 +91,9 @@ def new_kwok_operator(
     canary_deadline_s: float = 5.0,
     solver_preemption: bool = True,
     solver_gang: bool = True,
+    solver_tenants: str = "",
+    tenant_weights: str = "",
+    tenant_max_queue_depth: int = 64,
 ) -> Operator:
     store = shared_store if shared_store is not None else st.Store()
     # the operator's clock is authoritative for every age stamp, including a
@@ -210,6 +214,27 @@ def new_kwok_operator(
         from ..solver.pipeline import SolveService
 
         solve_service = SolveService(solver, depth=pipeline_depth, clock=clock)
+    tenant_mux = None
+    if solver_tenants and solve_service is not None:
+        # multi-tenant mux (solver/tenancy.py): the operator's own
+        # provisioner/disruption controllers become the FIRST registered
+        # tenant's view; other clusters' streams attach via
+        # tenant_mux.view(id)/submit(...). The mux owns the downstream
+        # (close() cascades). Tenancy off = this block never runs and the
+        # controllers hold the fleet/pipeline directly, byte-identical.
+        from ..solver.tenancy import TenantMux, TenantRegistry
+
+        registry = TenantRegistry.parse(
+            solver_tenants, tenant_weights,
+            max_queue_depth=tenant_max_queue_depth,
+        )
+        tenant_mux = TenantMux(
+            solve_service, registry,
+            breaker_threshold=breaker_threshold,
+            breaker_probe_s=breaker_probe_s,
+            clock=clock,
+        )
+        solve_service = tenant_mux.view(registry.first().tenant_id)
     from ..events.recorder import Recorder
     from ..provisioning.preemption import PreemptionController
 
@@ -362,6 +387,7 @@ def new_kwok_operator(
         solver=solver,
         interruption_queue=queue,
         solve_service=solve_service,
+        tenant_mux=tenant_mux,
         recorder=recorder,
         preemption=preemption,
     )

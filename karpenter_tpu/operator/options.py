@@ -111,6 +111,17 @@ class Options:
     # consecutive canary deadline misses before an owner is fenced and its
     # work re-routed (the fleet breaker's threshold)
     fence_after_misses: int = 2
+    # multi-tenant solver service (solver/tenancy.py): comma-separated
+    # tenant ids sharing this operator's owner pool behind a weighted-fair
+    # mux with per-tenant breakers/oracles; empty = tenancy off, the
+    # provisioner holds the fleet/pipeline directly (byte-identical path)
+    solver_tenants: str = ""
+    # per-tenant WFQ weights, "id=float,..." (unlisted tenants weigh 1.0);
+    # ids must appear in --solver-tenants — validated fail-closed at boot
+    tenant_weights: str = ""
+    # per-tenant admission bound: open solve requests (queued + in flight)
+    # above this raise TenantAdmissionReject instead of enqueueing
+    tenant_max_queue_depth: int = 64
     # per-solve deadline on the device path, seconds; 0 = no deadline
     solver_deadline_s: float = 0.0
     # breaker opens after this many consecutive device-path failures
@@ -227,6 +238,35 @@ def parse(argv: Optional[Sequence[str]] = None, cls=Options) -> Options:
             f"(got {interval_s}); it is the liveness-probe period of the "
             "solver fleet watchdog (solver/fleet.py)"
         )
+    # tenancy knob sanity (same fail-closed rule): a malformed tenant list
+    # or weight map must refuse startup, not silently mis-weight a tenant
+    # or serve an unknown one — TenantRegistry.parse raises ValueError on
+    # duplicates, unknown weight keys, and non-positive values
+    tenants_str = getattr(out, "solver_tenants", "") or ""
+    weights_str = getattr(out, "tenant_weights", "") or ""
+    tenant_depth = getattr(out, "tenant_max_queue_depth", None)
+    if weights_str.strip() and not tenants_str.strip():
+        raise SystemExit(
+            "refusing to start: --tenant-weights is set but --solver-tenants "
+            "is empty; weights only apply to registered tenants "
+            "(solver/tenancy.py)"
+        )
+    if tenant_depth is not None and int(tenant_depth) < 1:
+        raise SystemExit(
+            "refusing to start: --tenant-max-queue-depth must be >= 1 "
+            f"(got {tenant_depth}); it bounds one tenant's open solve "
+            "requests at the mux (solver/tenancy.py)"
+        )
+    if tenants_str.strip():
+        from ..solver.tenancy import TenantRegistry
+
+        try:
+            TenantRegistry.parse(
+                tenants_str, weights_str,
+                max_queue_depth=int(tenant_depth or 64),
+            )
+        except ValueError as e:
+            raise SystemExit(f"refusing to start: {e}") from None
     fmt = getattr(out, "log_format", None)
     if fmt is not None and fmt not in ("text", "json"):
         raise SystemExit(

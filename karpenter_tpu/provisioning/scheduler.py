@@ -89,6 +89,12 @@ class SolverInput:
     # deep catalog-key compare when hunting a patch donor (solver/
     # encode_cache.py); None is always safe (full compare).
     state_rev: Optional[tuple] = None
+    # Tenancy attribution (solver/tenancy.py): which tenant's cluster this
+    # snapshot belongs to. Never consulted by the solving math — it selects
+    # the per-tenant encode-cache namespace and arena residency namespace,
+    # and rides into span attrs / flight dumps / JSON logs. None = the
+    # single-tenant default namespace (byte-identical to pre-tenancy).
+    tenant_id: Optional[str] = None
 
 
 @dataclass

@@ -297,8 +297,18 @@ class ArgumentArena:
         self.last_stale = ()
         self.stats["invalidations"] += 1
 
-    def bucket_key(self, host_args: tuple, sharding=None) -> tuple:
-        return (tuple((a.shape, a.dtype.str) for a in host_args), sharding)
+    def bucket_key(self, host_args: tuple, sharding=None, ns=None) -> tuple:
+        """Residency key for one dispatch's kernel args. `ns` is the tenant
+        RESIDENCY namespace (solver/tenancy.py): it partitions buffers,
+        checkpoints, ladders, and shard records per tenant so one tenant's
+        churn never thrashes another's resident state — while anything
+        shape-keyed (the `_UNPACK_CACHE` below, jit/AOT compile buckets)
+        deliberately ignores it, so same-shaped tenants share every compiled
+        kernel. ns=None yields the pre-tenancy 2-tuple, byte-identical."""
+        shapes = tuple((a.shape, a.dtype.str) for a in host_args)
+        if ns is None:
+            return (shapes, sharding)
+        return (shapes, sharding, ns)
 
     def put_checkpoint(self, key: tuple, record: dict) -> None:
         """Record a solve's checkpoint set for its bucket (newest first,
@@ -352,7 +362,8 @@ class ArgumentArena:
             out.append(t[1])
         return tuple(out)
 
-    def adopt(self, host_args: tuple, prov: tuple, sharding=None) -> tuple:
+    def adopt(self, host_args: tuple, prov: tuple, sharding=None,
+              ns=None) -> tuple:
         """Return device-resident buffers matching `host_args`, uploading
         only stale entries as ONE packed buffer. `prov` aligns with
         `host_args` (backend.host_kernel_args): a hashable content-identity
@@ -369,7 +380,7 @@ class ArgumentArena:
         import jax
 
         self.stats["adopts"] += 1
-        key = (tuple((a.shape, a.dtype.str) for a in host_args), sharding)
+        key = self.bucket_key(host_args, sharding, ns=ns)
         bkt = self._buckets.get(key)
         if bkt is None:
             while len(self._buckets) >= self.max_buckets:

@@ -1114,13 +1114,14 @@ class TPUSolver(Solver):
         self.ledger.begin_solve()
         with obstrace.span("backend.upload"):
             if self.arena is not None:
-                args = self.arena.adopt(host_args, prov)
+                args = self.arena.adopt(host_args, prov, ns=enc2.tenant_id)
             else:
                 args = _device_args(host_args, prov, ledger=self.ledger)
             Sp = int(host_args[0].shape[0])
             lad_host = np.full((Sp, Lp), -1, np.int32)
             lad_host[:S_orig] = ladder_rows
-            dev_lad = self._ladder_arg(host_args, lad_host)
+            dev_lad = self._ladder_arg(host_args, lad_host,
+                                       ns=enc2.tenant_id)
         M0 = initial_claim_bucket(n_orig, self.max_claims)
         obstrace.annotate(ladder=True, ladder_rungs=int(Lmax),
                           claim_bucket=M0)
@@ -1139,7 +1140,7 @@ class TPUSolver(Solver):
             "rungs": int(Lmax),
         }
 
-    def _ladder_arg(self, host_args, lad_host: np.ndarray):
+    def _ladder_arg(self, host_args, lad_host: np.ndarray, ns=None):
         """Upload (or reuse) the run_ladder table. Ladder rungs are a
         per-bucket arena residency class like checkpoints (solver/arena.py
         _ladders): keyed by the arg bucket + a content digest, dropped by
@@ -1148,7 +1149,7 @@ class TPUSolver(Solver):
         import jax
 
         if self.arena is not None:
-            key = self.arena.bucket_key(host_args)
+            key = self.arena.bucket_key(host_args, ns=ns)
             dev = self.arena.get_ladder(key, lad_host)
             if dev is not None:
                 return dev
@@ -1661,7 +1662,7 @@ class TPUSolver(Solver):
                 faults.check("solver.arena_corrupt", tag=self.fault_tag)
                 # device-resident arena: only stale entries upload, packed
                 # into ONE buffer; an exact encode-cache hit uploads nothing
-                args = self.arena.adopt(host_args, prov)
+                args = self.arena.adopt(host_args, prov, ns=enc.tenant_id)
             else:
                 args = _device_args(host_args, prov, ledger=self.ledger)
         S, E, T, G = dims["S"], dims["E"], dims["T"], dims["G"]
@@ -1832,7 +1833,7 @@ class TPUSolver(Solver):
         from .tpu.ffd import ARG_INDEX
 
         run_idx = (ARG_INDEX["run_group"], ARG_INDEX["run_count"])
-        key = self.arena.bucket_key(host_args)
+        key = self.arena.bucket_key(host_args, ns=enc.tenant_id)
         recs = self.arena.get_checkpoints(key)
         if not recs:
             return None
@@ -1927,7 +1928,7 @@ class TPUSolver(Solver):
             return
         from .tpu.ffd import ARG_INDEX
 
-        key = self.arena.bucket_key(host_args)
+        key = self.arena.bucket_key(host_args, ns=enc.tenant_id)
         ctx = self.arena.context_signature(
             key, exclude=(ARG_INDEX["run_group"], ARG_INDEX["run_count"])
         )
@@ -2054,8 +2055,9 @@ class TPUSolver(Solver):
         self.ledger.begin_solve()
         key = None
         if self.arena is not None:
-            args = self.arena.adopt(sh_args, prov, sharding=shardings)
-            key = self.arena.bucket_key(sh_args, shardings)
+            args = self.arena.adopt(sh_args, prov, sharding=shardings,
+                                    ns=enc.tenant_id)
+            key = self.arena.bucket_key(sh_args, shardings, ns=enc.tenant_id)
         else:
             up = 0
             up_shard = 0

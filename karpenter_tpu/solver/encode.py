@@ -256,6 +256,11 @@ class EncodedInput:
     has_topology: bool = False
     has_affinity: bool = False
 
+    # tenancy (solver/tenancy.py): stamped from SolverInput.tenant_id so the
+    # backend can namespace arena RESIDENCY per tenant while compile buckets
+    # stay shape-keyed and shared. Never consulted by the solving math.
+    tenant_id: Optional[str] = None
+
     # zone-granular constraints (V axis), run by the device event engine
     # (ffd.py zone loop; SPEC.md "Topology spread" / "Inter-pod affinity"):
     # v_kind 0 = zone TSC (cap = maxSkew), 1 = zone anti-affinity,
@@ -526,6 +531,7 @@ def quantize_input(inp: SolverInput) -> SolverInput:
         capacity_types=inp.capacity_types,
         preference_policy=inp.preference_policy,
         state_rev=getattr(inp, "state_rev", None),
+        tenant_id=getattr(inp, "tenant_id", None),
     )
 
 
@@ -705,22 +711,28 @@ def _core_key(pods_f: List[Pod], inp: SolverInput) -> Tuple[tuple, np.ndarray]:
 
 
 def encode(inp: SolverInput) -> EncodedInput:
+    from . import encode_cache as ec
+
+    tenant_id = getattr(inp, "tenant_id", None)
     pods_f = [p for p in inp.pods if not p.scheduling_gated and p.node_name is None]
     if getattr(inp, "presorted", False):
         # relax-loop encodes materialize FRESH pod objects every iteration:
         # caching them would only evict hot production cores and pin dead
         # pod lists (r5 review) — build uncached
-        return _encode_with_nodes(_build_core(inp, pods_f), inp)
+        enc = _encode_with_nodes(_build_core(inp, pods_f), inp)
+        enc.tenant_id = tenant_id
+        return enc
+    # tenancy: each tenant patches/evicts inside its OWN core-cache
+    # namespace (solver/tenancy.py sharing boundary) — a noisy tenant can't
+    # evict another tenant's hot core or donate a patch across clusters.
+    # tenant_id=None keeps using the module-global _CORE_CACHE verbatim.
+    cache = ec.tenant_core_cache(tenant_id, _CORE_CACHE)
     key, ids = _core_key(pods_f, inp)
-    ent = _CORE_CACHE.get(key)
+    ent = cache.get(key)
     if ent is not None and np.array_equal(ids, ent[0]):
-        from . import encode_cache as ec
-
         ec.STATS["hits"] += 1
         core = ent[1]
     else:
-        from . import encode_cache as ec
-
         # delta-patch path: same sig universe + same catalog as a cached
         # core (pods added/removed within known groups) reuses every
         # group/type/pool table and rebuilds only the run split — falls
@@ -728,20 +740,22 @@ def encode(inp: SolverInput) -> EncodedInput:
         presort = ffd_sort_with_sigs(pods_f, presorted=False)
         structure = _group_structure(presort[0], presort[1])
         state_rev = getattr(inp, "state_rev", None)
-        core = ec.try_patch(key, presort, structure, _CORE_CACHE, state_rev)
+        core = ec.try_patch(key, presort, structure, cache, state_rev)
         if core is None:
             core = _build_core(inp, pods_f, presort, structure)
             ec.STATS["rebuilds"] += 1
         else:
             ec.STATS["patches"] += 1
-        if len(_CORE_CACHE) >= _CORE_CACHE_MAX:
-            _CORE_CACHE.pop(next(iter(_CORE_CACHE)))
+        if len(cache) >= _CORE_CACHE_MAX:
+            cache.pop(next(iter(cache)))
         # entry pins the instance-type objects whose ids appear in the key
         # (pods are pinned via core.group_pods), so ids can't be recycled
         # while the entry lives
         type_pins = tuple(it for p in inp.nodepools for it in p.instance_types)
-        _CORE_CACHE[key] = (ids, core, type_pins, state_rev)
-    return _encode_with_nodes(core, inp)
+        cache[key] = (ids, core, type_pins, state_rev)
+    enc = _encode_with_nodes(core, inp)
+    enc.tenant_id = tenant_id
+    return enc
 
 
 def _build_core(

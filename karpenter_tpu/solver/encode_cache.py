@@ -60,6 +60,29 @@ def next_core_rev() -> int:
     return _CORE_REV
 
 
+# Tenancy (solver/tenancy.py): per-tenant core-cache NAMESPACES. Each tenant
+# hits/patches/evicts inside its own dict (same _CORE_CACHE_MAX budget per
+# namespace), so one tenant's churn can never evict another's hot core and a
+# patch donor can never cross clusters. tenant_id=None maps to the caller's
+# default dict (encode.py _CORE_CACHE) so the single-tenant path — including
+# tests/bench that clear `em._CORE_CACHE` directly — is byte-identical.
+_TENANT_CORE_CACHES: Dict[str, dict] = {}
+
+
+def tenant_core_cache(tenant_id: Optional[str], default: dict) -> dict:
+    if tenant_id is None:
+        return default
+    cache = _TENANT_CORE_CACHES.get(tenant_id)
+    if cache is None:
+        cache = _TENANT_CORE_CACHES[tenant_id] = {}
+    return cache
+
+
+def drop_tenant(tenant_id: str) -> None:
+    """Release a removed tenant's encode namespace (TenantRegistry.remove)."""
+    _TENANT_CORE_CACHES.pop(tenant_id, None)
+
+
 def try_patch(key, presort, structure, core_cache, state_rev=None):
     """Scan `core_cache` for a donor core with the same catalog segment and
     the same ordered distinct-signature sequence as the new pod set; return

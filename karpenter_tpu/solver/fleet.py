@@ -142,16 +142,18 @@ class _FleetBreaker(CircuitBreaker):
 class _FleetEntry:
     """One logical fleet request across any number of owner re-routes."""
 
-    __slots__ = ("ticket", "inp", "fn", "kind", "rev", "owner", "owner_ticket",
-                 "requeues", "trace")
+    __slots__ = ("ticket", "inp", "fn", "kind", "rev", "tenant_id", "owner",
+                 "owner_ticket", "requeues", "trace")
 
     def __init__(self, ticket: SolveTicket, inp=None, fn=None,
-                 kind: str = PROVISIONING, rev=None):
+                 kind: str = PROVISIONING, rev=None,
+                 tenant_id: Optional[str] = None):
         self.ticket = ticket
         self.inp = inp
         self.fn = fn
         self.kind = kind
         self.rev = rev
+        self.tenant_id = tenant_id
         self.owner: Optional["FleetOwner"] = None
         self.owner_ticket: Optional[SolveTicket] = None
         self.requeues = 0
@@ -170,6 +172,7 @@ def _mint_fleet_trace(entry: _FleetEntry) -> None:
         return
     entry.trace = tr
     entry.ticket.solve_id = tr.solve_id
+    obstrace.set_tenant(tr, entry.tenant_id)
     if owned:
         entry.ticket.on_done(
             lambda t, _tr=tr: obstrace.finish(_tr, obstrace.status_of(t.error()))
@@ -266,14 +269,18 @@ class SolverFleet:
 
     # -- submission (SolveService surface) -----------------------------------
 
-    def submit(self, inp, kind: str = PROVISIONING, rev=None) -> SolveTicket:
+    def submit(self, inp, kind: str = PROVISIONING, rev=None,
+               tenant_id: Optional[str] = None) -> SolveTicket:
         if rev is None:
             rev = getattr(inp, "state_rev", None)
+        if tenant_id is None:
+            tenant_id = getattr(inp, "tenant_id", None)
         with self._lock:
             if self._closing:
                 raise ServiceStopped("solver fleet is closed")
-        ticket = SolveTicket(kind, rev=rev)
-        entry = _FleetEntry(ticket, inp=inp, kind=kind, rev=rev)
+        ticket = SolveTicket(kind, rev=rev, tenant_id=tenant_id)
+        entry = _FleetEntry(ticket, inp=inp, kind=kind, rev=rev,
+                            tenant_id=tenant_id)
         _mint_fleet_trace(entry)
         with self._lock:
             self._open.add(entry)
@@ -281,12 +288,14 @@ class SolverFleet:
         self._place(entry)
         return ticket
 
-    def submit_fn(self, dispatch_fn: Callable, kind: str = DISRUPTION) -> SolveTicket:
+    def submit_fn(self, dispatch_fn: Callable, kind: str = DISRUPTION,
+                  tenant_id: Optional[str] = None) -> SolveTicket:
         with self._lock:
             if self._closing:
                 raise ServiceStopped("solver fleet is closed")
-        ticket = SolveTicket(kind)
-        entry = _FleetEntry(ticket, fn=dispatch_fn, kind=kind)
+        ticket = SolveTicket(kind, tenant_id=tenant_id)
+        entry = _FleetEntry(ticket, fn=dispatch_fn, kind=kind,
+                            tenant_id=tenant_id)
         _mint_fleet_trace(entry)
         with self._lock:
             self._open.add(entry)
@@ -325,10 +334,14 @@ class SolverFleet:
                     obstrace.event("fleet.place", owner=owner.name,
                                    requeues=entry.requeues)
                     if entry.fn is not None:
-                        ot = owner.service.submit_fn(entry.fn, kind=entry.kind)
+                        ot = owner.service.submit_fn(
+                            entry.fn, kind=entry.kind,
+                            tenant_id=entry.tenant_id,
+                        )
                     else:
                         ot = owner.service.submit(entry.inp, kind=entry.kind,
-                                                  rev=entry.rev)
+                                                  rev=entry.rev,
+                                                  tenant_id=entry.tenant_id)
             except ServiceStopped:
                 continue  # owner fenced between pick and submit; re-pick
             with self._lock:
@@ -375,6 +388,11 @@ class SolverFleet:
             self.fleet_stats["oracle_degraded"] += 1
         try:
             with obstrace.attached(entry.trace), obstrace.span("fleet.oracle"):
+                # degraded solves stay attributable: the oracle span carries
+                # the tenant even though no owner service ever saw the request
+                if entry.tenant_id is not None:
+                    obstrace.annotate(tenant_id=entry.tenant_id,
+                                      kind=entry.kind)
                 res = self._oracle.solve(entry.inp)
         except Exception as e:  # noqa: BLE001 — delivered to the caller
             self._resolve(entry, error=e)

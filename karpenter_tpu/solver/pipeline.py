@@ -81,9 +81,13 @@ class SolveTicket:
     so a force-resolve racing a late decode can never overwrite a real
     result, and a requeued request can never double-act."""
 
-    def __init__(self, kind: str, rev=None):
+    def __init__(self, kind: str, rev=None, tenant_id: Optional[str] = None):
         self.kind = kind
         self.rev = rev
+        # tenancy attribution (solver/tenancy.py): scopes provisioning
+        # coalescing (only same-tenant snapshots supersede each other) and
+        # rides into the queue span / flight dumps. None = single-tenant.
+        self.tenant_id = tenant_id
         # tracing correlation token, minted (or adopted from the submitting
         # layer's trace) at ticket creation; None when tracing is off
         self.solve_id: Optional[str] = None
@@ -159,13 +163,21 @@ def _mint_trace(ticket: SolveTicket, kind: str):
     if tr is None:
         return None, None
     ticket.solve_id = tr.solve_id
+    obstrace.set_tenant(tr, ticket.tenant_id)
     if owned:
         ticket.on_done(
             lambda t, _tr=tr: obstrace.finish(_tr, obstrace.status_of(t.error()))
         )
     # cross-thread span: opens on the submitting thread, closed by the
-    # dispatcher when it pops the request — queue wait is its own stage
-    return tr, tr.start_span("pipeline.queue", parent=tr.root)
+    # dispatcher when it pops the request — queue wait is its own stage.
+    # The TICKET's kind labels it (an adopted trace may carry a different
+    # kind — e.g. a disruption probe fn under a provisioning trace), so
+    # submit_fn work is attributable in /debug/trace; tenant rides along.
+    qspan = tr.start_span("pipeline.queue", parent=tr.root)
+    qspan.set(kind=ticket.kind)
+    if ticket.tenant_id is not None:
+        qspan.set(tenant_id=ticket.tenant_id)
+    return tr, qspan
 
 
 class SolveService:
@@ -209,15 +221,21 @@ class SolveService:
 
     # -- submission ----------------------------------------------------------
 
-    def submit(self, inp, kind: str = PROVISIONING, rev=None) -> SolveTicket:
+    def submit(self, inp, kind: str = PROVISIONING, rev=None,
+               tenant_id: Optional[str] = None) -> SolveTicket:
         """Queue a SolverInput. Provisioning-class submits coalesce: every
-        provisioning request still queued (undispatched) is superseded —
-        its ticket raises Superseded — because this newer snapshot covers
-        it. `rev` is the snapshot's encode-cache revision stamp
-        (SolverInput.state_rev), recorded for observability."""
+        provisioning request still queued (undispatched) FOR THE SAME
+        TENANT is superseded — its ticket raises Superseded — because this
+        newer snapshot covers it. Tenant A's snapshot says nothing about
+        B's cluster, so cross-tenant requests are never coalesced; with
+        tenancy off every tenant_id is None and the behavior is exactly
+        the pre-tenancy one. `rev` is the snapshot's encode-cache revision
+        stamp (SolverInput.state_rev), recorded for observability."""
         if rev is None:
             rev = getattr(inp, "state_rev", None)
-        ticket = SolveTicket(kind, rev=rev)
+        if tenant_id is None:
+            tenant_id = getattr(inp, "tenant_id", None)
+        ticket = SolveTicket(kind, rev=rev, tenant_id=tenant_id)
         with self._cv:
             if self._stopped:
                 raise ServiceStopped("solve service is closed")
@@ -226,13 +244,18 @@ class SolveService:
             tr, qspan = _mint_trace(ticket, kind)
             if kind == PROVISIONING:
                 q = self._pending[PROVISIONING]
+                keep: deque = deque()
                 while q:
                     stale = q.popleft()
+                    if stale.ticket.tenant_id != tenant_id:
+                        keep.append(stale)
+                        continue
                     self.stats["coalesced"] += 1
                     SOLVE_COALESCED.inc(kind=kind)
                     if stale.queue_span is not None:
                         stale.queue_span.end("superseded")
                     stale.ticket._deliver(error=Superseded(by=ticket))
+                q.extend(keep)
             self._pending[kind].append(
                 _Request(ticket, inp=inp, rev=rev, trace=tr, queue_span=qspan)
             )
@@ -240,14 +263,15 @@ class SolveService:
             self._cv.notify_all()
         return ticket
 
-    def submit_fn(self, dispatch_fn: Callable, kind: str = DISRUPTION) -> SolveTicket:
+    def submit_fn(self, dispatch_fn: Callable, kind: str = DISRUPTION,
+                  tenant_id: Optional[str] = None) -> SolveTicket:
         """Queue generic device work: dispatch_fn() runs on the dispatcher
         thread (host prep + device dispatch) and returns a finish callable;
         finish() runs on the decoder thread and its return value resolves
         the ticket. Used by the disruption controller's batched speculative
         probes so they share the device queue (and its fairness) with
         ordinary solves. Never coalesced."""
-        ticket = SolveTicket(kind)
+        ticket = SolveTicket(kind, tenant_id=tenant_id)
         with self._cv:
             if self._stopped:
                 raise ServiceStopped("solve service is closed")

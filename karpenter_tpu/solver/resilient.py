@@ -201,10 +201,16 @@ class CircuitBreaker:
     """Consecutive-failure breaker with clock-injectable half-open probes."""
 
     def __init__(self, threshold: int = 3, probe_interval_s: float = 30.0,
-                 clock=time.monotonic):
+                 clock=time.monotonic, gauge=None, labels=None):
         self.threshold = max(1, int(threshold))
         self.probe_interval_s = probe_interval_s
         self.clock = clock
+        # export target: the global per-process gauge by default; the
+        # tenancy layer (solver/tenancy.py) passes its per-tenant gauge +
+        # a {"tenant": ...} label set so each tenant's breaker exports its
+        # OWN series instead of fighting over one global value
+        self._gauge = SOLVER_BREAKER_STATE if gauge is None else gauge
+        self._labels = dict(labels) if labels else {}
         self._lock = threading.Lock()
         self._state = CLOSED
         self._consecutive_failures = 0
@@ -212,7 +218,7 @@ class CircuitBreaker:
         self._export()
 
     def _export(self) -> None:
-        SOLVER_BREAKER_STATE.set(_STATE_GAUGE_VALUE[self._state])
+        self._gauge.set(_STATE_GAUGE_VALUE[self._state], **self._labels)
 
     @property
     def state(self) -> str:
@@ -239,6 +245,18 @@ class CircuitBreaker:
             # HALF_OPEN: one probe is already in flight this interval; route
             # concurrent solves to fallback until it reports
             return False
+
+    def peek_allow(self) -> bool:
+        """`allow()` without side effects: would the device path run right
+        now? The tenancy scheduler (solver/tenancy.py) scans every tenant's
+        breaker per dispatch decision — a mutating scan would flip OPEN ->
+        HALF_OPEN (and consume the probe slot) for tenants it never picks."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                return self.clock() - self._opened_at >= self.probe_interval_s
+            return False  # HALF_OPEN: the probe slot is taken
 
     def record_success(self) -> None:
         with self._lock:

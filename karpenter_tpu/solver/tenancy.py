@@ -1,0 +1,778 @@
+"""Tenancy layer: many clusters' solve streams on one shared owner pool.
+
+The fleet (solver/fleet.py) gives us N health-probed device owners behind
+the SolveService surface — but one surface serves ONE cluster's state.
+This module is the subsystem between callers and that surface: a
+`TenantRegistry` of tenant specs (weight, admission depth) and a
+`TenantMux` that multiplexes per-tenant solve streams onto the shared
+pool. The contract, pinned by tests/test_tenancy.py and solver/SPEC.md
+"Tenancy semantics":
+
+Sharing boundary — per-tenant state is exactly the state one tenant's
+churn could poison for another: the encode core-cache namespace
+(encode_cache.tenant_core_cache), the arena RESIDENCY namespace
+(arena.bucket_key ns= — buffers, checkpoints, ladders, shard records),
+the circuit breaker, and the oracle-fallback rung. Everything keyed by
+SHAPE stays shared: jit/AOT compile buckets, the arena `_UNPACK_CACHE`,
+claim-bucket lattices — two tenants with the same padded shapes hit the
+same compiled kernel, so compiles stay flat as tenants grow.
+
+Scheduling — per-tenant FIFO queues drained by virtual-time weighted-fair
+queueing: the dispatcher picks the backlogged tenant with the smallest
+virtual finish `max(V, F_t) + 1/w_t`, so under saturation throughput
+shares converge to the weights, an idle tenant re-enters at the current
+virtual time (no burst credit), and within one tenant order is FIFO.
+Admission control bounds each tenant's open requests (queued + in flight)
+at `max_queue_depth`; past it, submit raises the typed
+`TenantAdmissionReject` — backpressure lands on the noisy tenant alone.
+
+Failure isolation — each tenant carries its OWN CircuitBreaker and
+oracle rung. A device-path failure charges only that tenant's breaker
+and replays on that tenant's oracle (the ticket still resolves — poison
+degrades, it never drops); an open breaker routes that tenant's input
+solves straight to its oracle lane (a dedicated thread, so a slow oracle
+replay can't stall other tenants' dispatches) until a half-open probe
+closes it. Owner-level canary fencing stays global — a wedged DEVICE is
+everyone's problem — and the fleet's fence-requeue already replays
+survivors in original submission order, preserving per-tenant FIFO.
+
+Device-bound closures (submit_fn) bypass the breaker: they are bound to
+a specific owner's device state and cannot replay on an oracle, so the
+mux forwards them as-is and surfaces their failures verbatim.
+
+Tenancy off (no registry configured) means no TenantMux is constructed
+at all — the operator wires the provisioner straight to the fleet /
+pipeline seam, byte-identical to the pre-tenancy path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Callable, Dict, List, Optional
+
+from ..metrics.registry import (
+    TENANT_ADMISSION_REJECTS,
+    TENANT_BREAKER_STATE,
+    TENANT_DEGRADED,
+    TENANT_QUEUE_DEPTH,
+    TENANT_SOLVE_SECONDS,
+)
+from ..obs import trace as obstrace
+from .backend import ReferenceSolver
+from .pipeline import (
+    DISRUPTION,
+    PROVISIONING,
+    ServiceStopped,
+    SolveTicket,
+    Superseded,
+)
+from .resilient import CircuitBreaker
+
+log = logging.getLogger("karpenter_tpu")
+
+
+class TenantAdmissionReject(Exception):
+    """Typed admission refusal: the tenant's open-request count is at its
+    configured depth. The caller (a per-cluster provisioner) sheds load or
+    retries after its next reconcile — nothing was enqueued."""
+
+    def __init__(self, tenant_id: str, depth: int, limit: int):
+        super().__init__(
+            f"tenant {tenant_id!r}: {depth} open solve requests at the "
+            f"admission limit ({limit})"
+        )
+        self.tenant_id = tenant_id
+        self.depth = depth
+        self.limit = limit
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    tenant_id: str
+    weight: float = 1.0
+    max_queue_depth: int = 64
+
+    def __post_init__(self):
+        if not self.tenant_id:
+            raise ValueError("tenant_id must be non-empty")
+        if not self.weight > 0:
+            raise ValueError(
+                f"tenant {self.tenant_id!r}: weight must be > 0, "
+                f"got {self.weight}"
+            )
+        if self.max_queue_depth < 1:
+            raise ValueError(
+                f"tenant {self.tenant_id!r}: max_queue_depth must be >= 1, "
+                f"got {self.max_queue_depth}"
+            )
+
+
+class TenantRegistry:
+    """Ordered tenant universe. Registration order is the WFQ tie-break and
+    the operator's 'first tenant' (its own provisioner's view)."""
+
+    def __init__(self, specs=()):
+        self._specs: "OrderedDict[str, TenantSpec]" = OrderedDict()
+        for spec in specs:
+            self.register(spec)
+
+    def register(self, spec: TenantSpec) -> TenantSpec:
+        if spec.tenant_id in self._specs:
+            raise ValueError(f"duplicate tenant {spec.tenant_id!r}")
+        self._specs[spec.tenant_id] = spec
+        return spec
+
+    def spec(self, tenant_id: str) -> TenantSpec:
+        try:
+            return self._specs[tenant_id]
+        except KeyError:
+            raise KeyError(
+                f"unknown tenant {tenant_id!r} (registered: "
+                f"{list(self._specs)})"
+            ) from None
+
+    def tenants(self) -> List[TenantSpec]:
+        return list(self._specs.values())
+
+    def first(self) -> TenantSpec:
+        return next(iter(self._specs.values()))
+
+    def __len__(self) -> int:
+        return len(self._specs)
+
+    def __contains__(self, tenant_id: str) -> bool:
+        return tenant_id in self._specs
+
+    def remove(self, tenant_id: str) -> None:
+        """Drop a tenant and release its encode-cache namespace. (Arena
+        residency is per-owner device state; it ages out by bucket LRU.)"""
+        from . import encode_cache as ec
+
+        self._specs.pop(tenant_id, None)
+        ec.drop_tenant(tenant_id)
+
+    @classmethod
+    def parse(cls, tenants: str, weights: str = "",
+              max_queue_depth: int = 64) -> "TenantRegistry":
+        """Build a registry from the operator's flag syntax: `tenants` is a
+        comma-separated id list, `weights` is `id=float,...` (unlisted ids
+        weigh 1.0). Fail-closed: raises ValueError on duplicates, unknown
+        weight keys, non-positive weights, or a depth < 1 — the operator
+        refuses to start on a bad tenancy config rather than mis-serving."""
+        ids = [t.strip() for t in tenants.split(",") if t.strip()]
+        if not ids:
+            raise ValueError("--solver-tenants: no tenant ids")
+        wmap: Dict[str, float] = {}
+        for part in (p.strip() for p in weights.split(",") if p.strip()):
+            if "=" not in part:
+                raise ValueError(
+                    f"--tenant-weights: {part!r} is not id=weight"
+                )
+            tid, _, w = part.partition("=")
+            tid = tid.strip()
+            if tid not in ids:
+                raise ValueError(
+                    f"--tenant-weights: {tid!r} is not in --solver-tenants"
+                )
+            if tid in wmap:
+                raise ValueError(f"--tenant-weights: duplicate {tid!r}")
+            try:
+                wmap[tid] = float(w)
+            except ValueError:
+                raise ValueError(
+                    f"--tenant-weights: {w!r} is not a number"
+                ) from None
+        reg = cls()
+        for tid in ids:
+            reg.register(TenantSpec(
+                tenant_id=tid,
+                weight=wmap.get(tid, 1.0),
+                max_queue_depth=max_queue_depth,
+            ))
+        return reg
+
+
+class _TenantBreaker(CircuitBreaker):
+    """Per-tenant breaker: exports its own tenant-labeled gauge series and
+    flight-records with the tenant tag — one tenant's deadline storm shows
+    up in ITS series and ITS dump, never the global breaker's."""
+
+    def __init__(self, tenant_id: str, threshold: int = 3,
+                 probe_interval_s: float = 30.0, clock=time.monotonic):
+        self.tenant_id = tenant_id
+        super().__init__(
+            threshold=threshold, probe_interval_s=probe_interval_s,
+            clock=clock, gauge=TENANT_BREAKER_STATE,
+            labels={"tenant": tenant_id},
+        )
+
+    def _on_open(self, failures: int) -> None:
+        obstrace.dump("tenant_breaker_open", tenant=self.tenant_id,
+                      failures=failures, threshold=self.threshold)
+
+
+class _MuxRequest:
+    __slots__ = ("ticket", "inp", "fn", "kind", "rev", "trace", "qspan",
+                 "t0", "slotted", "vtag")
+
+    def __init__(self, ticket: SolveTicket, inp=None, fn=None,
+                 kind: str = PROVISIONING, rev=None, trace=None,
+                 qspan=None, t0: float = 0.0):
+        self.ticket = ticket
+        self.inp = inp
+        self.fn = fn
+        self.kind = kind
+        self.rev = rev
+        self.trace = trace
+        self.qspan = qspan  # "tenant.queue" span: submit -> mux dispatch
+        self.t0 = t0  # submit timestamp (mux clock) for the latency series
+        self.slotted = False  # holds one of the mux's downstream slots
+        # WFQ finish tag, stamped ONCE when this request first reaches its
+        # tenant's head (start-time fair queueing): re-deriving it from the
+        # advancing virtual clock every scan would inflate a backlogged
+        # light tenant's tag in lockstep with a heavy tenant's and starve it
+        self.vtag: Optional[float] = None
+
+
+class _TenantState:
+    __slots__ = ("spec", "breaker", "oracle", "queue", "lane", "lane_thread",
+                 "vfinish", "open_count", "stats")
+
+    def __init__(self, spec: TenantSpec, breaker: _TenantBreaker):
+        self.spec = spec
+        self.breaker = breaker
+        self.oracle = ReferenceSolver()  # this tenant's own fallback rung
+        self.queue: deque = deque()  # FIFO, both kinds — per-tenant order
+        self.lane: deque = deque()  # degraded requests for the oracle lane
+        self.lane_thread: Optional[threading.Thread] = None
+        self.vfinish = 0.0  # last virtual finish tag (WFQ)
+        self.open_count = 0  # queued + forwarded + lane, vs max_queue_depth
+        self.stats: Dict[str, int] = {
+            "submitted": 0,
+            "completed": 0,
+            "failed": 0,
+            "rejected": 0,
+            "degraded": 0,
+            "superseded": 0,
+        }
+
+
+class TenantMux:
+    """Multiplexes registered tenants' solve streams onto one downstream
+    SolveService/SolverFleet. Owns WFQ dispatch, admission control, and
+    per-tenant breaker/oracle isolation; the downstream surface stays
+    untouched (tenancy off = callers hold the downstream directly)."""
+
+    def __init__(self, service, registry: TenantRegistry,
+                 max_inflight: Optional[int] = None,
+                 breaker_threshold: int = 3,
+                 breaker_probe_s: float = 30.0,
+                 clock=time.monotonic,
+                 own_service: bool = True):
+        if not len(registry):
+            raise ValueError("TenantMux needs at least one registered tenant")
+        self._service = service
+        self.registry = registry
+        self._clock = clock
+        self._own_service = own_service
+        if max_inflight is None:
+            # keep the downstream pipeline full (every owner x its depth)
+            # while the REST of the backlog waits at the mux, where WFQ —
+            # not arrival order — decides who goes next
+            max_inflight = (getattr(service, "size", 1)
+                            * getattr(service, "depth", 2))
+        self.max_inflight = max(1, int(max_inflight))
+        self._cv = threading.Condition()
+        self._tenants: "OrderedDict[str, _TenantState]" = OrderedDict()
+        for spec in registry.tenants():
+            self._tenants[spec.tenant_id] = _TenantState(
+                spec,
+                _TenantBreaker(spec.tenant_id, threshold=breaker_threshold,
+                               probe_interval_s=breaker_probe_s, clock=clock),
+            )
+            TENANT_QUEUE_DEPTH.set(0, tenant=spec.tenant_id)
+        self._vtime = 0.0
+        self._inflight = 0  # forwarded to the downstream, unresolved
+        self._closing = False
+        self._open: set = set()  # _MuxRequest not yet resolved
+        # Superseded deliveries whose superseding downstream ticket is mid-
+        # forward (coalescing fires INSIDE service.submit, before _forward
+        # can record the mapping): (state, stale_req, superseding_dticket)
+        self._superseded_waiting: list = []
+        self._fwd: Dict[SolveTicket, _MuxRequest] = {}  # dticket -> req
+        self.mux_stats: Dict[str, int] = {
+            "mux_submitted": 0,
+            "forwarded": 0,
+            "degraded": 0,
+            "rejected": 0,
+            "mux_coalesced": 0,
+        }
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True, name="tenant-mux-dispatch"
+        )
+        self._dispatcher.start()
+
+    # -- submission ----------------------------------------------------------
+
+    def _state(self, tenant_id: Optional[str]) -> _TenantState:
+        if tenant_id is None or tenant_id not in self._tenants:
+            raise KeyError(
+                f"unknown tenant {tenant_id!r} (registered: "
+                f"{list(self._tenants)})"
+            )
+        return self._tenants[tenant_id]
+
+    def _mint_trace(self, ticket: SolveTicket, kind: str):
+        tr, owned = obstrace.adopt_or_begin(kind)
+        if tr is None:
+            return None, None
+        ticket.solve_id = tr.solve_id
+        obstrace.set_tenant(tr, ticket.tenant_id)
+        if owned:
+            ticket.on_done(
+                lambda t, _tr=tr: obstrace.finish(
+                    _tr, obstrace.status_of(t.error())
+                )
+            )
+        qspan = tr.start_span("tenant.queue", parent=tr.root)
+        qspan.set(tenant_id=ticket.tenant_id, kind=kind)
+        return tr, qspan
+
+    def _admit_locked(self, state: _TenantState) -> None:
+        if self._closing:
+            raise ServiceStopped("tenant mux is closed")
+        if state.open_count >= state.spec.max_queue_depth:
+            state.stats["rejected"] += 1
+            self.mux_stats["rejected"] += 1
+            TENANT_ADMISSION_REJECTS.inc(tenant=state.spec.tenant_id)
+            raise TenantAdmissionReject(
+                state.spec.tenant_id, state.open_count,
+                state.spec.max_queue_depth,
+            )
+
+    def submit(self, inp, tenant_id: Optional[str] = None,
+               kind: str = PROVISIONING, rev=None) -> SolveTicket:
+        """Queue one tenant's SolverInput. Same-tenant provisioning
+        snapshots coalesce at the mux (newest wins, Superseded delivered) —
+        a stale snapshot must not spend the tenant's WFQ turn."""
+        if tenant_id is None:
+            tenant_id = getattr(inp, "tenant_id", None)
+        state = self._state(tenant_id)
+        if rev is None:
+            rev = getattr(inp, "state_rev", None)
+        # stamp the input so encode/arena namespace residency per tenant;
+        # a fresh (shallow) copy — the caller's object is never mutated
+        if dataclasses.is_dataclass(inp) and \
+                getattr(inp, "tenant_id", None) != tenant_id:
+            inp = dataclasses.replace(inp, tenant_id=tenant_id)
+        with self._cv:
+            self._admit_locked(state)
+            ticket = SolveTicket(kind, rev=rev, tenant_id=tenant_id)
+            tr, qspan = self._mint_trace(ticket, kind)
+            req = _MuxRequest(ticket, inp=inp, kind=kind, rev=rev, trace=tr,
+                              qspan=qspan, t0=self._clock())
+            if kind == PROVISIONING:
+                keep: deque = deque()
+                while state.queue:
+                    stale = state.queue.popleft()
+                    if stale.kind != PROVISIONING or stale.inp is None:
+                        keep.append(stale)
+                        continue
+                    self.mux_stats["mux_coalesced"] += 1
+                    if stale.qspan is not None:
+                        stale.qspan.end("superseded")
+                    self._finish_locked(state, stale,
+                                        error=Superseded(by=ticket))
+                state.queue = keep
+            state.queue.append(req)
+            state.open_count += 1
+            state.stats["submitted"] += 1
+            self.mux_stats["mux_submitted"] += 1
+            self._open.add(req)
+            TENANT_QUEUE_DEPTH.set(len(state.queue),
+                                   tenant=state.spec.tenant_id)
+            self._cv.notify_all()
+        return ticket
+
+    def submit_fn(self, dispatch_fn: Callable,
+                  tenant_id: Optional[str] = None,
+                  kind: str = DISRUPTION) -> SolveTicket:
+        """Queue device-bound work for a tenant. Never coalesced; bypasses
+        the tenant breaker (a closure cannot replay on the oracle)."""
+        state = self._state(tenant_id)
+        with self._cv:
+            self._admit_locked(state)
+            ticket = SolveTicket(kind, tenant_id=tenant_id)
+            tr, qspan = self._mint_trace(ticket, kind)
+            req = _MuxRequest(ticket, fn=dispatch_fn, kind=kind, trace=tr,
+                              qspan=qspan, t0=self._clock())
+            state.queue.append(req)
+            state.open_count += 1
+            state.stats["submitted"] += 1
+            self.mux_stats["mux_submitted"] += 1
+            self._open.add(req)
+            TENANT_QUEUE_DEPTH.set(len(state.queue),
+                                   tenant=state.spec.tenant_id)
+            self._cv.notify_all()
+        return ticket
+
+    def view(self, tenant_id: str) -> "TenantView":
+        self._state(tenant_id)  # fail fast on unknown tenants
+        return TenantView(self, tenant_id)
+
+    # -- WFQ dispatch --------------------------------------------------------
+
+    def _pick_locked(self):
+        """Pop the next dispatchable request under the mux lock: the
+        backlogged tenant with the smallest virtual finish whose path can
+        act now (device path needs a downstream slot; the degrade path only
+        needs its lane). Degraded heads route to the oracle lane in-line
+        and selection repeats. Returns (state, req) to forward, or None."""
+        while True:
+            slot_free = self._inflight < self.max_inflight
+            best = None
+            for idx, state in enumerate(self._tenants.values()):
+                if not state.queue:
+                    continue
+                head = state.queue[0]
+                if head.vtag is None:
+                    # stamp the finish tag at head arrival and FREEZE it: an
+                    # idle tenant re-enters at the current virtual time (no
+                    # burst credit), while a backlogged tenant's tag stays
+                    # put so the advancing clock eventually reaches it
+                    head.vtag = (max(self._vtime, state.vfinish)
+                                 + 1.0 / state.spec.weight)
+                device = head.inp is None or state.breaker.peek_allow()
+                if device and not slot_free:
+                    continue
+                if best is None or (head.vtag, idx) < (best[0], best[1]):
+                    best = (head.vtag, idx, state, device)
+            if best is None:
+                return None
+            _, _, state, device = best
+            req = state.queue.popleft()
+            TENANT_QUEUE_DEPTH.set(len(state.queue),
+                                   tenant=state.spec.tenant_id)
+            # allow() is the mutating twin of the peek above: it may flip
+            # OPEN -> HALF_OPEN and consume the probe slot — call it only
+            # for the tenant actually being dispatched
+            if device and req.inp is not None and not state.breaker.allow():
+                device = False  # raced with a failure; degrade after all
+            if not device:
+                if req.qspan is not None:
+                    req.qspan.end("degraded")
+                self._lane_put_locked(state, req)
+                continue
+            # WFQ accounting: only DEVICE dispatches consume the shared
+            # pool, so only they advance the tags; oracle-lane work rides
+            # the tenant's own thread and is free from the pool's view
+            state.vfinish = req.vtag
+            self._vtime = max(self._vtime,
+                              req.vtag - 1.0 / state.spec.weight)
+            self._inflight += 1
+            req.slotted = True
+            if req.qspan is not None:
+                req.qspan.end()
+            return state, req
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cv:
+                job = self._pick_locked()
+                while job is None:
+                    if self._closing:
+                        return
+                    self._cv.wait()
+                    job = self._pick_locked()
+            # forward OUTSIDE the lock: service.submit runs coalescing
+            # callbacks (and, fully degraded, even oracle solves) inline
+            self._forward(*job)
+
+    def _forward(self, state: _TenantState, req: _MuxRequest) -> None:
+        tid = state.spec.tenant_id
+        try:
+            with obstrace.attached(req.trace):
+                if req.fn is not None:
+                    dticket = self._service.submit_fn(
+                        req.fn, kind=req.kind, tenant_id=tid
+                    )
+                else:
+                    dticket = self._service.submit(
+                        req.inp, kind=req.kind, rev=req.rev, tenant_id=tid
+                    )
+        except ServiceStopped as e:
+            self._finish(state, req, error=e)
+            return
+        except Exception as e:  # noqa: BLE001 — isolate: charge + degrade
+            self._on_device_failure(state, req, e)
+            return
+        with self._cv:
+            self._fwd[dticket] = req
+            self.mux_stats["forwarded"] += 1
+            # flush Superseded deliveries parked on the downstream ticket
+            # this submit just created (their coalescing callback ran
+            # inside service.submit, before the mapping above existed)
+            flushes = [(s, r) for (s, r, by) in self._superseded_waiting
+                       if by is dticket]
+            if flushes:
+                self._superseded_waiting = [
+                    (s, r, by) for (s, r, by) in self._superseded_waiting
+                    if by is not dticket
+                ]
+        for s, r in flushes:
+            self._finish(s, r, error=Superseded(by=req.ticket))
+        dticket.on_done(
+            lambda t, s=state, r=req: self._on_downstream_done(s, r, t)
+        )
+
+    def _on_downstream_done(self, state: _TenantState, req: _MuxRequest,
+                            dticket: SolveTicket) -> None:
+        with self._cv:
+            self._fwd.pop(dticket, None)
+        if req.ticket.done():
+            return
+        err = dticket.error()
+        if err is None:
+            state.breaker.record_success()
+            self._finish(state, req, result=dticket.result())
+            return
+        if isinstance(err, Superseded):
+            # map the superseding DOWNSTREAM ticket back to its mux ticket;
+            # park mid-forward deliveries exactly like the fleet does
+            with self._cv:
+                by_req = self._fwd.get(err.by) if err.by is not None else None
+                if by_req is None and err.by is not None and not self._closing:
+                    self._superseded_waiting.append((state, req, err.by))
+                    return
+            self._finish(state, req, error=Superseded(
+                by=by_req.ticket if by_req is not None else None
+            ))
+            return
+        if isinstance(err, ServiceStopped):
+            # infrastructure teardown, not this tenant's fault: no breaker
+            # charge, no oracle replay (the input may outlive the pool)
+            self._finish(state, req, error=err)
+            return
+        self._on_device_failure(state, req, err)
+
+    def _on_device_failure(self, state: _TenantState, req: _MuxRequest,
+                           err: BaseException) -> None:
+        """Charge THIS tenant's breaker; replay inputs on THIS tenant's
+        oracle rung (the solve still lands — poison degrades, never drops);
+        closures surface the failure verbatim."""
+        state.breaker.record_failure()
+        if req.inp is None:
+            self._finish(state, req, error=err)
+            return
+        log.warning(
+            "tenant %s: device-path solve failed (%s: %s) — replaying on "
+            "the tenant oracle", state.spec.tenant_id, type(err).__name__,
+            err, extra={"solve_id": req.ticket.solve_id,
+                        "tenant_id": state.spec.tenant_id},
+        )
+        with self._cv:
+            self._lane_put_locked(state, req)
+            self._cv.notify_all()
+
+    # -- per-tenant oracle lane ----------------------------------------------
+
+    def _lane_put_locked(self, state: _TenantState, req: _MuxRequest) -> None:
+        if req.slotted:
+            req.slotted = False
+            self._inflight -= 1
+        if req.inp is None:
+            # device-bound closure with an open breaker: cannot replay —
+            # mirror the fleet's no-healthy-owner contract
+            req_err = ServiceStopped(
+                f"tenant {state.spec.tenant_id!r} breaker open: "
+                "device-bound work cannot replay on the oracle"
+            )
+            self._finish_locked(state, req, error=req_err)
+            return
+        state.lane.append(req)
+        if state.lane_thread is None:
+            state.lane_thread = threading.Thread(
+                target=self._lane_loop, args=(state,), daemon=True,
+                name=f"tenant-oracle-{state.spec.tenant_id}",
+            )
+            state.lane_thread.start()
+
+    def _lane_loop(self, state: _TenantState) -> None:
+        while True:
+            with self._cv:
+                while not state.lane and not self._closing:
+                    self._cv.wait()
+                if not state.lane:
+                    return  # closing and drained
+                req = state.lane.popleft()
+            self._oracle_solve(state, req)
+
+    def _oracle_solve(self, state: _TenantState, req: _MuxRequest) -> None:
+        tid = state.spec.tenant_id
+        with self._cv:
+            state.stats["degraded"] += 1
+            self.mux_stats["degraded"] += 1
+        TENANT_DEGRADED.inc(tenant=tid)
+        try:
+            with obstrace.attached(req.trace), obstrace.span("tenant.oracle"):
+                # degraded solves stay attributable in /debug/trace and
+                # flight dumps even though no owner service saw them
+                obstrace.annotate(tenant_id=tid, kind=req.kind)
+                res = state.oracle.solve(req.inp)
+        except Exception as e:  # noqa: BLE001 — delivered to the caller
+            self._finish(state, req, error=e)
+            return
+        self._finish(state, req, result=res)
+
+    # -- resolution ----------------------------------------------------------
+
+    def _finish(self, state: _TenantState, req: _MuxRequest, result=None,
+                error: Optional[BaseException] = None) -> None:
+        with self._cv:
+            self._finish_locked(state, req, result=result, error=error)
+            self._cv.notify_all()
+
+    def _finish_locked(self, state: _TenantState, req: _MuxRequest,
+                       result=None,
+                       error: Optional[BaseException] = None) -> None:
+        delivered = req.ticket._deliver(result=result, error=error)
+        if req in self._open:
+            self._open.discard(req)
+            state.open_count = max(0, state.open_count - 1)
+        if req.slotted:
+            req.slotted = False
+            self._inflight -= 1
+        if not delivered:
+            return
+        if error is None:
+            state.stats["completed"] += 1
+            TENANT_SOLVE_SECONDS.observe(
+                max(0.0, self._clock() - req.t0),
+                tenant=state.spec.tenant_id,
+            )
+        elif isinstance(error, Superseded):
+            state.stats["superseded"] += 1
+        else:
+            state.stats["failed"] += 1
+
+    # -- introspection (SolveService-surface compatible) ---------------------
+
+    def queue_depth(self) -> int:
+        with self._cv:
+            held = sum(len(s.queue) + len(s.lane)
+                       for s in self._tenants.values())
+        return held + self._service.queue_depth()
+
+    def occupancy(self) -> float:
+        return self._service.occupancy()
+
+    def unresolved(self) -> int:
+        """Mux tickets not yet resolved (the soak harness's dropped-solve
+        detector reads this after a full drain: it must be 0)."""
+        with self._cv:
+            return sum(1 for r in self._open if not r.ticket.done())
+
+    def tenant_stats(self) -> Dict[str, Dict[str, object]]:
+        with self._cv:
+            return {
+                tid: dict(
+                    state.stats,
+                    queued=len(state.queue),
+                    lane=len(state.lane),
+                    open=state.open_count,
+                    weight=state.spec.weight,
+                    breaker=state.breaker.state,
+                )
+                for tid, state in self._tenants.items()
+            }
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        agg = dict(self._service.stats)
+        with self._cv:
+            agg.update(self.mux_stats)
+            agg["tenants"] = len(self._tenants)
+            agg["mux_open"] = len(self._open)
+        return agg
+
+    @property
+    def solver(self):
+        return self._service.solver
+
+    def resume_stats(self) -> Dict[str, float]:
+        return self._service.resume_stats()
+
+    def shard_stats(self) -> Dict[str, float]:
+        return self._service.shard_stats()
+
+    def decode_stats(self) -> Dict[str, float]:
+        return self._service.decode_stats()
+
+    # -- shutdown ------------------------------------------------------------
+
+    def close(self) -> None:
+        """Stop accepting work; fail everything still held at the mux with
+        ServiceStopped; close the downstream (when owned — its stop
+        resolves every forwarded ticket); join the worker threads. No mux
+        ticket is ever left unresolved."""
+        with self._cv:
+            if self._closing:
+                return
+            self._closing = True
+            drained: List[_MuxRequest] = []
+            for state in self._tenants.values():
+                while state.queue:
+                    drained.append((state, state.queue.popleft()))
+                while state.lane:
+                    drained.append((state, state.lane.popleft()))
+                TENANT_QUEUE_DEPTH.set(0, tenant=state.spec.tenant_id)
+            self._cv.notify_all()
+        err = ServiceStopped("tenant mux is closed")
+        for state, req in drained:
+            if req.qspan is not None:
+                req.qspan.end("stopped")
+            self._finish(state, req, error=err)
+        if self._own_service:
+            self._service.close()
+        # downstream close resolved every forwarded ticket; anything the
+        # callbacks missed (not own_service + caller never closed) fails now
+        with self._cv:
+            leftover = list(self._open)
+        for req in leftover:
+            state = self._tenants.get(req.ticket.tenant_id)
+            if state is not None:
+                self._finish(state, req, error=err)
+        self._dispatcher.join(timeout=5)
+        for state in self._tenants.values():
+            if state.lane_thread is not None:
+                state.lane_thread.join(timeout=5)
+
+
+class TenantView:
+    """One tenant's SolveService-shaped handle on the mux: the operator
+    wires its own provisioner/disruption controller to `mux.view(tenant)`
+    so every submission is pinned to that tenant; introspection falls
+    through to the mux (and from there the shared downstream)."""
+
+    def __init__(self, mux: TenantMux, tenant_id: str):
+        self._mux = mux
+        self.tenant_id = tenant_id
+
+    def submit(self, inp, kind: str = PROVISIONING, rev=None) -> SolveTicket:
+        return self._mux.submit(inp, tenant_id=self.tenant_id, kind=kind,
+                                rev=rev)
+
+    def submit_fn(self, dispatch_fn: Callable,
+                  kind: str = DISRUPTION) -> SolveTicket:
+        return self._mux.submit_fn(dispatch_fn, tenant_id=self.tenant_id,
+                                   kind=kind)
+
+    def close(self) -> None:
+        self._mux.close()
+
+    def __getattr__(self, name):
+        return getattr(self._mux, name)
