@@ -569,3 +569,92 @@ PROBE_BATCH_SIZE = REGISTRY.register(
         buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
     )
 )
+
+# -- decision provenance (obs/explain.py; ISSUE 12 — same naming rule as the
+#    trace series: no _tpu segment, records are backend-neutral) --------------
+
+SOLVER_EXPLAIN_RECORDS = REGISTRY.register(
+    Counter(
+        "karpenter_solver_explain_records_total",
+        "Explain records captured, by table source: device = decoded from "
+        "the EXPLAIN wire section (tpu/ffd.explain_pack), host = the numpy "
+        "deriver (obs/explain.host_table — oracle/native legs and every "
+        "device carve-out)",
+        ("source",),
+    )
+)
+SOLVER_EXPLAIN_WIDE = REGISTRY.register(
+    Counter(
+        "karpenter_solver_explain_wide_total",
+        "Device explain fetches whose wire buffer flagged overflow (node "
+        "index above uint16) — the host deriver recomputed the table, "
+        "mirroring the claim delta's wide re-fetch carve-out",
+    )
+)
+SOLVER_EXPLAIN_BYTES = REGISTRY.register(
+    Gauge(
+        "karpenter_solver_explain_bytes_per_solve",
+        "Device→host bytes the last EXPLAIN wire fetch moved (0 when the "
+        "explain knob is off — the off path adds no tunnel traffic, which "
+        "bench's --explain-suite asserts via the transfer ledger)",
+    )
+)
+
+# -- SLO engine (obs/slo.py; ISSUE 12) ----------------------------------------
+
+SLO_BURN_RATE = REGISTRY.register(
+    Gauge(
+        "karpenter_slo_burn_rate",
+        "Multi-window burn rate per SLO stage: breach fraction over the "
+        "window divided by the error budget (1 - target). window=fast is "
+        "5m, window=slow is 1h; page when fast>=14.4 and slow>=6 "
+        "(obs/slo.py, surfaced in /healthz)",
+        ("stage", "window"),
+    )
+)
+SLO_BREACHES = REGISTRY.register(
+    Counter(
+        "karpenter_slo_breaches_total",
+        "Span observations exceeding their stage's SLO latency threshold "
+        "(obs/slo.py objectives; fed from trace finish like "
+        "karpenter_solver_stage_seconds)",
+        ("stage",),
+    )
+)
+
+# -- per-tenant metering (obs/slo.py; ISSUE 12 — billing-grade usage ledger
+#    on top of the ISSUE 11 mux: tenant \"default\" when no mux attributed
+#    the solve) ---------------------------------------------------------------
+
+TENANT_METER_SOLVES = REGISTRY.register(
+    Counter(
+        "karpenter_tenant_meter_solves_total",
+        "Completed solves metered per tenant (one per finished trace; "
+        "tenant from the trace's tenancy attribution)",
+        ("tenant",),
+    )
+)
+TENANT_METER_DEVICE_MS = REGISTRY.register(
+    Counter(
+        "karpenter_tenant_meter_device_ms_total",
+        "Device dispatch milliseconds metered per tenant (sum of "
+        "backend.dispatch span durations at trace finish)",
+        ("tenant",),
+    )
+)
+TENANT_METER_H2D_BYTES = REGISTRY.register(
+    Counter(
+        "karpenter_tenant_meter_h2d_bytes_total",
+        "Host→device bytes metered per tenant (transfer-ledger uploads "
+        "attributed via the calling thread's trace tenancy)",
+        ("tenant",),
+    )
+)
+TENANT_METER_D2H_BYTES = REGISTRY.register(
+    Counter(
+        "karpenter_tenant_meter_d2h_bytes_total",
+        "Device→host bytes metered per tenant (transfer-ledger fetches "
+        "attributed via the calling thread's trace tenancy)",
+        ("tenant",),
+    )
+)

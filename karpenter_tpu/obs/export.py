@@ -25,13 +25,21 @@ def chrome_trace(traces, anchor: Optional[Dict[str, float]] = None) -> dict:
     tids: Dict[str, int] = {}
     for tr in traces:
         snap = tr.snapshot() if hasattr(tr, "snapshot") else tr
+        # multi-tenant reads: attributed traces get their own track lane
+        # (`tenant/<id>/<thread>`) so one tenant's solves line up visually
+        # instead of interleaving with every other tenant on shared worker
+        # threads; unattributed traces keep the bare thread lane
+        tenant = snap.get("tenant_id")
+        lane_prefix = f"tenant/{tenant}/" if tenant else ""
         for sp in snap["spans"]:
-            tid = tids.setdefault(sp["thread"], len(tids) + 1)
+            tid = tids.setdefault(lane_prefix + sp["thread"], len(tids) + 1)
             args = dict(sp["attrs"])
             args.update(
                 solve_id=snap["solve_id"], span_id=sp["span_id"],
                 parent_id=sp["parent_id"], status=sp["status"],
             )
+            if tenant:
+                args["tenant_id"] = tenant
             if snap["links"]:
                 args["links"] = snap["links"]
             ev = {

@@ -40,11 +40,13 @@ log = logging.getLogger("karpenter_tpu")
 
 class FlightRecorder:
     def __init__(self, dir: Optional[str] = None, capacity: int = 32,
-                 min_interval_s: float = 30.0, clock=time.monotonic):
+                 min_interval_s: float = 30.0, clock=time.monotonic,
+                 keep: int = 32):
         self.dir = dir or tempfile.gettempdir()
         self.capacity = max(1, int(capacity))
         self.min_interval_s = float(min_interval_s)
         self.clock = clock
+        self.keep = max(1, int(keep))
         self._lock = threading.Lock()
         self._seq = 0
         self._last_by_reason: Dict[str, float] = {}
@@ -107,11 +109,24 @@ class FlightRecorder:
                 "partial_traces": [t.snapshot() for t in partial],
                 "traces": [t.snapshot() for t in traces],
             }
+            try:
+                # decision provenance riding the crash dump: the most recent
+                # explain records (why each pod landed where it did) for the
+                # solves whose traces are being snapshotted. default=str
+                # round-trip so a stray non-JSON value degrades to a string
+                # instead of failing the whole dump.
+                from . import explain as _explain
+                payload["explain"] = json.loads(
+                    json.dumps(_explain.store().recent(8), default=str)
+                )
+            except Exception:  # noqa: BLE001
+                payload["explain"] = None
             with open(path, "w") as f:
                 json.dump(payload, f, indent=1)
         except Exception as e:  # noqa: BLE001 — a dump must never crash a fence
             log.error("flight recorder: dump to %s failed: %s", path, e)
             return None
+        self._prune()
         with self._lock:
             self.dumps += 1
             self.last_dump = {
@@ -124,6 +139,33 @@ class FlightRecorder:
             "(reason: %s)", len(traces), len(partial), path, reason,
         )
         return path
+
+    def _prune(self) -> None:
+        """Cap on-disk dumps at `keep` (oldest-first by mtime, across every
+        process writing to the same dir — the glob is pid-agnostic). The
+        throttle bounds RATE; this bounds TOTAL, so a long-lived crash loop
+        cannot creep past the per-reason interval and fill the disk. Best
+        effort: pruning runs on fence/breaker recovery paths and must never
+        raise past them."""
+        try:
+            prefix = "karpenter-flightrec-"
+            entries = []
+            for name in os.listdir(self.dir):
+                if not name.startswith(prefix) or not name.endswith(".json"):
+                    continue
+                p = os.path.join(self.dir, name)
+                try:
+                    entries.append((os.path.getmtime(p), p))
+                except OSError:
+                    continue  # raced with another pruner
+            entries.sort()
+            for _, p in entries[:max(0, len(entries) - self.keep)]:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass  # raced; the file is gone either way
+        except Exception as e:  # noqa: BLE001 — never fail a recovery path
+            log.error("flight recorder: prune in %s failed: %s", self.dir, e)
 
     def health(self) -> Dict[str, object]:
         """Summary surfaced by the operator's health endpoint."""

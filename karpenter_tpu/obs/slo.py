@@ -1,0 +1,235 @@
+"""Per-stage latency SLOs with multi-window burn rates + tenant metering.
+
+Raw histograms (karpenter_solver_stage_seconds) answer "how slow was it";
+an operator paging decision needs "how fast am I spending the error
+budget". This module keeps, per SLO stage, a rolling 1-hour ring of 10s
+buckets of (observations, threshold breaches) and evaluates the classic
+multi-window burn rate:
+
+    burn(window) = breach_fraction(window) / (1 - target)
+
+over a FAST 5m window (catches a sudden regression within minutes) and a
+SLOW 1h window (filters one-bucket blips). Alert states follow the
+standard pairing — page when fast >= 14.4 AND slow >= 6 (budget gone in
+hours), warn when fast >= 6 AND slow >= 3 — exported as
+`karpenter_slo_burn_rate{stage,window}` gauges and the /healthz "slo"
+object.
+
+Feed: `observe_trace()` is called by obs/trace.finish for every completed
+trace, so SLOs measure exactly what the spans measure — no second timing
+source. The same hook meters per-tenant usage (solves, device-dispatch
+milliseconds); the transfer ledger (solver/arena.py) meters per-tenant
+h2d/d2h bytes through `meter_bytes()`. Unattributed solves meter under
+tenant "default" so the series always exists.
+
+The clock is injectable (`configure(clock=...)`) so tests drive window
+rotation deterministically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from ..metrics.registry import (
+    SLO_BREACHES,
+    SLO_BURN_RATE,
+    TENANT_METER_D2H_BYTES,
+    TENANT_METER_DEVICE_MS,
+    TENANT_METER_H2D_BYTES,
+    TENANT_METER_SOLVES,
+)
+
+FAST_WINDOW_S = 300
+SLOW_WINDOW_S = 3600
+_BUCKET_S = 10
+_N_BUCKETS = SLOW_WINDOW_S // _BUCKET_S
+
+# multi-window alert thresholds (burn-rate pairs)
+PAGE_FAST, PAGE_SLOW = 14.4, 6.0
+WARN_FAST, WARN_SLOW = 6.0, 3.0
+
+# stage -> (latency threshold seconds, target success fraction)
+DEFAULT_OBJECTIVES: Dict[str, Tuple[float, float]] = {
+    "solve": (1.0, 0.99),
+    "pipeline.queue": (0.5, 0.99),
+    "backend.dispatch": (0.5, 0.99),
+}
+
+
+def parse_objectives(spec: str) -> Dict[str, Tuple[float, float]]:
+    """Parse the operator knob: "stage=threshold_ms:target,..." — e.g.
+    "solve=1000:0.99,backend.dispatch=500:0.995". Empty string means the
+    defaults. Raises ValueError on malformed entries (options.py turns
+    that into a fail-closed SystemExit)."""
+    if not spec.strip():
+        return dict(DEFAULT_OBJECTIVES)
+    out: Dict[str, Tuple[float, float]] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        stage, _, rest = part.partition("=")
+        ms_s, _, target_s = rest.partition(":")
+        stage = stage.strip()
+        if not stage or not ms_s or not target_s:
+            raise ValueError(f"bad SLO objective {part!r} "
+                             "(want stage=threshold_ms:target)")
+        ms = float(ms_s)
+        target = float(target_s)
+        if ms <= 0 or not (0.0 < target < 1.0):
+            raise ValueError(f"bad SLO objective {part!r} "
+                             "(threshold_ms > 0, 0 < target < 1)")
+        out[stage] = (ms / 1000.0, target)
+    return out
+
+
+class _StageWindow:
+    """Ring of 10s buckets over the slow window; head advances lazily on
+    observe/read so idle stages decay to zero without a timer thread."""
+
+    __slots__ = ("threshold_s", "target", "total", "breached", "_cur")
+
+    def __init__(self, threshold_s: float, target: float):
+        self.threshold_s = float(threshold_s)
+        self.target = min(max(float(target), 0.0), 0.999999)
+        self.total = [0] * _N_BUCKETS
+        self.breached = [0] * _N_BUCKETS
+        self._cur: Optional[int] = None  # absolute bucket id of the head
+
+    def _advance(self, now: float) -> None:
+        b = int(now // _BUCKET_S)
+        if self._cur is None:
+            self._cur = b
+            return
+        d = b - self._cur
+        if d <= 0:
+            return
+        for i in range(min(d, _N_BUCKETS)):
+            idx = (self._cur + 1 + i) % _N_BUCKETS
+            self.total[idx] = 0
+            self.breached[idx] = 0
+        self._cur = b
+
+    def observe(self, duration_s: float, now: float) -> bool:
+        self._advance(now)
+        idx = self._cur % _N_BUCKETS
+        self.total[idx] += 1
+        breach = duration_s > self.threshold_s
+        if breach:
+            self.breached[idx] += 1
+        return breach
+
+    def _fraction(self, window_s: int) -> float:
+        n = window_s // _BUCKET_S
+        tot = br = 0
+        for i in range(n):
+            idx = (self._cur - i) % _N_BUCKETS
+            tot += self.total[idx]
+            br += self.breached[idx]
+        return br / tot if tot else 0.0
+
+    def rates(self, now: float) -> Tuple[float, float]:
+        self._advance(now)
+        budget = 1.0 - self.target
+        return (self._fraction(FAST_WINDOW_S) / budget,
+                self._fraction(SLOW_WINDOW_S) / budget)
+
+
+_LOCK = threading.Lock()
+_CLOCK = time.monotonic
+_STAGES: Dict[str, _StageWindow] = {}
+
+
+def configure(objectives: Optional[Dict[str, Tuple[float, float]]] = None,
+              clock=time.monotonic) -> None:
+    """(Re)configure stage objectives; resets all windows — call once at
+    operator boot, or per-test for isolation."""
+    global _CLOCK, _STAGES
+    with _LOCK:
+        _CLOCK = clock
+        obj = DEFAULT_OBJECTIVES if objectives is None else objectives
+        _STAGES = {s: _StageWindow(th, tg) for s, (th, tg) in obj.items()}
+
+
+configure()
+
+
+def record(stage: str, duration_s: float, now: Optional[float] = None) -> None:
+    """One span observation against its stage objective (no-op for stages
+    without one). Pushes the stage's burn-rate gauges on every record so
+    /metrics never lags the windows."""
+    win = _STAGES.get(stage)
+    if win is None:
+        return
+    with _LOCK:
+        t = _CLOCK() if now is None else now
+        if win.observe(duration_s, t):
+            SLO_BREACHES.inc(stage=stage)
+        fast, slow = win.rates(t)
+    SLO_BURN_RATE.set(fast, stage=stage, window="fast")
+    SLO_BURN_RATE.set(slow, stage=stage, window="slow")
+
+
+def _state(fast: float, slow: float) -> str:
+    if fast >= PAGE_FAST and slow >= PAGE_SLOW:
+        return "page"
+    if fast >= WARN_FAST and slow >= WARN_SLOW:
+        return "warn"
+    return "ok"
+
+
+def burn_rates() -> Dict[str, Dict[str, float]]:
+    with _LOCK:
+        t = _CLOCK()
+        return {s: dict(zip(("fast", "slow"), w.rates(t)))
+                for s, w in _STAGES.items()}
+
+
+def health() -> dict:
+    """The /healthz "slo" object: per-stage burn rates + alert state,
+    overall = the worst stage."""
+    rates = burn_rates()
+    stages = {}
+    worst = "ok"
+    order = {"ok": 0, "warn": 1, "page": 2}
+    for s, r in sorted(rates.items()):
+        st = _state(r["fast"], r["slow"])
+        stages[s] = {"fast": round(r["fast"], 4), "slow": round(r["slow"], 4),
+                     "state": st}
+        if order[st] > order[worst]:
+            worst = st
+    return {"state": worst, "stages": stages}
+
+
+# -- per-tenant metering -------------------------------------------------------
+
+
+def observe_trace(trace) -> None:
+    """Feed one finished trace: per-stage SLO observations + the tenant
+    usage ledger (solves, device-dispatch ms). Called by obs/trace.finish;
+    never raises past it."""
+    tenant = getattr(trace, "tenant_id", None) or "default"
+    TENANT_METER_SOLVES.inc(tenant=tenant)
+    with _LOCK:
+        now = _CLOCK()
+    dispatch_ms = 0.0
+    for sp in list(trace.spans):
+        if sp.t1 is None:
+            continue
+        d = sp.t1 - sp.t0
+        record(sp.name, d, now=now)
+        if sp.name == "backend.dispatch":
+            dispatch_ms += d * 1000.0
+    if dispatch_ms:
+        TENANT_METER_DEVICE_MS.inc(dispatch_ms, tenant=tenant)
+
+
+def meter_bytes(tenant: Optional[str], h2d: int = 0, d2h: int = 0) -> None:
+    """Transfer-ledger feed (solver/arena.py): per-tenant tunnel bytes."""
+    t = tenant or "default"
+    if h2d:
+        TENANT_METER_H2D_BYTES.inc(h2d, tenant=t)
+    if d2h:
+        TENANT_METER_D2H_BYTES.inc(d2h, tenant=t)

@@ -223,6 +223,14 @@ def finish(trace: Optional[Trace], status: str = "ok") -> None:
     for sp in list(trace.spans):
         if sp.t1 is not None:
             SOLVER_STAGE_SECONDS.observe(sp.t1 - sp.t0, stage=sp.name)
+    # same span walk feeds the SLO burn-rate windows + tenant metering
+    # (obs/slo.py) — one timing source for histograms, SLOs and billing
+    try:
+        from . import slo as _slo
+
+        _slo.observe_trace(trace)
+    except Exception:  # noqa: BLE001 — diagnostics never fail a solve
+        log.exception("trace: SLO feed failed — continuing")
 
 
 def status_of(error: Optional[BaseException]) -> str:

@@ -21,6 +21,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..controllers import store as st
 from ..metrics.registry import REGISTRY
+from ..obs import explain as obsexplain
+from ..obs import slo as obsslo
 from ..obs import trace as obstrace
 from ..obs.export import chrome_trace
 from ..obs.logjson import JsonLogFormatter
@@ -48,6 +50,9 @@ def serve_endpoints(port: int, health_port: int, enable_profiling: bool = False)
                 body = json.dumps({
                     "status": "ok",
                     "flight_recorder": rec.health() if rec is not None else None,
+                    # per-stage SLO burn-rate state (obs/slo.py): "ok" |
+                    # "warn" | "page" overall, per-stage fast/slow rates
+                    "slo": obsslo.health(),
                 }).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -55,20 +60,88 @@ def serve_endpoints(port: int, health_port: int, enable_profiling: bool = False)
                 self.wfile.write(body)
             elif self.path.startswith("/debug/trace"):
                 # Perfetto-loadable dump of the last N finished traces plus
-                # every still-open (in-flight or wedged) solve
+                # every still-open (in-flight or wedged) solve; filterable
+                # to one solve (?solve_id=) or one tenant's lanes (?tenant=)
                 _, _, query = self.path.partition("?")
                 last = None
+                solve_id = tenant = None
                 for part in query.split("&"):
-                    if part.startswith("last="):
+                    if not part:
+                        continue
+                    key, _, val = part.partition("=")
+                    if key == "last":
                         try:
-                            last = max(1, int(part.split("=", 1)[1]))
+                            last = max(1, int(val))
                         except ValueError:
                             self.send_response(400)
                             self.end_headers()
                             self.wfile.write(b"bad last\n")
                             return
+                    elif key == "solve_id":
+                        if not val:
+                            self.send_response(400)
+                            self.end_headers()
+                            self.wfile.write(b"bad solve_id\n")
+                            return
+                        solve_id = val
+                    elif key == "tenant":
+                        if not val:
+                            self.send_response(400)
+                            self.end_headers()
+                            self.wfile.write(b"bad tenant\n")
+                            return
+                        tenant = val
                 traces = obstrace.recent(last) + obstrace.active_traces()
+                if solve_id is not None:
+                    traces = [t for t in traces if t.solve_id == solve_id]
+                if tenant is not None:
+                    traces = [t for t in traces if t.tenant_id == tenant]
                 body = json.dumps(chrome_trace(traces)).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path.startswith("/debug/explain"):
+                # decision provenance (obs/explain.py): ?solve_id= returns
+                # that solve's record (404 when evicted/unknown), ?pod=
+                # every retained record mentioning the pod, bare = the
+                # most recent records
+                _, _, query = self.path.partition("?")
+                solve_id = pod = None
+                for part in query.split("&"):
+                    if not part:
+                        continue
+                    key, _, val = part.partition("=")
+                    if key == "solve_id":
+                        if not val:
+                            self.send_response(400)
+                            self.end_headers()
+                            self.wfile.write(b"bad solve_id\n")
+                            return
+                        solve_id = val
+                    elif key == "pod":
+                        if not val:
+                            self.send_response(400)
+                            self.end_headers()
+                            self.wfile.write(b"bad pod\n")
+                            return
+                        pod = val
+                store = obsexplain.store()
+                if solve_id is not None:
+                    payload = store.get(solve_id)
+                    if payload is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        self.wfile.write(b"unknown solve_id\n")
+                        return
+                elif pod is not None:
+                    payload = store.by_pod(pod)
+                else:
+                    payload = store.recent(16)
+                body = json.dumps(
+                    {"enabled": obsexplain.enabled(), "result": payload},
+                    default=str,
+                ).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.end_headers()
@@ -106,8 +179,12 @@ def main(argv=None) -> int:
     obstrace.configure(
         enabled=o.solver_tracing,
         ring=o.trace_ring_size,
-        recorder=FlightRecorder(dir=o.flight_recorder_dir or None),
+        recorder=FlightRecorder(dir=o.flight_recorder_dir or None,
+                                keep=o.flight_recorder_keep),
     )
+    obsexplain.configure(enabled=o.solver_explain, top_k=o.explain_top_k,
+                         ring=o.explain_ring_size)
+    obsslo.configure(objectives=obsslo.parse_objectives(o.slo_objectives))
     log = logging.getLogger("karpenter_tpu")
     solver = (
         TPUSolver(arena=o.solver_arena, resume=o.solver_resume,

@@ -51,6 +51,24 @@ class Options:
     # flight-recorder dump directory (invariant-gate reject / breaker open /
     # fleet fence write crash evidence here); empty = the system temp dir
     flight_recorder_dir: str = ""
+    # on-disk flight-recorder dump cap: after each dump the oldest
+    # karpenter-flightrec-*.json files beyond this count are pruned (the
+    # per-reason throttle bounds rate; this bounds total disk)
+    flight_recorder_keep: int = 32
+    # decision provenance (obs/explain.py): per-solve explain records —
+    # chosen node, top-K rejected candidates with typed reason codes,
+    # preemption/gang rationale — served at /debug/explain and attached to
+    # flight-recorder dumps. Off by default: the off path adds zero device
+    # traffic and zero allocations (proven inert in bench.py --explain-suite)
+    solver_explain: bool = False
+    # rejected-candidate rows kept per group in each explain record
+    explain_top_k: int = 8
+    # explain records kept for /debug/explain (ring, newest wins)
+    explain_ring_size: int = 256
+    # per-stage latency SLOs (obs/slo.py): "stage=threshold_ms:target,..."
+    # e.g. "solve=1000:0.99,backend.dispatch=500:0.995"; empty = defaults.
+    # Burn rates export as karpenter_slo_burn_rate and gate /healthz
+    slo_objectives: str = ""
     feature_gates: str = ""
     leader_elect: bool = True
     # solver backend: tpu | reference
@@ -285,9 +303,39 @@ def parse(argv: Optional[Sequence[str]] = None, cls=Options) -> Options:
     # paths, so a typo'd env value ("ture", "on") must not silently become
     # False and mask the fast path being off in prod — fail closed like the
     # resume interval above instead of inheriting bool()'s permissiveness.
+    # explain/SLO knob sanity (same fail-closed rule as the rings above)
+    keep = getattr(out, "flight_recorder_keep", None)
+    if keep is not None and int(keep) < 1:
+        raise SystemExit(
+            "refusing to start: --flight-recorder-keep must be >= 1 "
+            f"(got {keep}); it caps on-disk flight-recorder dumps "
+            "(obs/recorder.py)"
+        )
+    topk = getattr(out, "explain_top_k", None)
+    if topk is not None and int(topk) < 1:
+        raise SystemExit(
+            "refusing to start: --explain-top-k must be >= 1 "
+            f"(got {topk}); it is the rejected-candidate rows kept per "
+            "group in each explain record (obs/explain.py)"
+        )
+    ering = getattr(out, "explain_ring_size", None)
+    if ering is not None and int(ering) < 1:
+        raise SystemExit(
+            "refusing to start: --explain-ring-size must be >= 1 "
+            f"(got {ering}); it bounds the explain-record ring backing "
+            "/debug/explain (obs/explain.py)"
+        )
+    slo_spec = getattr(out, "slo_objectives", None)
+    if slo_spec:
+        from ..obs.slo import parse_objectives
+
+        try:
+            parse_objectives(slo_spec)
+        except ValueError as e:
+            raise SystemExit(f"refusing to start: {e}") from None
     for name in (
         "solver_device_decode", "solver_relax_ladder",
-        "solver_preemption", "solver_gang",
+        "solver_preemption", "solver_gang", "solver_explain",
     ):
         if not hasattr(out, name):
             continue

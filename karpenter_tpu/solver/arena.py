@@ -55,6 +55,7 @@ from ..metrics.registry import (
     SOLVER_UPLOAD_ARRAYS,
     SOLVER_UPLOAD_BYTES,
 )
+from ..obs import slo as obsslo
 from ..obs import trace as obstrace
 
 _LEDGER_FIELDS = ("h2d_bytes", "h2d_arrays", "h2d_msgs", "d2h_bytes",
@@ -96,12 +97,16 @@ class TransferLedger:
                          ("h2d_msgs", msgs), ("h2d_shard_bytes", shard_bytes)):
                 self.solve[k] += v
                 self.total[k] += v
+        # per-tenant usage ledger (obs/slo.py): attribute via the calling
+        # thread's trace tenancy — uploads happen inside backend.upload
+        obsslo.meter_bytes(obstrace.current_tenant_id(), h2d=nbytes)
 
     def record_fetch(self, nbytes: int, msgs: int = 1) -> None:
         with self._lock:
             for k, v in (("d2h_bytes", nbytes), ("d2h_msgs", msgs)):
                 self.solve[k] += v
                 self.total[k] += v
+        obsslo.meter_bytes(obstrace.current_tenant_id(), d2h=nbytes)
 
     def record_adopt(self, outcome: str) -> None:
         # encode-cache hit class rides on the solve's span tree (the

@@ -13,6 +13,7 @@ bit-identical; `tests/test_solver_parity.py` asserts it.
 from __future__ import annotations
 
 import abc
+import logging
 import threading as _threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
@@ -32,6 +33,8 @@ from ..provisioning.scheduler import (
 from ..scheduling.requirements import IN, Requirement, Requirements
 from ..metrics.registry import (
     SOLVER_DECODE_BYTES,
+    SOLVER_EXPLAIN_BYTES,
+    SOLVER_EXPLAIN_WIDE,
     SOLVER_MESH_DEVICES,
     SOLVER_RELAX_DISPATCHES,
     SOLVER_RESUME_HIT_RATE,
@@ -41,9 +44,12 @@ from ..metrics.registry import (
     SOLVER_SOLVES,
     SOLVER_WIDE_REFETCH,
 )
+from ..obs import explain as obsexplain
 from ..obs import trace as obstrace
 from ..utils.resources import PODS, Resources
 from .encode import EncodedInput, UnpackableInput, encode, quantize_input
+
+log = logging.getLogger("karpenter_tpu")
 
 
 class Solver(abc.ABC):
@@ -74,7 +80,10 @@ class ReferenceSolver(Solver):
         # solve; delegation layers count nothing (no double counting)
         SOLVER_SOLVES.inc(backend="oracle")
         with obstrace.span("backend.oracle"):
-            return canonicalize_placements(inp, Scheduler(inp).solve())
+            res = canonicalize_placements(inp, Scheduler(inp).solve())
+        if obsexplain.enabled():
+            obsexplain.capture(inp, res, "oracle")
+        return res
 
 
 def canonicalize_placements(inp: SolverInput, res: SolverResult) -> SolverResult:
@@ -901,6 +910,12 @@ class TPUSolver(Solver):
                 return self.fallback.solve(qinp)
             self.stats["device_solves"] += 1
             SOLVER_SOLVES.inc(backend="device")
+            if obsexplain.enabled():
+                # the EXPLAIN table decoded from the device wire rides the
+                # result (stashed by _device_solve_async); None = a carve-out
+                # (resume/shard/overflow) — the host deriver recomputes
+                tbl = getattr(out, "_explain_table", None)
+                obsexplain.capture(qinp, out, "tpu", enc=enc, table=tbl)
             return out
 
         return AsyncSolve(finish)
@@ -975,7 +990,23 @@ class TPUSolver(Solver):
                 # materialized signature differs from its unrelaxed twins),
                 # so canonicalize fungible-pod assignments over the ORIGINAL
                 # pods — the same post-pass ReferenceSolver applies
-                return canonicalize_placements(qinp, out)
+                final = canonicalize_placements(qinp, out)
+                if obsexplain.enabled():
+                    # relaxed/materialized runs differ from the original
+                    # encode frame, so the table host-derives against the
+                    # ORIGINAL input; the rungs each pod dropped ride as a
+                    # leg annotation (an execution detail, not a decision
+                    # fact — excluded from the parity fingerprint)
+                    obsexplain.capture(
+                        qinp, final, "tpu",
+                        annotations={
+                            "relax_dispatches": n_disp,
+                            "relax_dropped": {
+                                u: r for u, r in dropped.items() if r
+                            },
+                        },
+                    )
+                return final
             dropped[cand] += 1
         self.stats["fallback_solves"] += 1
         return self.fallback.solve(qinp)
@@ -1233,7 +1264,17 @@ class TPUSolver(Solver):
             self.stats["ladder_rungs_used"] = lad["rungs"]
             SOLVER_SOLVES.inc(backend="device")
             SOLVER_RELAX_DISPATCHES.set(1.0)
-            return canonicalize_placements(qinp, res)
+            final = canonicalize_placements(qinp, res)
+            if obsexplain.enabled():
+                # same frame rule as _relax_solve: table host-derives
+                # against the original input (the ladder enc carries ghost
+                # rung groups); rung count is a leg annotation
+                obsexplain.capture(
+                    qinp, final, "tpu",
+                    annotations={"relax_dispatches": 1,
+                                 "ladder_rungs": lad["rungs"]},
+                )
+            return final
         dropped = {u: 0 for u in items_map}
         return self._relax_solve(qinp, items_map, order, dropped, None)
 
@@ -1629,6 +1670,68 @@ class TPUSolver(Solver):
             pass  # backend without async host copies: asarray will block
         return flat_dev, unpack
 
+    def _device_explain(self, enc: EncodedInput, out):
+        """Dispatch the EXPLAIN side kernel (tpu/ffd.explain_pack) over the
+        solve's device-resident take table plus the host-built side tables
+        (encode.explain_tables), fetch the int32 wire buffer through the
+        transfer ledger, and decode the real-group prefix. Returns
+        (n_rejected, words) or None when the node axis overflows the uint16
+        entry half — the host deriver recomputes at full width, counted by
+        SOLVER_EXPLAIN_WIDE (same carve-out discipline as the claim-delta
+        wide refetch). The group axis pads to a power of two so the jit
+        cache stays bounded; Z/C widths pad to >= 1 with all-False columns,
+        the same rule the numpy twin applies, keeping the tables bit-equal."""
+        from .tpu.ffd import explain_pack, unpack_explain
+        from .encode import explain_tables
+
+        take_e = out.take_e
+        Sp, Ep = int(take_e.shape[0]), int(take_e.shape[1])
+        if Ep > 0xFFFF:
+            SOLVER_EXPLAIN_WIDE.inc()
+            return None
+        t = explain_tables(enc)
+        G = int(t["group_req"].shape[0])
+        E = int(t["node_free"].shape[0])
+        R = int(t["group_req"].shape[1])
+        S = int(t["run_group"].shape[0])
+        Gp = 1 << (max(G, 1) - 1).bit_length()
+        gz = np.asarray(t["group_zone"], bool).reshape(G, -1)
+        gc = np.asarray(t["group_ct"], bool).reshape(G, -1)
+        Z, C = max(1, gz.shape[1]), max(1, gc.shape[1])
+        run_group = np.zeros(Sp, dtype=np.int32)
+        run_group[:S] = t["run_group"]
+        group_req = np.zeros((Gp, R), dtype=np.int32)
+        group_req[:G] = t["group_req"]
+        node_free = np.zeros((Ep, R), dtype=np.int32)
+        node_free[:E] = t["node_free"]
+        node_compat = np.zeros((Gp, Ep), dtype=bool)
+        node_compat[:G, :E] = t["node_compat"]
+        node_zone = np.full(Ep, -1, dtype=np.int32)
+        node_zone[:E] = t["node_zone"]
+        node_ct = np.full(Ep, -1, dtype=np.int32)
+        node_ct[:E] = t["node_ct"]
+        group_zone = np.zeros((Gp, Z), dtype=bool)
+        group_zone[:G, : gz.shape[1]] = gz
+        group_ct = np.zeros((Gp, C), dtype=bool)
+        group_ct[:G, : gc.shape[1]] = gc
+        group_topo = np.zeros(Gp, dtype=bool)
+        group_topo[:G] = t["group_topo"]
+        group_aff = np.zeros(Gp, dtype=bool)
+        group_aff[:G] = t["group_aff"]
+        k = obsexplain.top_k()
+        flat = np.asarray(explain_pack(
+            take_e, run_group, group_req, node_free, node_compat,
+            node_zone, node_ct, group_zone, group_ct, group_topo,
+            group_aff, np.int32(E), np.int32(G), top_k=k,
+        ))
+        self.ledger.record_fetch(flat.nbytes)
+        SOLVER_EXPLAIN_BYTES.set(float(flat.nbytes))
+        overflow, n_rej, words = unpack_explain(flat, G)
+        if overflow:
+            SOLVER_EXPLAIN_WIDE.inc()
+            return None
+        return n_rej, words
+
     def _device_solve_async(self, enc: EncodedInput):
         try:
             host_args, dims, prov = host_kernel_args(enc, self._bucket)
@@ -1693,6 +1796,28 @@ class TPUSolver(Solver):
             try:
                 M = M0
                 cur_plan, cur_out, cur_ring = plan, out, ring
+
+                def stash_explain(res):
+                    # EXPLAIN side section: cold dispatches only — a resumed
+                    # solve's take table is stitched host-side, so the
+                    # device rows alone would disagree with the final
+                    # decisions; those solves host-derive (carve-out).
+                    # Stashed as a plain attribute: solve_async's finish
+                    # hands it to obs/explain.capture as the wire table.
+                    if res is None or cur_plan is not None:
+                        return res
+                    if not obsexplain.enabled():
+                        return res
+                    try:
+                        tbl = self._device_explain(enc, cur_out)
+                    except Exception:  # noqa: BLE001 — provenance never
+                        log.exception(  # fails a solve; host deriver covers
+                            "explain: device table dispatch failed")
+                        tbl = None
+                    if tbl is not None:
+                        res._explain_table = tbl
+                    return res
+
                 with obstrace.span("backend.fetch"):
                     flat, up = np.asarray(flat_dev), unpack
                     self.ledger.record_fetch(flat.nbytes)
@@ -1767,7 +1892,7 @@ class TPUSolver(Solver):
                                 cur_ring, take_e_p, take_c_p, leftover_p,
                             )
                         SOLVER_RESUME_HIT_RATE.set(self.resume_hit_rate)
-                        return res
+                        return stash_explain(res)
                     if cur_plan is not None:
                         # suffix dispatch: rows [0:k] of the full take tables
                         # are the donor record's (decision-identical by
@@ -1803,7 +1928,7 @@ class TPUSolver(Solver):
                         take_e_p, take_c_p, leftover_p,
                     )
                     SOLVER_RESUME_HIT_RATE.set(self.resume_hit_rate)
-                    return res
+                    return stash_explain(res)
             finally:
                 self.ledger.end_solve()
 

@@ -1523,3 +1523,63 @@ def _encode_with_nodes(core: _EncodeCore, inp: SolverInput) -> EncodedInput:
         gang_min_ranks=core.gang_min_ranks,
         gang_ids=core.gang_ids,
     )
+
+
+# ---------------------------------------------------------------------------
+# Decision-provenance side tables (obs/explain.py, tpu/ffd.explain_pack)
+# ---------------------------------------------------------------------------
+
+
+# id(group_pods) -> (group_pods strong ref, group_topo, group_aff); tiny
+# bounded memo for the O(pods) flags walk below
+_EXPLAIN_FLAGS_CACHE: dict = {}
+
+
+def explain_tables(enc: EncodedInput) -> dict:
+    """The EXPLAIN side-kernel inputs (tpu/ffd.EXPLAIN_ARG_SPEC minus the
+    scan-owned take_e and the padding scalars), unpadded — the encoder
+    already owns every one of these tensors, so the explain path adds no
+    new object walks beyond the per-group engine flags. Shared verbatim by
+    the device kernel dispatch (backend) and the host deriver
+    (obs/explain.host_table), which is what makes their outputs
+    bit-comparable.
+
+    The per-group engine-flags walk is O(pods), too hot to repeat per
+    solve (the explain on-path budget is 2%): the flags memoize keyed on
+    the IDENTITY of enc.group_pods, which delta-patched enc copies share
+    by reference (dataclasses.replace keeps field refs), so warm solves
+    hit. The held list guards against id() reuse; the cheap array dict is
+    rebuilt from the current enc every call because node tables DO change
+    across patches."""
+    gp = enc.group_pods
+    hit = _EXPLAIN_FLAGS_CACHE.get(id(gp))
+    if hit is not None and hit[0] is gp:
+        group_topo, group_aff = hit[1], hit[2]
+    else:
+        G = int(enc.group_req.shape[0])
+        group_topo = np.zeros(G, dtype=bool)
+        group_aff = np.zeros(G, dtype=bool)
+        for g in range(G):
+            topo = aff = False
+            for p in gp[g]:
+                topo = topo or bool(getattr(p, "topology_spread", None))
+                aff = aff or bool(getattr(p, "affinity_terms", None))
+                if topo and aff:
+                    break
+            group_topo[g] = topo
+            group_aff[g] = aff
+        if len(_EXPLAIN_FLAGS_CACHE) >= 8:
+            _EXPLAIN_FLAGS_CACHE.pop(next(iter(_EXPLAIN_FLAGS_CACHE)))
+        _EXPLAIN_FLAGS_CACHE[id(gp)] = (gp, group_topo, group_aff)
+    return {
+        "run_group": enc.run_group,
+        "group_req": enc.group_req,
+        "node_free": enc.node_free,
+        "node_compat": enc.node_compat,
+        "node_zone": enc.node_zone,
+        "node_ct": enc.node_ct,
+        "group_zone": enc.group_zone,
+        "group_ct": enc.group_ct,
+        "group_topo": group_topo,
+        "group_aff": group_aff,
+    }

@@ -481,9 +481,15 @@ class SolverFleet:
         # Guarded: a failed dump must not leave the owner fenced with its
         # service running and survivors never re-routed
         try:
+            from ..obs import slo as obsslo
+
+            # tag the dump with the SLO picture at fence time: whether the
+            # fence happened inside an already-burning error budget is the
+            # first triage question, answered without replaying the windows
             obstrace.dump("fleet_fence", owner=owner.name, fence_reason=reason,
                           fence_count=owner.fence_count,
-                          requeued=len(survivors))
+                          requeued=len(survivors),
+                          slo_state=obsslo.health()["state"])
         except Exception:  # noqa: BLE001 — diagnostics never abort the fence
             log.exception("solver fleet: flight-recorder dump failed while "
                           "fencing %s — continuing recovery", owner.name)

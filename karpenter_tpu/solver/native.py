@@ -18,6 +18,7 @@ import numpy as np
 
 from ..provisioning.scheduler import SolverInput, SolverResult
 from ..metrics.registry import SOLVER_SOLVES
+from ..obs import explain as obsexplain
 from .backend import ReferenceSolver, Solver, decode
 from .encode import EncodedInput, encode, quantize_input
 
@@ -214,6 +215,8 @@ class NativeSolver(Solver):
             return self.fallback.solve(qinp)
         self.stats["native_solves"] += 1
         SOLVER_SOLVES.inc(backend="native")
+        if obsexplain.enabled():
+            obsexplain.capture(qinp, result, "native", enc=enc)
         return result
 
 

@@ -362,6 +362,16 @@ class SolveService:
             "wide_refetches": int(stats.get("wide_refetches", 0)),
         }
 
+    def slo_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-stage SLO burn rates (obs/slo.py) as seen through this
+        pipeline's span feed — every solve it dispatches lands a
+        pipeline.queue / backend.dispatch / solve observation when its
+        trace finishes, so this surface is the bench/test view of the
+        operator's /healthz slo object."""
+        from ..obs import slo as obsslo
+
+        return obsslo.burn_rates()
+
     def close(self) -> None:
         """Stop accepting work; fail queued (undispatched) requests with
         ServiceStopped; let in-flight requests drain (up to 30s)."""

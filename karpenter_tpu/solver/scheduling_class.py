@@ -67,6 +67,7 @@ from ..provisioning.scheduler import (
     SolverResult,
     ffd_sort,
 )
+from ..obs import explain as obsexplain
 from ..obs import trace as obstrace
 from ..scheduling.requirements import Requirements
 from ..utils.resources import PODS
@@ -399,6 +400,16 @@ class ClassAwareSolver:
                 SOLVER_GANGS_PLACED.inc()
             for g in gangs_unschedulable:
                 SOLVER_GANGS_UNSCHEDULABLE.inc()
+            # provenance: per-gang verdicts are decision facts the result
+            # object doesn't carry (beyond the unschedulable list) — staged
+            # for the class-level explain capture below
+            for gid, (_size, mr, members) in sorted(gangs.items()):
+                obsexplain.note("gang", {
+                    "gang": gid,
+                    "committed": gid not in gangs_unschedulable,
+                    "placed": sum(1 for u in members if u in res.placements),
+                    "min_ranks": mr,
+                })
 
         # ---- preemption pass ----------------------------------------------
         evictions: List[Eviction] = []
@@ -423,12 +434,19 @@ class ClassAwareSolver:
             gangs_unschedulable=len(set(gangs_unschedulable)),
             preemptions=len(evictions),
         )
-        return dataclasses.replace(
+        final = dataclasses.replace(
             res,
             errors=errors,
             evictions=evictions,
             gangs_unschedulable=sorted(set(gangs_unschedulable)),
         )
+        if obsexplain.enabled():
+            # the class-level record supersedes the inner leg's (same
+            # solve_id → store merge): it re-derives over the FINAL result
+            # (post strip/re-solve, with evictions + gang verdicts attached)
+            # so every leg that reaches here fingerprints the same facts
+            obsexplain.capture(inp, final, "class", drain_notes=True)
+        return final
 
     def _first_failing_gang(self, pods, res, gangs, already, gang_fn):
         """First gang in scan order whose verdict fails, via the planner's

@@ -600,6 +600,12 @@ class TenantMux:
                 name=f"tenant-oracle-{state.spec.tenant_id}",
             )
             state.lane_thread.start()
+        # every lane append must wake the lane thread HERE: the WFQ scan
+        # (_pick_locked) routes breaker-open heads to the lane and then goes
+        # back to waiting without notifying, so an idle lane thread that won
+        # the race for submit()'s notify (and re-waited on an empty lane)
+        # would otherwise sleep forever on a resolvable ticket
+        self._cv.notify_all()
 
     def _lane_loop(self, state: _TenantState) -> None:
         while True:

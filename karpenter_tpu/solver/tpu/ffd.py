@@ -2364,3 +2364,129 @@ def unpack_evictions(buf):
         for i in range(n)
     ]
     return overflow, rows
+
+
+# --- decision provenance (obs/explain.py) --------------------------------
+#
+# Why pod p did NOT land on node e, computed where the decision was made:
+# a SIDE KERNEL over the EXPLAIN_ARG_SPEC tables below plus the scan's own
+# take_e — ffd_solve's frozen 36-tensor signature is untouched (the
+# CLASS_ARG_SPEC precedent). The packed int32 buffer mirrors the claim
+# delta's wire discipline: a small header with an overflow flag, uint16
+# payload halves, and a carve-out — overflow (a node index above uint16)
+# makes the HOST deriver (obs/explain.host_table) recompute the table
+# instead of trusting truncated bits. Off by default: backend.py only
+# dispatches this kernel when the explain knob is on, so the off path
+# moves zero extra bytes across the tunnel.
+#
+# The reason enum and its precedence (smallest nonzero code wins) are the
+# wire contract, pinned by tests/test_arg_spec_drift.py against the
+# decoder-side names in obs/explain.REASON_NAMES and the SPEC.md table.
+
+EXPLAIN_REASONS = (
+    ("feasible", 0),
+    ("zone", 1),
+    ("capacity_type", 2),
+    ("taint", 3),
+    ("resources", 4),
+    ("topology", 5),
+    ("affinity", 6),
+)
+EXPLAIN_HEADER_WORDS = 3  # [overflow_flag, n_groups, top_k] i32
+EXPLAIN_ENTRY_WORDS = 1   # e | (reason << 16) per rejected candidate
+
+EXPLAIN_ARG_SPEC = (
+    "take_e",       # [Sp, Ep] i32 — the scan's own output (device-resident)
+    "run_group",    # [Sp] i32
+    "group_req",    # [Gp, R] i32
+    "node_free",    # [Ep, R] i32 (pre-solve)
+    "node_compat",  # [Gp, Ep] bool (labels+taints admission)
+    "node_zone",    # [Ep] i32 (-1 unknown)
+    "node_ct",      # [Ep] i32 (-1 unknown)
+    "group_zone",   # [Gp, Z] bool
+    "group_ct",     # [Gp, C] bool
+    "group_topo",   # [Gp] bool — group owns a spread engine constraint
+    "group_aff",    # [Gp] bool — group owns affinity terms
+    "e_count",      # i32 scalar — real node count inside the Ep padding
+    "g_count",      # i32 scalar — real group count inside the Gp padding
+)
+
+
+def explain_words(n_groups: int, k: int) -> int:
+    """Buffer length in int32 words: header + per-group (count + k entries)."""
+    return EXPLAIN_HEADER_WORDS + n_groups * (1 + k * EXPLAIN_ENTRY_WORDS)
+
+
+@functools.partial(jax.jit, static_argnames=("top_k",))
+def explain_pack(take_e, run_group, group_req, node_free, node_compat,
+                 node_zone, node_ct, group_zone, group_ct, group_topo,
+                 group_aff, e_count, g_count, *, top_k: int):
+    """Pack the per-group rejection table into one int32 wire buffer.
+
+    Post-solve semantics: final free = node_free − Σ_s take_e[s,e]·req, so
+    a node is "rejected" for group g iff it cannot admit+fit ONE MORE pod
+    of g — with the fixed cause precedence zone > capacity_type > taint >
+    resources > topology > affinity, and any node the group actually
+    landed pods on reported feasible. All int32 arithmetic: the numpy twin
+    obs/explain.reason_codes/rejection_table produces the same bits, which
+    the randomized parity suite asserts.
+
+    Layout: [overflow, g_count, top_k] then per group (padded rows
+    zeroed/-1) one n_rejected word + top_k entry words, entry =
+    e | (reason << 16), -1 = empty slot."""
+    Sp, Ep = take_e.shape
+    Gp = group_req.shape[0]
+    take_e = take_e.astype(jnp.int32)
+    req_s = group_req[run_group]                                # [Sp, R]
+    usage = take_e.T @ req_s                                    # [Ep, R]
+    free_final = node_free - usage
+    Z = group_zone.shape[1]
+    C = group_ct.shape[1]
+    zid = jnp.clip(node_zone, 0, Z - 1)
+    cid = jnp.clip(node_ct, 0, C - 1)
+    zone_ok = jnp.where(node_zone[None, :] >= 0, group_zone[:, zid], True)
+    ct_ok = jnp.where(node_ct[None, :] >= 0, group_ct[:, cid], True)
+    fits = jnp.all(free_final[None, :, :] >= group_req[:, None, :], axis=-1)
+    ghot = (run_group[None, :] == jnp.arange(Gp, dtype=jnp.int32)[:, None])
+    placed = (ghot.astype(jnp.int32) @ take_e) > 0              # [Gp, Ep]
+    code = jnp.where(
+        ~zone_ok, 1,
+        jnp.where(~ct_ok, 2,
+        jnp.where(~node_compat, 3,
+        jnp.where(~fits, 4,
+        jnp.where(group_topo[:, None], 5,
+        jnp.where(group_aff[:, None], 6, 0))))))
+    code = jnp.where(placed, 0, code).astype(jnp.int32)
+    e_idx = jnp.arange(Ep, dtype=jnp.int32)
+    real_e = e_idx[None, :] < e_count
+    real_g = jnp.arange(Gp, dtype=jnp.int32) < g_count
+    rej = (code > 0) & real_e & real_g[:, None]
+    n_rej = jnp.sum(rej, axis=1).astype(jnp.int32)              # [Gp]
+    key = jnp.where(rej, e_idx[None, :], Ep)
+    order = jnp.argsort(key, axis=1)[:, :top_k]
+    ent_e = jnp.take_along_axis(key, order, axis=1)
+    ent_c = jnp.take_along_axis(code, order, axis=1)
+    valid = ent_e < Ep
+    words = jnp.where(valid, ent_e | (ent_c << 16), -1).astype(jnp.int32)
+    if words.shape[1] < top_k:  # fewer nodes than top-k: pad empty slots
+        pad = jnp.full((Gp, top_k - words.shape[1]), -1, dtype=jnp.int32)
+        words = jnp.concatenate([words, pad], axis=1)
+    overflow = jnp.int32(Ep > 0xFFFF)
+    header = jnp.stack([
+        overflow, g_count.astype(jnp.int32), jnp.int32(top_k)
+    ])
+    rows = jnp.concatenate([n_rej[:, None], words], axis=1)     # [Gp, 1+K]
+    return jnp.concatenate([header, rows.reshape(-1)])
+
+
+def unpack_explain(flat, n_groups: int):
+    """Inverse of explain_pack for the REAL group prefix: (overflow,
+    n_rejected [G] i32, words [G, K] i32). Pure numpy — the backend's
+    decode half of the EXPLAIN wire section."""
+    flat = np.asarray(flat, dtype=np.int32)
+    overflow = bool(flat[0])
+    k = int(flat[2])
+    body = flat[EXPLAIN_HEADER_WORDS:].reshape(-1, 1 + k)
+    n_rej = np.ascontiguousarray(body[:n_groups, 0])
+    words = np.ascontiguousarray(body[:n_groups, 1:])
+    return overflow, n_rej, words

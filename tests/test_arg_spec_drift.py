@@ -301,3 +301,49 @@ def test_gang_stage_carry_layout():
     flows through `base` automatically; adding one HERE without updating the
     merge/rollback seam would silently truncate the rollback."""
     assert ffd.GangStage._fields == ("base", "gang", "members_placed")
+
+
+# -- explain wire (ISSUE 12) ---------------------------------------------------
+
+
+def test_explain_arg_spec_is_pinned():
+    """EXPLAIN_ARG_SPEC is a SIDE table (CLASS_ARG_SPEC precedent): it must
+    not leak into the frozen 36-tensor ffd.ARG_SPEC, and its names are the
+    wire contract the backend dispatch and the AOT story build against."""
+    assert ffd.EXPLAIN_ARG_SPEC == (
+        "take_e", "run_group", "group_req", "node_free", "node_compat",
+        "node_zone", "node_ct", "group_zone", "group_ct", "group_topo",
+        "group_aff", "e_count", "g_count",
+    )
+    assert not set(ffd.EXPLAIN_ARG_SPEC) & {"max_claims", "emit_takes"}
+    assert len(ffd.ARG_SPEC) == 36  # explain must not widen the scan
+
+
+def test_explain_pack_signature_matches_spec():
+    params = list(inspect.signature(ffd.explain_pack.__wrapped__).parameters)
+    assert tuple(p for p in params if p != "top_k") == ffd.EXPLAIN_ARG_SPEC, (
+        "explain_pack's positional params drifted from EXPLAIN_ARG_SPEC"
+    )
+    assert params[-1] == "top_k"  # the single static
+
+
+def test_explain_wire_layout_is_pinned():
+    """Header [overflow, g_count, top_k] + per group one count word and
+    top_k 1-word entries (e | reason << 16, -1 empty) — the claim-delta
+    discipline: fixed header, uint16 payload halves, overflow carve-out."""
+    assert ffd.EXPLAIN_HEADER_WORDS == 3
+    assert ffd.EXPLAIN_ENTRY_WORDS == 1
+    assert ffd.explain_words(4, 8) == 3 + 4 * (1 + 8)
+
+
+def test_explain_reasons_match_decoder_names():
+    """The kernel-side enum and the decoder-side names (obs/explain) are one
+    contract — a code without a name renders as 'codeN' in records, a name
+    without a code can never appear on the wire."""
+    from karpenter_tpu.obs import explain as obsexplain
+
+    assert dict((c, n) for n, c in ffd.EXPLAIN_REASONS) == obsexplain.REASON_NAMES
+    codes = [c for _, c in ffd.EXPLAIN_REASONS]
+    assert codes == sorted(codes) == list(range(len(codes))), (
+        "reason codes must stay dense and ordered — precedence is the wire"
+    )

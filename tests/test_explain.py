@@ -1,0 +1,659 @@
+"""Decision provenance & SLO engine (ISSUE 12).
+
+Covers the whole explain stack end to end:
+
+- wire bit-parity: the device kernel (tpu/ffd.explain_pack) and the host
+  deriver (obs/explain.reason_codes + rejection_table) produce the SAME
+  int32 words on randomized tables, including the zero-width zone/ct and
+  fewer-nodes-than-top-k edges, plus the uint16 overflow carve-out;
+- 3-way record parity: oracle / native / TPU captures fingerprint
+  bit-identically on randomized scenarios, through the relax ladder, the
+  class pass (preemption + gangs), mesh-sharded solves and checkpointed
+  resume (the carve-outs host-derive but must still match);
+- off-path inertness: explain off moves zero extra d2h bytes and the
+  disabled hooks allocate nothing;
+- ExplainStore semantics: lazy materialization, merge-put, ring eviction,
+  by-pod lookup;
+- SLO engine: burn-rate windows under an injected clock, page/warn/ok
+  states, objective-spec parsing, trace feed + tenant metering;
+- operator surface: /debug/explain + /debug/trace filters (400 on bad
+  params, 404 on unknown solve), /healthz slo object;
+- flight-recorder dump pruning (--flight-recorder-keep).
+"""
+
+import gc
+import json
+import random
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.metrics.registry import (
+    SOLVER_EXPLAIN_WIDE,
+    TENANT_METER_D2H_BYTES,
+    TENANT_METER_SOLVES,
+)
+from karpenter_tpu.obs import explain as obsexplain
+from karpenter_tpu.obs import slo as obsslo
+from karpenter_tpu.provisioning.scheduler import SolverInput
+from karpenter_tpu.solver import scheduling_class as sc
+from karpenter_tpu.solver.backend import ReferenceSolver, TPUSolver
+from karpenter_tpu.solver.encode import encode, quantize_input
+from karpenter_tpu.solver.native import NativeSolver
+from karpenter_tpu.solver.tpu import ffd
+
+from tests.test_scheduling_class import gang_labels, mknode, victim
+from tests.test_solver_parity import ZONES, mkpod, pool
+
+
+@pytest.fixture(autouse=True)
+def _explain_defaults():
+    """Every test starts and ends with the production defaults."""
+    obsexplain.configure(enabled=False)
+    obsslo.configure()
+    sc.configure(preemption=True, gang=True)
+    yield
+    obsexplain.configure(enabled=False)
+    obsslo.configure()
+    sc.configure(preemption=True, gang=True)
+
+
+def _capture_one(solver, inp, quantized=False):
+    """Solve with explain on; return (result, the LAST stored entry,
+    materialized)."""
+    obsexplain.configure(enabled=True, top_k=8)
+    res = solver.solve(quantize_input(inp) if quantized else inp)
+    ents = obsexplain.store().recent(1)
+    assert ents, "explain enabled but nothing captured"
+    return res, ents[0]
+
+
+def _assert_three_way(inp, k=8):
+    """Oracle / native / TPU captures must fingerprint identically."""
+    legs = {}
+    for name, solver, q in (
+        ("oracle", ReferenceSolver(), True),
+        ("native", NativeSolver(), False),
+        ("tpu", TPUSolver(), False),
+    ):
+        _, ent = _capture_one(solver, inp, quantized=q)
+        legs[name] = ent
+    base = legs["oracle"]
+    for name in ("native", "tpu"):
+        assert legs[name]["fingerprint"] == base["fingerprint"], (
+            f"{name} diverges from oracle:\n"
+            + "\n".join(obsexplain.diff_records(
+                base["record"], legs[name]["record"])[:12])
+        )
+    return legs
+
+
+# ---------------------------------------------------------------------------
+# Wire bit-parity: numpy twin vs device kernel
+# ---------------------------------------------------------------------------
+
+
+class TestWireBitParity:
+    def _random_tables(self, rng, G, E, S, R=2, Z=2, C=2):
+        t = {
+            "take_e": rng.integers(0, 3, size=(S, E), dtype=np.int32),
+            "run_group": rng.integers(0, G, size=S, dtype=np.int32),
+            "group_req": rng.integers(0, 4, size=(G, R), dtype=np.int32),
+            "node_free": rng.integers(0, 16, size=(E, R), dtype=np.int32),
+            "node_compat": rng.random((G, E)) < 0.8,
+            "node_zone": rng.integers(-1, Z, size=E, dtype=np.int32),
+            "node_ct": rng.integers(-1, C, size=E, dtype=np.int32),
+            "group_zone": rng.random((G, Z)) < 0.7,
+            "group_ct": rng.random((G, C)) < 0.7,
+            "group_topo": rng.random(G) < 0.2,
+            "group_aff": rng.random(G) < 0.2,
+        }
+        return t
+
+    def _device(self, t, G, E, k):
+        """Pad + dispatch exactly like backend._device_explain."""
+        Gp = 1 << (max(G, 1) - 1).bit_length()
+        Z = max(1, t["group_zone"].shape[1])
+        C = max(1, t["group_ct"].shape[1])
+        R = t["group_req"].shape[1]
+        gr = np.zeros((Gp, R), np.int32)
+        gr[:G] = t["group_req"]
+        nc = np.zeros((Gp, E), bool)
+        nc[:G] = t["node_compat"]
+        gz = np.zeros((Gp, Z), bool)
+        gz[:G, : t["group_zone"].shape[1]] = t["group_zone"]
+        gct = np.zeros((Gp, C), bool)
+        gct[:G, : t["group_ct"].shape[1]] = t["group_ct"]
+        gt = np.zeros(Gp, bool)
+        gt[:G] = t["group_topo"]
+        ga = np.zeros(Gp, bool)
+        ga[:G] = t["group_aff"]
+        flat = np.asarray(ffd.explain_pack(
+            t["take_e"], t["run_group"], gr, t["node_free"], nc,
+            t["node_zone"], t["node_ct"], gz, gct, gt, ga,
+            np.int32(E), np.int32(G), top_k=k,
+        ))
+        assert flat.shape[0] == ffd.explain_words(Gp, k)
+        return ffd.unpack_explain(flat, G)
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_randomized_tables_bit_equal(self, seed):
+        rng = np.random.default_rng(seed)
+        G, E, S, k = (int(rng.integers(1, 9)), int(rng.integers(1, 20)),
+                      int(rng.integers(1, 30)), int(rng.integers(1, 6)))
+        t = self._random_tables(rng, G, E, S)
+        codes = obsexplain.reason_codes(**t)
+        h_rej, h_words = obsexplain.rejection_table(codes, k)
+        overflow, d_rej, d_words = self._device(t, G, E, k)
+        assert not overflow
+        np.testing.assert_array_equal(h_rej, d_rej)
+        np.testing.assert_array_equal(h_words, d_words)
+
+    def test_zero_width_zone_ct_axes(self):
+        rng = np.random.default_rng(7)
+        t = self._random_tables(rng, 3, 5, 8, Z=2, C=2)
+        t["group_zone"] = np.zeros((3, 0), bool)
+        t["group_ct"] = np.zeros((3, 0), bool)
+        t["node_zone"] = np.full(5, -1, np.int32)
+        t["node_ct"] = np.full(5, -1, np.int32)
+        codes = obsexplain.reason_codes(**t)
+        h_rej, h_words = obsexplain.rejection_table(codes, 4)
+        overflow, d_rej, d_words = self._device(t, 3, 5, 4)
+        assert not overflow
+        np.testing.assert_array_equal(h_rej, d_rej)
+        np.testing.assert_array_equal(h_words, d_words)
+
+    def test_fewer_nodes_than_top_k_pads_empty(self):
+        rng = np.random.default_rng(9)
+        t = self._random_tables(rng, 2, 3, 4)
+        k = 8  # > E: both sides must pad the trailing slots with -1
+        codes = obsexplain.reason_codes(**t)
+        h_rej, h_words = obsexplain.rejection_table(codes, k)
+        assert h_words.shape == (2, k)
+        overflow, d_rej, d_words = self._device(t, 2, 3, k)
+        assert d_words.shape == (2, k)
+        np.testing.assert_array_equal(h_words, d_words)
+        assert (h_words[:, 3:] == -1).all()
+
+    def test_placed_node_is_always_feasible(self):
+        # one group, one node, resources exhausted by its own pods: the
+        # node cannot fit one more, but the group landed there — feasible
+        t = {
+            "take_e": np.array([[2]], np.int32),
+            "run_group": np.array([0], np.int32),
+            "group_req": np.array([[4]], np.int32),
+            "node_free": np.array([[8]], np.int32),
+            "node_compat": np.ones((1, 1), bool),
+            "node_zone": np.array([-1], np.int32),
+            "node_ct": np.array([-1], np.int32),
+            "group_zone": np.zeros((1, 0), bool),
+            "group_ct": np.zeros((1, 0), bool),
+            "group_topo": np.zeros(1, bool),
+            "group_aff": np.zeros(1, bool),
+        }
+        codes = obsexplain.reason_codes(**t)
+        assert codes[0, 0] == obsexplain.REASON_FEASIBLE
+
+    def test_uint16_overflow_carves_out_to_host(self):
+        """A node axis above uint16 must skip the device table (counted by
+        SOLVER_EXPLAIN_WIDE) — the host deriver recomputes at full width."""
+        solver = TPUSolver()
+
+        class _Out:
+            take_e = np.zeros((1, 0x10000 + 1), np.int32)
+
+        before = SOLVER_EXPLAIN_WIDE.value()
+        assert solver._device_explain(None, _Out()) is None
+        assert SOLVER_EXPLAIN_WIDE.value() == before + 1
+
+
+# ---------------------------------------------------------------------------
+# 3-way record parity on full solves
+# ---------------------------------------------------------------------------
+
+
+class TestThreeWayParity:
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_randomized_basic(self, seed):
+        rng = random.Random(seed)
+        pods = [
+            mkpod(f"p{i:03d}", cpu=f"{rng.choice([250, 500, 1000, 2000])}m",
+                  mem=f"{rng.choice([256, 512, 1024, 4096])}Mi")
+            for i in range(30)
+        ]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        legs = _assert_three_way(inp)
+        rec = legs["tpu"]["record"]
+        assert len(rec["pods"]) == 30
+        assert legs["tpu"]["annotations"]["source"] == "device"
+        assert legs["oracle"]["annotations"]["source"] == "host"
+
+    def test_unschedulable_pods_surface_as_unplaced(self):
+        pods = [mkpod("ok", cpu="500m"),
+                mkpod("huge", cpu="999")]  # no catalog type fits
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        legs = _assert_three_way(inp)
+        assert "huge" in legs["tpu"]["record"]["unplaced"]
+        assert legs["tpu"]["record"]["pods"]["huge"]["chosen"] is None
+
+    def test_relax_ladder_leg_captures_and_matches(self):
+        from karpenter_tpu.api.objects import TopologySpreadConstraint
+
+        sel = {"app": "soft"}
+        pods = [
+            mkpod(f"s{i}", labels=dict(sel), topology_spread=[
+                TopologySpreadConstraint(
+                    max_skew=1, topology_key="topology.kubernetes.io/zone",
+                    label_selector=sel, when_unsatisfiable="ScheduleAnyway")
+            ])
+            for i in range(3)
+        ]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        obsexplain.configure(enabled=True, top_k=8)
+        ref = ReferenceSolver().solve(quantize_input(inp))
+        ref_ent = obsexplain.store().recent(1)[0]
+        tpu = TPUSolver(relax_ladder=True)
+        res = tpu.solve(inp)
+        tpu_ent = obsexplain.store().recent(1)[0]
+        assert res.placements == ref.placements
+        assert tpu_ent["fingerprint"] == ref_ent["fingerprint"], (
+            obsexplain.diff_records(ref_ent["record"], tpu_ent["record"])[:8]
+        )
+
+    def test_preemption_rides_the_record(self):
+        # full node + no nodepool alternative: placing "hi" needs an eviction
+        hi = [mkpod("hi", cpu="2", mem="2Gi", priority=100)]
+        nodes = [mknode("n1", cpu="0", mem="0Mi",
+                        victims=[victim("lo", priority=0), victim("lo2", priority=1)])]
+        inp = SolverInput(pods=hi, nodes=nodes, nodepools=[], zones=ZONES)
+        fps = {}
+        for name, backend, q in (("oracle", ReferenceSolver(), True),
+                                 ("native", NativeSolver(), False),
+                                 ("tpu", TPUSolver(), False)):
+            caw = sc.ClassAwareSolver(backend)
+            _, ent = _capture_one(caw, inp, quantized=q)
+            fps[name] = ent
+        rec = fps["tpu"]["record"]
+        assert rec["preemptions"], "eviction plan missing from the record"
+        assert rec["preemptions"][0]["victim"] == "lo"
+        assert rec["preemptions"][0]["for_pod"] == "hi"
+        assert (fps["oracle"]["fingerprint"] == fps["native"]["fingerprint"]
+                == fps["tpu"]["fingerprint"])
+
+    def test_gang_verdicts_ride_the_record(self):
+        committed = [mkpod(f"g{i}", cpu="500m", labels=gang_labels("job-a", 3))
+                     for i in range(3)]
+        doomed = [mkpod(f"d{i}", cpu="999", labels=gang_labels("job-b", 2))
+                  for i in range(2)]
+        inp = SolverInput(pods=committed + doomed, nodes=[],
+                          nodepools=[pool()], zones=ZONES)
+        fps = {}
+        for name, backend, q in (("oracle", ReferenceSolver(), True),
+                                 ("tpu", TPUSolver(), False)):
+            caw = sc.ClassAwareSolver(backend)
+            _, ent = _capture_one(caw, inp, quantized=q)
+            fps[name] = ent
+        rec = fps["tpu"]["record"]
+        assert rec["gangs"]["job-a"]["committed"] is True
+        assert rec["gangs"]["job-a"]["placed"] == 3
+        assert rec["gangs"]["job-b"]["committed"] is False
+        assert rec["gangs_unschedulable"] == ["job-b"]
+        assert fps["oracle"]["fingerprint"] == fps["tpu"]["fingerprint"]
+
+    def test_mesh_sharded_solve_host_derives_and_matches(self):
+        rng = random.Random(3)
+        pods = [
+            mkpod(f"p{i:03d}", cpu=rng.choice(["250m", "500m", "1", "2"]),
+                  mem=rng.choice(["512Mi", "1Gi", "2Gi"]))
+            for i in range(60)
+        ]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        obsexplain.configure(enabled=True, top_k=8)
+        ReferenceSolver().solve(quantize_input(inp))
+        ref_ent = obsexplain.store().recent(1)[0]
+        s = TPUSolver(shards=2)
+        s.solve(inp)
+        ent = obsexplain.store().recent(1)[0]
+        assert ent["fingerprint"] == ref_ent["fingerprint"], (
+            obsexplain.diff_records(ref_ent["record"], ent["record"])[:8]
+        )
+        if s.stats.get("sharded_solves"):
+            # sharded finish has no device table — the carve-out host-derives
+            assert ent["annotations"]["source"] == "host"
+
+    def test_resumed_solve_host_derives_and_matches(self):
+        from tests.test_scan_resume import _add_replica, _fleet, _warm_solver
+
+        inp = _fleet()
+        tail = _add_replica(inp, 0, "tail-0")
+        warm = _warm_solver()
+        obsexplain.configure(enabled=True, top_k=8)
+        warm.solve(inp)
+        warm.solve(tail)
+        assert warm.stats["resume_solves"] == 1, warm.stats
+        ent = obsexplain.store().recent(1)[0]
+        # resumed solves are stitched host-side: no device table
+        assert ent["annotations"]["source"] == "host"
+        ReferenceSolver().solve(quantize_input(tail))
+        ref_ent = obsexplain.store().recent(1)[0]
+        assert ent["fingerprint"] == ref_ent["fingerprint"], (
+            obsexplain.diff_records(ref_ent["record"], ent["record"])[:8]
+        )
+
+
+# ---------------------------------------------------------------------------
+# Off-path inertness
+# ---------------------------------------------------------------------------
+
+
+class TestOffPathInertness:
+    def test_disabled_capture_returns_none_and_stores_nothing(self):
+        obsexplain.configure(enabled=False)
+        assert obsexplain.capture(None, None, "test") is None
+        obsexplain.note("gang", {"gang": "g"})
+        assert len(obsexplain.store()) == 0
+
+    def test_disabled_hooks_allocate_nothing(self):
+        obsexplain.configure(enabled=False)
+        for _ in range(64):  # warm inline caches
+            obsexplain.capture(None, None, "test")
+            obsexplain.note("k", {})
+        gc.collect()
+        gc.disable()
+        try:
+            b0 = sys.getallocatedblocks()
+            for _ in range(5_000):
+                obsexplain.capture(None, None, "test")
+                obsexplain.note("k", {})
+            grew = sys.getallocatedblocks() - b0
+        finally:
+            gc.enable()
+        assert grew < 50, f"disabled hooks allocated {grew} blocks"
+
+    def test_explain_off_moves_zero_extra_d2h_bytes(self):
+        pods = [mkpod(f"p{i}", cpu="500m") for i in range(12)]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        s = TPUSolver()
+        s.solve(inp)  # cold
+        led = s.ledger
+
+        def delta():
+            f0 = led.snapshot()["total"]["d2h_bytes"]
+            s.solve(inp)
+            return led.snapshot()["total"]["d2h_bytes"] - f0
+
+        off1, off2 = delta(), delta()
+        assert off1 == off2, "explain-off warm solves must fetch identically"
+        obsexplain.configure(enabled=True, top_k=4)
+        on = delta()
+        obsexplain.configure(enabled=False)
+        off3 = delta()
+        assert off3 == off1, "disabling explain must restore the baseline"
+        assert on > off1, "explain-on must move the EXPLAIN section"
+
+
+# ---------------------------------------------------------------------------
+# ExplainStore semantics
+# ---------------------------------------------------------------------------
+
+
+class TestStoreSemantics:
+    def _solve_entry(self):
+        pods = [mkpod(f"p{i}", cpu="500m") for i in range(4)]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        obsexplain.configure(enabled=True, top_k=4)
+        res = ReferenceSolver().solve(quantize_input(inp))
+        return res
+
+    def test_capture_defers_and_reads_materialize(self):
+        self._solve_entry()
+        st = obsexplain.store()
+        with st._lock:
+            raw = next(iter(st._entries.values()))
+        assert "_defer" in raw and "record" not in raw, (
+            "capture must not build the record on the solve path"
+        )
+        ent = st.recent(1)[0]
+        assert "record" in ent and "_defer" not in ent
+        fp1 = ent["fingerprint"]
+        assert st.recent(1)[0]["fingerprint"] == fp1  # idempotent
+
+    def test_merge_put_unions_annotations(self):
+        st = obsexplain.ExplainStore(ring=4)
+        st.put("s1", {"solve_id": "s1", "record": {"pods": {}},
+                      "annotations": {"source": "device", "rungs": 2}})
+        out = st.put("s1", {"solve_id": "s1", "record": {"pods": {"p": {}}},
+                            "annotations": {"source": "host"}})
+        assert out["annotations"] == {"source": "host", "rungs": 2}
+        assert out["record"]["pods"] == {"p": {}}
+        assert len(st) == 1
+
+    def test_ring_evicts_oldest(self):
+        st = obsexplain.ExplainStore(ring=2)
+        for i in range(4):
+            st.put(f"s{i}", {"solve_id": f"s{i}", "record": {"pods": {}},
+                             "annotations": {}})
+        assert len(st) == 2
+        assert st.get("s0") is None and st.get("s1") is None
+        assert st.get("s3") is not None
+
+    def test_by_pod_finds_the_solve(self):
+        self._solve_entry()
+        hits = obsexplain.store().by_pod("p2")
+        assert hits and "p2" in hits[-1]["record"]["pods"]
+        assert obsexplain.store().by_pod("nope") == []
+
+
+# ---------------------------------------------------------------------------
+# SLO engine
+# ---------------------------------------------------------------------------
+
+
+class TestSLOEngine:
+    def test_parse_objectives(self):
+        obj = obsslo.parse_objectives("solve=250:0.999,backend.dispatch=100:0.99")
+        assert obj["solve"] == (0.25, 0.999)
+        assert obj["backend.dispatch"] == (0.1, 0.99)
+        assert obsslo.parse_objectives("") == obsslo.DEFAULT_OBJECTIVES
+        for bad in ("solve=abc:0.9", "solve=100", "solve=100:1.5", "=1:0.9"):
+            with pytest.raises(ValueError):
+                obsslo.parse_objectives(bad)
+
+    def test_burn_rates_with_injected_clock(self):
+        t = [1000.0]
+        obsslo.configure(objectives={"solve": (1.0, 0.99)},
+                         clock=lambda: t[0])
+        # 50% breach rate over the fast window: burn = 0.5 / 0.01 = 50
+        for i in range(100):
+            obsslo.record("solve", 2.0 if i % 2 == 0 else 0.1)
+            t[0] += 1.0
+        r = obsslo.burn_rates()["solve"]
+        assert r["fast"] == pytest.approx(50.0, rel=0.1)
+        assert obsslo.health()["state"] == "page"
+        assert obsslo.health()["stages"]["solve"]["state"] == "page"
+
+    def test_windows_age_out(self):
+        t = [5000.0]
+        obsslo.configure(objectives={"solve": (1.0, 0.99)},
+                         clock=lambda: t[0])
+        for _ in range(10):
+            obsslo.record("solve", 5.0)  # all breaching
+        assert obsslo.burn_rates()["solve"]["fast"] > 0
+        t[0] += obsslo.SLOW_WINDOW_S + 60  # a full slow window later
+        r = obsslo.burn_rates()["solve"]
+        assert r["fast"] == 0.0 and r["slow"] == 0.0
+        assert obsslo.health()["state"] == "ok"
+
+    def test_unknown_stage_is_ignored(self):
+        obsslo.configure(objectives={"solve": (1.0, 0.99)})
+        obsslo.record("no.such.stage", 99.0)  # must not raise or register
+        assert "no.such.stage" not in obsslo.burn_rates()
+
+    def test_observe_trace_feeds_slo_and_meters(self):
+        class _Span:
+            def __init__(self, name, t0, t1):
+                self.name, self.t0, self.t1 = name, t0, t1
+
+        class _Trace:
+            tenant_id = "acme"
+            spans = [_Span("solve", 0.0, 2.0),
+                     _Span("backend.dispatch", 0.0, 0.75),
+                     _Span("open", 0.0, None)]
+
+        obsslo.configure(objectives={"solve": (1.0, 0.99),
+                                     "backend.dispatch": (0.5, 0.99)})
+        solves0 = TENANT_METER_SOLVES.value(tenant="acme")
+        obsslo.observe_trace(_Trace())
+        assert TENANT_METER_SOLVES.value(tenant="acme") == solves0 + 1
+        assert obsslo.burn_rates()["solve"]["fast"] > 0
+
+    def test_meter_bytes_defaults_tenant(self):
+        d0 = TENANT_METER_D2H_BYTES.value(tenant="default")
+        obsslo.meter_bytes(None, d2h=1024)
+        assert TENANT_METER_D2H_BYTES.value(tenant="default") == d0 + 1024
+
+
+# ---------------------------------------------------------------------------
+# Operator surface: /debug/explain, /debug/trace filters, /healthz slo
+# ---------------------------------------------------------------------------
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10
+        ) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+@pytest.fixture(scope="class")
+def server():
+    from karpenter_tpu.operator.__main__ import serve_endpoints
+
+    srv = serve_endpoints(0, 0, enable_profiling=False)
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+class TestEndpoints:
+    def test_explain_bad_params_400(self, server):
+        for q in ("?solve_id=", "?pod="):
+            status, body = _get(server, f"/debug/explain{q}")
+            assert status == 400, (q, body)
+
+    def test_explain_unknown_solve_404(self, server):
+        status, body = _get(server, "/debug/explain?solve_id=nope")
+        assert status == 404 and "unknown" in body
+
+    def test_explain_serves_records_and_pod_lookup(self, server):
+        pods = [mkpod(f"web-{i}", cpu="500m") for i in range(3)]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        obsexplain.configure(enabled=True, top_k=4)
+        ReferenceSolver().solve(quantize_input(inp))
+        sid = obsexplain.store().recent(1)[0]["solve_id"]
+
+        status, body = _get(server, "/debug/explain")
+        doc = json.loads(body)
+        assert status == 200 and doc["enabled"] is True
+        assert any(e["solve_id"] == sid for e in doc["result"])
+
+        status, body = _get(server, f"/debug/explain?solve_id={sid}")
+        doc = json.loads(body)
+        assert status == 200
+        assert "web-1" in doc["result"]["record"]["pods"]
+
+        status, body = _get(server, "/debug/explain?pod=web-2")
+        doc = json.loads(body)
+        assert status == 200 and doc["result"], "pod lookup came up empty"
+
+    def test_trace_filter_bad_params_400(self, server):
+        for q in ("?solve_id=", "?tenant=", "?last=bogus"):
+            status, body = _get(server, f"/debug/trace{q}")
+            assert status == 400, (q, body)
+
+    def test_trace_filters_narrow_the_dump(self, server):
+        from karpenter_tpu.obs import trace as obstrace
+
+        obstrace.configure(enabled=True, ring=16)
+        try:
+            tr = obstrace.begin("solve")
+            obstrace.set_tenant(tr, "acme")
+            with obstrace.attached(tr):
+                with obstrace.span("solve"):
+                    pass
+            obstrace.finish(tr)
+            sid = tr.solve_id
+            status, body = _get(server, f"/debug/trace?solve_id={sid}")
+            doc = json.loads(body)
+            assert status == 200
+            names = {e["args"].get("solve_id") for e in doc["traceEvents"]
+                     if e.get("ph") == "X"}
+            assert names == {sid}
+            status, body = _get(server, "/debug/trace?tenant=acme")
+            assert status == 200 and json.loads(body)["traceEvents"]
+            status, body = _get(server, "/debug/trace?tenant=nobody")
+            assert status == 200 and not json.loads(body)["traceEvents"]
+        finally:
+            obstrace.configure(enabled=False)
+
+    def test_healthz_reports_slo_state(self, server):
+        status, body = _get(server, "/healthz")
+        doc = json.loads(body)
+        assert status == 200
+        assert doc["slo"]["state"] in ("ok", "warn", "page")
+        assert "stages" in doc["slo"]
+
+
+# ---------------------------------------------------------------------------
+# Flight-recorder dump pruning (--flight-recorder-keep)
+# ---------------------------------------------------------------------------
+
+
+class TestRecorderPruning:
+    def test_dumps_pruned_to_keep_oldest_first(self, tmp_path):
+        from karpenter_tpu.obs.recorder import FlightRecorder
+
+        rec = FlightRecorder(dir=str(tmp_path), keep=3)
+        for i in range(7):
+            old = tmp_path / f"karpenter-flightrec-000-fake{i}.json"
+            old.write_text("{}")
+            # stagger mtimes so oldest-first is deterministic
+            import os
+            os.utime(old, (1000 + i, 1000 + i))
+        rec.dump("test")
+        left = sorted(tmp_path.glob("karpenter-flightrec-*.json"))
+        assert len(left) == 3, left
+        names = [p.name for p in left]
+        # the newest fakes + the real dump survive; fake0..fake4 pruned
+        assert not any(f"fake{i}" in n for i in range(4) for n in names)
+
+    def test_prune_survives_hostile_directory(self, tmp_path):
+        from karpenter_tpu.obs.recorder import FlightRecorder
+
+        rec = FlightRecorder(dir=str(tmp_path), keep=1)
+        (tmp_path / "karpenter-flightrec-not-a-dump.json").mkdir()
+        rec.dump("test")  # must not raise despite the undeletable entry
+
+    def test_keep_floor_is_one(self, tmp_path):
+        from karpenter_tpu.obs.recorder import FlightRecorder
+
+        rec = FlightRecorder(dir=str(tmp_path), keep=0)
+        assert rec.keep == 1
+
+    def test_dump_attaches_recent_explain_records(self, tmp_path):
+        from karpenter_tpu.obs.recorder import FlightRecorder
+
+        pods = [mkpod("p0", cpu="500m")]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+        obsexplain.configure(enabled=True, top_k=4)
+        ReferenceSolver().solve(quantize_input(inp))
+        rec = FlightRecorder(dir=str(tmp_path), keep=4)
+        path = rec.dump("test")
+        doc = json.loads((tmp_path / path.split("/")[-1]).read_text())
+        assert doc["explain"], "dump must attach the recent explain records"
+        assert "p0" in doc["explain"][-1]["record"]["pods"]

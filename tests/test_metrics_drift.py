@@ -82,3 +82,38 @@ def test_no_dead_series():
 def test_registered_names_unique():
     names = [m.name for m in reg.REGISTRY.metrics]
     assert len(names) == len(set(names)), "duplicate series registered"
+
+
+def test_slo_and_meter_series_are_registered():
+    """ISSUE 12 acceptance: the SLO burn-rate gauges and the per-tenant
+    meters are part of the /metrics contract — their exact names are what
+    dashboards and billing scrape, so pin them."""
+    registered = {m.name for m in reg.REGISTRY.metrics}
+    for name in (
+        "karpenter_slo_burn_rate",
+        "karpenter_slo_breaches_total",
+        "karpenter_tenant_meter_solves_total",
+        "karpenter_tenant_meter_device_ms_total",
+        "karpenter_tenant_meter_h2d_bytes_total",
+        "karpenter_tenant_meter_d2h_bytes_total",
+        "karpenter_solver_explain_records_total",
+        "karpenter_solver_explain_wide_total",
+        "karpenter_solver_explain_bytes_per_solve",
+    ):
+        assert name in registered, f"{name} missing from the registry"
+
+
+def test_every_reason_code_has_name_and_spec_row():
+    """Every kernel reason code must have a decoder-side name AND a SPEC.md
+    row — an undocumented code is a wire symbol operators cannot read."""
+    from karpenter_tpu.obs.explain import REASON_NAMES
+    from karpenter_tpu.solver.tpu.ffd import EXPLAIN_REASONS
+
+    spec = (PKG / "solver" / "SPEC.md").read_text()
+    for name, code in EXPLAIN_REASONS:
+        assert REASON_NAMES.get(code) == name, (
+            f"reason {code} ({name}) missing/misnamed in obs/explain.REASON_NAMES"
+        )
+        assert re.search(rf"\|\s*`?{code}`?\s*\|\s*`{name}`", spec), (
+            f"reason {code} ({name}) has no SPEC.md table row"
+        )

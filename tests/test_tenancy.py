@@ -293,6 +293,34 @@ def test_breaker_isolation_poison_degrades_only_the_victim():
         mux.close()
 
 
+def test_breaker_routed_submits_never_lose_the_lane_wakeup():
+    """Regression: the WFQ scan routes breaker-open heads to the oracle lane
+    from inside the dispatch wait loop; if that append doesn't notify, an
+    idle lane thread that consumed submit()'s wakeup first (and re-waited on
+    a then-empty lane) sleeps forever on a resolvable ticket. Hammer the
+    breaker-routed path — every submit must land degraded, promptly."""
+    svc = FakeService(fail_marker="poison")
+    mux = TenantMux(svc, mkregistry(("a", 1.0)), breaker_threshold=1,
+                    breaker_probe_s=600.0, own_service=True)
+    try:
+        assert mux.submit(mkinput("poison-0"), tenant_id="a",
+                          kind=DISRUPTION).result(timeout=10)
+        deadline = time.monotonic() + 5
+        while (mux.tenant_stats()["a"]["breaker"] != "open"
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert mux.tenant_stats()["a"]["breaker"] == "open"
+        fwd0 = len(svc.order)
+        for i in range(50):
+            res = mux.submit(mkinput(f"lane-{i}"), tenant_id="a",
+                             kind=DISRUPTION).result(timeout=10)
+            assert res.claims and res.claims[0].pod_uids == [f"lane-{i}"]
+        assert len(svc.order) == fwd0  # all 50 rode the lane, none forwarded
+        assert mux.unresolved() == 0
+    finally:
+        mux.close()
+
+
 def test_fn_requests_bypass_breaker_and_surface_failures_verbatim():
     """Device-bound closures cannot replay on an oracle, so they bypass the
     tenant breaker (an open breaker still forwards them) and a downstream
