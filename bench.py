@@ -1806,6 +1806,161 @@ def bench_explain_suite() -> None:
     }))
 
 
+# ---------------------------------------------------------- streaming suite
+
+
+def _streaming_run(batches: int = 120, pods_per_batch: int = 8,
+                   base_pods: int = 64, epoch_every: int = 32,
+                   parity_every: int = 20, drain: bool = True) -> dict:
+    """ISSUE 13 streaming delta-solve: a sustained arrival trace through the
+    journal -> StreamingSolver -> solver path. Each batch creates pods in the
+    store, pump() folds the journal delta, build_input() assembles from the
+    resident model, and the solve runs with run-table event staging enabled
+    (backend.stream_run_events -> arena.apply_run_events).
+
+    Two timings per batch: the INGEST leg (pump + pending + build_input —
+    the host tax streaming makes event-proportional) drives
+    arrival_batches_per_sec; the full batch (ingest + solve) drives
+    steady_state_solve_p99_ms. Every `parity_every` batches the snapshot
+    path solves the same universe and the placements must match exactly
+    (parity_failures MUST stay 0). With `drain` (the steady-state default)
+    each batch's pods are BOUND after the solve — arrivals leave the pending
+    set the way a real binder empties it, so the working set stays constant
+    (base_pods standing backlog + one batch) instead of growing O(batches).
+    Host-measurable end to end — the model fold, journal, and arena/ledger
+    semantics are platform-independent."""
+    from karpenter_tpu.api.objects import (
+        NodeClaimTemplate,
+        NodePool,
+        ObjectMeta,
+        Pod,
+    )
+    from karpenter_tpu.catalog.catalog import CatalogSpec, generate
+    from karpenter_tpu.controllers import store as kst
+    from karpenter_tpu.kwok.cloud import KwokCloud
+    from karpenter_tpu.kwok.cloudprovider import KwokCloudProvider
+    from karpenter_tpu.provisioning.provisioner import Provisioner
+    from karpenter_tpu.solver.backend import TPUSolver
+    from karpenter_tpu.solver.streaming import StreamingSolver
+    from karpenter_tpu.state.cluster import Cluster
+    from karpenter_tpu.utils.resources import Resources
+
+    store = kst.Store()
+    types = generate(CatalogSpec())
+    cloud = KwokCloud(store, types)
+    provider = KwokCloudProvider(cloud, types)
+    cluster = Cluster(store)
+    store.create(kst.NODEPOOLS, NodePool(
+        meta=ObjectMeta(name="general"), template=NodeClaimTemplate()))
+    solver = TPUSolver(max_claims=1024)
+    solver.stream_run_events = True
+    streaming = StreamingSolver(cluster, provider, epoch_every=epoch_every)
+    snap = Provisioner(store, cluster, provider, solver,
+                       batch_idle_s=0, batch_max_s=0)
+
+    sizes = [("100m", "128Mi"), ("250m", "256Mi"), ("500m", "512Mi"),
+             ("1", "1Gi"), ("2", "2Gi"), ("500m", "1Gi"), ("1", "2Gi")]
+
+    def _mkpod(i: int) -> Pod:
+        cpu, mem = sizes[i % len(sizes)]
+        return Pod(meta=ObjectMeta(name=f"s-{i}", uid=f"s-{i}"),
+                   requests=Resources.parse({"cpu": cpu, "memory": mem}))
+
+    n = 0
+    for _ in range(base_pods):
+        store.create(kst.PODS, _mkpod(n))
+        n += 1
+    streaming.pump()
+    # warm: compile + full packed upload happen outside the measured loop
+    solver.solve(streaming.build_input(streaming.pending_pods()))
+    up0 = solver.ledger.total["h2d_bytes"]
+    ingest_s = 0.0
+    batch_ms = []
+    parity_failures = 0
+    t0 = time.perf_counter()
+    for b in range(batches):
+        for _ in range(pods_per_batch):
+            store.create(kst.PODS, _mkpod(n))
+            n += 1
+        tb = time.perf_counter()
+        streaming.pump()
+        pending = streaming.pending_pods()
+        inp = streaming.build_input(pending)
+        ingest_s += time.perf_counter() - tb
+        res = solver.solve(inp)
+        batch_ms.append((time.perf_counter() - tb) * 1000)
+        if parity_every and b % parity_every == 0:
+            ref = solver.solve(snap.build_input(cluster.pending_pods()))
+            if res.placements != ref.placements:
+                parity_failures += 1
+        if drain:
+            # the binder's job: this batch's arrivals got placements, so
+            # they leave pending. The MODIFIED events stream through the
+            # journal and fold in the NEXT batch's pump — part of its ingest.
+            for i in range(n - pods_per_batch, n):
+                p = store.get(kst.PODS, f"s-{i}")
+                p.node_name = "soak-sink"
+                store.update(kst.PODS, p)
+    elapsed = time.perf_counter() - t0
+    up_bytes = solver.ledger.total["h2d_bytes"] - up0
+    snap_stats = streaming.snapshot()
+    return {
+        "arrival_batches_per_sec": round(batches / max(ingest_s, 1e-9), 1),
+        "steady_state_solve_p99_ms": round(
+            float(np.percentile(np.asarray(batch_ms), 99)), 2),
+        "rebaseline_total": int(snap_stats["rebaseline_total"]),
+        "streaming_upload_bytes_per_batch": round(up_bytes / batches, 1),
+        "streaming_batches_applied": int(snap_stats["batches_applied"]),
+        "streaming_events_applied": int(snap_stats["events_applied"]),
+        "streaming_epoch_checks": int(snap_stats["epoch_checks"]),
+        "streaming_drift_detected": int(snap_stats["drift_detected"]),
+        "streaming_parity_failures": parity_failures,
+        "streaming_wall_s": round(elapsed, 2),
+        "streaming_event_stage_hits": int(
+            solver.stats.get("event_stage_hits", 0)),
+    }
+
+
+def _streaming_metrics() -> dict:
+    """Streaming delta-solve keys for the run JSON and every host-only
+    marker branch (ISSUE 13 acceptance: the backend-unavailable marker must
+    still carry the streaming keys)."""
+    try:
+        out = _streaming_run()
+        print(
+            f"[bench] streaming: {out['streaming_batches_applied']} batches @ "
+            f"{out['arrival_batches_per_sec']:.0f}/s ingest — "
+            f"solve_p99={out['steady_state_solve_p99_ms']:.1f}ms "
+            f"rebaselines={out['rebaseline_total']} "
+            f"upload/batch={out['streaming_upload_bytes_per_batch']:.0f}B "
+            f"parity_failures={out['streaming_parity_failures']}",
+            file=sys.stderr,
+        )
+        return out
+    except Exception as e:  # noqa: BLE001 — the marker line must still emit
+        print(f"[bench] streaming metrics failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return {}
+
+
+def bench_streaming_suite() -> None:
+    """CLI entry (--streaming-suite): run the streaming delta-solve suite
+    standalone and print ONE JSON line tagged streaming_suite."""
+    out = _streaming_run(
+        batches=int(os.environ.get("KTPU_STREAMING_BATCHES", "200")),
+        pods_per_batch=int(os.environ.get("KTPU_STREAMING_PODS", "8")),
+    )
+    assert out["streaming_parity_failures"] == 0, out
+    assert out["streaming_batches_applied"] > 0, out
+    print(json.dumps({
+        "metric": "arrival_batches_per_sec",
+        "value": out["arrival_batches_per_sec"],
+        "unit": "batches/s",
+        "streaming_suite": True,
+        **out,
+    }))
+
+
 def bench_encode_only(num_pods: int = 50_000) -> None:
     """CPU micro-bench of the HOST encode path alone (no device, no jax
     backend init): fresh full encode vs exact-key hit vs steady-state
@@ -1883,6 +2038,9 @@ def main() -> None:
     if "--explain-suite" in sys.argv[1:]:
         bench_explain_suite()
         return
+    if "--streaming-suite" in sys.argv[1:]:
+        bench_streaming_suite()
+        return
     # JAX_PLATFORMS pinned to host-only platforms means no accelerator can
     # EVER appear — the 4-attempt probe/backoff loop (~13 min) would be pure
     # waste. Fail fast with a reason distinct from a tunnel outage.
@@ -1896,7 +2054,8 @@ def main() -> None:
                    **_resume_metrics(), **_decode_relax_metrics(),
                    **_sharded_metrics(), **_soak_metrics(),
                    **_gang_metrics(), **_trace_stage_metrics(),
-                   **_tenant_metrics(), **_explain_metrics()},
+                   **_tenant_metrics(), **_explain_metrics(),
+                   **_streaming_metrics()},
         )
         return
     plat = wait_for_backend()
@@ -1915,7 +2074,8 @@ def main() -> None:
                    **_resume_metrics(), **_decode_relax_metrics(),
                    **_sharded_metrics(), **_soak_metrics(),
                    **_gang_metrics(), **_trace_stage_metrics(),
-                   **_tenant_metrics(), **_explain_metrics()},
+                   **_tenant_metrics(), **_explain_metrics(),
+                   **_streaming_metrics()},
         )
         return
     if plat.startswith("cpu"):
@@ -1928,7 +2088,8 @@ def main() -> None:
                    **_resume_metrics(), **_decode_relax_metrics(),
                    **_sharded_metrics(), **_soak_metrics(),
                    **_gang_metrics(), **_trace_stage_metrics(),
-                   **_tenant_metrics(), **_explain_metrics()},
+                   **_tenant_metrics(), **_explain_metrics(),
+                   **_streaming_metrics()},
         )
         return
 
@@ -2197,6 +2358,11 @@ def _run(plat: str) -> None:
     # capture overhead (< 2%), off-path inertness, burn-rate sanity
     explain_keys = _explain_metrics()
 
+    # ---- streaming delta-solve (ISSUE 13): journal-fed resident model —
+    # ingest throughput, steady-state solve p99, re-baseline count, and the
+    # per-batch upload (run-table edit triplets instead of full tables)
+    streaming_keys = _streaming_metrics()
+
     print(
         json.dumps(
             {
@@ -2265,6 +2431,10 @@ def _run(plat: str) -> None:
                 # decision provenance + SLO engine (ISSUE 12): explain wire
                 # bytes/solve, capture overhead < 2%, burn-rate sanity
                 **explain_keys,
+                # streaming delta-solve (ISSUE 13): event-proportional ingest
+                # rate, steady-state p99, re-baselines, bytes/batch — parity
+                # failures MUST be 0
+                **streaming_keys,
                 "decode_bytes_per_solve": round(
                     e2e_solver.ledger.decode_bytes_per_solve, 1
                 ),

@@ -121,6 +121,7 @@ class DisruptionController:
         self._command: Optional[Command] = None
         self._provisioner_helper: Optional[Provisioner] = None
         self._prep_cache = None  # per-reconcile prepared batched universe
+        self._prep_rev = 0  # journal state_rev the prepared universe observed
         self.stats: Dict[str, int] = {}
         # TPU backend: evaluate candidate subsets as one vmapped batch
         # (solver/tpu/consolidate.py); sequential path remains ground truth
@@ -146,8 +147,20 @@ class DisruptionController:
             return False
         budgets = self._budget_allowance(candidates)
         t0 = time.perf_counter()
+        from ..solver.pipeline import Superseded
+
         for method in ("drifted", "empty", "multi-consolidation", "single-consolidation"):
-            cmd = self._evaluate(method, candidates, budgets)
+            try:
+                cmd = self._evaluate(method, candidates, budgets)
+            except Superseded:
+                # a streamed journal batch was applied while a speculative
+                # probe was in flight: the prepared universe is older than
+                # the provisioner's last-solved state. Defer the whole tick
+                # (same contract as a superseded provisioning snapshot) and
+                # re-prepare at the new journal rev next loop.
+                self.stats["superseded_defers"] = self.stats.get("superseded_defers", 0) + 1
+                DISRUPTION_EVAL_DURATION.observe(time.perf_counter() - t0, method="superseded")
+                return False
             if cmd is not None:
                 DISRUPTION_EVAL_DURATION.observe(time.perf_counter() - t0, method=method)
                 self._execute(cmd)
@@ -378,11 +391,15 @@ class DisruptionController:
         # so a stale universe could serve probes against constraints that no
         # longer exist. Pod object identity + the global mutation epoch pin
         # the exact pod contents; the entry pins the pod objects so a freed
-        # id can't be recycled into a colliding key.
+        # id can't be recycled into a colliding key. The journal rev pins the
+        # store-event history: under --solver-streaming the provisioner folds
+        # event batches between our reconcile ticks, and a universe prepared
+        # before a fold must not serve probes after it (state/cluster.py).
         key = (
             tuple(c.claim.name for c in consolidatable),
             pod_mutation_epoch(),
             tuple(id(p) for c in consolidatable for p in c.pods),
+            self.cluster.journal.rev(),
         )
         if self._prep_cache is not None and self._prep_cache[0] == key:
             return self._prep_cache[1]
@@ -405,6 +422,9 @@ class DisruptionController:
         except Exception:
             prep = None
         self._prep_cache = (key, prep, [p for c in consolidatable for p in c.pods])
+        # the universe's journal state_rev: probes fired against this prep
+        # defer (Superseded) once the streaming consumer applies a newer batch
+        self._prep_rev = self.cluster.journal.rev()
         return prep
 
     def _max_budget_prefix(self, pool: List[Candidate], method: str, budgets) -> int:
@@ -427,8 +447,18 @@ class DisruptionController:
                 lambda: self._batched.evaluate_prepared_async(prep, subsets),
                 kind="disruption",
             )
-            return ticket.result()
-        return self._batched.evaluate_prepared(prep, subsets)
+            out = ticket.result()
+        else:
+            out = self._batched.evaluate_prepared(prep, subsets)
+        # streaming staleness guard: the provisioner may fold journal batches
+        # while the probe is in flight. A probe answered against a universe
+        # older than the last APPLIED batch must not drive a disruption
+        # command — defer exactly like a superseded provisioning snapshot.
+        from ..solver.pipeline import Superseded
+
+        if self.cluster.journal.applied_rev > self._prep_rev:
+            raise Superseded()
+        return out
 
     def _multi_batched(self, consolidatable: List[Candidate], budgets):
         """Batched speculative probes: a decision-for-decision replay of the

@@ -658,3 +658,43 @@ TENANT_METER_D2H_BYTES = REGISTRY.register(
         ("tenant",),
     )
 )
+
+# -- streaming delta-solve (solver/streaming.py; ISSUE 13) --------------------
+
+STREAMING_BATCHES_APPLIED = REGISTRY.register(
+    Counter(
+        "karpenter_streaming_batches_applied_total",
+        "Journal event batches the streaming model folded into its resident "
+        "solve universe (one per non-empty drain; solver/streaming.py)",
+    )
+)
+STREAMING_EVENTS_APPLIED = REGISTRY.register(
+    Counter(
+        "karpenter_streaming_events_applied_total",
+        "Individual journal events folded across all applied batches",
+    )
+)
+STREAMING_REBASELINE = REGISTRY.register(
+    Counter(
+        "karpenter_streaming_rebaseline_total",
+        "Forced full re-baselines of the streaming model, by cause: journal "
+        "overflow/loss, inexpressible batch (catalog mutation), epoch parity "
+        "drift, fleet fence",
+        ("reason",),
+    )
+)
+STREAMING_JOURNAL_DEPTH = REGISTRY.register(
+    Gauge(
+        "karpenter_streaming_journal_depth",
+        "Buffered events awaiting the next drain in the ClusterJournal "
+        "(state/cluster.py; 0 while no streaming consumer is attached)",
+    )
+)
+STREAMING_STATE_AGE = REGISTRY.register(
+    Gauge(
+        "karpenter_streaming_resident_state_age_seconds",
+        "Age of the streaming model's device-resident baseline: seconds "
+        "since the last full re-encode (re-baseline or epoch check) — how "
+        "long decisions have been extending purely from deltas",
+    )
+)

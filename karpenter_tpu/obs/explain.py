@@ -425,10 +425,17 @@ def capture(inp, res, backend: str, enc=None,
         ann = dict(annotations or {})
         ann.setdefault("source", "device" if table is not None else "host")
         ann["backend"] = backend
+        jseq = obstrace.current_journal_seq()
+        if jseq is not None:
+            # streaming attribution (solver/streaming.py): a streamed solve
+            # has no snapshot boundary — the journal seq of the event batch
+            # that triggered it is how /debug/explain answers "which solve"
+            ann.setdefault("journal_seq", jseq)
         sid = obstrace.current_solve_id() or f"x{next(_XSEQ):06d}"
         entry = {
             "solve_id": sid,
             "tenant_id": obstrace.current_tenant_id(),
+            "journal_seq": jseq,
             "annotations": ann,
             "_defer": (inp, enc, res, table, notes, _TOP_K),
         }

@@ -92,12 +92,25 @@ class FlightRecorder:
             # are in this record — lets an operator triage a fence/breaker
             # dump straight to the affected cluster(s) without walking spans
             tenants: Dict[str, Dict[str, int]] = {}
+            # streaming attribution (solver/streaming.py): the journal-seq
+            # window the record covers — with no snapshot solve_id boundary,
+            # "which event batches were in flight when it broke" is the
+            # triage coordinate the journal seq range answers
+            jseqs: list = []
             for t in traces + partial:
+                js = getattr(t, "journal_seq", None)
+                if js is not None:
+                    jseqs.append(int(js))
                 tid = t.tenant_id
                 if tid is None:
                     continue
                 ent = tenants.setdefault(tid, {"finished": 0, "partial": 0})
                 ent["partial" if not t.done else "finished"] += 1
+            journal = (
+                {"min_seq": min(jseqs), "max_seq": max(jseqs),
+                 "streamed_traces": len(jseqs)}
+                if jseqs else None
+            )
             payload = {
                 "reason": reason,
                 "tags": {k: _trace._jsonable(v)
@@ -106,6 +119,7 @@ class FlightRecorder:
                 "monotonic": time.monotonic(),
                 "canary_history": canary,
                 "tenants": tenants,
+                "journal": journal,
                 "partial_traces": [t.snapshot() for t in partial],
                 "traces": [t.snapshot() for t in traces],
             }

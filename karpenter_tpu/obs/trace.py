@@ -105,8 +105,8 @@ class Trace:
     `begin()`. Links (e.g. requeued_from) record cross-owner history
     that is not itself a timed operation."""
 
-    __slots__ = ("solve_id", "kind", "tenant_id", "spans", "links", "root",
-                 "status", "done", "created_wall", "_lock")
+    __slots__ = ("solve_id", "kind", "tenant_id", "journal_seq", "spans",
+                 "links", "root", "status", "done", "created_wall", "_lock")
 
     def __init__(self, solve_id: str, kind: str):
         self.solve_id = solve_id
@@ -114,6 +114,10 @@ class Trace:
         # tenancy attribution (solver/tenancy.py): set once by the minting
         # layer via set_tenant(); read by logjson/recorder/debug exports
         self.tenant_id: Optional[str] = None
+        # streaming attribution (solver/streaming.py): seq of the journal
+        # event batch this solve folded in — the solve's identity when no
+        # snapshot boundary exists; set via set_journal()
+        self.journal_seq: Optional[int] = None
         # reentrant: Trace.snapshot holds it while Span.snapshot (same
         # lock, shared with every span) re-acquires for the attrs copy
         self._lock = threading.RLock()
@@ -144,6 +148,7 @@ class Trace:
             "solve_id": self.solve_id,
             "kind": self.kind,
             "tenant_id": self.tenant_id,
+            "journal_seq": self.journal_seq,
             "status": self.status,
             "done": self.done,
             "created_wall": self.created_wall,
@@ -350,6 +355,23 @@ def set_tenant(trace: Optional[Trace], tenant_id: Optional[str]) -> None:
         return
     trace.tenant_id = tenant_id
     trace.root.set(tenant_id=tenant_id)
+
+
+def current_journal_seq() -> Optional[int]:
+    st = getattr(_TLS, "stack", None)
+    return st[-1][0].journal_seq if st else None
+
+
+def set_journal(trace: Optional[Trace], seq: Optional[int]) -> None:
+    """Stamp journal attribution (solver/streaming.py) on a trace + its root
+    span: `seq` is the newest ClusterJournal event this solve's universe
+    folds in, the streamed solve's identity when no snapshot solve_id
+    boundary exists. None-safe both ways, like set_tenant — the snapshot
+    path allocates nothing extra."""
+    if trace is None or seq is None:
+        return
+    trace.journal_seq = seq
+    trace.root.set(journal_seq=seq)
 
 
 def annotate(**attrs) -> None:

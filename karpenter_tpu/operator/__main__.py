@@ -223,6 +223,8 @@ def main(argv=None) -> int:
         solver_tenants=o.solver_tenants,
         tenant_weights=o.tenant_weights,
         tenant_max_queue_depth=o.tenant_max_queue_depth,
+        solver_streaming=o.solver_streaming,
+        streaming_epoch_every=o.streaming_epoch_every,
     )
     serve_endpoints(o.metrics_port, o.health_probe_port,
                     enable_profiling=o.enable_profiling)

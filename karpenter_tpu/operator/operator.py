@@ -54,6 +54,7 @@ class Operator:
     tenant_mux: Optional[object] = None  # solver/tenancy.py TenantMux
     recorder: Optional[object] = None  # events/recorder.py Recorder
     preemption: Optional[object] = None  # provisioning/preemption.py
+    streaming: Optional[object] = None  # solver/streaming.py StreamingSolver
 
 
 def new_kwok_operator(
@@ -94,6 +95,8 @@ def new_kwok_operator(
     solver_tenants: str = "",
     tenant_weights: str = "",
     tenant_max_queue_depth: int = 64,
+    solver_streaming: bool = False,
+    streaming_epoch_every: int = 64,
 ) -> Operator:
     store = shared_store if shared_store is not None else st.Store()
     # the operator's clock is authoritative for every age stamp, including a
@@ -160,6 +163,7 @@ def new_kwok_operator(
     if solver_preemption or solver_gang:
         solver = sc.ClassAwareSolver(solver)
     solve_service = None
+    fleet = None
     if solver_pipeline and solver_fleet_size >= 2:
         # solver fleet (solver/fleet.py): N independently health-checked
         # owners behind the SolveService surface — owner 0 is the solver
@@ -206,6 +210,7 @@ def new_kwok_operator(
             fence_after_misses=fence_after_misses,
             start_monitor=True,
         )
+        fleet = solve_service
     elif solver_pipeline:
         # one owner for the device solve seam: controller solves queue
         # through the service's three-stage pipeline (encode ∥ compute ∥
@@ -235,6 +240,34 @@ def new_kwok_operator(
             clock=clock,
         )
         solve_service = tenant_mux.view(registry.first().tenant_id)
+    streaming = None
+    if solver_streaming:
+        # streaming delta-solve (solver/streaming.py, ISSUE 13): the
+        # provisioner folds journal event batches into a resident model
+        # instead of snapshotting the store, and every TPU backend in the
+        # deployment stages run-table edits as device scatters
+        from ..solver.streaming import StreamingSolver
+
+        streaming = StreamingSolver(
+            cluster, cloud_provider,
+            preference_policy=preference_policy,
+            epoch_every=streaming_epoch_every, clock=clock,
+        )
+
+        def _enable_stream_stage(s) -> None:
+            inner = s
+            while hasattr(inner, "__dict__") and "inner" in inner.__dict__:
+                inner = inner.inner
+            if hasattr(inner, "stream_run_events"):
+                inner.stream_run_events = True
+
+        _enable_stream_stage(solver)
+        if fleet is not None:
+            for o in fleet.owners:
+                _enable_stream_stage(o.solver)
+            # a fence invalidates the owner's arena: the streaming model
+            # re-baselines so replays never extend presumed-resident state
+            fleet.fence_listeners.append(streaming.on_fence)
     from ..events.recorder import Recorder
     from ..provisioning.preemption import PreemptionController
 
@@ -252,6 +285,7 @@ def new_kwok_operator(
         solve_service=solve_service,
         preemption=preemption,
         recorder=recorder,
+        streaming=streaming,
     )
     from ..controllers.volume import VolumeTopologyController
 
@@ -390,4 +424,5 @@ def new_kwok_operator(
         tenant_mux=tenant_mux,
         recorder=recorder,
         preemption=preemption,
+        streaming=streaming,
     )

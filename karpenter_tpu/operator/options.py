@@ -140,6 +140,16 @@ class Options:
     # per-tenant admission bound: open solve requests (queued + in flight)
     # above this raise TenantAdmissionReject instead of enqueueing
     tenant_max_queue_depth: int = 64
+    # streaming delta-solve (solver/streaming.py): the provisioner folds
+    # ClusterJournal event batches into a resident incremental model and
+    # assembles solve inputs from it (event-rate-proportional host cost),
+    # with the backend shipping run-table edits as device scatters. Default
+    # off (snapshot path, byte-identical) → soak → on; decisions are
+    # bit-identical either way (tests/test_streaming_solve.py parity).
+    solver_streaming: bool = False
+    # applied event batches between full re-encode parity checks of the
+    # streaming model (epoch protocol; drift re-baselines). 0 = never.
+    streaming_epoch_every: int = 64
     # per-solve deadline on the device path, seconds; 0 = no deadline
     solver_deadline_s: float = 0.0
     # breaker opens after this many consecutive device-path failures
@@ -325,6 +335,14 @@ def parse(argv: Optional[Sequence[str]] = None, cls=Options) -> Options:
             f"(got {ering}); it bounds the explain-record ring backing "
             "/debug/explain (obs/explain.py)"
         )
+    epoch = getattr(out, "streaming_epoch_every", None)
+    if epoch is not None and int(epoch) < 0:
+        raise SystemExit(
+            "refusing to start: --streaming-epoch-every must be >= 0 "
+            f"(got {epoch}); it is the applied-batch count between the "
+            "streaming model's full parity checks, 0 = never "
+            "(solver/streaming.py)"
+        )
     slo_spec = getattr(out, "slo_objectives", None)
     if slo_spec:
         from ..obs.slo import parse_objectives
@@ -336,6 +354,7 @@ def parse(argv: Optional[Sequence[str]] = None, cls=Options) -> Options:
     for name in (
         "solver_device_decode", "solver_relax_ladder",
         "solver_preemption", "solver_gang", "solver_explain",
+        "solver_streaming",
     ):
         if not hasattr(out, name):
             continue

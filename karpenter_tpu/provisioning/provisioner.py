@@ -45,6 +45,7 @@ class Provisioner:
         solve_service=None,
         preemption=None,
         recorder=None,
+        streaming=None,
     ):
         self.store = store
         self.cluster = cluster
@@ -63,6 +64,11 @@ class Provisioner:
         # preemptions surface as pod events through the recorder
         self._preemption = preemption
         self._recorder = recorder
+        # streaming delta-solve (solver/streaming.py, --solver-streaming):
+        # when set, reconcile folds journal event batches into the resident
+        # model and assembles the solve input from it instead of scanning
+        # the store — decision-identical, event-rate-proportional
+        self._streaming = streaming
         self._first_seen: Optional[float] = None
         self._last_count = 0
         self._claim_seq = 0
@@ -160,7 +166,12 @@ class Provisioner:
     # -- reconcile ----------------------------------------------------------
 
     def reconcile(self) -> bool:
-        pending = self.cluster.pending_pods()
+        journal_seq = None
+        if self._streaming is not None:
+            journal_seq = self._streaming.pump()
+            pending = self._streaming.pending_pods()
+        else:
+            pending = self.cluster.pending_pods()
         SCHEDULER_QUEUE_DEPTH.set(len(pending))
         PODS_UNSCHEDULABLE.set(float(len(pending)), state="pending")
         if not self._batch_ready(pending):
@@ -170,10 +181,18 @@ class Provisioner:
         # mint the solve's trace HERE — the provisioner is the top of the
         # span tree; the service/fleet/backend layers below adopt it
         _tr = obstrace.begin("provisioning")
+        # streamed solves have no snapshot boundary: the journal seq of the
+        # newest folded event batch IS the solve's identity (obs/explain,
+        # flight-recorder dumps key on it)
+        obstrace.set_journal(_tr, journal_seq)
         with obstrace.attached(_tr):
             obstrace.annotate(pending_pods=len(pending))
             with obstrace.span("provision.build_input"):
-                inp = self.build_input(pending)
+                inp = (
+                    self._streaming.build_input(pending)
+                    if self._streaming is not None
+                    else self.build_input(pending)
+                )
         try:
             if self._solve_service is not None:
                 # pipelined path: the service owns the device — this snapshot

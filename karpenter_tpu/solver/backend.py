@@ -753,7 +753,16 @@ class TPUSolver(Solver):
             "sharded_solves": 0, "shard_fixup_runs": 0,
             "sharded_fallbacks": 0, "shard_resume_solves": 0,
             "shard_resume_runs_skipped": 0,
+            "event_stage_hits": 0, "event_stage_misses": 0,
         }
+        # streaming run-table staging (solver/streaming.py, SPEC.md
+        # "Streaming semantics"): when on, each device solve first tries to
+        # sync the arena's resident run tables via an edit-triplet scatter
+        # (arena.apply_run_events) so adopt() sees them fresh and the h2d
+        # payload shrinks to the triplets. Default off — the StreamingSolver
+        # flips it; decisions are identical either way (the stage only
+        # changes HOW the same bytes reach the device).
+        self.stream_run_events = False
         # mesh-sharded provisioning solve (ISSUE 7, SPEC.md "Sharding
         # semantics"): shards >= 2 partitions ONE solve's run axis across a
         # device mesh (block-local scans + host carry-exchange stitch,
@@ -1763,6 +1772,16 @@ class TPUSolver(Solver):
                 # resilience layer invalidates the arena, and the replay (or
                 # the re-routed owner) pays one full re-adoption upload
                 faults.check("solver.arena_corrupt", tag=self.fault_tag)
+                if self.stream_run_events:
+                    # streaming stage: scatter run-table edits on device so
+                    # the adopt below digest-hits entries 0/1 (zero
+                    # run-array upload); a declined stage just falls back
+                    # to adopt's normal packed delta — same bytes land
+                    staged = self.arena.apply_run_events(
+                        host_args, prov, ns=enc.tenant_id)
+                    self.stats[
+                        "event_stage_hits" if staged else "event_stage_misses"
+                    ] += 1
                 # device-resident arena: only stale entries upload, packed
                 # into ONE buffer; an exact encode-cache hit uploads nothing
                 args = self.arena.adopt(host_args, prov, ns=enc.tenant_id)

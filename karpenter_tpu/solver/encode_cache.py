@@ -176,6 +176,30 @@ def run_lcp(prev: tuple, cur: tuple) -> int:
     return k
 
 
+def run_table_events(prev_rg, prev_rc, rg, rc, max_events: int = 0):
+    """Diff two same-shape padded run tables into the (pos, gid, cnt) edit
+    triplets of the streaming event-apply kernel (tpu/ffd.ffd_apply_events).
+
+    Returns an int32 [K, 3] array of the positions where either table
+    changed, or None when the tables' shapes differ (different compile
+    bucket — a whole-array upload is the only move) or when K exceeds
+    `max_events` (> 0; a near-total rewrite is cheaper shipped whole than as
+    a triplet table 3x its size). K == 0 returns an empty [0, 3] array —
+    the caller skips the dispatch entirely."""
+    import numpy as np
+
+    if prev_rg.shape != rg.shape or prev_rc.shape != rc.shape:
+        return None
+    changed = np.nonzero((prev_rg != rg) | (prev_rc != rc))[0]
+    if max_events and len(changed) > max_events:
+        return None
+    ev = np.empty((len(changed), 3), dtype=np.int32)
+    ev[:, 0] = changed
+    ev[:, 1] = rg[changed]
+    ev[:, 2] = rc[changed]
+    return ev
+
+
 def run_block_identity(ident: tuple, n_shards: int, block: int) -> tuple:
     """Per-mesh-block slices of a run_identity() tuple: block d of a sharded
     solve covers real runs [d*block, min((d+1)*block, len(ident))) of the

@@ -248,6 +248,12 @@ class SolverFleet:
             "canary_probes": 0,
             "canary_misses": 0,
         }
+        # fence notifications (solver/streaming.py): called AFTER an owner's
+        # arena is invalidated, with the fence reason — the streaming model
+        # force-rebaselines so resilient replays never extend a universe
+        # whose device residency was just declared unknowable. Guarded:
+        # listener failures never abort recovery.
+        self.fence_listeners: List[Callable[[str], None]] = []
         self.owners: List[FleetOwner] = []
         for i in range(self.size):
             solver = solver_factory(i)
@@ -506,6 +512,11 @@ class SolverFleet:
                 inv()
             except Exception:  # noqa: BLE001 — best-effort on a dead owner
                 pass
+        for listener in list(self.fence_listeners):
+            try:
+                listener(reason)
+            except Exception:  # noqa: BLE001 — diagnostics never abort
+                log.exception("solver fleet: fence listener failed")
         for entry in survivors:  # original submission order
             if not entry.ticket.done():
                 self._reroute(entry)
@@ -679,6 +690,9 @@ class SolverFleet:
 
     def decode_stats(self) -> Dict[str, float]:
         return self.owners[0].service.decode_stats()
+
+    def streaming_stats(self) -> Dict[str, float]:
+        return self.owners[0].service.streaming_stats()
 
     def close(self) -> None:
         """Stop the watchdog and every owner; every fleet ticket resolves

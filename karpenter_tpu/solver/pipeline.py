@@ -362,6 +362,23 @@ class SolveService:
             "wide_refetches": int(stats.get("wide_refetches", 0)),
         }
 
+    def streaming_stats(self) -> Dict[str, float]:
+        """Streaming event-stage counters of the owned backend (zeros when
+        the backend has none, or `--solver-streaming` is off) — the ISSUE 13
+        bench keys. Hits are solves whose run tables reached the device as
+        an edit-triplet scatter (arena.apply_run_events) instead of a packed
+        re-upload; misses declined and paid adopt's normal path."""
+        inner = self.solver
+        stats = getattr(inner, "stats", None) or {}
+        arena = getattr(inner, "arena", None)
+        astats = getattr(arena, "stats", None) or {}
+        return {
+            "event_stage_hits": int(stats.get("event_stage_hits", 0)),
+            "event_stage_misses": int(stats.get("event_stage_misses", 0)),
+            "event_batches": int(astats.get("event_batches", 0)),
+            "event_edits": int(astats.get("event_edits", 0)),
+        }
+
     def slo_stats(self) -> Dict[str, Dict[str, float]]:
         """Per-stage SLO burn rates (obs/slo.py) as seen through this
         pipeline's span feed — every solve it dispatches lands a

@@ -1,0 +1,441 @@
+"""Streaming delta-solve: event batches in, kernel dispatches out (ISSUE 13).
+
+Every snapshot solve pays a host tax proportional to CLUSTER SIZE — list the
+store, rebuild every ExistingNode, re-derive the pool catalog — before the
+encode cache and the argument arena can even start shaving the device side.
+This module makes the solve path proportional to EVENT RATE instead: a
+`StreamingSolver` subscribes to the `ClusterJournal` (state/cluster.py) and
+folds ordered event batches into a resident incremental model of the solve
+universe, so `build_input()` is a cache assembly, not a cluster scan.
+
+The resident model mirrors exactly what `Provisioner.build_input` reads:
+
+  - pod / node / claim / pool / daemonset mirrors, keyed like the store and
+    holding the SAME live objects (the store mutates in place — content is
+    never stale; only membership and derived caches need events);
+  - per-node `ExistingNode` views (the expensive Resources math), rebuilt
+    only for nodes an event dirtied, via the SAME `existing_node_view`
+    helper the snapshot path uses — the two can never drift;
+  - per-node pool-usage contributions, folded in the snapshot path's
+    state-node order so the aggregate is bit-identical;
+  - the pool catalog (instance types, zone/capacity-type universes), reused
+    while the provider's `catalog_token()` holds and no catalog-kind store
+    event fired.
+
+Downstream, everything already composes: the streamed input carries the same
+`state_rev` stamp, so `encode_cache.try_patch` hits, `run_identity`/LCP
+resume dispatches `ffd_resume` from the deepest device checkpoint, and the
+backend's `stream_run_events` staging (arena.apply_run_events) ships the run
+tables as edit triplets — h2d is only the changed runs, d2h stays the packed
+claim delta.
+
+Safety protocol (solver/SPEC.md "Streaming semantics"):
+
+  - journal loss (overflow, detach) forces a full re-baseline — the model
+    never extends a gapped stream;
+  - catalog-kind events and provider token changes are INEXPRESSIBLE as
+    deltas: the catalog caches rebuild from the store, decision-identical
+    to the snapshot path (the fallback table in SPEC.md);
+  - every `epoch_every` applied batches, a full snapshot re-derivation runs
+    and is compared against the streamed model; any drift re-baselines and
+    counts `karpenter_streaming_rebaseline_total{reason="drift"}`;
+  - a fleet fence (fleet.fence_listeners) re-baselines, matching the arena
+    invalidation — replays never act on device state the model presumed
+    resident;
+  - a `pod_mutation_epoch` bump (in-place sig mutation, no store event)
+    resyncs the pod-derived maps.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..api import wellknown as wk
+from ..api.objects import pod_mutation_epoch
+from ..controllers import store as st
+from ..metrics.registry import (
+    STREAMING_BATCHES_APPLIED,
+    STREAMING_EVENTS_APPLIED,
+    STREAMING_JOURNAL_DEPTH,
+    STREAMING_REBASELINE,
+    STREAMING_STATE_AGE,
+)
+from ..provisioning.scheduler import ExistingNode, NodePoolSpec, SolverInput
+from ..state.cluster import Cluster, StateNode, existing_node_view
+from ..utils.resources import Resources
+
+_CATALOG_KINDS = frozenset((st.NODEPOOLS, st.NODECLASSES, st.DAEMONSETS))
+
+
+class StreamingSolver:
+    """Incremental solve-universe model fed by the ClusterJournal.
+
+    Not a `Solver` — it sits ABOVE the solver seam: the provisioner calls
+    `pump()` each tick (fold pending journal events), reads `pending_pods()`
+    for batching, and `build_input(pending)` for the solve; the input then
+    flows through the unchanged service/fleet/backend stack. Thread-safe:
+    pump/build run under one lock (the provisioner and the epoch check are
+    the only writers; fence listeners only set a flag).
+    """
+
+    def __init__(self, cluster: Cluster, cloud_provider,
+                 preference_policy: str = "Respect",
+                 epoch_every: int = 64, clock=time.monotonic):
+        self.cluster = cluster
+        self.store = cluster.store
+        self.journal = cluster.journal
+        self.cloud_provider = cloud_provider
+        self.preference_policy = preference_policy
+        self.epoch_every = max(0, int(epoch_every))  # 0 = never
+        self.clock = clock
+        self._lock = threading.RLock()
+        self._rebaseline_wanted: Optional[str] = None  # fence flag
+        self.stats: Dict[str, int] = {
+            "batches_applied": 0, "events_applied": 0,
+            "rebaseline_total": 0, "epoch_checks": 0, "drift_detected": 0,
+            "catalog_rebuilds": 0, "streamed_solves": 0,
+        }
+        self._attached = False
+        self._applied_seq = 0
+        self._baseline_at = self.clock()
+        # -- mirrors (store order; values are the LIVE stored objects) ------
+        self._pods: Dict[str, object] = {}
+        self._nodes: Dict[str, object] = {}       # by meta.name
+        self._claims: Dict[str, object] = {}
+        # -- pod-derived maps ----------------------------------------------
+        self._pod_ord: Dict[str, int] = {}        # store insertion order
+        self._ord = 0
+        self._pod_node: Dict[str, Optional[str]] = {}
+        self._by_node: Dict[str, Dict[str, object]] = {}
+        self._pod_epoch = pod_mutation_epoch()
+        # -- per-state-node derived caches ---------------------------------
+        self._claim_names: Dict[str, Set[str]] = {}
+        self._en_cache: Dict[str, Optional[ExistingNode]] = {}
+        self._usage_cache: Dict[str, Optional[Tuple[str, Resources]]] = {}
+        self._dirty: Set[str] = set()
+        # -- catalog caches -------------------------------------------------
+        self._catalog_dirty = True
+        self._pool_token: object = None
+        self._pool_types: Dict[str, list] = {}
+        self._daemonsets: List[object] = []
+        self._zones: Tuple[str, ...] = ()
+        self._cts: Tuple[str, ...] = ()
+        self._since_epoch_check = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def on_fence(self, reason: str) -> None:
+        """Fleet fence listener: the next pump re-baselines. Only sets a
+        flag — the fence path must stay failure-proof."""
+        self._rebaseline_wanted = "fence"
+
+    def force_rebaseline(self, reason: str = "forced") -> None:
+        self._rebaseline_wanted = reason
+
+    def _rebaseline(self, reason: str) -> None:
+        """Full snapshot resync: re-attach the journal, rebuild every mirror
+        and derived map from the store. The attach-then-list order closes
+        the race: events landing between attach() and the list() calls are
+        re-delivered by the next drain, and folding them is idempotent
+        (level-triggered — the mirror re-reads the same live object)."""
+        STREAMING_REBASELINE.inc(reason=reason)
+        self.stats["rebaseline_total"] += 1
+        self._applied_seq = self.journal.attach()
+        self._pods.clear()
+        self._pod_ord.clear()
+        self._ord = 0
+        self._pod_node.clear()
+        self._by_node.clear()
+        self._nodes.clear()
+        self._claims.clear()
+        self._claim_names.clear()
+        self._en_cache.clear()
+        self._usage_cache.clear()
+        self._dirty.clear()
+        for p in self.store.list(st.PODS):
+            self._fold_pod("ADDED", f"{p.meta.namespace}/{p.meta.name}", p)
+        for n in self.store.list(st.NODES):
+            self._nodes[n.meta.name] = n
+        for c in self.store.list(st.NODECLAIMS):
+            self._fold_claim(
+                "ADDED", f"{c.meta.namespace}/{c.meta.name}", c)
+        self._catalog_dirty = True
+        self._pod_epoch = pod_mutation_epoch()
+        self._attached = True
+        self._baseline_at = self.clock()
+        self._since_epoch_check = 0
+        self.journal.mark_applied(self._applied_seq)
+
+    # -- event folding -------------------------------------------------------
+
+    def _fold_pod(self, event: str, key: str, pod) -> None:
+        prev_node = self._pod_node.get(key)
+        if event == "DELETED":
+            self._pods.pop(key, None)
+            self._pod_ord.pop(key, None)
+            self._pod_node.pop(key, None)
+            if prev_node:
+                self._by_node.get(prev_node, {}).pop(key, None)
+                self._dirty.add(prev_node)
+            return
+        if key not in self._pods:
+            self._ord += 1
+            self._pod_ord[key] = self._ord
+        self._pods[key] = pod
+        cur_node = pod.node_name or None
+        self._pod_node[key] = cur_node
+        if prev_node and prev_node != cur_node:
+            self._by_node.get(prev_node, {}).pop(key, None)
+            self._dirty.add(prev_node)
+        if cur_node:
+            self._by_node.setdefault(cur_node, {})[key] = pod
+            # a bound pod's content change (requests, labels, deleting)
+            # moves its node's free/evictability — dirty unconditionally
+            self._dirty.add(cur_node)
+
+    def _fold_claim(self, event: str, key: str, claim) -> None:
+        prev = self._claim_names.get(key, set())
+        if event == "DELETED":
+            self._claims.pop(key, None)
+            self._claim_names.pop(key, None)
+            self._dirty |= prev
+            return
+        self._claims[key] = claim
+        names = {n for n in (claim.node_name, claim.name) if n}
+        self._claim_names[key] = names
+        self._dirty |= prev | names
+
+    def _fold(self, ev) -> None:
+        if ev.kind == st.PODS:
+            self._fold_pod(ev.event, ev.key, ev.obj)
+        elif ev.kind == st.NODES:
+            name = ev.obj.meta.name
+            if ev.event == "DELETED":
+                self._nodes.pop(name, None)
+            else:
+                self._nodes[name] = ev.obj
+            self._dirty.add(name)
+        elif ev.kind == st.NODECLAIMS:
+            self._fold_claim(ev.event, ev.key, ev.obj)
+        elif ev.kind in _CATALOG_KINDS:
+            # inexpressible as a delta (SPEC.md fallback table): pool
+            # contents / daemonset overhead / axes universes rebuild from
+            # the store next build_input — decision-identical snapshot leg
+            self._catalog_dirty = True
+        # PDBs / PVs / PVCs: not provisioning inputs; PVC zone resolution
+        # reaches pods as pod mutations (controllers/volume.py)
+
+    def pump(self) -> int:
+        """Fold every journal event since the last pump; returns the seq of
+        the newest folded event (the solve's journal attribution). Cheap
+        when nothing happened; re-baselines on stream loss, a pending fence
+        flag, or an in-place pod sig mutation epoch bump."""
+        with self._lock:
+            want = self._rebaseline_wanted
+            if want is not None:
+                self._rebaseline_wanted = None
+                self._rebaseline(want)
+            elif not self._attached:
+                self._rebaseline("baseline")
+            else:
+                events, lost = self.journal.drain(self._applied_seq)
+                if lost:
+                    self._rebaseline("journal_lost")
+                elif events:
+                    for ev in events:
+                        self._fold(ev)
+                    self._applied_seq = events[-1].seq
+                    self.stats["batches_applied"] += 1
+                    self.stats["events_applied"] += len(events)
+                    STREAMING_BATCHES_APPLIED.inc()
+                    STREAMING_EVENTS_APPLIED.inc(len(events))
+                    self.journal.mark_applied(self._applied_seq)
+                    self._since_epoch_check += 1
+                    if self.epoch_every and (
+                            self._since_epoch_check >= self.epoch_every):
+                        self._epoch_check()
+            if pod_mutation_epoch() != self._pod_epoch:
+                # in-place sig mutation: no store event fired, but bound-pod
+                # requests/labels may have moved — resync the pod maps
+                self._rebaseline("pod_epoch")
+            STREAMING_JOURNAL_DEPTH.set(float(self.journal.depth()))
+            STREAMING_STATE_AGE.set(self.clock() - self._baseline_at)
+            return self._applied_seq
+
+    # -- assembly ------------------------------------------------------------
+
+    def pending_pods(self) -> List[object]:
+        """Same predicate + order as Cluster.pending_pods(), over the mirror
+        (store insertion order) instead of a store list."""
+        with self._lock:
+            return [
+                p for p in self._pods.values()
+                if not p.bound and not p.scheduling_gated
+                and p.phase == "Pending" and not p.meta.deleting
+            ]
+
+    def _node_pods(self, name: str) -> List[object]:
+        d = self._by_node.get(name)
+        if not d:
+            return []
+        return [p for _, p in sorted(
+            d.items(), key=lambda kv: self._pod_ord.get(kv[0], 0))]
+
+    def _state_nodes(self) -> List[StateNode]:
+        """The snapshot path's state-node join, over the mirrors: claims in
+        store order (joined to their nodes), then unclaimed nodes — the fold
+        order `nodepool_usage` aggregates in must match bit-for-bit."""
+        out: List[StateNode] = []
+        claimed: Set[str] = set()
+        for c in self._claims.values():
+            node = self._nodes.get(c.node_name) if c.node_name else None
+            if node is not None:
+                claimed.add(node.meta.name)
+            out.append(StateNode(node=node, claim=c))
+        for name, n in self._nodes.items():
+            if name not in claimed:
+                out.append(StateNode(node=n, claim=None))
+        return out
+
+    def _refresh_views(self) -> Tuple[List[ExistingNode], Dict[str, Resources]]:
+        ens: List[ExistingNode] = []
+        usage: Dict[str, Resources] = {}
+        dirty = self._dirty
+        for sn in self._state_nodes():
+            name = sn.name
+            if name in dirty or name not in self._en_cache:
+                self._en_cache[name] = existing_node_view(
+                    sn, self._node_pods(name))
+                np_name = sn.nodepool
+                cap = None
+                if sn.claim is not None and sn.claim.capacity:
+                    cap = sn.claim.capacity
+                elif sn.node is not None:
+                    cap = sn.node.capacity
+                self._usage_cache[name] = (
+                    (np_name, cap) if np_name and cap else None
+                )
+            en = self._en_cache[name]
+            if en is not None:
+                ens.append(en)
+            contrib = self._usage_cache[name]
+            if contrib is not None:
+                usage[contrib[0]] = usage.get(
+                    contrib[0], Resources()).add(contrib[1])
+        self._dirty = set()
+        ens.sort(key=lambda n: n.id)
+        return ens, usage
+
+    def _refresh_catalog(self) -> None:
+        """Rebuild the instance-type / zone / capacity-type / daemonset
+        caches from the store + provider — the snapshot path's loop,
+        verbatim. Runs on catalog-kind events and provider token changes;
+        a provider with no catalog_token() can never prove reuse, so the
+        caches rebuild every solve (still snapshot-identical)."""
+        self.stats["catalog_rebuilds"] += 1
+        self._pool_types = {}
+        zones: set = set()
+        cts: set = set()
+        for np_obj in self.store.list(st.NODEPOOLS):
+            if np_obj.meta.deleting:
+                continue
+            types = self.cloud_provider.get_instance_types(np_obj.name)
+            self._pool_types[np_obj.name] = types
+            for it in types:
+                zr = it.requirements.get(wk.ZONE_LABEL)
+                if zr:
+                    zones.update(zr.values_list())
+                cr = it.requirements.get(wk.CAPACITY_TYPE_LABEL)
+                if cr:
+                    cts.update(cr.values_list())
+        self._zones = tuple(sorted(zones))
+        self._cts = tuple(sorted(cts))
+        self._daemonsets = [d for d in self.store.list(st.DAEMONSETS)]
+        self._catalog_dirty = False
+
+    def build_input(self, pending: List[object]) -> SolverInput:
+        """Assemble the streamed SolverInput — content-equal to
+        `Provisioner.build_input(pending)` on the same universe (the parity
+        the epoch check and tests/test_streaming_solve.py enforce)."""
+        with self._lock:
+            self.stats["streamed_solves"] += 1
+            tok_fn = getattr(self.cloud_provider, "catalog_token", None)
+            tok = tok_fn() if callable(tok_fn) else None
+            if self._catalog_dirty or tok is None or tok != self._pool_token:
+                self._refresh_catalog()
+                self._pool_token = tok
+            ens, usage = self._refresh_views()
+            pools: List[NodePoolSpec] = []
+            for np_obj in self.store.list(st.NODEPOOLS):
+                if np_obj.meta.deleting:
+                    continue
+                types = self._pool_types.get(np_obj.name)
+                if types is None:
+                    # pool raced in after the catalog refresh without an
+                    # event reaching us yet — fetch; the event re-dirties
+                    types = self.cloud_provider.get_instance_types(
+                        np_obj.name)
+                pools.append(NodePoolSpec(
+                    name=np_obj.name,
+                    weight=np_obj.weight,
+                    requirements=np_obj.scheduling_requirements(),
+                    taints=list(np_obj.template.taints),
+                    instance_types=types,
+                    limits=np_obj.limits,
+                    usage=usage.get(np_obj.name, type(np_obj.limits)()),
+                ))
+            state_rev = None
+            deltas = getattr(self.cluster, "encode_deltas", None)
+            if deltas is not None and tok is not None:
+                tracker, crev, prev, nrev = deltas.snapshot()
+                state_rev = (tracker, (crev, tok), prev, nrev)
+            return SolverInput(
+                pods=pending,
+                nodes=ens,
+                nodepools=pools,
+                daemonset_pods=self._daemonsets,
+                zones=self._zones,
+                capacity_types=self._cts or ("on-demand", "spot"),
+                preference_policy=self.preference_policy,
+                state_rev=state_rev,
+            )
+
+    # -- epoch / parity ------------------------------------------------------
+
+    def _epoch_check(self) -> None:
+        """Periodic reconciliation: re-derive the pod/node legs from a full
+        store scan and compare against the streamed model. Drift means an
+        event class the fold missed — re-baseline rather than let decisions
+        extend a wrong universe. Caller holds the lock."""
+        self.stats["epoch_checks"] += 1
+        self._since_epoch_check = 0
+        snap_pending_keys = [
+            f"{p.meta.namespace}/{p.meta.name}"
+            for p in self.cluster.pending_pods()
+        ]
+        mine_pending_keys = [
+            f"{p.meta.namespace}/{p.meta.name}" for p in self.pending_pods()
+        ]
+        snap_nodes = self.cluster.existing_nodes_for_scheduler()
+        snap_usage = self.cluster.nodepool_usage()
+        dirty_backup = set(self._dirty)
+        mine_nodes, mine_usage = self._refresh_views()
+        self._dirty |= dirty_backup
+        if (snap_pending_keys != mine_pending_keys
+                or snap_nodes != mine_nodes or snap_usage != mine_usage):
+            self.stats["drift_detected"] += 1
+            self._rebaseline("drift")
+
+    # -- introspection -------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                **self.stats,
+                "applied_seq": self._applied_seq,
+                "journal_depth": self.journal.depth(),
+                "journal_overflows": self.journal.overflows,
+                "resident_state_age_s": self.clock() - self._baseline_at,
+            }

@@ -718,6 +718,9 @@ class TenantMux:
     def decode_stats(self) -> Dict[str, float]:
         return self._service.decode_stats()
 
+    def streaming_stats(self) -> Dict[str, float]:
+        return self._service.streaming_stats()
+
     # -- shutdown ------------------------------------------------------------
 
     def close(self) -> None:

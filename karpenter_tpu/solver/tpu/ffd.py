@@ -291,6 +291,36 @@ DELTA_ENTRY_U16 = 2  # (code, count) uint16 per entry word; code = e | E+m
 # tests/test_arg_spec_drift.py.
 SHARD_BLOCK_MULT = 16
 
+# --- streaming event-batch apply (SPEC.md "Streaming semantics") ------------
+#
+# The streaming delta-solve subsystem (solver/streaming.py) keeps run_group/
+# run_count device-resident across solves and edits them in place: an
+# arrival/eviction batch becomes a tiny table of (pos, gid, cnt) triplets
+# scattered into the resident run arrays, instead of re-uploading the whole
+# [Sp] pair. One int32 row per edited run position; padding rows carry
+# pos = -1 and are dropped by the scatter (mode="drop"), so the triplet
+# count buckets to a handful of compile variants. Layout pinned by
+# tests/test_arg_spec_drift.py.
+
+EVENT_ENTRY_WORDS = 3  # (pos, gid, cnt) int32 per run edit
+EVENT_PAD_POS = -1  # padding rows scatter out of range and are dropped
+
+
+@functools.partial(jax.jit)
+def ffd_apply_events(run_group, run_count, events):
+    """Scatter an event batch into the resident run tables.
+
+    run_group/run_count are the device-resident [Sp] int32 arrays (ARG_SPEC
+    entries 0 and 1); events is [K, EVENT_ENTRY_WORDS] int32 of (pos, gid,
+    cnt) edits. Positions outside [0, Sp) — including EVENT_PAD_POS padding
+    — are dropped. Returns the edited (run_group, run_count) pair; inputs
+    are NOT donated (no jit in this repo donates), so the caller swaps the
+    arena's resident buffers for the returned ones."""
+    pos = events[:, 0]
+    rg = run_group.at[pos].set(events[:, 1], mode="drop")
+    rc = run_count.at[pos].set(events[:, 2], mode="drop")
+    return rg, rc
+
 
 def compact_takes(take_e, take_c, cap: int):
     """[Sp,E]/[Sp,M] dense takes -> run-major packed nonzero entries.

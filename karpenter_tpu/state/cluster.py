@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -138,6 +139,165 @@ class EncodeDeltas:
             return (self, self.catalog_rev, self.pods_rev, self.nodes_rev)
 
 
+@dataclass
+class JournalEvent:
+    """One store event in journal order. `obj` is the LIVE stored object —
+    the store mutates objects in place before update(), so an event is a
+    level-triggered dirty notification ("re-read this object"), never a
+    state-at-event-time payload (solver/SPEC.md "Streaming semantics")."""
+
+    seq: int
+    event: str  # ADDED | MODIFIED | DELETED
+    kind: str
+    key: str  # store key, "namespace/name"
+    obj: object
+
+
+class ClusterJournal:
+    """Ordered event journal feeding the streaming delta-solve subsystem
+    (solver/streaming.py).
+
+    Every store event gets a monotonic `seq` stamp — the journal's
+    `state_rev`. The stamp is always maintained (it is one counter bump per
+    event, and the disruption engine's mid-stream staleness guard reads it
+    unconditionally); the event BUFFER only fills while a streaming consumer
+    is attached, so the journal costs nothing when `--solver-streaming` is
+    off. The buffer is bounded: when it overflows, the oldest events are
+    dropped and the next drain() reports the loss so the consumer re-baselines
+    from a full snapshot instead of silently acting on a gapped stream.
+
+    `applied_rev` is the seq of the last event batch a streaming consumer
+    folded into its solve universe — the reference point for the disruption
+    engine's Superseded defer (a speculative probe prepared at rev r must not
+    act once applied_rev > r: the provisioner has already solved against a
+    newer universe than the probe's).
+    """
+
+    def __init__(self, store: st.Store, maxlen: int = 4096):
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.maxlen = max(1, int(maxlen))
+        self._events: deque = deque()
+        self._attached = False
+        # seq of the oldest event still in the buffer minus 1: drain(after)
+        # with after < _floor means events were lost to overflow
+        self._floor = 0
+        self.overflows = 0
+        self.applied_rev = 0
+        store.watch(None, self._on_event)
+
+    def _on_event(self, event: str, kind: str, obj) -> None:
+        with self._lock:
+            self._seq += 1
+            if not self._attached:
+                self._floor = self._seq
+                return
+            key = f"{obj.meta.namespace}/{obj.meta.name}"
+            self._events.append(
+                JournalEvent(self._seq, event, kind, key, obj)
+            )
+            if len(self._events) > self.maxlen:
+                dropped = self._events.popleft()
+                self._floor = dropped.seq
+                self.overflows += 1
+
+    def rev(self) -> int:
+        """Monotonic seq of the newest store event (the journal state_rev)."""
+        with self._lock:
+            return self._seq
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def attach(self) -> int:
+        """Start buffering events; returns the current seq (the consumer's
+        baseline — it must snapshot the store AT OR AFTER this seq)."""
+        with self._lock:
+            self._attached = True
+            self._events.clear()
+            self._floor = self._seq
+            return self._seq
+
+    def detach(self) -> None:
+        with self._lock:
+            self._attached = False
+            self._events.clear()
+            self._floor = self._seq
+
+    def drain(self, after_seq: int) -> Tuple[List[JournalEvent], bool]:
+        """Events with seq > after_seq, in order, plus a `lost` flag: True
+        when the buffer no longer covers (after_seq, now] — an overflow
+        evicted events the consumer never saw, or the consumer was never
+        attached — so it must re-baseline from a full snapshot."""
+        with self._lock:
+            if not self._attached or after_seq < self._floor:
+                return [], self._seq > after_seq
+            out = [e for e in self._events if e.seq > after_seq]
+            # retire everything: drained events were returned, and anything
+            # at or before after_seq the consumer has already folded in
+            self._events.clear()
+            self._floor = self._seq
+            return out, False
+
+    def mark_applied(self, seq: int) -> None:
+        """Record that a streaming consumer folded events through `seq` into
+        its solve universe (read by the disruption staleness guard)."""
+        with self._lock:
+            if seq > self.applied_rev:
+                self.applied_rev = seq
+
+
+def existing_node_view(sn: StateNode, pods: List[Pod]) -> Optional[ExistingNode]:
+    """One StateNode + its bound pods -> the scheduler's ExistingNode, or
+    None when the node is not schedulable capacity. Shared verbatim by the
+    snapshot path (existing_nodes_for_scheduler) and the streaming model
+    (solver/streaming.py) so the two can never drift."""
+    if sn.node is not None and (sn.node.meta.deleting or sn.node.unschedulable):
+        return None
+    if sn.claim is not None and sn.claim.meta.deleting:
+        return None
+    alloc = sn.allocatable()
+    if not alloc:
+        return None
+    free = Resources(alloc)
+    for p in pods:
+        free = free.sub(p.requests)
+    free[PODS] = alloc.get_(PODS) - len(pods)
+    taints = list(sn.node.taints) if sn.node is not None else list(
+        (sn.claim.taints if sn.claim else [])
+    )
+    # the unregistered taint is lifecycle plumbing, not a scheduling
+    # constraint for the simulated scheduler (pods will land once
+    # registration removes it)
+    taints = [t for t in taints if t.key != wk.UNREGISTERED_TAINT_KEY]
+    return ExistingNode(
+        id=sn.name,
+        labels=dict(sn.labels()),
+        taints=taints,
+        free=free,
+        pod_labels=[dict(p.meta.labels) for p in pods],
+        bound_pods=[
+            BoundPodRef(
+                uid=p.meta.uid,
+                priority=p.priority,
+                requests=p.requests,
+                # never evict: do-not-disrupt, DaemonSets (their
+                # capacity doesn't free — they reschedule right
+                # back), or pods already on the way out
+                evictable=(
+                    p.meta.annotations.get(
+                        wk.DO_NOT_DISRUPT_ANNOTATION
+                    ) != "true"
+                    and p.owner_kind != "DaemonSet"
+                    and not p.meta.deleting
+                ),
+            )
+            for p in pods
+        ],
+    )
+
+
 class Cluster:
     def __init__(self, store: st.Store, clock=time.monotonic):
         self.store = store
@@ -148,6 +308,11 @@ class Cluster:
         # provisioner and the disruption engine's simulation helper so
         # their solves patch against each other's cached cores
         self.encode_deltas = EncodeDeltas(store)
+        # ordered event journal for the streaming delta-solve subsystem
+        # (solver/streaming.py) and the disruption engine's mid-stream
+        # staleness guard; costs one counter bump per store event until a
+        # streaming consumer attaches
+        self.journal = ClusterJournal(store)
 
     # -- assembly -----------------------------------------------------------
 
@@ -190,52 +355,9 @@ class Cluster:
         by_node = self.bound_pods()
         out: List[ExistingNode] = []
         for sn in self.state_nodes():
-            if sn.node is not None and (sn.node.meta.deleting or sn.node.unschedulable):
-                continue
-            if sn.claim is not None and sn.claim.meta.deleting:
-                continue
-            alloc = sn.allocatable()
-            if not alloc:
-                continue
-            pods = by_node.get(sn.name, [])
-            free = Resources(alloc)
-            for p in pods:
-                free = free.sub(p.requests)
-            free[PODS] = alloc.get_(PODS) - len(pods)
-            taints = list(sn.node.taints) if sn.node is not None else list(
-                (sn.claim.taints if sn.claim else [])
-            )
-            # the unregistered taint is lifecycle plumbing, not a scheduling
-            # constraint for the simulated scheduler (pods will land once
-            # registration removes it)
-            taints = [t for t in taints if t.key != wk.UNREGISTERED_TAINT_KEY]
-            out.append(
-                ExistingNode(
-                    id=sn.name,
-                    labels=dict(sn.labels()),
-                    taints=taints,
-                    free=free,
-                    pod_labels=[dict(p.meta.labels) for p in pods],
-                    bound_pods=[
-                        BoundPodRef(
-                            uid=p.meta.uid,
-                            priority=p.priority,
-                            requests=p.requests,
-                            # never evict: do-not-disrupt, DaemonSets (their
-                            # capacity doesn't free — they reschedule right
-                            # back), or pods already on the way out
-                            evictable=(
-                                p.meta.annotations.get(
-                                    wk.DO_NOT_DISRUPT_ANNOTATION
-                                ) != "true"
-                                and p.owner_kind != "DaemonSet"
-                                and not p.meta.deleting
-                            ),
-                        )
-                        for p in pods
-                    ],
-                )
-            )
+            en = existing_node_view(sn, by_node.get(sn.name, []))
+            if en is not None:
+                out.append(en)
         out.sort(key=lambda n: n.id)
         return out
 

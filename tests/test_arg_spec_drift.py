@@ -336,6 +336,74 @@ def test_explain_wire_layout_is_pinned():
     assert ffd.explain_words(4, 8) == 3 + 4 * (1 + 8)
 
 
+# -- streaming delta-solve (ISSUE 13) -----------------------------------------
+
+
+def test_event_batch_wire_layout_is_pinned():
+    """The run-table edit triplet is the streaming h2d wire: int32 rows of
+    (pos, gid, cnt), padded to the compile bucket with EVENT_PAD_POS rows
+    that the drop-mode scatter discards. encode_cache.run_table_events
+    writes it, arena.apply_run_events pads+ships it, ffd_apply_events
+    scatters it — all three against these constants."""
+    assert ffd.EVENT_ENTRY_WORDS == 3, "event rows are (pos, gid, cnt)"
+    assert ffd.EVENT_PAD_POS == -1, "pad rows drop via scatter mode='drop'"
+    params = list(
+        inspect.signature(ffd.ffd_apply_events.__wrapped__).parameters
+    )
+    assert params == ["run_group", "run_count", "events"], (
+        "ffd_apply_events' tensor params drifted"
+    )
+
+
+def test_run_table_events_wire_roundtrip():
+    """Host-side contract of the diff: applying the triplets to the previous
+    tables reproduces the new ones exactly; shape mismatch and over-budget
+    diffs refuse (None) instead of truncating."""
+    import numpy as np
+
+    from karpenter_tpu.solver.encode_cache import run_table_events
+
+    prev_rg = np.arange(16, dtype=np.int32)
+    prev_rc = np.ones(16, dtype=np.int32)
+    rg, rc = prev_rg.copy(), prev_rc.copy()
+    rg[3] = 99
+    rc[7] = 5
+    ev = run_table_events(prev_rg, prev_rc, rg, rc)
+    assert ev.dtype == np.int32 and ev.shape[1] == ffd.EVENT_ENTRY_WORDS
+    got_rg, got_rc = prev_rg.copy(), prev_rc.copy()
+    got_rg[ev[:, 0]] = ev[:, 1]
+    got_rc[ev[:, 0]] = ev[:, 2]
+    assert (got_rg == rg).all() and (got_rc == rc).all()
+    assert run_table_events(prev_rg, prev_rc, rg, rc, max_events=1) is None
+    assert run_table_events(prev_rg[:8], prev_rc[:8], rg, rc) is None
+    empty = run_table_events(rg, rc, rg, rc)
+    assert empty.shape == (0, 3)
+
+
+def test_streaming_entry_point_signatures():
+    """The provisioner binds pump()/pending_pods()/build_input(pending); the
+    backend stage calls arena.apply_run_events(host_args, prov, sharding,
+    ns); the model drains with journal.drain(after_seq). Pin all of them —
+    the streaming seam is positional at every layer."""
+    from karpenter_tpu.solver.arena import ArgumentArena
+    from karpenter_tpu.solver.streaming import StreamingSolver
+    from karpenter_tpu.state.cluster import ClusterJournal
+
+    assert list(inspect.signature(StreamingSolver.pump).parameters) == ["self"]
+    assert list(
+        inspect.signature(StreamingSolver.pending_pods).parameters
+    ) == ["self"]
+    assert list(
+        inspect.signature(StreamingSolver.build_input).parameters
+    ) == ["self", "pending"]
+    assert list(
+        inspect.signature(ClusterJournal.drain).parameters
+    ) == ["self", "after_seq"]
+    assert list(
+        inspect.signature(ArgumentArena.apply_run_events).parameters
+    ) == ["self", "host_args", "prov", "sharding", "ns"]
+
+
 def test_explain_reasons_match_decoder_names():
     """The kernel-side enum and the decoder-side names (obs/explain) are one
     contract — a code without a name renders as 'codeN' in records, a name

@@ -103,6 +103,22 @@ def test_slo_and_meter_series_are_registered():
         assert name in registered, f"{name} missing from the registry"
 
 
+def test_streaming_series_are_registered():
+    """ISSUE 13 acceptance: the streaming delta-solve series are part of the
+    /metrics contract — applied batches/events, reason-labeled re-baselines,
+    journal depth, and resident-state age are what the soak dashboards and
+    the re-baseline alert scrape, so pin their exact names."""
+    registered = {m.name for m in reg.REGISTRY.metrics}
+    for name in (
+        "karpenter_streaming_batches_applied_total",
+        "karpenter_streaming_events_applied_total",
+        "karpenter_streaming_rebaseline_total",
+        "karpenter_streaming_journal_depth",
+        "karpenter_streaming_resident_state_age_seconds",
+    ):
+        assert name in registered, f"{name} missing from the registry"
+
+
 def test_every_reason_code_has_name_and_spec_row():
     """Every kernel reason code must have a decoder-side name AND a SPEC.md
     row — an undocumented code is a wire symbol operators cannot read."""

@@ -1,0 +1,464 @@
+"""Streaming delta-solve (ISSUE 13): journal-fed resident model parity.
+
+The invariant under test is the subsystem's whole contract: after EVERY
+folded event batch, the streamed `build_input()` must be decision-identical
+to the snapshot path on the same universe — through randomized churn, fence
+re-baselines, injected drift, and the backend's staged run-table scatters.
+The journal itself (ordering, overflow -> lost, applied_rev) and the
+disruption engine's mid-stream Superseded defer are pinned here too.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api.objects import (
+    NodeClaimTemplate,
+    NodePool,
+    ObjectMeta,
+    Pod,
+)
+from karpenter_tpu.catalog.catalog import CatalogSpec, generate
+from karpenter_tpu.controllers import store as st
+from karpenter_tpu.kwok.cloud import KwokCloud
+from karpenter_tpu.kwok.cloudprovider import KwokCloudProvider
+from karpenter_tpu.operator.operator import new_kwok_operator
+from karpenter_tpu.provisioning.provisioner import Provisioner
+from karpenter_tpu.solver.backend import ReferenceSolver
+from karpenter_tpu.solver.streaming import StreamingSolver
+from karpenter_tpu.state.cluster import Cluster, ClusterJournal
+from karpenter_tpu.utils.resources import Resources
+
+TYPES = generate(CatalogSpec())
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def mkpool(name="general", weight=0):
+    return NodePool(meta=ObjectMeta(name=name),
+                    template=NodeClaimTemplate(), weight=weight)
+
+
+def mkpod(name, cpu="500m", mem="512Mi", **kw):
+    return Pod(meta=ObjectMeta(name=name, uid=name),
+               requests=Resources.parse({"cpu": cpu, "memory": mem}), **kw)
+
+
+def _env():
+    store = st.Store()
+    cloud = KwokCloud(store, TYPES)
+    provider = KwokCloudProvider(cloud, TYPES)
+    cluster = Cluster(store)
+    return store, provider, cluster
+
+
+def _assert_parity(streaming, snap, cluster, solver):
+    """The bit-identity the subsystem promises: pending set, existing-node
+    views, axes, and the solve decisions all match the snapshot path."""
+    pend_s = streaming.pending_pods()
+    pend_c = cluster.pending_pods()
+    assert [p.meta.uid for p in pend_s] == [p.meta.uid for p in pend_c]
+    inp_s = streaming.build_input(pend_s)
+    inp_c = snap.build_input(pend_c)
+    assert inp_s.zones == inp_c.zones
+    assert inp_s.capacity_types == inp_c.capacity_types
+    assert inp_s.nodes == inp_c.nodes  # ExistingNode dataclass equality
+    assert [(p.name, p.weight, p.usage) for p in inp_s.nodepools] == [
+        (p.name, p.weight, p.usage) for p in inp_c.nodepools
+    ]
+    a = solver.solve(inp_s)
+    b = solver.solve(inp_c)
+    assert a.placements == b.placements
+    return a
+
+
+class TestChurnTraceParity:
+    def test_randomized_churn_trace_is_decision_identical(self):
+        """A randomized arrival/deletion/bind/catalog churn trace through the
+        REAL controllers (operator settle creates claims, fabricates nodes,
+        binds pods): after every batch the streamed model must agree with a
+        fresh snapshot, decisions included."""
+        rng = random.Random(20260805)
+        clock = FakeClock()
+        op = new_kwok_operator(clock=clock)
+        op.store.create(st.NODEPOOLS, mkpool("general"))
+        streaming = StreamingSolver(op.cluster, op.cloud_provider,
+                                    epoch_every=0, clock=clock)
+        ref = ReferenceSolver()
+        snap = Provisioner(op.store, op.cluster, op.cloud_provider, ref,
+                           batch_idle_s=0, batch_max_s=0, clock=clock)
+        n = 0
+        extra_pool = False
+        for step in range(14):
+            roll = rng.random()
+            if roll < 0.45 or n == 0:
+                for _ in range(rng.randint(1, 4)):
+                    op.store.create(st.PODS, mkpod(
+                        f"c{n}", cpu=rng.choice(("250m", "500m", "1")),
+                        mem=rng.choice(("256Mi", "512Mi", "1Gi"))))
+                    n += 1
+            elif roll < 0.60:
+                pending = op.cluster.pending_pods()
+                if pending:
+                    victim = rng.choice(pending)
+                    op.store.delete(st.PODS, victim.meta.name,
+                                    namespace=victim.meta.namespace)
+            elif roll < 0.75:
+                # catalog-kind churn: a second pool appears/disappears —
+                # inexpressible as a delta, must fall back snapshot-identical
+                if extra_pool:
+                    op.store.delete(st.NODEPOOLS, "burst")
+                else:
+                    op.store.create(st.NODEPOOLS, mkpool("burst", weight=50))
+                extra_pool = not extra_pool
+            else:
+                # the real control loop: claims created, nodes fabricated
+                # and registered, pods bound — node/claim/pod events stream
+                clock.advance(1.0)
+                op.manager.settle()
+            streaming.pump()
+            _assert_parity(streaming, snap, op.cluster, ref)
+        assert streaming.stats["batches_applied"] > 0
+        assert streaming.stats["drift_detected"] == 0
+
+    def test_bound_and_gated_pods_drop_from_pending(self):
+        store, provider, cluster = _env()
+        store.create(st.NODEPOOLS, mkpool())
+        streaming = StreamingSolver(cluster, provider, epoch_every=0)
+        store.create(st.PODS, mkpod("a"))
+        store.create(st.PODS, mkpod("b", scheduling_gated=True))
+        store.create(st.PODS, mkpod("c"))
+        streaming.pump()
+        assert [p.meta.uid for p in streaming.pending_pods()] == ["a", "c"]
+        # the binder's unbind/bind route fires MODIFIED through the store
+        c = store.get(st.PODS, "c")
+        c.node_name = "n0"  # .bound is derived from the binding
+        store.update(st.PODS, c)
+        streaming.pump()
+        assert [p.meta.uid for p in streaming.pending_pods()] == ["a"]
+        assert [p.meta.uid for p in cluster.pending_pods()] == ["a"]
+
+
+class TestRebaseline:
+    def test_epoch_check_rebaselines_on_injected_drift(self):
+        """Corrupt the resident model behind the journal's back: the next
+        epoch check must detect the divergence, count it, re-baseline, and
+        come back parity-correct."""
+        store, provider, cluster = _env()
+        store.create(st.NODEPOOLS, mkpool())
+        streaming = StreamingSolver(cluster, provider, epoch_every=1)
+        for i in range(4):
+            store.create(st.PODS, mkpod(f"p{i}"))
+        streaming.pump()
+        # simulate a missed fold (the bug class the check exists for)
+        streaming._pods.pop("default/p1", None)
+        assert len(streaming.pending_pods()) == 3
+        before = streaming.stats["rebaseline_total"]
+        store.create(st.PODS, mkpod("p4"))
+        streaming.pump()  # folds p4, epoch check fires, drift -> re-baseline
+        assert streaming.stats["drift_detected"] == 1
+        assert streaming.stats["rebaseline_total"] == before + 1
+        assert [p.meta.uid for p in streaming.pending_pods()] == [
+            p.meta.uid for p in cluster.pending_pods()
+        ]
+
+    def test_fence_mid_stream_drops_no_events(self):
+        """A fleet fence between two batches re-baselines the model, and the
+        events that arrived around the fence all survive (the attach-then-
+        list fold is level-triggered)."""
+        from karpenter_tpu.metrics.registry import STREAMING_REBASELINE
+
+        store, provider, cluster = _env()
+        store.create(st.NODEPOOLS, mkpool())
+        streaming = StreamingSolver(cluster, provider, epoch_every=0)
+        store.create(st.PODS, mkpod("pre"))
+        streaming.pump()
+        store.create(st.PODS, mkpod("in-flight"))
+        fences = STREAMING_REBASELINE.value(reason="fence")
+        streaming.on_fence("canary_miss")
+        store.create(st.PODS, mkpod("post"))
+        streaming.pump()
+        assert STREAMING_REBASELINE.value(reason="fence") == fences + 1
+        assert [p.meta.uid for p in streaming.pending_pods()] == [
+            "pre", "in-flight", "post"
+        ]
+
+    def test_journal_overflow_forces_rebaseline(self):
+        store, provider, cluster = _env()
+        store.create(st.NODEPOOLS, mkpool())
+        streaming = StreamingSolver(cluster, provider, epoch_every=0)
+        streaming.pump()
+        cluster.journal.maxlen = 4
+        before = streaming.stats["rebaseline_total"]
+        for i in range(12):  # > maxlen: the buffer drops the oldest events
+            store.create(st.PODS, mkpod(f"of{i}"))
+        streaming.pump()
+        assert streaming.stats["rebaseline_total"] == before + 1
+        assert len(streaming.pending_pods()) == 12
+
+    def test_pod_epoch_bump_resyncs(self):
+        """An in-place sig mutation fires no store event — the epoch counter
+        is the only signal, and pump must re-baseline on it."""
+        store, provider, cluster = _env()
+        store.create(st.NODEPOOLS, mkpool())
+        store.create(st.PODS, mkpod("p"))
+        streaming = StreamingSolver(cluster, provider, epoch_every=0)
+        streaming.pump()
+        before = streaming.stats["rebaseline_total"]
+        p = store.get(st.PODS, "p")
+        # warm the solver-sig cache as a real solve would: the epoch only
+        # bumps when a mutation invalidates a POPULATED cache
+        from karpenter_tpu.solver.encode import _pod_signature
+
+        _pod_signature(p)
+        p.requests = Resources.parse({"cpu": "2", "memory": "4Gi"})
+        streaming.pump()
+        assert streaming.stats["rebaseline_total"] == before + 1
+        assert streaming.pending_pods()[0].requests == p.requests
+
+
+class TestJournal:
+    def test_seq_bumps_detached_and_buffers_attached(self):
+        store = st.Store()
+        j = ClusterJournal(store, maxlen=8)
+        store.create(st.PODS, mkpod("a"))
+        assert j.rev() == 1 and j.depth() == 0  # stamped, not buffered
+        base = j.attach()
+        store.create(st.PODS, mkpod("b"))
+        store.create(st.PODS, mkpod("c"))
+        events, lost = j.drain(base)
+        assert not lost
+        assert [(e.event, e.key) for e in events] == [
+            ("ADDED", "default/b"), ("ADDED", "default/c")
+        ]
+        # events carry the LIVE stored object (level-triggered contract)
+        assert events[0].obj is store.get(st.PODS, "b")
+
+    def test_overflow_reports_lost(self):
+        store = st.Store()
+        j = ClusterJournal(store, maxlen=3)
+        base = j.attach()
+        for i in range(6):
+            store.create(st.PODS, mkpod(f"p{i}"))
+        assert j.overflows > 0
+        events, lost = j.drain(base)
+        assert lost and events == []
+        # after a re-baseline at the current rev, the stream is clean again
+        base = j.attach()
+        store.create(st.PODS, mkpod("fresh"))
+        events, lost = j.drain(base)
+        assert not lost and len(events) == 1
+
+    def test_mark_applied_is_monotonic(self):
+        store = st.Store()
+        j = ClusterJournal(store)
+        j.mark_applied(5)
+        j.mark_applied(3)  # late writer must not move it backwards
+        assert j.applied_rev == 5
+
+
+class TestStagedRunEvents:
+    def test_staged_scatter_is_device_host_identical_and_decision_neutral(self):
+        """With stream_run_events on, a warm re-solve whose run tables moved
+        a little ships edit triplets instead of whole tables. After the
+        staged scatter the DEVICE copy must equal the freshly encoded host
+        arrays exactly (adopt trusts the tags), and decisions must match an
+        unstaged control solver bit for bit."""
+        import dataclasses as _dc
+
+        from karpenter_tpu.provisioning.scheduler import SolverInput
+        from karpenter_tpu.solver import backend
+        from karpenter_tpu.solver.encode import encode, quantize_input
+
+        from tests.test_solver_parity import ZONES, mkpod as kpod, pool
+
+        pods = [kpod(f"p{i}", cpu=("250m", "500m", "750m", "1")[i % 4])
+                for i in range(24)]
+        inp1 = SolverInput(pods=pods, nodes=[], nodepools=[pool()],
+                           zones=ZONES)
+        # same pod count, one spec's size changed: same compile bucket,
+        # different run tables -> a small diff the staging can ship
+        pods2 = list(pods)
+        pods2[3] = _dc.replace(pods[3], requests=Resources.parse(
+            {"cpu": "1", "memory": "1Gi"}))
+        inp2 = SolverInput(pods=pods2, nodes=[], nodepools=[pool()],
+                           zones=ZONES)
+
+        streamed = backend.TPUSolver(max_claims=256)
+        streamed.stream_run_events = True
+        control = backend.TPUSolver(max_claims=256)
+        r1 = streamed.solve(inp1)
+        c1 = control.solve(inp1)
+        assert r1.placements == c1.placements
+        r2 = streamed.solve(inp2)
+        c2 = control.solve(inp2)
+        assert r2.placements == c2.placements
+        stats = streamed.stats
+        assert stats["event_stage_hits"] + stats["event_stage_misses"] > 0
+        if stats["event_stage_hits"]:
+            # the bucket's resident run tables equal the host encode exactly
+            enc = encode(quantize_input(inp2))
+            host_args, _dims, _prov = backend.host_kernel_args(
+                enc, streamed._bucket)
+            key = streamed.arena.bucket_key(host_args, None,
+                                            ns=enc.tenant_id)
+            dev, _tags = streamed.arena._buckets[key]
+            assert (np.asarray(dev[0]) == np.asarray(host_args[0])).all()
+            assert (np.asarray(dev[1]) == np.asarray(host_args[1])).all()
+
+    def test_stage_declines_on_unknown_diff_base(self):
+        """First sight of a bucket (no recorded host pair) must decline the
+        stage and let adopt pay the normal upload — never scatter against an
+        unverified base."""
+        from karpenter_tpu.provisioning.scheduler import SolverInput
+        from karpenter_tpu.solver import backend
+
+        from tests.test_solver_parity import ZONES, mkpod as kpod, pool
+
+        solver = backend.TPUSolver(max_claims=256)
+        solver.stream_run_events = True
+        inp = SolverInput(pods=[kpod("p0"), kpod("p1")], nodes=[],
+                          nodepools=[pool()], zones=ZONES)
+        solver.solve(inp)
+        assert solver.stats["event_stage_misses"] >= 1
+        assert solver.stats["event_stage_hits"] == 0
+
+
+class TestDisruptionGuard:
+    def _controller(self):
+        from karpenter_tpu.disruption.controller import DisruptionController
+
+        store, provider, cluster = _env()
+        ctrl = DisruptionController(store, cluster, provider,
+                                    ReferenceSolver())
+        return ctrl, store, cluster
+
+    def test_probe_defers_once_applied_rev_passes_prep_rev(self):
+        from karpenter_tpu.solver.pipeline import Superseded
+
+        ctrl, store, cluster = self._controller()
+
+        class _Stub:
+            def evaluate_prepared(self, prep, subsets):
+                return "verdicts"
+
+        ctrl._batched = _Stub()
+        store.create(st.PODS, mkpod("x"))
+        ctrl._prep_rev = cluster.journal.rev()
+        # quiescent stream: the probe's universe is current -> no defer
+        assert ctrl._evaluate_probe_batch(None, []) == "verdicts"
+        # a streamed batch lands (and is applied) while the probe flies
+        store.create(st.PODS, mkpod("y"))
+        cluster.journal.mark_applied(cluster.journal.rev())
+        with pytest.raises(Superseded):
+            ctrl._evaluate_probe_batch(None, [])
+
+    def test_reconcile_defers_the_tick_on_superseded(self):
+        from karpenter_tpu.solver.pipeline import Superseded
+
+        ctrl, _store, _cluster = self._controller()
+        ctrl._candidates = lambda: [object()]
+        ctrl._budget_allowance = lambda c: {}
+        def _boom(method, candidates, budgets):
+            raise Superseded()
+        ctrl._evaluate = _boom
+        assert ctrl.reconcile() is False
+        assert ctrl.stats["superseded_defers"] == 1
+
+    def test_prepared_universe_key_includes_journal_rev(self):
+        """The per-reconcile prep cache must not survive a journal advance:
+        the rev is part of the key, so a batch applied between probes forces
+        a re-prepare on the next reconcile."""
+        import inspect
+
+        from karpenter_tpu.disruption import controller as dc
+
+        src = inspect.getsource(dc.DisruptionController._prepared_universe)
+        assert "journal.rev()" in src
+
+
+class TestOperatorWiring:
+    def test_streamed_operator_matches_snapshot_operator(self):
+        """Same injected workload through two full operators — one streaming,
+        one snapshot. The end state (bindings, node shapes) must agree."""
+        def drive(streaming_on):
+            clock = FakeClock()
+            op = new_kwok_operator(clock=clock,
+                                   solver_streaming=streaming_on,
+                                   streaming_epoch_every=2)
+            op.store.create(st.NODEPOOLS, mkpool())
+            for i in range(6):
+                op.store.create(st.PODS, mkpod(
+                    f"p{i}", cpu=("250m", "500m", "1")[i % 3]))
+            op.manager.settle()
+            op.store.create(st.PODS, mkpod("late", cpu="100m", mem="128Mi"))
+            clock.advance(1.0)
+            op.manager.settle()
+            pods = sorted((p.meta.name, p.bound) for p in op.store.list(st.PODS))
+            nodes = sorted(
+                n.meta.labels.get("node.kubernetes.io/instance-type", "")
+                for n in op.store.list(st.NODES)
+            )
+            return op, pods, nodes
+
+        op_s, pods_s, nodes_s = drive(True)
+        _op_c, pods_c, nodes_c = drive(False)
+        assert pods_s == pods_c
+        assert nodes_s == nodes_c
+        assert op_s.streaming is not None
+        assert op_s.streaming.stats["streamed_solves"] > 0
+        assert op_s.streaming.stats["drift_detected"] == 0
+
+    def test_fleet_fence_listener_and_stage_flag_are_wired(self):
+        from karpenter_tpu.solver.backend import TPUSolver, concrete_backend
+
+        op = new_kwok_operator(solver=TPUSolver(max_claims=64),
+                               solver_streaming=True, solver_fleet_size=2)
+        try:
+            fleet = op.solve_service
+            assert op.streaming.on_fence in fleet.fence_listeners
+            for o in fleet.owners:
+                inner = concrete_backend(o.solver)
+                if isinstance(inner, TPUSolver):
+                    assert inner.stream_run_events is True
+        finally:
+            op.solve_service.close()
+
+    def test_journal_seq_rides_trace_and_snapshot(self):
+        from karpenter_tpu.obs import trace as obstrace
+
+        obstrace.configure(enabled=True, ring=16)
+        try:
+            tr = obstrace.begin("provisioning")
+            obstrace.set_journal(tr, 42)
+            assert tr.journal_seq == 42
+            with obstrace.attached(tr):
+                assert obstrace.current_journal_seq() == 42
+            obstrace.finish(tr, "ok")
+            assert tr.snapshot()["journal_seq"] == 42
+        finally:
+            obstrace.configure(enabled=False, recorder=None)
+
+
+@pytest.mark.slow
+def test_streaming_soak_sustains_arrival_rate():
+    """ISSUE 13 soak acceptance: >= 1k arrival-batches/sec through the
+    journal -> fold -> assemble ingest path, zero drift, zero re-baselines
+    past the initial baseline."""
+    import bench
+
+    out = bench._streaming_run(batches=1200, pods_per_batch=2, base_pods=32,
+                               epoch_every=0, parity_every=0)
+    assert out["arrival_batches_per_sec"] >= 1000, out
+    assert out["streaming_drift_detected"] == 0, out
+    assert out["rebaseline_total"] == 1, out  # the initial baseline only
