@@ -698,3 +698,87 @@ STREAMING_STATE_AGE = REGISTRY.register(
         "long decisions have been extending purely from deltas",
     )
 )
+
+# -- runtime health plane (obs/telemetry.py + obs/anomaly.py; ISSUE 14) -------
+
+SOLVER_COMPILES = REGISTRY.register(
+    Counter(
+        "karpenter_solver_compiles_total",
+        "Kernel (re)compiles observed at the jit/AOT entry points, by kernel "
+        "and kind: kind=prewarm covers AOT lowers and warm-up-phase "
+        "dispatches; kind=hot_path is any post-prewarm compile on the "
+        "dispatch path — a defect the recompile detector WARNs on "
+        "(obs/telemetry.py)",
+        ("kernel", "kind"),
+    )
+)
+SOLVER_COMPILE_SECONDS = REGISTRY.register(
+    Histogram(
+        "karpenter_solver_compile_seconds",
+        "Wall seconds spent in a compiling kernel entry (trace + XLA compile "
+        "+ first dispatch for kind=hot_path/prewarm calls; lower().compile() "
+        "time for AOT prewarm points)",
+        ("kernel", "kind"),
+    )
+)
+SOLVER_PREWARM_COVERAGE = REGISTRY.register(
+    Gauge(
+        "karpenter_solver_prewarm_coverage",
+        "AOT prewarm coverage: claim-bucket lattice points compiled divided "
+        "by points requested (1.0 = full lattice; < 1.0 surfaces as a "
+        "/healthz WARN — a broken compile cache shows at startup, not as "
+        "mystery hot-path compiles)",
+    )
+)
+SOLVER_PREWARM_FAILURES = REGISTRY.register(
+    Counter(
+        "karpenter_solver_prewarm_failures_total",
+        "AOT prewarm lattice points that failed to lower/compile "
+        "(backend.prewarm_aot; logged once per bucket, never raised)",
+    )
+)
+SOLVER_ARENA_BYTES = REGISTRY.register(
+    Gauge(
+        "karpenter_solver_arena_bytes",
+        "Device-resident arena bytes by residency class (args / ckpt / "
+        "ladder / shard / run_host) and tenant namespace (tenant=default "
+        "outside the mux) — the accounting the arena byte budget evicts "
+        "against (solver/arena.py)",
+        ("class", "tenant"),
+    )
+)
+SOLVER_ARENA_EVICTIONS = REGISTRY.register(
+    Counter(
+        "karpenter_solver_arena_evictions_total",
+        "Arena buckets evicted (LRU under the byte budget, plus max_buckets "
+        "FIFO turnover); an evicted bucket costs exactly one cold packed "
+        "re-upload, never a wrong answer",
+    )
+)
+SOLVER_HBM_BYTES = REGISTRY.register(
+    Gauge(
+        "karpenter_solver_hbm_bytes",
+        "Device memory watermarks from jax memory_stats() when the runtime "
+        "reports them (kind=bytes_in_use / peak_bytes_in_use / bytes_limit); "
+        "absent on runtimes without allocator stats",
+        ("kind",),
+    )
+)
+SOLVER_PERF_ANOMALIES = REGISTRY.register(
+    Counter(
+        "karpenter_solver_perf_anomalies_total",
+        "Rolling-baseline anomaly trips per trace stage: sustained latency "
+        "beyond the configured multiplier of the EWMA/quantile baseline "
+        "(obs/anomaly.py; flips /healthz to WARN and dumps the flight "
+        "recorder with reason perf_anomaly)",
+        ("stage",),
+    )
+)
+SOLVER_PERF_ANOMALY_STATE = REGISTRY.register(
+    Gauge(
+        "karpenter_solver_perf_anomaly_state",
+        "1 while the stage's rolling-baseline detector is tripped, 0 after "
+        "it recovers (obs/anomaly.py)",
+        ("stage",),
+    )
+)

@@ -1,0 +1,198 @@
+"""Rolling-baseline latency anomaly detection per trace stage.
+
+SLO burn rates (obs/slo.py) alert on absolute objectives an operator
+wrote down; this module alerts on DEVIATION FROM THE STAGE'S OWN
+HISTORY, so a recompile storm, arena-thrash, or fold-drift re-baseline
+loop surfaces within seconds of starting — even when the absolute
+latency is still inside its SLO.
+
+Baseline math (solver/SPEC.md "Telemetry semantics"):
+
+- `mean`  — EWMA of the stage duration (alpha 0.1);
+- `dev`   — EWMA of |x - mean| (the mean absolute deviation);
+- `q`     — streaming ~p95: an asymmetric-step quantile walk (up-steps
+            19x the down-step, both proportional to `dev`), so the
+            estimate needs no sample buffer and adapts as the stage
+            drifts.
+
+An observation BREACHES when x > multiplier * max(mean + 3*dev, q)
+after `min_samples` warm-up observations. `sustain` consecutive
+breaches TRIP the stage (counter + gauge + /healthz WARN + one
+throttled flight-recorder dump with reason `perf_anomaly`); `recover`
+consecutive clean observations clear it. While breaching, the baseline
+updates at alpha/8 — resistant enough not to chase a regression, alive
+enough that a legitimate workload shift re-baselines instead of paging
+forever.
+
+Feed: `observe_trace()` is called by obs/trace.finish for every
+completed trace — the same spans that feed the histograms and SLOs, no
+second timing source. The clock is injectable (`configure(clock=...)`)
+so tests drive trip/recover/throttle deterministically.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+from ..metrics.registry import SOLVER_PERF_ANOMALIES, SOLVER_PERF_ANOMALY_STATE
+
+log = logging.getLogger("karpenter_tpu")
+
+_ALPHA = 0.1
+_Q_LR = 0.05  # quantile step = dev * _Q_LR (x19 upward)
+_MAX_STAGES = 64
+
+
+class _Baseline:
+    __slots__ = ("n", "mean", "dev", "q", "breach_streak", "ok_streak",
+                 "anomalous", "trips", "last_dump")
+
+    def __init__(self):
+        self.n = 0
+        self.mean = 0.0
+        self.dev = 0.0
+        self.q = 0.0
+        self.breach_streak = 0
+        self.ok_streak = 0
+        self.anomalous = False
+        self.trips = 0
+        self.last_dump: Optional[float] = None
+
+    def threshold(self, multiplier: float) -> float:
+        return multiplier * max(self.mean + 3.0 * self.dev, self.q)
+
+    def observe(self, x: float, multiplier: float, min_samples: int) -> bool:
+        """Fold one duration; returns True when it breached the baseline."""
+        warm = self.n >= min_samples
+        breach = warm and x > self.threshold(multiplier)
+        alpha = _ALPHA / 8.0 if breach else _ALPHA
+        if self.n == 0:
+            self.mean = x
+            self.q = x
+        else:
+            diff = x - self.mean
+            self.mean += alpha * diff
+            self.dev += alpha * (abs(diff) - self.dev)
+            step = max(self.dev, abs(self.mean) * 0.01, 1e-9) * _Q_LR
+            if x > self.q:
+                self.q += 19.0 * step
+            else:
+                self.q -= step
+        self.n += 1
+        return breach
+
+
+_LOCK = threading.Lock()
+_ENABLED = True
+_CLOCK = time.monotonic
+_MULTIPLIER = 3.0
+_SUSTAIN = 5
+_RECOVER = 10
+_MIN_SAMPLES = 20
+_DUMP_INTERVAL_S = 60.0
+_STAGES: Dict[str, _Baseline] = {}
+
+
+def configure(enabled: bool = True, multiplier: float = 3.0, sustain: int = 5,
+              recover: int = 10, min_samples: int = 20,
+              dump_interval_s: float = 60.0, clock=time.monotonic) -> None:
+    """(Re)configure the detector; resets every stage baseline — call once
+    at operator boot (multiplier from --anomaly-threshold), or per-test."""
+    global _ENABLED, _MULTIPLIER, _SUSTAIN, _RECOVER, _MIN_SAMPLES
+    global _DUMP_INTERVAL_S, _CLOCK
+    with _LOCK:
+        _ENABLED = bool(enabled)
+        _MULTIPLIER = float(multiplier)
+        _SUSTAIN = max(1, int(sustain))
+        _RECOVER = max(1, int(recover))
+        _MIN_SAMPLES = max(1, int(min_samples))
+        _DUMP_INTERVAL_S = float(dump_interval_s)
+        _CLOCK = clock
+        _STAGES.clear()
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def observe(stage: str, duration_s: float) -> None:
+    """Fold one stage duration into its rolling baseline; trip/recover the
+    stage's anomaly state and fire the (throttled) flight dump on a trip."""
+    if not _ENABLED:
+        return
+    dump_tags = None
+    with _LOCK:
+        base = _STAGES.get(stage)
+        if base is None:
+            if len(_STAGES) >= _MAX_STAGES:
+                return  # bounded: never let stage-name churn grow state
+            base = _STAGES[stage] = _Baseline()
+        if base.observe(duration_s, _MULTIPLIER, _MIN_SAMPLES):
+            base.breach_streak += 1
+            base.ok_streak = 0
+        else:
+            base.ok_streak += 1
+            base.breach_streak = 0
+            if base.anomalous and base.ok_streak >= _RECOVER:
+                base.anomalous = False
+                SOLVER_PERF_ANOMALY_STATE.set(0, stage=stage)
+                log.info("anomaly: stage %s recovered (baseline %.1f ms)",
+                         stage, base.mean * 1000.0)
+        if base.breach_streak >= _SUSTAIN and not base.anomalous:
+            base.anomalous = True
+            base.trips += 1
+            SOLVER_PERF_ANOMALIES.inc(stage=stage)
+            SOLVER_PERF_ANOMALY_STATE.set(1, stage=stage)
+            now = _CLOCK()
+            if base.last_dump is None or now - base.last_dump >= _DUMP_INTERVAL_S:
+                base.last_dump = now
+                dump_tags = {
+                    "stage": stage,
+                    "observed_ms": round(duration_s * 1000.0, 2),
+                    "baseline_ms": round(base.mean * 1000.0, 2),
+                    "threshold_ms": round(
+                        base.threshold(_MULTIPLIER) * 1000.0, 2),
+                }
+    if dump_tags is not None:
+        log.warning(
+            "anomaly: PERF ANOMALY on stage %s — %.1f ms sustained vs "
+            "baseline %.1f ms (threshold %.1f ms)", dump_tags["stage"],
+            dump_tags["observed_ms"], dump_tags["baseline_ms"],
+            dump_tags["threshold_ms"],
+        )
+        from . import trace as _trace
+
+        _trace.dump("perf_anomaly", **dump_tags)
+
+
+def observe_trace(trace) -> None:
+    """Feed one finished trace's closed spans (obs/trace.finish hook);
+    never raises past it."""
+    if not _ENABLED:
+        return
+    for sp in list(trace.spans):
+        if sp.t1 is not None:
+            observe(sp.name, sp.t1 - sp.t0)
+
+
+def health() -> dict:
+    """The /healthz "anomaly" object: warn while any stage is tripped."""
+    with _LOCK:
+        stages = {}
+        worst = "ok"
+        for name, b in sorted(_STAGES.items()):
+            if b.n == 0:
+                continue
+            stages[name] = {
+                "mean_ms": round(b.mean * 1000.0, 3),
+                "p95_ms": round(b.q * 1000.0, 3),
+                "samples": b.n,
+                "anomalous": b.anomalous,
+                "trips": b.trips,
+            }
+            if b.anomalous:
+                worst = "warn"
+    return {"state": worst, "stages": stages}

@@ -39,12 +39,22 @@ log = logging.getLogger("karpenter_tpu")
 
 
 class FlightRecorder:
+    # per-reason throttle overrides (seconds): health-plane reasons fire
+    # from hot paths (every dispatch / every trace.finish), so they hold a
+    # longer floor than the fence/breaker default regardless of how low an
+    # operator tunes `min_interval_s` for crash forensics
+    REASON_INTERVALS: Dict[str, float] = {
+        "recompile": 60.0,
+        "perf_anomaly": 60.0,
+    }
+
     def __init__(self, dir: Optional[str] = None, capacity: int = 32,
                  min_interval_s: float = 30.0, clock=time.monotonic,
                  keep: int = 32):
         self.dir = dir or tempfile.gettempdir()
         self.capacity = max(1, int(capacity))
         self.min_interval_s = float(min_interval_s)
+        self.reason_intervals: Dict[str, float] = dict(self.REASON_INTERVALS)
         self.clock = clock
         self.keep = max(1, int(keep))
         self._lock = threading.Lock()
@@ -70,9 +80,10 @@ class FlightRecorder:
         """Write the flight record; returns the path, or None when the
         per-reason throttle suppressed it."""
         now = self.clock()
+        interval = self.reason_intervals.get(reason, self.min_interval_s)
         with self._lock:
             last = self._last_by_reason.get(reason)
-            if last is not None and now - last < self.min_interval_s:
+            if last is not None and now - last < interval:
                 self.throttled += 1
                 return None
             self._last_by_reason[reason] = now
@@ -135,6 +146,17 @@ class FlightRecorder:
                 )
             except Exception:  # noqa: BLE001
                 payload["explain"] = None
+            try:
+                # runtime health context (obs/telemetry.py): last-window
+                # gauges, compile/hot-path state, anomaly baselines — so a
+                # recompile/perf_anomaly dump is self-contained and a fence
+                # dump shows whether the health plane saw it coming
+                from . import telemetry as _telemetry
+                payload["telemetry"] = json.loads(
+                    json.dumps(_telemetry.dump_payload(), default=str)
+                )
+            except Exception:  # noqa: BLE001
+                payload["telemetry"] = None
             with open(path, "w") as f:
                 json.dump(payload, f, indent=1)
         except Exception as e:  # noqa: BLE001 — a dump must never crash a fence

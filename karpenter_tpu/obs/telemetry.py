@@ -1,0 +1,493 @@
+"""Runtime health plane: compile/recompile observability + telemetry ring.
+
+The paper's latency story assumes the frozen ARG_SPEC kernel signature
+never triggers a hot-path recompile after `prewarm_aot` — a single XLA
+compile on the dispatch path costs more than a thousand steady-state
+solves. Nothing watched that invariant; this module does, without
+touching JAX internals:
+
+- every public jitted entry point in solver/tpu/ffd.py is rebound to a
+  `_KernelHook` proxy (`instrument()`) that derives a dispatch signature
+  ((shape, dtype) per array argument + the static kwargs) and treats the
+  first sighting of a signature as a compile event. That is exactly
+  jit's own cache key granularity for this repo (no weak-type or
+  sharding-only churn exists on these call sites), so the detector is
+  deterministic on any backend — including CPU CI where a persistent
+  compile cache would hide real compile latency.
+- `mark_prewarm_done()` is the phase boundary (the operator's warm-up
+  thread calls it after prewarm_aot + warmup): compiles before it count
+  as kind=prewarm (expected), compiles after it on the dispatch path
+  count as kind=hot_path — a defect that WARNs /healthz, dumps the
+  flight recorder (reason `recompile`, throttled per reason), and
+  attaches the offending signature's diff against the nearest known one.
+- `lower()` calls proxy through to a `_LoweredHook` whose `.compile()`
+  registers the signature as prewarmed — AOT lowers are never hot-path.
+- the AOT coverage gauge + failure counter make a partially-broken
+  prewarm visible at startup (`note_prewarm`, `note_prewarm_failure`).
+
+The same module keeps the in-process telemetry ring served at
+`/debug/vars?window=` and attached to flight-recorder dumps: periodic
+samples of the health-plane gauges (`maybe_sample()` is called from the
+pipeline's decode loop; `set_gauge()` lets the arena/ledger publish
+scalars without coupling), a bounded event log (`note_event`: fleet
+fences, arena evictions), and named health providers (`register_provider`
+— the operator registers the streaming solver's health here so /healthz
+can reach it through the same module-global pattern it uses for
+obs/slo.py).
+
+Off path: `configure(enabled=False)` makes the kernel hooks a single
+module-global read + tail call — no signature tuple is built, nothing
+allocates (bench.py guards this with sys.getallocatedblocks, like the
+trace-off path). `__wrapped__` on every hook stays the inner plain
+traceable function, so consolidate.py / parallel/sharded.py vmap it
+directly and tests/test_arg_spec_drift.py introspects it unchanged.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..metrics.registry import (
+    SOLVER_COMPILE_SECONDS,
+    SOLVER_COMPILES,
+    SOLVER_HBM_BYTES,
+    SOLVER_PREWARM_COVERAGE,
+    SOLVER_PREWARM_FAILURES,
+)
+
+log = logging.getLogger("karpenter_tpu")
+
+_LOCK = threading.RLock()
+_ENABLED = True
+_CLOCK = time.monotonic
+_SAMPLE_INTERVAL_S = 10.0
+
+# kernel -> {signature: True} (insertion-ordered; bounded)
+_SEEN: Dict[str, Dict[tuple, bool]] = {}
+_SEEN_MAX = 512
+# kernel -> ARG_SPEC-style names for signature diffs
+_ARG_NAMES: Dict[str, Tuple[str, ...]] = {}
+_PREWARM_DONE = False
+_PREWARM = {"requested": 0, "compiled": 0, "failures": 0}
+_PREWARM_FAIL_LOGGED: set = set()
+# hot-path recompile records (newest last, bounded)
+_HOT: deque = deque(maxlen=32)
+_RING: deque = deque(maxlen=128)
+_EVENTS: deque = deque(maxlen=64)
+_GAUGES: Dict[str, float] = {}
+_PROVIDERS: Dict[str, Callable[[], object]] = {}
+_LAST_SAMPLE = 0.0
+stats: Dict[str, int] = {"checks": 0, "compiles": 0, "hot_path_compiles": 0,
+                         "samples": 0}
+
+
+def configure(enabled: bool = True, ring: int = 128,
+              sample_interval_s: float = 10.0, clock=time.monotonic) -> None:
+    """(Re)configure the health plane; resets every counter, the seen-
+    signature sets, the prewarm phase, and the ring — call once at operator
+    boot, or per-test for isolation. Resetting the signature sets means the
+    next dispatch of each bucket records one (prewarm-phase) compile event
+    even when jit's in-process cache is still warm — the detector counts
+    signature sightings, not XLA invocations (solver/SPEC.md "Telemetry
+    semantics")."""
+    global _ENABLED, _CLOCK, _SAMPLE_INTERVAL_S, _RING, _PREWARM_DONE
+    global _LAST_SAMPLE
+    with _LOCK:
+        _ENABLED = bool(enabled)
+        _CLOCK = clock
+        _SAMPLE_INTERVAL_S = float(sample_interval_s)
+        _RING = deque(maxlen=max(1, int(ring)))
+        _SEEN.clear()
+        _PREWARM_DONE = False
+        _PREWARM.update(requested=0, compiled=0, failures=0)
+        _PREWARM_FAIL_LOGGED.clear()
+        _HOT.clear()
+        _EVENTS.clear()
+        _GAUGES.clear()
+        _PROVIDERS.clear()
+        _LAST_SAMPLE = 0.0
+        stats.update(checks=0, compiles=0, hot_path_compiles=0, samples=0)
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+# -- dispatch signatures -------------------------------------------------------
+
+
+def _sig_of(x) -> object:
+    """Hashable signature of one call argument: (shape, dtype) for arrays
+    and ShapeDtypeStructs, recursive for (Named)tuples (FFDState), the value
+    itself for hashable statics. The dtype OBJECT (hashable, interned per
+    type) goes in verbatim — stringifying 36 dtypes per dispatch would
+    dominate the check cost (bench telemetry_overhead_pct guard)."""
+    shp = getattr(x, "shape", None)
+    if shp is not None:
+        return (shp if type(shp) is tuple else tuple(shp),
+                getattr(x, "dtype", None))
+    if isinstance(x, tuple):
+        return tuple(_sig_of(e) for e in x)
+    try:
+        hash(x)
+        return x
+    except TypeError:
+        return repr(x)[:64]
+
+
+def _signature(args: tuple, kwargs: dict) -> tuple:
+    return (
+        tuple(_sig_of(a) for a in args),
+        tuple(sorted((k, _sig_of(v)) for k, v in kwargs.items())),
+    )
+
+
+def _sig_diff(name: str, sig: tuple) -> List[dict]:
+    """The offending arg-signature diff: positions where `sig` departs from
+    the NEAREST known signature of the same kernel (fewest differing
+    entries), labeled with ARG_SPEC names when the kernel registered them."""
+    known = _SEEN.get(name, {})
+    args, kw = sig
+    best, best_score = None, None
+    for cand in known:
+        cargs, ckw = cand
+        if len(cargs) != len(args):
+            continue
+        score = sum(a != b for a, b in zip(args, cargs)) + (kw != ckw)
+        if best_score is None or score < best_score:
+            best, best_score = cand, score
+    if best is None:
+        return [{"arg": "*", "got": "no same-arity signature on record",
+                 "want": None}]
+    names = _ARG_NAMES.get(name, ())
+    out = []
+    for i, (got, want) in enumerate(zip(args, best[0])):
+        if got != want:
+            out.append({"arg": names[i] if i < len(names) else i,
+                        "got": repr(got), "want": repr(want)})
+        if len(out) >= 8:
+            break
+    if best[1] != kw:
+        out.append({"arg": "statics", "got": repr(kw), "want": repr(best[1])})
+    return out
+
+
+def _note_compile(name: str, sig: tuple, seconds: float, kind: str) -> None:
+    """Record one compile event; on kind=hot_path also record the defect
+    (detector state + throttled flight dump with the signature diff)."""
+    diff = None
+    with _LOCK:
+        seen = _SEEN.setdefault(name, {})
+        if kind == "hot_path":
+            diff = _sig_diff(name, sig)
+            _HOT.append({"wall": time.time(), "kernel": name,
+                         "compile_s": round(seconds, 4), "diff": diff})
+            stats["hot_path_compiles"] += 1
+        if sig not in seen:
+            while len(seen) >= _SEEN_MAX:
+                seen.pop(next(iter(seen)))
+            seen[sig] = True
+        stats["compiles"] += 1
+    SOLVER_COMPILES.inc(kernel=name, kind=kind)
+    SOLVER_COMPILE_SECONDS.observe(seconds, kernel=name, kind=kind)
+    if kind == "hot_path":
+        log.warning(
+            "telemetry: HOT-PATH recompile of %s (%.0f ms) — post-prewarm "
+            "dispatch hit an uncompiled signature; diff vs nearest known: %s",
+            name, seconds * 1000.0, diff,
+        )
+        from . import trace as _trace
+
+        _trace.dump("recompile", kernel=name,
+                    compile_ms=round(seconds * 1000.0, 1), diff=repr(diff))
+
+
+class _LoweredHook:
+    """Proxy for a jit Lowered object: `.compile()` records a prewarm
+    compile event and registers the signature as known (an AOT lower is by
+    definition never a hot-path compile)."""
+
+    __slots__ = ("_name", "_sig", "_lowered")
+
+    def __init__(self, name: str, sig: tuple, lowered):
+        self._name = name
+        self._sig = sig
+        self._lowered = lowered
+
+    def compile(self, *a, **kw):
+        t0 = time.perf_counter()
+        out = self._lowered.compile(*a, **kw)
+        _note_compile(self._name, self._sig, time.perf_counter() - t0,
+                      "prewarm")
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self._lowered, item)
+
+
+class _KernelHook:
+    """Compile-observability proxy around one jitted entry point. Preserves
+    `__wrapped__` (the plain traceable function) and passes every other
+    attribute through to the jit object."""
+
+    def __init__(self, name: str, fn, arg_names: Tuple[str, ...] = ()):
+        self._name = name
+        self._fn = fn
+        self.__wrapped__ = fn.__wrapped__
+        self.__name__ = name
+        _ARG_NAMES[name] = tuple(arg_names)
+
+    def __call__(self, *args, **kwargs):
+        if not _ENABLED:
+            return self._fn(*args, **kwargs)
+        sig = _signature(args, kwargs)
+        seen = _SEEN.get(self._name)
+        stats["checks"] += 1
+        if seen is not None and sig in seen:
+            return self._fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        _note_compile(self._name, sig, time.perf_counter() - t0,
+                      "hot_path" if _PREWARM_DONE else "prewarm")
+        return out
+
+    def lower(self, *args, **kwargs):
+        low = self._fn.lower(*args, **kwargs)
+        if not _ENABLED:
+            return low
+        return _LoweredHook(self._name, _signature(args, kwargs), low)
+
+    def __getattr__(self, item):
+        return getattr(self._fn, item)
+
+
+def instrument(name: str, fn, arg_names: Tuple[str, ...] = ()):
+    """Wrap one jitted entry point (idempotent: re-instrumenting a hook
+    returns it unchanged — module reloads must not stack proxies)."""
+    if isinstance(fn, _KernelHook):
+        return fn
+    return _KernelHook(name, fn, arg_names)
+
+
+# -- prewarm phase -------------------------------------------------------------
+
+
+def note_prewarm(requested: int, compiled: int) -> None:
+    """AOT prewarm coverage accounting (backend.prewarm_aot): lattice points
+    compiled vs requested; the gauge and /healthz WARN derive from the
+    running totals (prewarm may run once per mesh/bucket refresh)."""
+    with _LOCK:
+        _PREWARM["requested"] += int(requested)
+        _PREWARM["compiled"] += int(compiled)
+        req, comp = _PREWARM["requested"], _PREWARM["compiled"]
+    SOLVER_PREWARM_COVERAGE.set(comp / req if req else 1.0)
+
+
+def note_prewarm_failure(bucket: str, exc: BaseException) -> None:
+    """Count one failed prewarm lattice point; logged once per bucket so a
+    broken compile path is visible without a crash-loop's worth of spam."""
+    with _LOCK:
+        _PREWARM["failures"] += 1
+        first = bucket not in _PREWARM_FAIL_LOGGED
+        _PREWARM_FAIL_LOGGED.add(bucket)
+    SOLVER_PREWARM_FAILURES.inc()
+    if first:
+        log.warning("telemetry: AOT prewarm failed at %s: %s: %s "
+                    "(logged once per bucket; coverage < 100%% WARNs "
+                    "/healthz)", bucket, type(exc).__name__, exc)
+
+
+def mark_prewarm_done() -> None:
+    """Arm the hot-path recompile detector: every signature first seen on a
+    dispatch after this call is a defect. Called by the operator's warm-up
+    thread after prewarm_aot + warmup complete."""
+    global _PREWARM_DONE
+    with _LOCK:
+        _PREWARM_DONE = True
+
+
+def prewarm_done() -> bool:
+    return _PREWARM_DONE
+
+
+def hot_path_records() -> List[dict]:
+    with _LOCK:
+        return list(_HOT)
+
+
+# -- gauges / events / providers ----------------------------------------------
+
+
+def set_gauge(name: str, value: float) -> None:
+    """Publish one scalar into the telemetry ring's gauge map (arena bytes,
+    ledger rates — anything a dashboard wants per sample window)."""
+    if not _ENABLED:
+        return
+    _GAUGES[name] = float(value)
+
+
+def note_event(name: str, **tags) -> None:
+    """Append one bounded-log event (fleet fence, arena eviction): shows up
+    in ring samples and flight-recorder dump payloads."""
+    if not _ENABLED:
+        return
+    with _LOCK:
+        _EVENTS.append({"wall": time.time(), "event": name, **tags})
+
+
+def register_provider(name: str, fn: Callable[[], object]) -> None:
+    """Register a named health provider (e.g. the streaming solver's
+    health()); pulled by snapshot()/healthz through this module's globals —
+    the endpoint handler has no operator reference (operator/__main__.py)."""
+    _PROVIDERS[name] = fn
+
+
+def provider_result(name: str) -> Optional[object]:
+    fn = _PROVIDERS.get(name)
+    if fn is None:
+        return None
+    try:
+        return fn()
+    except Exception as e:  # noqa: BLE001 — health must never take down /healthz
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def hbm_stats() -> Optional[Dict[str, int]]:
+    """JAX allocator watermarks when the runtime reports them (real devices
+    and some CPU builds); pushes the karpenter_solver_hbm_bytes gauges.
+    None — silently — everywhere memory_stats() is unsupported."""
+    try:
+        import jax
+
+        ms = jax.devices()[0].memory_stats()
+        if not ms:
+            return None
+        out = {k: int(v) for k, v in ms.items()
+               if k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")}
+        for k, v in out.items():
+            SOLVER_HBM_BYTES.set(v, kind=k)
+        return out or None
+    except Exception:  # noqa: BLE001 — diagnostics never fail a solve
+        return None
+
+
+# -- ring / snapshots ----------------------------------------------------------
+
+
+def _compile_totals() -> Dict[str, Dict[str, float]]:
+    out: Dict[str, Dict[str, float]] = {}
+    for kernel in _SEEN:
+        ent = {}
+        for kind in ("prewarm", "hot_path"):
+            v = SOLVER_COMPILES.value(kernel=kernel, kind=kind)
+            if v:
+                ent[kind] = v
+        if ent:
+            out[kernel] = ent
+    return out
+
+
+def snapshot() -> Dict[str, object]:
+    """Point-in-time health-plane state: compile totals, detector state,
+    prewarm coverage, published gauges, provider results, HBM watermarks."""
+    with _LOCK:
+        prew = dict(_PREWARM)
+        prew["done"] = _PREWARM_DONE
+        body = {
+            "enabled": _ENABLED,
+            "stats": dict(stats),
+            "compiles": _compile_totals(),
+            "hot_path": list(_HOT)[-8:],
+            "prewarm": prew,
+            "gauges": dict(_GAUGES),
+            "events": list(_EVENTS)[-16:],
+        }
+        providers = list(_PROVIDERS)
+    body["providers"] = {n: provider_result(n) for n in providers}
+    hbm = hbm_stats()
+    if hbm:
+        body["hbm"] = hbm
+    return body
+
+
+def sample(now: Optional[float] = None) -> Dict[str, object]:
+    """Append one snapshot to the telemetry ring (the /debug/vars series)."""
+    global _LAST_SAMPLE
+    snap = snapshot()
+    with _LOCK:
+        t = _CLOCK() if now is None else now
+        snap["wall"] = time.time()
+        snap["monotonic"] = t
+        _RING.append(snap)
+        _LAST_SAMPLE = t
+        stats["samples"] += 1
+    return snap
+
+
+def maybe_sample() -> None:
+    """Throttled ring advance — called from the pipeline's decode loop (one
+    cheap clock read per solve in the steady state)."""
+    if not _ENABLED:
+        return
+    now = _CLOCK()
+    if now - _LAST_SAMPLE < _SAMPLE_INTERVAL_S:
+        return
+    try:
+        sample(now)
+    except Exception:  # noqa: BLE001 — diagnostics never fail a solve
+        log.exception("telemetry: ring sample failed — continuing")
+
+
+def recent_samples(n: Optional[int] = None) -> List[dict]:
+    with _LOCK:
+        out = list(_RING)
+    return out if n is None else out[-int(n):]
+
+
+def debug_vars(window: Optional[int] = None) -> Dict[str, object]:
+    """The /debug/vars payload: current snapshot + the last `window` ring
+    samples (all retained samples when no window is given)."""
+    return {"now": snapshot(), "samples": recent_samples(window)}
+
+
+def dump_payload() -> Dict[str, object]:
+    """What a flight-recorder dump attaches: the live snapshot, the last
+    few ring samples, and the anomaly engine's state."""
+    out = {"snapshot": snapshot(), "samples": recent_samples(4)}
+    try:
+        from . import anomaly as _anomaly
+
+        out["anomaly"] = _anomaly.health()
+    except Exception:  # noqa: BLE001
+        out["anomaly"] = None
+    return out
+
+
+def health() -> Dict[str, object]:
+    """The /healthz "telemetry" object: ok unless the recompile detector
+    tripped or AOT prewarm coverage is short of the requested lattice."""
+    with _LOCK:
+        hot = list(_HOT)[-4:]
+        hot_n = stats["hot_path_compiles"]
+        prew = dict(_PREWARM)
+        prew["done"] = _PREWARM_DONE
+    warnings = []
+    if hot_n:
+        warnings.append("hot_path_recompiles")
+    req = prew["requested"]
+    coverage = prew["compiled"] / req if req else None
+    if coverage is not None and coverage < 1.0:
+        warnings.append("prewarm_coverage")
+    if prew["failures"]:
+        warnings.append("prewarm_failures")
+    return {
+        "state": "warn" if warnings else "ok",
+        "warnings": warnings,
+        "hot_path_compiles": hot_n,
+        "recent_hot_path": hot,
+        "prewarm": {**prew, "coverage": coverage},
+    }

@@ -236,6 +236,14 @@ def finish(trace: Optional[Trace], status: str = "ok") -> None:
         _slo.observe_trace(trace)
     except Exception:  # noqa: BLE001 — diagnostics never fail a solve
         log.exception("trace: SLO feed failed — continuing")
+    # ... and the rolling-baseline anomaly detector (obs/anomaly.py):
+    # sustained per-stage deviation trips perf_anomaly in /healthz
+    try:
+        from . import anomaly as _anomaly
+
+        _anomaly.observe_trace(trace)
+    except Exception:  # noqa: BLE001
+        log.exception("trace: anomaly feed failed — continuing")
 
 
 def status_of(error: Optional[BaseException]) -> str:

@@ -21,8 +21,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..controllers import store as st
 from ..metrics.registry import REGISTRY
+from ..obs import anomaly as obsanomaly
 from ..obs import explain as obsexplain
 from ..obs import slo as obsslo
+from ..obs import telemetry as obstelemetry
 from ..obs import trace as obstrace
 from ..obs.export import chrome_trace
 from ..obs.logjson import JsonLogFormatter
@@ -47,13 +49,56 @@ def serve_endpoints(port: int, health_port: int, enable_profiling: bool = False)
                 self.wfile.write(body)
             elif self.path in ("/healthz", "/readyz"):
                 rec = obstrace.recorder()
+                slo = obsslo.health()
+                telem = obstelemetry.health()
+                anom = obsanomaly.health()
+                # worst-of across the health planes: SLO burn rates can
+                # "page"; telemetry (hot-path recompiles, prewarm gaps) and
+                # anomaly (baseline deviation) contribute "warn"
+                rank = {"ok": 0, "warn": 1, "page": 2}
+                status = max(
+                    (slo["state"], telem["state"], anom["state"]),
+                    key=lambda s: rank.get(s, 0),
+                )
                 body = json.dumps({
-                    "status": "ok",
+                    "status": status,
                     "flight_recorder": rec.health() if rec is not None else None,
                     # per-stage SLO burn-rate state (obs/slo.py): "ok" |
                     # "warn" | "page" overall, per-stage fast/slow rates
-                    "slo": obsslo.health(),
-                }).encode()
+                    "slo": slo,
+                    # runtime health plane (obs/telemetry.py + anomaly.py):
+                    # compile/prewarm state + rolling-baseline deviations
+                    "telemetry": telem,
+                    "anomaly": anom,
+                    # streaming delta-solve health when the operator
+                    # registered its provider (journal lag, re-baselines)
+                    "streaming": obstelemetry.provider_result("streaming"),
+                }, default=str).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path.startswith("/debug/vars"):
+                # in-process telemetry ring (obs/telemetry.py): the current
+                # snapshot plus the last ?window= ring samples — JSON for
+                # dashboards/dumps, 400 on a bad param like /debug/trace
+                _, _, query = self.path.partition("?")
+                window = None
+                for part in query.split("&"):
+                    if not part:
+                        continue
+                    key, _, val = part.partition("=")
+                    if key == "window":
+                        try:
+                            window = max(1, int(val))
+                        except ValueError:
+                            self.send_response(400)
+                            self.end_headers()
+                            self.wfile.write(b"bad window\n")
+                            return
+                body = json.dumps(
+                    obstelemetry.debug_vars(window), default=str
+                ).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.end_headers()
@@ -185,12 +230,18 @@ def main(argv=None) -> int:
     obsexplain.configure(enabled=o.solver_explain, top_k=o.explain_top_k,
                          ring=o.explain_ring_size)
     obsslo.configure(objectives=obsslo.parse_objectives(o.slo_objectives))
+    # runtime health plane: compile observability + telemetry ring
+    # (--telemetry) and rolling-baseline anomaly detection, threshold from
+    # --anomaly-threshold (validated > 1.0 in options.parse)
+    obstelemetry.configure(enabled=o.telemetry)
+    obsanomaly.configure(enabled=o.telemetry, multiplier=o.anomaly_threshold)
     log = logging.getLogger("karpenter_tpu")
     solver = (
         TPUSolver(arena=o.solver_arena, resume=o.solver_resume,
                   ckpt_every=o.resume_checkpoint_interval,
                   device_decode=o.solver_device_decode,
-                  relax_ladder=o.solver_relax_ladder)
+                  relax_ladder=o.solver_relax_ladder,
+                  arena_budget_mb=o.arena_budget_mb)
         if o.solver_backend == "tpu"
         else ReferenceSolver()
     )

@@ -34,6 +34,7 @@ from ..lifecycle.controller import (
     RegistrationController,
 )
 from ..lifecycle.repair import RepairController
+from ..obs import telemetry as obstelemetry
 from ..provisioning.provisioner import Provisioner
 from ..solver.backend import ReferenceSolver, Solver, TPUSolver
 from ..state.cluster import Cluster
@@ -253,6 +254,9 @@ def new_kwok_operator(
             preference_policy=preference_policy,
             epoch_every=streaming_epoch_every, clock=clock,
         )
+        # /healthz surfacing: serve_endpoints has no operator reference, so
+        # streaming health rides the telemetry provider registry
+        obstelemetry.register_provider("streaming", streaming.health)
 
         def _enable_stream_stage(s) -> None:
             inner = s
@@ -409,8 +413,17 @@ def new_kwok_operator(
                                    expected_pods=prewarm_scale_pods)
             if warm_start and hasattr(solver, "warmup"):
                 solver.warmup(types, zones)
+            # arm the hot-path recompile detector ONLY after BOTH warm
+            # passes: warmup() executes real solves whose compiles are
+            # legitimate prewarm events, so marking done inside
+            # prewarm_aot would flag them as false hot-path defects
+            obstelemetry.mark_prewarm_done()
 
         threading.Thread(target=_warm, daemon=True, name="solver-warmup").start()
+    else:
+        # no warm pass configured: every compile is by definition on the
+        # dispatch path — arm the detector at boot so they are visible
+        obstelemetry.mark_prewarm_done()
     return Operator(
         store=store,
         cloud=cloud,

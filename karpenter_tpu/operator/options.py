@@ -150,6 +150,24 @@ class Options:
     # applied event batches between full re-encode parity checks of the
     # streaming model (epoch protocol; drift re-baselines). 0 = never.
     streaming_epoch_every: int = 64
+    # runtime health plane (obs/telemetry.py + obs/anomaly.py): compile/
+    # recompile observability, telemetry ring at /debug/vars, HBM gauges.
+    # Off = the instrumented kernel hooks tail-call straight through
+    # (allocation-free off path, proven in bench.py)
+    telemetry: bool = True
+    # arena residency byte budget, MiB (solver/arena.py): > 0 bounds TOTAL
+    # accounted device residency (all classes x tenants) with LRU
+    # whole-bucket eviction; 0 = unbounded (the max_buckets cap still holds)
+    arena_budget_mb: int = 0
+    # rolling-baseline anomaly multiplier (obs/anomaly.py): a stage trips
+    # perf_anomaly when its duration sustains above
+    # multiplier * max(ewma + 3*dev, ~p95); must be > 1.0
+    anomaly_threshold: float = 3.0
+    # bench regression baseline: path to a BENCH_rNN.json record for
+    # tools/bench_gate.py comparisons; empty = gate off. Validated at boot
+    # (must exist and parse as JSON) so a typo'd path fails the deploy, not
+    # the nightly gate run.
+    bench_baseline: str = ""
     # per-solve deadline on the device path, seconds; 0 = no deadline
     solver_deadline_s: float = 0.0
     # breaker opens after this many consecutive device-path failures
@@ -343,6 +361,34 @@ def parse(argv: Optional[Sequence[str]] = None, cls=Options) -> Options:
             "streaming model's full parity checks, 0 = never "
             "(solver/streaming.py)"
         )
+    # health-plane knob sanity (same fail-closed rule as everything above)
+    budget = getattr(out, "arena_budget_mb", None)
+    if budget is not None and int(budget) < 0:
+        raise SystemExit(
+            "refusing to start: --arena-budget-mb must be >= 0 "
+            f"(got {budget}); > 0 bounds arena residency with LRU eviction, "
+            "0 = unbounded (solver/arena.py)"
+        )
+    thresh = getattr(out, "anomaly_threshold", None)
+    if thresh is not None and float(thresh) <= 1.0:
+        raise SystemExit(
+            "refusing to start: --anomaly-threshold must be > 1.0 "
+            f"(got {thresh}); it multiplies each stage's rolling baseline — "
+            "<= 1.0 would flag normal latency as anomalous (obs/anomaly.py)"
+        )
+    baseline = getattr(out, "bench_baseline", "") or ""
+    if baseline.strip():
+        import json as _json
+
+        try:
+            with open(baseline) as f:
+                _json.load(f)
+        except (OSError, ValueError) as e:
+            raise SystemExit(
+                f"refusing to start: --bench-baseline {baseline!r} is not a "
+                f"readable JSON bench record ({e}); point it at a "
+                "BENCH_rNN.json (tools/bench_gate.py)"
+            ) from None
     slo_spec = getattr(out, "slo_objectives", None)
     if slo_spec:
         from ..obs.slo import parse_objectives
@@ -354,7 +400,7 @@ def parse(argv: Optional[Sequence[str]] = None, cls=Options) -> Options:
     for name in (
         "solver_device_decode", "solver_relax_ladder",
         "solver_preemption", "solver_gang", "solver_explain",
-        "solver_streaming",
+        "solver_streaming", "telemetry",
     ):
         if not hasattr(out, name):
             continue

@@ -50,6 +50,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..metrics.registry import (
+    SOLVER_ARENA_BYTES,
+    SOLVER_ARENA_EVICTIONS,
     SOLVER_ARENA_HIT_RATE,
     SOLVER_DECODE_BYTES,
     SOLVER_UPLOAD_ARRAYS,
@@ -166,6 +168,26 @@ class TransferLedger:
             }
 
 
+def _nbytes(obj) -> int:
+    """Host-side byte estimate of one residency record: numpy / device
+    arrays by .nbytes, containers recursively, scalars/metadata free."""
+    try:
+        if isinstance(obj, np.ndarray):
+            return int(obj.nbytes)
+        if isinstance(obj, dict):
+            return sum(_nbytes(v) for v in obj.values())
+        if isinstance(obj, (list, tuple)):
+            return sum(_nbytes(v) for v in obj)
+        if isinstance(obj, (bytes, bytearray)):
+            return len(obj)
+        nb = getattr(obj, "nbytes", None)  # jax.Array and friends
+        if nb is not None:
+            return int(nb)
+    except Exception:
+        pass
+    return 0
+
+
 def _digest(a: np.ndarray) -> bytes:
     """Content digest of a host array (shape/dtype live in the bucket key)."""
     return hashlib.blake2b(
@@ -249,14 +271,27 @@ class ArgumentArena:
     A bucket is one padded shape signature ((shape, dtype) per ARG_SPEC
     entry, plus the placement sharding) — exactly the compile-bucket
     granularity of the kernel, so a bucket's resident buffers are always
-    shape-compatible with its dispatches. Bounded FIFO like the encode
-    core cache (a control loop alternates between a handful of buckets).
+    shape-compatible with its dispatches. Bounded LRU (adopt re-inserts
+    the key on every hit): the `max_buckets` cap and the optional
+    `budget_bytes` byte budget both evict whole cold buckets — every
+    residency class at once — via `_evict_bucket`, counted on
+    `karpenter_solver_arena_evictions_total`.
     """
 
     def __init__(self, ledger: Optional[TransferLedger] = None,
-                 max_buckets: int = 4):
+                 max_buckets: int = 4, budget_bytes: int = 0):
         self.ledger = ledger if ledger is not None else TransferLedger()
         self.max_buckets = max_buckets
+        # arena byte budget across EVERY residency class (0 = unbounded):
+        # when the accounted total exceeds it, whole cold buckets evict
+        # LRU-first (_enforce_budget) — the evicted tenant's next solve
+        # pays one cold packed upload, never a wrong answer.
+        self.budget_bytes = int(budget_bytes)
+        # bucket key -> {residency class -> accounted host-equivalent bytes}
+        self._bytes: Dict[tuple, Dict[str, int]] = {}
+        # (class, tenant) gauge label sets ever pushed, so stale series
+        # zero out when their residency drops instead of lying forever
+        self._gauge_keys: set = set()
         # bucket key -> [device buffers per entry, (token, digest) per entry]
         self._buckets: Dict[tuple, list] = {}
         # checkpoint residency class (backend._plan_resume): per-bucket FFD
@@ -293,7 +328,7 @@ class ArgumentArena:
         self.stats: Dict[str, int] = {
             "adopts": 0, "exact_hits": 0, "delta_uploads": 0,
             "full_uploads": 0, "invalidations": 0,
-            "event_batches": 0, "event_edits": 0,
+            "event_batches": 0, "event_edits": 0, "evictions": 0,
         }
 
     def invalidate(self) -> None:
@@ -307,8 +342,83 @@ class ArgumentArena:
         self._ladders.clear()
         self._shards.clear()
         self._run_host.clear()
+        self._bytes.clear()
         self.last_stale = ()
         self.stats["invalidations"] += 1
+        self._push_gauges()
+
+    # -- byte accounting + budgeted eviction (ISSUE 14) ---------------------
+
+    @staticmethod
+    def _tenant_of(key: tuple) -> str:
+        return str(key[2]) if len(key) > 2 and key[2] is not None else "default"
+
+    def total_bytes(self) -> int:
+        return sum(sum(cls.values()) for cls in self._bytes.values())
+
+    def bytes_by_class(self) -> Dict[Tuple[str, str], int]:
+        """Accounted bytes per (residency class, tenant) — the
+        `karpenter_solver_arena_bytes{class,tenant}` label space."""
+        out: Dict[Tuple[str, str], int] = {}
+        for key, classes in self._bytes.items():
+            ten = self._tenant_of(key)
+            for cls, nb in classes.items():
+                out[(cls, ten)] = out.get((cls, ten), 0) + nb
+        return out
+
+    def _push_gauges(self) -> None:
+        cur = self.bytes_by_class()
+        for (cls, ten) in self._gauge_keys - set(cur):
+            SOLVER_ARENA_BYTES.set(0, **{"class": cls, "tenant": ten})
+        for (cls, ten), nb in cur.items():
+            SOLVER_ARENA_BYTES.set(nb, **{"class": cls, "tenant": ten})
+        self._gauge_keys |= set(cur)
+
+    def _account(self, key: tuple, cls: str, nbytes: int) -> None:
+        self._bytes.setdefault(key, {})[cls] = int(nbytes)
+        self._push_gauges()
+
+    def _evict_bucket(self, key: tuple) -> None:
+        """Drop EVERY residency class for one bucket key — resident args,
+        checkpoints, ladder tables, shard records, streaming run copies —
+        so eviction never strands a derived record whose donor args are
+        gone (the old FIFO cap dropped only `_buckets` and leaked the
+        rest). Decision-safe by construction: the next adopt of the key
+        re-uploads cold and every derived path re-records."""
+        self._buckets.pop(key, None)
+        self._ckpts.pop(key, None)
+        self._shards.pop(key, None)
+        self._run_host.pop(key, None)
+        for lk in [lk for lk in self._ladders if lk[0] == key]:
+            self._ladders.pop(lk, None)
+        self._bytes.pop(key, None)
+        self.stats["evictions"] += 1
+        SOLVER_ARENA_EVICTIONS.inc()
+        obstrace.annotate(arena_evicted=1)
+
+    def _enforce_budget(self, current_key: Optional[tuple] = None) -> None:
+        """Evict coldest-first (insertion order of `_buckets` = LRU, adopt
+        re-inserts on every hit) until the accounted total fits the budget.
+        `current_key` — the bucket the in-flight dispatch holds live device
+        references to — goes last, and only if it alone still busts the
+        budget (the caller's references keep its buffers alive through the
+        dispatch; residency simply isn't retained for the next solve)."""
+        if self.budget_bytes <= 0:
+            return
+        changed = False
+        while self.total_bytes() > self.budget_bytes:
+            victim = next(
+                (k for k in self._buckets if k != current_key), None)
+            if victim is None:
+                victim = next(
+                    (k for k in self._bytes if k != current_key),
+                    current_key if current_key in self._bytes else None)
+            if victim is None:
+                break
+            self._evict_bucket(victim)
+            changed = True
+        if changed:
+            self._push_gauges()
 
     def bucket_key(self, host_args: tuple, sharding=None, ns=None) -> tuple:
         """Residency key for one dispatch's kernel args. `ns` is the tenant
@@ -329,6 +439,8 @@ class ArgumentArena:
         lst = self._ckpts.setdefault(key, [])
         lst.insert(0, record)
         del lst[self.max_ckpts_per_bucket:]
+        self._account(key, "ckpt", sum(_nbytes(r) for r in lst))
+        self._enforce_budget(key)
 
     def get_checkpoints(self, key: tuple) -> list:
         return self._ckpts.get(key, [])
@@ -338,6 +450,8 @@ class ArgumentArena:
         for its bucket (one per bucket — the newest sharded solve is the
         only useful resume donor). Dies on invalidate()."""
         self._shards[key] = record
+        self._account(key, "shard", _nbytes(record))
+        self._enforce_budget(key)
 
     def get_shard_record(self, key: tuple):
         return self._shards.get(key)
@@ -346,6 +460,9 @@ class ArgumentArena:
         """Record a bucket's device-resident relax-ladder table (one per
         bucket — a bucket's preference fleet has one current rung layout)."""
         self._ladders[(key, host_table.shape)] = (_digest(host_table), dev)
+        self._account(key, "ladder", sum(
+            _nbytes(v[1]) for lk, v in self._ladders.items() if lk[0] == key))
+        self._enforce_budget(key)
 
     def get_ladder(self, key: tuple, host_table: np.ndarray):
         """The bucket's resident ladder table if its content matches, else
@@ -381,6 +498,7 @@ class ArgumentArena:
         dig_rg, dig_rc = _digest(rg), _digest(rc)
         prev = self._run_host.get(key)
         self._run_host[key] = (rg.copy(), rc.copy(), dig_rg, dig_rc)
+        self._account(key, "run_host", rg.nbytes + rc.nbytes)
         bkt = self._buckets.get(key)
         if bkt is None or prev is None:
             return False
@@ -461,12 +579,15 @@ class ArgumentArena:
 
         self.stats["adopts"] += 1
         key = self.bucket_key(host_args, sharding, ns=ns)
-        bkt = self._buckets.get(key)
+        bkt = self._buckets.pop(key, None)
         if bkt is None:
             while len(self._buckets) >= self.max_buckets:
-                self._buckets.pop(next(iter(self._buckets)))
+                self._evict_bucket(next(iter(self._buckets)))
             bkt = [[None] * len(host_args), [None] * len(host_args)]
-            self._buckets[key] = bkt
+        # re-insert on EVERY adopt: dict order is the LRU order the budget
+        # enforcer and the bucket cap both evict from the front of
+        self._buckets[key] = bkt
+        self._account(key, "args", sum(int(a.nbytes) for a in host_args))
         dev, tags = bkt
         stale: List[int] = []
         for i, a in enumerate(host_args):
@@ -490,6 +611,7 @@ class ArgumentArena:
         if not stale:
             self.stats["exact_hits"] += 1
             led.record_adopt("exact_hit")
+            self._enforce_budget(key)
             return tuple(dev)
         # pack stale entries into one contiguous byte buffer per distinct
         # sharding → one upload each → jitted unpack scatters into typed
@@ -533,4 +655,5 @@ class ArgumentArena:
         led.record_upload(total_bytes, len(stale), msgs=len(groups),
                           shard_bytes=total_shard)
         led.record_adopt("full_upload" if full else "delta_upload")
+        self._enforce_budget(key)
         return tuple(dev)

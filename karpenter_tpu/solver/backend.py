@@ -735,7 +735,7 @@ class TPUSolver(Solver):
                  arena: bool = True, resume: bool = True,
                  ckpt_every: int = 16, ckpt_slots: int = 4,
                  device_decode: bool = True, relax_ladder: bool = True,
-                 shards: int = 0):
+                 shards: int = 0, arena_budget_mb: int = 0):
         self.max_claims = max_claims
         if fallback is None:
             # fallback chain: native C++ core (compiled-class speed), which
@@ -786,8 +786,13 @@ class TPUSolver(Solver):
         from .arena import ArgumentArena, TransferLedger
 
         self.ledger = TransferLedger()
+        # arena_budget_mb > 0 bounds TOTAL accounted residency (all classes,
+        # all tenants) with LRU whole-bucket eviction — `--arena-budget-mb`
         self.arena: Optional[ArgumentArena] = (
-            ArgumentArena(self.ledger) if arena else None
+            ArgumentArena(
+                self.ledger,
+                budget_bytes=max(0, int(arena_budget_mb)) * 1024 * 1024,
+            ) if arena else None
         )
         # checkpointed-scan resume (solver/tpu/ffd.py CheckpointRing +
         # SPEC.md "Resume semantics"): cold solves harvest an FFDState
@@ -1390,16 +1395,24 @@ class TPUSolver(Solver):
             pods=pods, nodes=[], nodepools=[pool],
             zones=tuple(zones), capacity_types=tuple(capacity_types),
         )))
+        from ..obs import telemetry as obstelemetry
+
         try:
             args0, dims = kernel_args(enc, self._bucket)
-        except UnpackableInput:
+        except UnpackableInput as e:
+            obstelemetry.note_prewarm_failure("encode", e)
+            obstelemetry.note_prewarm(1, 0)
             return 0
         dims = dict(dims)
         dims["D"] = int(args0[ARG_SPEC.index("zone_col_mask")].shape[0])
         for i, name in enumerate(ARG_SPEC):
             if tuple(args0[i].shape) != tuple(dims[s] for s in _AOT_SHAPES[name]):
-                return 0  # table out of sync with kernel_args — never
-                # compile shapes production would not request
+                # table out of sync with kernel_args — never compile shapes
+                # production would not request; surfaced as zero coverage
+                obstelemetry.note_prewarm_failure(
+                    "shape_table", f"{name} drifted from _AOT_SHAPES")
+                obstelemetry.note_prewarm(1, 0)
+                return 0
         if claim_buckets is None:
             mc = self.max_claims
             # initial buckets for small/medium/configured surges, plus the
@@ -1451,6 +1464,10 @@ class TPUSolver(Solver):
             jax.ShapeDtypeStruct((16,), s.dtype) if i < 2 else s
             for i, s in enumerate(specs)
         )
+        # lattice points requested, in the unit `n` counts: the compile
+        # observability coverage gauge is compiled/requested — <1.0 when a
+        # compile failed or the sharded leg was cut short (/healthz WARN)
+        requested = len(claim_buckets) * (2 if with_zone_engine else 1)
         n = 0
         for M in claim_buckets:
             for ze in (False, True) if with_zone_engine else (False,):
@@ -1468,8 +1485,15 @@ class TPUSolver(Solver):
                             state_spec(int(M)), *resume_specs,
                             max_claims=int(M), zone_engine=ze, **ck
                         ).compile()
-                except Exception:
-                    return n  # a compile failure would repeat at every point
+                except Exception as e:
+                    # a compile failure would repeat at every point — stop,
+                    # but COUNT it: the old silent best-effort return left a
+                    # broken compile cache to show up as mystery hot-path
+                    # compiles at the first production dispatch
+                    obstelemetry.note_prewarm_failure(
+                        f"M={int(M)},zone_engine={ze}", e)
+                    obstelemetry.note_prewarm(requested, n)
+                    return n
                 n += 1
         mesh = self._shard_mesh()
         if mesh is not None:
@@ -1482,6 +1506,7 @@ class TPUSolver(Solver):
             Nd = int(mesh.devices.size)
             Sp = specs[0].shape[0]
             if token not in self._shard_prewarmed and Sp % Nd == 0:
+                requested += len(claim_buckets)
                 try:
                     from jax.sharding import NamedSharding, PartitionSpec
 
@@ -1503,8 +1528,11 @@ class TPUSolver(Solver):
                         ).compile()
                         n += 1
                     self._shard_prewarmed.add(token)
-                except Exception:
+                except Exception as e:
+                    obstelemetry.note_prewarm_failure(f"sharded:{token}", e)
+                    obstelemetry.note_prewarm(requested, n)
                     return n
+        obstelemetry.note_prewarm(requested, n)
         return n
 
     # -- device path --------------------------------------------------------

@@ -68,6 +68,7 @@ from ..metrics.registry import (
     FLEET_HEALTHY,
     FLEET_REQUEUED,
 )
+from ..obs import telemetry as obstelemetry
 from ..obs import trace as obstrace
 from .backend import ReferenceSolver, Solver
 from .pipeline import (
@@ -475,6 +476,7 @@ class SolverFleet:
             survivors = list(owner.outstanding.values())
             owner.outstanding.clear()
         FLEET_FAILOVER.inc(owner=owner.name)
+        obstelemetry.note_event("fleet_fence", owner=owner.name, reason=reason)
         log.warning(
             "solver fleet: FENCING %s (%s) — stopping its service, "
             "re-routing %d outstanding request(s)",

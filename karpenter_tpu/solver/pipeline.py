@@ -51,6 +51,7 @@ from ..metrics.registry import (
     SOLVE_PIPELINE_DEPTH,
     SOLVE_PIPELINE_OCCUPANCY,
 )
+from ..obs import telemetry as obstelemetry
 from ..obs import trace as obstrace
 
 PROVISIONING = "provisioning"
@@ -504,6 +505,10 @@ class SolveService:
                 self._mark_busy_locked()
                 SOLVE_PIPELINE_DEPTH.set(len(self._inflight))
                 self._cv.notify_all()
+            # health-plane ring sample (obs/telemetry.py): the dispatcher
+            # is the one thread guaranteed to run while solves flow, so it
+            # carries the throttled sampler (off the lock; never raises)
+            obstelemetry.maybe_sample()
 
     def _next_peek_locked(self) -> Optional[str]:
         for kind in (PROVISIONING, DISRUPTION):

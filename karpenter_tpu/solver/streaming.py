@@ -100,6 +100,10 @@ class StreamingSolver:
         self._attached = False
         self._applied_seq = 0
         self._baseline_at = self.clock()
+        # last re-baseline provenance for the health surface: the
+        # `karpenter_streaming_rebaseline_total{reason}` series says HOW
+        # OFTEN; /healthz wants the most recent WHY without a metrics query
+        self.last_rebaseline: Dict[str, object] = {"reason": None, "count": 0}
         # -- mirrors (store order; values are the LIVE stored objects) ------
         self._pods: Dict[str, object] = {}
         self._nodes: Dict[str, object] = {}       # by meta.name
@@ -142,6 +146,9 @@ class StreamingSolver:
         (level-triggered — the mirror re-reads the same live object)."""
         STREAMING_REBASELINE.inc(reason=reason)
         self.stats["rebaseline_total"] += 1
+        self.last_rebaseline = {
+            "reason": reason, "count": self.stats["rebaseline_total"],
+        }
         self._applied_seq = self.journal.attach()
         self._pods.clear()
         self._pod_ord.clear()
@@ -438,4 +445,25 @@ class StreamingSolver:
                 "journal_depth": self.journal.depth(),
                 "journal_overflows": self.journal.overflows,
                 "resident_state_age_s": self.clock() - self._baseline_at,
+            }
+
+    def health(self) -> Dict[str, object]:
+        """The /healthz "streaming" object (registered as a telemetry
+        provider by the operator): journal lag — newest store event vs the
+        seq this consumer has folded — plus re-baseline provenance. Lag
+        that keeps growing means the pump stalled; a climbing re-baseline
+        count means fold-drift/overflow is forcing snapshot resyncs."""
+        with self._lock:
+            rev = self.journal.rev()
+            return {
+                "journal": {
+                    "rev": rev,
+                    "applied_seq": self._applied_seq,
+                    "lag": max(0, rev - self._applied_seq),
+                    "depth": self.journal.depth(),
+                    "overflows": self.journal.overflows,
+                },
+                "last_rebaseline": dict(self.last_rebaseline),
+                "rebaseline_total": self.stats["rebaseline_total"],
+                "streamed_solves": self.stats["streamed_solves"],
             }

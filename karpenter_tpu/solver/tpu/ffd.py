@@ -2520,3 +2520,32 @@ def unpack_explain(flat, n_groups: int):
     n_rej = np.ascontiguousarray(body[:n_groups, 0])
     words = np.ascontiguousarray(body[:n_groups, 1:])
     return overflow, n_rej, words
+
+
+# --- compile observability (obs/telemetry.py; ISSUE 14) ------------------
+#
+# Every public jitted entry point is rebound to a telemetry hook that
+# derives a dispatch signature ((shape, dtype) per array + the statics)
+# and counts first sightings as compile events — arming the hot-path
+# recompile detector once the operator marks the prewarm phase done.
+# The hooks preserve `__wrapped__` (the plain traceable function
+# consolidate.py and parallel/sharded.py vmap and the arg-spec drift
+# test introspects) and proxy `.lower()` so prewarm_aot's AOT compiles
+# register their signatures as prewarmed.
+
+from ...obs import telemetry as _telemetry  # noqa: E402
+
+ffd_apply_events = _telemetry.instrument("ffd_apply_events", ffd_apply_events)
+ffd_solve = _telemetry.instrument("ffd_solve", ffd_solve, arg_names=ARG_SPEC)
+ffd_solve_ckpt = _telemetry.instrument(
+    "ffd_solve_ckpt", ffd_solve_ckpt, arg_names=ARG_SPEC)
+ffd_resume = _telemetry.instrument(
+    "ffd_resume", ffd_resume, arg_names=("init_state",) + tuple(ARG_SPEC))
+ffd_solve_ladder = _telemetry.instrument(
+    "ffd_solve_ladder", ffd_solve_ladder,
+    arg_names=("run_ladder",) + tuple(ARG_SPEC))
+ffd_solve_sharded = _telemetry.instrument(
+    "ffd_solve_sharded", ffd_solve_sharded, arg_names=ARG_SPEC)
+gang_commit = _telemetry.instrument("gang_commit", gang_commit)
+preemption_plan = _telemetry.instrument("preemption_plan", preemption_plan)
+explain_pack = _telemetry.instrument("explain_pack", explain_pack)

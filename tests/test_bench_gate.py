@@ -1,0 +1,162 @@
+"""Bench regression sentinel: tools/bench_gate.py contract (ISSUE 14).
+
+The gate is the first CI-able perf guardrail over the BENCH_rNN.json
+records, so its exact semantics are pinned here:
+- a fabricated regression (2x a latency, half a throughput) exits 1,
+  an in-tolerance drift exits 0;
+- marker records (`value: -1`, `backend_unavailable`) and keys missing
+  on a side are SKIPPED — a host without the accelerator toolchain
+  gates clean (exit 0) with a loud vacuous-gate warning, never red;
+- direction is per key (per_sec/rate/hit/... higher-is-better), and
+  per-key tolerances fall through the p99/first-call heuristic;
+- usage/IO errors exit 2, distinguishable from a real regression.
+
+The CLI is spec-loaded from tools/ (not a package): the same mechanism
+bench.py --baseline uses.
+"""
+
+import importlib.util
+import json
+import pathlib
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+_spec = importlib.util.spec_from_file_location(
+    "bench_gate", ROOT / "tools" / "bench_gate.py")
+gate = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(gate)
+
+
+BASELINE = {
+    "n": 7,
+    "rc": 0,
+    "cmd": "python bench.py",
+    "parsed": {
+        "metric": "solve_p99_50k_pods_x_700_types",
+        "value": 120.0,
+        "e2e_p50_ms": 180.0,
+        "e2e_p99_ms": 260.0,
+        "kernel_pipelined_ms": 11.0,
+        "arrival_batches_per_sec": 50.0,
+        "upload_bytes_per_solve": 4096.0,
+        "first_call_s": 30.0,
+        "backend_unavailable": False,
+    },
+}
+
+
+def _write(tmp_path, name, obj):
+    p = tmp_path / name
+    p.write_text(json.dumps(obj))
+    return str(p)
+
+
+def _current(**overrides):
+    cur = json.loads(json.dumps(BASELINE))
+    cur["n"] = BASELINE["n"] + 1
+    cur["parsed"].update(overrides)
+    return cur
+
+
+def test_in_tolerance_drift_exits_zero(tmp_path, capsys):
+    base = _write(tmp_path, "base.json", BASELINE)
+    cur = _write(tmp_path, "cur.json", _current(
+        e2e_p50_ms=198.0,                 # +10% inside the 20% tolerance
+        arrival_batches_per_sec=45.0,     # -10% inside 20% (higher-better)
+        upload_bytes_per_solve=4096.0,
+    ))
+    assert gate.main(["--baseline", base, "--current", cur]) == 0
+    out = capsys.readouterr().out
+    assert "REGRESSED" not in out and "vacuous" not in out
+
+
+def test_doubled_latency_exits_one(tmp_path, capsys):
+    base = _write(tmp_path, "base.json", BASELINE)
+    cur = _write(tmp_path, "cur.json", _current(e2e_p50_ms=360.0))
+    assert gate.main(["--baseline", base, "--current", cur]) == 1
+    assert "e2e_p50_ms" in capsys.readouterr().out
+
+
+def test_halved_throughput_exits_one_direction_aware(tmp_path, capsys):
+    """arrival_batches_per_sec is higher-is-better: HALVING it regresses
+    even though the raw value went down, and DOUBLING it must not."""
+    base = _write(tmp_path, "base.json", BASELINE)
+    worse = _write(tmp_path, "worse.json",
+                   _current(arrival_batches_per_sec=25.0))
+    better = _write(tmp_path, "better.json",
+                    _current(arrival_batches_per_sec=100.0))
+    assert gate.main(["--baseline", base, "--current", worse]) == 1
+    assert gate.main(["--baseline", base, "--current", better]) == 0
+
+
+def test_marker_record_gates_clean_but_loud(tmp_path, capsys):
+    """A backend_unavailable marker (value -1, the BENCH_r05.json shape)
+    has nothing comparable: exit 0 with the vacuous-gate warning."""
+    base = _write(tmp_path, "base.json", BASELINE)
+    marker = _write(tmp_path, "marker.json", {
+        "n": 9, "rc": 0,
+        "parsed": {"metric": "solve_p99_50k_pods_x_700_types", "value": -1,
+                   "backend_unavailable": True,
+                   "reason": "jax/tpu runtime not importable"},
+    })
+    assert gate.main(["--baseline", base, "--current", marker]) == 0
+    assert "vacuous" in capsys.readouterr().out
+
+
+def test_missing_keys_are_skipped_not_failed(tmp_path, capsys):
+    base = _write(tmp_path, "base.json", BASELINE)
+    cur = _write(tmp_path, "cur.json", {
+        "n": 8, "parsed": {"e2e_p50_ms": 181.0}})
+    assert gate.main(["--baseline", base, "--current", cur]) == 0
+    assert "skipped" in capsys.readouterr().out
+
+
+def test_io_and_usage_errors_exit_two(tmp_path):
+    base = _write(tmp_path, "base.json", BASELINE)
+    assert gate.main(["--baseline", str(tmp_path / "nope.json"),
+                      "--current", base]) == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert gate.main(["--baseline", base, "--current", str(bad)]) == 2
+    assert gate.main(["--baseline", base, "--current", base,
+                      "--default-tolerance", "-1"]) == 2
+
+
+def test_current_defaults_to_newest_bench_record(tmp_path):
+    _write(tmp_path, "BENCH_r02.json", BASELINE)
+    _write(tmp_path, "BENCH_r10.json", _current(e2e_p50_ms=1000.0))
+    assert gate.newest_bench_record(str(tmp_path)).endswith("BENCH_r10.json")
+    base = _write(tmp_path, "BENCH_r02.json", BASELINE)
+    assert gate.main(["--baseline", base]) == 1  # picked r10, which regressed
+
+
+def test_tolerance_heuristics():
+    assert gate.tolerance_for("e2e_p99_ms", 0.20) == 0.30          # per-key
+    assert gate.tolerance_for("other_p99_ms", 0.20) == 0.30        # p99 rule
+    assert gate.tolerance_for("first_call_s", 0.20) == 1.00        # cold start
+    assert gate.tolerance_for("some_counter", 0.15) == 0.15        # default
+    assert gate.higher_is_better("arrival_batches_per_sec")
+    assert gate.higher_is_better("arena_hit_rate")
+    assert not gate.higher_is_better("e2e_p50_ms")
+
+
+def test_extract_metrics_flattens_and_skips_bookkeeping():
+    got = gate.extract_metrics(BASELINE)
+    assert got["solve_p99_50k_pods_x_700_types"] == 120.0  # metric/value pair
+    assert got["e2e_p50_ms"] == 180.0                      # parsed flattens
+    assert "n" not in got and "rc" not in got              # bookkeeping
+    assert "backend_unavailable" not in got                # bools skipped
+    nested = gate.extract_metrics({"parsed": {"sub": {"x_ms": 5.0}}})
+    assert nested == {"sub.x_ms": 5.0}
+
+
+@pytest.mark.parametrize("record", ["BENCH_r03.json", "BENCH_r05.json"])
+def test_repo_records_self_gate_clean(record):
+    """Every shipped record must gate clean against itself — the identity
+    diff is the smoke test CI runs without a perf box."""
+    path = ROOT / record
+    if not path.exists():
+        pytest.skip(f"{record} not in the tree")
+    assert gate.main(["--baseline", str(path), "--current", str(path)]) == 0

@@ -133,3 +133,24 @@ def test_every_reason_code_has_name_and_spec_row():
         assert re.search(rf"\|\s*`?{code}`?\s*\|\s*`{name}`", spec), (
             f"reason {code} ({name}) has no SPEC.md table row"
         )
+
+
+def test_health_plane_series_are_registered():
+    """ISSUE 14 acceptance: the runtime health plane's series are part of
+    the /metrics contract — compile/recompile counts, AOT prewarm coverage,
+    arena byte accounting + evictions, HBM watermarks, and the anomaly
+    detector's trip state are what the recompile alert and the memory
+    dashboards scrape, so pin their exact names."""
+    registered = {m.name for m in reg.REGISTRY.metrics}
+    for name in (
+        "karpenter_solver_compiles_total",
+        "karpenter_solver_compile_seconds",
+        "karpenter_solver_prewarm_coverage",
+        "karpenter_solver_prewarm_failures_total",
+        "karpenter_solver_arena_bytes",
+        "karpenter_solver_arena_evictions_total",
+        "karpenter_solver_hbm_bytes",
+        "karpenter_solver_perf_anomalies_total",
+        "karpenter_solver_perf_anomaly_state",
+    ):
+        assert name in registered, f"{name} missing from the registry"

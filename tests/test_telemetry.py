@@ -1,0 +1,395 @@
+"""Runtime health plane: recompile detector, arena byte budget, anomaly
+engine, and the /debug/vars telemetry ring (ISSUE 14).
+
+ISSUE 14 acceptance:
+- the hot-path recompile detector stays SILENT across the whole existing
+  kernel matrix (solve / ckpt+resume / ladder / shard / gang / preemption /
+  explain / apply_events) re-dispatched at identical shapes, and catches an
+  injected signature-perturbing dispatch with exactly one hot_path event,
+  a /healthz WARN, and one (per-reason throttled) flight-recorder dump
+  carrying the arg-signature diff;
+- a byte-budgeted arena evicts cold buckets and STILL decides bit-identically
+  to an unbudgeted solver — eviction means a cold re-upload, never a wrong
+  answer — while total accounted bytes stay under the budget;
+- the rolling-baseline anomaly engine trips after `sustain` breaches,
+  recovers after `recover` clean observations, and throttles its flight
+  dumps per stage — all driven by an injected fake clock;
+- /debug/vars serves the ring as JSON (window param clamped, 400 on junk).
+"""
+
+import glob
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from karpenter_tpu.metrics.registry import (
+    SOLVER_ARENA_EVICTIONS,
+    SOLVER_PERF_ANOMALIES,
+)
+from karpenter_tpu.obs import anomaly as obsanomaly
+from karpenter_tpu.obs import explain as obsexplain
+from karpenter_tpu.obs import telemetry as obstelemetry
+from karpenter_tpu.obs import trace as obstrace
+from karpenter_tpu.obs.recorder import FlightRecorder
+from karpenter_tpu.operator.__main__ import serve_endpoints
+from karpenter_tpu.provisioning.scheduler import SolverInput
+from karpenter_tpu.solver import scheduling_class as sc
+from karpenter_tpu.solver.backend import TPUSolver
+from karpenter_tpu.solver.tpu import ffd
+
+from tests.test_e2e_kwok import FakeClock
+from tests.test_metrics_endpoint import _get
+from tests.test_scan_resume import _add_replica, _fleet, _warm_solver
+from tests.test_scheduling_class import gang_labels, mknode, victim
+from tests.test_solver_parity import ZONES, mkpod, pool
+from tests.test_transfer_arena import _assert_same, _inp
+
+
+@pytest.fixture(autouse=True)
+def _fresh_health_plane():
+    """Boot-state health plane per test; restore module-import defaults
+    after (prewarm not done, detector empty, no recorder, explain off)."""
+    obstelemetry.configure()
+    obsanomaly.configure()
+    obstrace.configure()
+    yield
+    obstelemetry.configure()
+    obsanomaly.configure()
+    obstrace.configure()
+    obsexplain.configure(enabled=False)
+
+
+def _stub_kernel():
+    """A plain callable shaped like a jitted entry (has __wrapped__) so
+    detector semantics can be driven without paying an XLA compile."""
+
+    def fn(*args, **kwargs):
+        return 0
+
+    fn.__wrapped__ = fn
+    return fn
+
+
+# -- recompile detector ------------------------------------------------------
+
+
+def test_kernel_matrix_stays_silent_at_fixed_buckets():
+    """Round 1 dispatches every jitted entry point in the matrix before the
+    prewarm boundary (compiles are expected, kind=prewarm); round 2 repeats
+    the IDENTICAL inputs on fresh solver instances after mark_prewarm_done()
+    — every signature is on record, so the hot-path detector must not fire
+    once across the whole matrix."""
+    sc.configure(preemption=True, gang=True)
+    obsexplain.configure(enabled=True, top_k=8)
+    try:
+
+        def drive():
+            # ffd_solve (+ explain_pack: capture is enabled)
+            TPUSolver(resume=False).solve(_inp(12))
+            # ffd_solve_ckpt then ffd_resume via an append-tail warm solve
+            warm = _warm_solver()
+            base = _fleet(n_specs=8, prefix="t")
+            warm.solve(base)
+            warm.solve(_add_replica(base, 2, "t-extra"))
+            assert warm.stats["resume_solves"] == 1, warm.stats
+            # ffd_solve_ladder: soft topology spread engages the relax ladder
+            from karpenter_tpu.api.objects import TopologySpreadConstraint
+
+            sel = {"app": "soft"}
+            soft = [
+                mkpod(f"s{i}", labels=dict(sel), topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key="topology.kubernetes.io/zone",
+                        label_selector=sel,
+                        when_unsatisfiable="ScheduleAnyway")])
+                for i in range(3)
+            ]
+            TPUSolver(relax_ladder=True).solve(SolverInput(
+                pods=soft, nodes=[], nodepools=[pool()], zones=ZONES))
+            # ffd_solve_sharded: enough distinct runs to split across shards
+            mixed = [
+                mkpod(f"m{i:03d}", cpu=["250m", "500m", "1", "2"][i % 4],
+                      mem=["512Mi", "1Gi", "2Gi"][i % 3])
+                for i in range(60)
+            ]
+            TPUSolver(shards=2).solve(SolverInput(
+                pods=mixed, nodes=[], nodepools=[pool()], zones=ZONES))
+            # gang_commit (device planner) over an all-placed gang
+            gang = [mkpod(f"g{i}", cpu="500m", labels=gang_labels("job", 4))
+                    for i in range(4)]
+            sc.ClassAwareSolver(TPUSolver()).solve(SolverInput(
+                pods=gang, nodes=[], nodepools=[pool()], zones=ZONES))
+            # preemption_plan (device planner): full node + eligible victims
+            node = mknode("n0", cpu="0", mem="0Mi", victims=[
+                victim("v-a", priority=1), victim("v-b", priority=2)])
+            hi = mkpod("hi", cpu="2", mem="2Gi", priority=100)
+            sc.ClassAwareSolver(TPUSolver()).solve(SolverInput(
+                pods=[hi], nodes=[node], nodepools=[], zones=ZONES))
+            # ffd_apply_events (streaming run-table scatter)
+            ev = jnp.array([[0, 1, 2], [3, 2, 1]], jnp.int32)
+            assert ev.shape[1] == ffd.EVENT_ENTRY_WORDS
+            ffd.ffd_apply_events(
+                jnp.zeros(16, jnp.int32), jnp.zeros(16, jnp.int32), ev)
+
+        drive()
+        seen = set(obstelemetry.snapshot()["compiles"])
+        want = {"ffd_solve", "ffd_solve_ckpt", "ffd_resume",
+                "ffd_solve_ladder", "ffd_solve_sharded", "gang_commit",
+                "preemption_plan", "explain_pack", "ffd_apply_events"}
+        assert want <= seen, f"matrix missed kernels: {want - seen}"
+        assert obstelemetry.stats["hot_path_compiles"] == 0
+
+        obstelemetry.mark_prewarm_done()
+        drive()
+        assert obstelemetry.stats["hot_path_compiles"] == 0, (
+            obstelemetry.hot_path_records())
+        assert obstelemetry.health()["state"] == "ok"
+    finally:
+        sc.configure(preemption=True, gang=True)
+        obsexplain.configure(enabled=False)
+
+
+def test_hot_path_recompile_detected_warned_and_dump_throttled(tmp_path):
+    """A post-prewarm dispatch at an unseen signature is a defect: exactly
+    one hot_path event with the arg diff, /healthz WARNs, and ONE flight
+    dump (reason `recompile`) — further offenders inside the per-reason
+    throttle window are counted but not dumped, until the window reopens."""
+    clock = FakeClock()
+    obstrace.configure(enabled=True, recorder=FlightRecorder(
+        dir=str(tmp_path), clock=clock))
+
+    s = TPUSolver()
+    s.solve(_inp(40))
+    obstelemetry.mark_prewarm_done()
+    s.solve(_inp(40))  # identical bucket: silent
+    assert obstelemetry.stats["hot_path_compiles"] == 0
+    assert obstelemetry.health()["state"] == "ok"
+
+    s.solve(_inp(40, specs=20))  # bucket change post-prewarm: the defect
+    assert obstelemetry.stats["hot_path_compiles"] == 1
+    rec = obstelemetry.hot_path_records()[-1]
+    assert rec["kernel"] == "ffd_solve_ckpt" and rec["diff"], rec
+    health = obstelemetry.health()
+    assert health["state"] == "warn"
+    assert "hot_path_recompiles" in health["warnings"]
+    dumps = glob.glob(os.path.join(str(tmp_path), "*-recompile.json"))
+    assert len(dumps) == 1, dumps
+    with open(dumps[0]) as f:
+        payload = json.load(f)
+    # the dump carries the telemetry snapshot (ISSUE 14 satellite)
+    assert payload.get("telemetry"), list(payload)
+
+    # second offender while the recompile throttle window is closed: the
+    # event is recorded, the dump is suppressed
+    probe = obstelemetry.instrument("probe_throttle", _stub_kernel())
+    probe(np.zeros((3, 3), np.int32))
+    assert obstelemetry.stats["hot_path_compiles"] == 2
+    assert len(glob.glob(os.path.join(str(tmp_path), "*-recompile.json"))) == 1
+
+    clock.advance(61.0)  # reopen the 60s per-reason window
+    probe(np.zeros((4, 4), np.int32))
+    assert obstelemetry.stats["hot_path_compiles"] == 3
+    assert len(glob.glob(os.path.join(str(tmp_path), "*-recompile.json"))) == 2
+
+
+def test_instrument_is_idempotent_and_off_path_is_inert():
+    fn = _stub_kernel()
+    hook = obstelemetry.instrument("probe_inert", fn)
+    assert obstelemetry.instrument("probe_inert", hook) is hook
+    assert hook.__wrapped__ is fn  # vmap/introspection contract
+
+    obstelemetry.configure(enabled=False)
+    before = dict(obstelemetry.stats)
+    hook(np.zeros((2, 2), np.float32))
+    assert obstelemetry.stats == before  # no check, no compile recorded
+
+
+def test_prewarm_coverage_and_failures_warn():
+    obstelemetry.note_prewarm(4, 3)
+    obstelemetry.note_prewarm_failure("M=64,zone_engine=False",
+                                      RuntimeError("boom"))
+    health = obstelemetry.health()
+    assert health["state"] == "warn"
+    assert {"prewarm_coverage", "prewarm_failures"} <= set(health["warnings"])
+    assert health["prewarm"]["coverage"] == 0.75
+    assert health["prewarm"]["failures"] == 1
+
+
+# -- arena byte budget -------------------------------------------------------
+
+
+def test_arena_budget_evicts_cold_and_preserves_decisions():
+    """With the budget pinned to exactly one resident bucket, alternating
+    buckets forces evict + cold re-upload on every swap — decisions must
+    stay bit-identical to an unbudgeted control solver, accounted bytes
+    must never exceed the budget, and every eviction is counted."""
+    budgeted, control = TPUSolver(), TPUSolver()
+    a, b = _inp(40), _inp(40, specs=20)  # two distinct shape buckets
+
+    _assert_same(budgeted.solve(a), control.solve(a), "cold")
+    budget = budgeted.arena.total_bytes()
+    assert budget > 0
+    budgeted.arena.budget_bytes = budget
+
+    ev0 = budgeted.arena.stats["evictions"]
+    ctr0 = SOLVER_ARENA_EVICTIONS.value()
+    for tag, inp in (("bucket-b", b), ("back-to-a", a), ("b-again", b)):
+        _assert_same(budgeted.solve(inp), control.solve(inp), tag)
+        assert budgeted.arena.total_bytes() <= budget, tag
+    assert budgeted.arena.stats["evictions"] - ev0 >= 2
+    assert SOLVER_ARENA_EVICTIONS.value() - ctr0 >= 2
+    # the class breakdown is the accounting of record: it sums to the total
+    assert budgeted.arena.total_bytes() == sum(
+        budgeted.arena.bytes_by_class().values())
+    # the control solver was never evicted
+    assert control.arena.stats["evictions"] == 0
+
+
+# -- rolling-baseline anomaly engine -----------------------------------------
+
+
+def test_anomaly_trip_recover_and_dump_throttle(tmp_path):
+    """Fake-clock driven: `sustain` breaches trip the stage (counter + warn
+    + one perf_anomaly flight dump), `recover` clean observations clear it,
+    and a re-trip inside the per-stage dump interval is counted but not
+    dumped until the clock advances past it. Breach magnitudes escalate per
+    trip so the slow-adapting (alpha/8) baseline can never catch up."""
+    clock = FakeClock()
+    obstrace.configure(enabled=True, recorder=FlightRecorder(
+        dir=str(tmp_path), clock=clock))
+    obsanomaly.configure(multiplier=3.0, sustain=3, recover=4, min_samples=5,
+                         dump_interval_s=60.0, clock=clock)
+
+    def dumps():
+        return glob.glob(os.path.join(str(tmp_path), "*-perf_anomaly.json"))
+
+    for _ in range(10):  # warm-up: flat 10ms baseline
+        obsanomaly.observe("sched.solve", 0.010)
+    warm = obsanomaly.health()
+    assert warm["state"] == "ok"
+    assert not warm["stages"]["sched.solve"]["anomalous"]
+    assert warm["stages"]["sched.solve"]["samples"] == 10
+
+    trips0 = SOLVER_PERF_ANOMALIES.value(stage="sched.solve")
+    for _ in range(3):  # sustain=3 breaches -> trip
+        obsanomaly.observe("sched.solve", 1e3)
+    health = obsanomaly.health()
+    assert health["state"] == "warn"
+    assert health["stages"]["sched.solve"]["anomalous"]
+    assert health["stages"]["sched.solve"]["trips"] == 1
+    assert SOLVER_PERF_ANOMALIES.value(stage="sched.solve") - trips0 == 1
+    assert len(dumps()) == 1
+    with open(dumps()[0]) as f:
+        payload = json.load(f)
+    assert payload["tags"]["stage"] == "sched.solve"
+    assert payload["tags"]["observed_ms"] > payload["tags"]["baseline_ms"]
+
+    for _ in range(4):  # recover=4 clean observations
+        obsanomaly.observe("sched.solve", 0.010)
+    assert obsanomaly.health()["state"] == "ok"
+    assert not obsanomaly.health()["stages"]["sched.solve"]["anomalous"]
+
+    for _ in range(3):  # re-trip inside the 60s dump window: throttled
+        obsanomaly.observe("sched.solve", 1e6)
+    assert obsanomaly.health()["stages"]["sched.solve"]["trips"] == 2
+    assert len(dumps()) == 1
+
+    clock.advance(61.0)
+    for _ in range(4):
+        obsanomaly.observe("sched.solve", 0.010)
+    for _ in range(3):  # third trip, window reopened: second dump
+        obsanomaly.observe("sched.solve", 1e9)
+    assert obsanomaly.health()["stages"]["sched.solve"]["trips"] == 3
+    assert len(dumps()) == 2
+
+
+def test_anomaly_disabled_is_inert():
+    obsanomaly.configure(enabled=False)
+    for _ in range(50):
+        obsanomaly.observe("stage.x", 1e9)
+    assert obsanomaly.health() == {"state": "ok", "stages": {}}
+
+
+# -- telemetry ring / gauges / providers -------------------------------------
+
+
+def test_ring_sample_carries_gauges_events_and_providers():
+    obstelemetry.set_gauge("arena_bytes_total", 123.0)
+    obstelemetry.note_event("fleet_fence", owner="solver-1", reason="probe")
+    obstelemetry.register_provider("p", lambda: {"ok": True})
+    snap = obstelemetry.sample()
+    assert snap["gauges"]["arena_bytes_total"] == 123.0
+    assert snap["events"][-1]["event"] == "fleet_fence"
+    assert snap["events"][-1]["owner"] == "solver-1"
+    assert snap["providers"]["p"] == {"ok": True}
+    assert obstelemetry.recent_samples(1) == [snap]
+
+    # a broken provider is contained, never takes down the snapshot
+    obstelemetry.register_provider("bad", lambda: 1 / 0)
+    got = obstelemetry.provider_result("bad")
+    assert "error" in got and "ZeroDivisionError" in got["error"]
+    assert obstelemetry.provider_result("missing") is None
+
+
+def test_maybe_sample_throttles_on_injected_clock():
+    clock = FakeClock()
+    obstelemetry.configure(sample_interval_s=10.0, clock=clock)
+    obstelemetry.maybe_sample()
+    obstelemetry.maybe_sample()  # inside the interval: skipped
+    assert obstelemetry.stats["samples"] == 1
+    clock.advance(10.0)
+    obstelemetry.maybe_sample()
+    assert obstelemetry.stats["samples"] == 2
+
+
+# -- endpoints ---------------------------------------------------------------
+
+
+@pytest.fixture()
+def server():
+    srv = serve_endpoints(0, 0, enable_profiling=False)
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+def test_debug_vars_endpoint_matrix(server):
+    for _ in range(3):
+        obstelemetry.sample()
+    status, ctype, body = _get(server, "/debug/vars")
+    assert status == 200 and ctype == "application/json"
+    payload = json.loads(body)
+    assert "now" in payload and len(payload["samples"]) >= 3
+    assert payload["now"]["enabled"] is True
+
+    status, _, body = _get(server, "/debug/vars?window=2")
+    assert status == 200 and len(json.loads(body)["samples"]) == 2
+
+    status, _, body = _get(server, "/debug/vars?window=-3")
+    assert status == 200  # clamped to 1
+    assert len(json.loads(body)["samples"]) == 1
+
+    status, _, _ = _get(server, "/debug/vars?window=nope")
+    assert status == 400
+
+
+def test_healthz_worst_of_health_planes(server):
+    obstelemetry.register_provider("streaming", lambda: {"journal": {"lag": 0}})
+    status, _, body = _get(server, "/healthz")
+    payload = json.loads(body)
+    assert status == 200
+    assert payload["status"] == "ok"
+    assert payload["telemetry"]["state"] == "ok"
+    assert payload["anomaly"]["state"] == "ok"
+    assert payload["streaming"] == {"journal": {"lag": 0}}
+
+    # one hot-path recompile flips the worst-of status to warn
+    obstelemetry.mark_prewarm_done()
+    probe = obstelemetry.instrument("probe_hz", _stub_kernel())
+    probe(np.zeros((2, 2), np.int32))
+    payload = json.loads(_get(server, "/healthz")[2])
+    assert payload["status"] == "warn"
+    assert "hot_path_recompiles" in payload["telemetry"]["warnings"]

@@ -1,0 +1,205 @@
+#!/usr/bin/env python3
+"""Bench regression gate: diff two BENCH_rNN.json records, exit nonzero
+on regression.
+
+The bench harness (bench.py) appends one JSON record per run —
+`{"n": NN, "cmd": ..., "rc": ..., "tail": ..., "parsed": {...}}` — whose
+`parsed` object carries the headline metrics (kernel/warm/e2e p50/p99,
+upload/decode bytes, arrival_batches_per_sec, ...). This CLI is the
+first CI-able perf guardrail over them:
+
+    python tools/bench_gate.py --baseline BENCH_r03.json
+    python tools/bench_gate.py --baseline BENCH_r03.json --current run.json
+
+Rules (solver/SPEC.md "Telemetry semantics"):
+
+- only keys NUMERIC AND > 0 on BOTH sides compare — marker records
+  (`value: -1`, `backend_unavailable: true`, `parsed: null`) and keys
+  one side lacks are skipped with a note, never failed. A record from a
+  host without the accelerator toolchain therefore always gates clean.
+- direction is per key: names containing per_sec / rate / hit /
+  speedup / shrink / coverage are higher-is-better; everything else
+  (latencies, bytes, counts) is lower-is-better.
+- tolerance is per key (`TOLERANCES`, else a p99/first-call heuristic,
+  else `--default-tolerance`): regression means the current value is
+  outside baseline * (1 +/- tolerance) in the bad direction.
+
+Exit status: 0 = no regression (including "nothing comparable", which
+prints a warning — an empty gate must not masquerade as a green one
+silently), 1 = at least one regression, 2 = usage/IO error. Stdlib only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# metadata / bookkeeping keys that are never performance metrics
+SKIP_KEYS = {
+    "n", "rc", "vs_baseline", "backend_unavailable", "wall_time",
+    "unit", "metric", "reason", "cmd", "tail",
+}
+
+# per-key relative tolerances; anything absent falls through the
+# heuristic in tolerance_for(). Tail latencies get more slack than
+# medians; byte counters are near-deterministic and get less.
+TOLERANCES: Dict[str, float] = {
+    "solve_p99_50k_pods_x_700_types": 0.25,
+    "kernel_pipelined_ms": 0.20,
+    "link_roundtrip_ms": 0.25,
+    "e2e_p50_ms": 0.20,
+    "e2e_p99_ms": 0.30,
+    "config3_e2e_p50_ms": 0.25,
+    "config4_e2e_p50_ms": 0.25,
+    "upload_bytes_per_solve": 0.10,
+    "decode_bytes_per_solve": 0.10,
+    "arrival_batches_per_sec": 0.20,
+}
+
+HIGHER_BETTER_PAT = re.compile(
+    r"per_sec|_rate|rate_|hit|speedup|shrink|coverage")
+
+
+def tolerance_for(key: str, default: float) -> float:
+    if key in TOLERANCES:
+        return TOLERANCES[key]
+    if "p99" in key:
+        return 0.30
+    if "first_call" in key:  # cold-start compile time: wildly host-dependent
+        return 1.00
+    return default
+
+
+def higher_is_better(key: str) -> bool:
+    return bool(HIGHER_BETTER_PAT.search(key))
+
+
+def extract_metrics(record: object, prefix: str = "") -> Dict[str, float]:
+    """Flatten a bench record to {metric_name: value}. Understands the
+    `{"metric": name, "value": v}` convention (the pair collapses to one
+    entry under `name`) and recurses through `parsed`/nested dicts."""
+    out: Dict[str, float] = {}
+    if not isinstance(record, dict):
+        return out
+    named = record.get("metric")
+    if isinstance(named, str) and isinstance(
+            record.get("value"), (int, float)):
+        out[named] = float(record["value"])
+    for key, val in record.items():
+        if key in SKIP_KEYS or key == "value":
+            continue
+        if isinstance(val, bool):
+            continue
+        if isinstance(val, (int, float)):
+            out[prefix + key] = float(val)
+        elif isinstance(val, dict):
+            out.update(extract_metrics(
+                val, prefix="" if key == "parsed" else prefix + key + "."))
+    return out
+
+
+def newest_bench_record(root: str) -> Optional[str]:
+    """Highest-numbered BENCH_rNN.json under `root` (the repo convention:
+    the newest run has the highest NN)."""
+    paths = glob.glob(os.path.join(root, "BENCH_r*.json"))
+
+    def num(p: str) -> int:
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else -1
+
+    paths = [p for p in paths if num(p) >= 0]
+    return max(paths, key=num) if paths else None
+
+
+def compare(baseline: Dict[str, float], current: Dict[str, float],
+            default_tolerance: float) -> Tuple[List[dict], List[str]]:
+    """(rows, skipped): one row per comparable key, names of skipped ones."""
+    rows: List[dict] = []
+    skipped: List[str] = []
+    for key in sorted(set(baseline) | set(current)):
+        base = baseline.get(key)
+        cur = current.get(key)
+        if (base is None or cur is None or base <= 0 or cur <= 0):
+            skipped.append(key)
+            continue
+        tol = tolerance_for(key, default_tolerance)
+        hib = higher_is_better(key)
+        if hib:
+            limit = base * (1.0 - tol)
+            regressed = cur < limit
+        else:
+            limit = base * (1.0 + tol)
+            regressed = cur > limit
+        rows.append({
+            "key": key, "baseline": base, "current": cur,
+            "delta_pct": (cur - base) / base * 100.0,
+            "tolerance_pct": tol * 100.0,
+            "direction": "higher_better" if hib else "lower_better",
+            "regressed": regressed,
+        })
+    return rows, skipped
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_gate",
+        description="diff BENCH_rNN.json metrics; exit 1 on regression")
+    ap.add_argument("--baseline", required=True,
+                    help="baseline BENCH_rNN.json (the reference run)")
+    ap.add_argument("--current", default=None,
+                    help="run under test (default: newest BENCH_r*.json "
+                         "next to the baseline)")
+    ap.add_argument("--default-tolerance", type=float, default=0.20,
+                    help="relative tolerance for keys without a per-key "
+                         "entry (default 0.20)")
+    args = ap.parse_args(argv)
+    if args.default_tolerance < 0:
+        print("bench_gate: --default-tolerance must be >= 0", file=sys.stderr)
+        return 2
+    current_path = args.current
+    if current_path is None:
+        current_path = newest_bench_record(
+            os.path.dirname(os.path.abspath(args.baseline)))
+        if current_path is None:
+            print("bench_gate: no BENCH_r*.json found for --current",
+                  file=sys.stderr)
+            return 2
+    try:
+        with open(args.baseline) as f:
+            base_rec = json.load(f)
+        with open(current_path) as f:
+            cur_rec = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"bench_gate: {e}", file=sys.stderr)
+        return 2
+    rows, skipped = compare(
+        extract_metrics(base_rec), extract_metrics(cur_rec),
+        args.default_tolerance)
+    print(f"bench_gate: {os.path.basename(args.baseline)} -> "
+          f"{os.path.basename(current_path)}")
+    for r in rows:
+        mark = "REGRESSED" if r["regressed"] else "ok"
+        arrow = "^" if r["direction"] == "higher_better" else "v"
+        print(f"  [{mark:>9}] {r['key']:<36} {r['baseline']:>12.2f} -> "
+              f"{r['current']:>12.2f}  ({r['delta_pct']:+.1f}%, "
+              f"tol {r['tolerance_pct']:.0f}% {arrow})")
+    if skipped:
+        print(f"  skipped (missing/non-positive on a side): "
+              f"{', '.join(skipped)}")
+    bad = [r for r in rows if r["regressed"]]
+    if bad:
+        print(f"bench_gate: {len(bad)} regression(s)", file=sys.stderr)
+        return 1
+    if not rows:
+        # marker-only records (e.g. backend_unavailable) gate clean, loudly
+        print("bench_gate: WARNING — no comparable metrics; gate is vacuous")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
