@@ -1633,6 +1633,7 @@ def _tenant_pass(weights: dict, solves_per_tenant: int, num_pods: int,
         elapsed = time.monotonic() - t0
         dropped = mux.unresolved() + failed
         stats = mux.tenant_stats()
+        mux_stats = dict(mux.mux_stats)
     finally:
         mux.close()
     return {
@@ -1643,6 +1644,7 @@ def _tenant_pass(weights: dict, solves_per_tenant: int, num_pods: int,
         "dropped": dropped,
         "rejects": rejects,
         "stats": stats,
+        "mux": mux_stats,
     }
 
 
@@ -1690,6 +1692,11 @@ def _tenant_run(num_tenants: int = 8, solves_per_tenant: int = 10,
     )
     non_victim_p99 = sorted(v for tid, v in p99_cont.items() if tid != victim)
     victim_stats = cont["stats"][victim]
+    # cohort fusion (ISSUE 16): size/width of the contended pass's fused
+    # dispatches — the host-only proxy for "one launch serves many tenants"
+    cont_mux = cont.get("mux", {})
+    fused = int(cont_mux.get("cohort_dispatches", 0))
+    memb = int(cont_mux.get("cohort_members", 0))
     return {
         "tenant_count": num_tenants,
         "tenant_p99_ms": round(
@@ -1700,6 +1707,8 @@ def _tenant_run(num_tenants: int = 8, solves_per_tenant: int = 10,
             cont["completed"] / max(cont["elapsed_s"], 1e-9), 2
         ),
         "fairness_index": round(fairness, 3),
+        "cohort_size_mean": round(memb / max(1, fused), 2),
+        "fused_dispatches_total": fused,
         "noisy_neighbor_slowdown_x": round(slowdown, 2),
         "tenant_admission_rejects_total": cont["rejects"] + sum(
             s["rejected"] for s in cont["stats"].values()
@@ -1721,6 +1730,8 @@ def _tenant_metrics() -> dict:
             f"{out['aggregate_solves_per_sec']:.1f} solves/s — "
             f"p99={out['tenant_p99_ms']}ms "
             f"fairness={out['fairness_index']} "
+            f"cohort_mean={out['cohort_size_mean']} "
+            f"fused={out['fused_dispatches_total']} "
             f"noisy_neighbor={out['noisy_neighbor_slowdown_x']}x "
             f"victim_degraded={out['tenant_victim_degraded']} "
             f"dropped={out['tenant_dropped_solves']}",

@@ -559,6 +559,32 @@ TENANT_DEGRADED = REGISTRY.register(
         ("tenant",),
     )
 )
+SOLVER_COHORT_SIZE = REGISTRY.register(
+    Histogram(
+        "karpenter_solver_cohort_size",
+        "Members per fused cross-tenant cohort dispatch (tenancy.py WFQ "
+        "cohort picking): size 1 never lands here — a lone winner rides "
+        "the legacy single-head path",
+        buckets=(2, 3, 4, 6, 8, 12, 16),
+    )
+)
+SOLVER_FUSED_DISPATCHES = REGISTRY.register(
+    Counter(
+        "karpenter_solver_fused_dispatches_total",
+        "Cross-tenant cohort dispatches forwarded as ONE downstream unit "
+        "(>= 2 members; one kernel launch serves every fuse-compatible "
+        "member)",
+    )
+)
+SOLVER_COHORT_POISON_REPLAYS = REGISTRY.register(
+    Counter(
+        "karpenter_solver_cohort_poison_replays_total",
+        "Cohort members whose fused device path failed and replayed solo "
+        "on their OWN tenant's oracle lane (co-members kept their fused "
+        "results), per tenant",
+        ("tenant",),
+    )
+)
 
 PROBE_BATCH_SIZE = REGISTRY.register(
     Histogram(

@@ -274,6 +274,8 @@ def main(argv=None) -> int:
         solver_tenants=o.solver_tenants,
         tenant_weights=o.tenant_weights,
         tenant_max_queue_depth=o.tenant_max_queue_depth,
+        solver_cohort=o.solver_cohort,
+        solver_cohort_max=o.solver_cohort_max,
         solver_streaming=o.solver_streaming,
         streaming_epoch_every=o.streaming_epoch_every,
     )

@@ -96,6 +96,8 @@ def new_kwok_operator(
     solver_tenants: str = "",
     tenant_weights: str = "",
     tenant_max_queue_depth: int = 64,
+    solver_cohort: bool = True,
+    solver_cohort_max: int = 8,
     solver_streaming: bool = False,
     streaming_epoch_every: int = 64,
 ) -> Operator:
@@ -239,6 +241,8 @@ def new_kwok_operator(
             breaker_threshold=breaker_threshold,
             breaker_probe_s=breaker_probe_s,
             clock=clock,
+            cohort=solver_cohort,
+            cohort_max=solver_cohort_max,
         )
         solve_service = tenant_mux.view(registry.first().tenant_id)
     streaming = None

@@ -140,6 +140,12 @@ class Options:
     # per-tenant admission bound: open solve requests (queued + in flight)
     # above this raise TenantAdmissionReject instead of enqueueing
     tenant_max_queue_depth: int = 64
+    # cross-tenant fused cohort dispatch (SPEC.md "Cohort semantics"): the
+    # mux extends each WFQ winner into a same-quantum-bucket cohort that
+    # rides ONE kernel launch; off = byte-identical legacy single-head path
+    solver_cohort: bool = True
+    # cohort width cap (members per fused dispatch); validated fail-closed
+    solver_cohort_max: int = 8
     # streaming delta-solve (solver/streaming.py): the provisioner folds
     # ClusterJournal event batches into a resident incremental model and
     # assembles solve inputs from it (event-rate-proportional host cost),
@@ -313,6 +319,13 @@ def parse(argv: Optional[Sequence[str]] = None, cls=Options) -> Options:
             )
         except ValueError as e:
             raise SystemExit(f"refusing to start: {e}") from None
+    cohort_max = getattr(out, "solver_cohort_max", None)
+    if cohort_max is not None and int(cohort_max) < 1:
+        raise SystemExit(
+            "refusing to start: --solver-cohort-max must be >= 1 "
+            f"(got {cohort_max}); it caps members per fused cohort "
+            "dispatch (solver/tenancy.py)"
+        )
     fmt = getattr(out, "log_format", None)
     if fmt is not None and fmt not in ("text", "json"):
         raise SystemExit(
@@ -400,7 +413,7 @@ def parse(argv: Optional[Sequence[str]] = None, cls=Options) -> Options:
     for name in (
         "solver_device_decode", "solver_relax_ladder",
         "solver_preemption", "solver_gang", "solver_explain",
-        "solver_streaming", "telemetry",
+        "solver_streaming", "solver_cohort", "telemetry",
     ):
         if not hasattr(out, name):
             continue

@@ -77,23 +77,32 @@ def _mesh_token(mesh: Mesh) -> tuple:
     return tok
 
 
-def batched_solve(mesh: Mesh, batched_args: tuple, max_claims: int):
+def batched_solve(mesh: Mesh, batched_args: tuple, max_claims: int,
+                  zone_engine: bool = True):
     """vmap ffd_solve over a leading candidate axis, sharded across the mesh.
 
     `batched_args`: the positional ffd_solve arrays (order/arity defined by
     ffd.ARG_SPEC), each with a leading batch axis B divisible by the mesh
     size. Returns FFDOutput with leading batch axes, sharded the same way.
+
+    `zone_engine` mirrors ffd_solve's static of the same name (the cohort
+    dispatch passes the members' shared `enc.V > 0` so a fused lane runs the
+    exact kernel its solo dispatch would); it is part of the jit-cache key.
     """
     axis = mesh.axis_names[0]
     key = (
         _mesh_token(mesh),
         len(batched_args),
         int(max_claims),
+        bool(zone_engine),
     )
     ent = _JIT_CACHE.get(key)
     if ent is None:
         sharding = NamedSharding(mesh, P(axis))
-        fn = jax.vmap(functools.partial(ffd_solve.__wrapped__, max_claims=max_claims))
+        fn = jax.vmap(functools.partial(
+            ffd_solve.__wrapped__, max_claims=max_claims,
+            zone_engine=zone_engine,
+        ))
         jfn = jax.jit(
             fn, in_shardings=(sharding,) * len(batched_args), out_shardings=sharding
         )
@@ -104,6 +113,49 @@ def batched_solve(mesh: Mesh, batched_args: tuple, max_claims: int):
     return jfn(*placed)
 
 
+# Memoized jitted pad fn per (arity, target batch, per-arg shapes/dtypes):
+# the cohort dispatch pads every fused batch to its power-of-two bucket, so
+# without the cache each dispatch would re-trace a fresh concatenate per arg.
+_PAD_CACHE: dict = {}
+
+
+def pad_batch(batched_args: tuple, batch: int) -> tuple:
+    """Pad a batched args tuple to `batch` lanes by replicating the LAST
+    real member's lane on device.
+
+    This is the cached pad-member path `replicate_args` lacks: the inputs
+    are already device-resident (argument-arena buffers), and the pad lanes
+    are broadcast views of the last real row — zero host→device bytes, no
+    TransferLedger traffic. Decode discards the pad lanes (only real members
+    are fanned out), so their content only needs to be a valid solve, which
+    the replicated member trivially is."""
+    if not batched_args:
+        return tuple(batched_args)
+    b = int(batched_args[0].shape[0])
+    if b >= batch:
+        return tuple(batched_args)
+    key = (
+        len(batched_args),
+        int(batch),
+        tuple((tuple(a.shape), str(a.dtype)) for a in batched_args),
+    )
+    fn = _PAD_CACHE.get(key)
+    if fn is None:
+        pad = batch - b
+
+        def _pad(args):
+            return tuple(
+                jnp.concatenate(
+                    [a, jnp.broadcast_to(a[-1:], (pad,) + a.shape[1:])]
+                )
+                for a in args
+            )
+
+        fn = jax.jit(_pad)
+        _PAD_CACHE[key] = fn
+    return tuple(fn(tuple(batched_args)))
+
+
 def replicate_args(args: tuple, batch: int, sharding=None) -> tuple:
     """Tile single-solve args to a batch (test/dryrun helper).
 
@@ -111,7 +163,12 @@ def replicate_args(args: tuple, batch: int, sharding=None) -> tuple:
     `np.broadcast_to(...).copy()` materialized a full [B, ...] host copy
     per arg, an O(batch) host-memory blowup at width 64+. Device-resident
     inputs (argument-arena buffers) skip the upload entirely; pass a
-    NamedSharding to place the broadcast rows directly on a mesh."""
+    NamedSharding to place the broadcast rows directly on a mesh.
+
+    When the args are ALREADY batched and only pad lanes are needed (the
+    cohort dispatch rounding up to its batch bucket), use `pad_batch` — it
+    reuses the last real member's device buffers for the pad lanes instead
+    of broadcasting the full tuple."""
     out = []
     for a in args:
         base = jnp.asarray(a)

@@ -43,6 +43,7 @@ possibly-corrupt resident buffers (solver/SPEC.md "Transfer semantics").
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import threading
 from typing import Dict, List, Optional, Tuple
@@ -74,7 +75,29 @@ class TransferLedger:
 
     def __init__(self):
         self._lock = threading.Lock()
+        # thread-local per-tenant-meter suppression (see unmetered()):
+        # ledger counters always record; only the obs/slo attribution is
+        # conditionally skipped, and only on the suppressing thread
+        self._local = threading.local()
         self.reset()
+
+    @contextlib.contextmanager
+    def unmetered(self):
+        """Suppress the per-tenant usage-meter attribution (obs/slo
+        meter_bytes) for records made by THIS thread inside the block; the
+        ledger's own counters still record every byte. The cohort dispatch
+        uses this around its stacked-batch adopt: the fused upload is one
+        physical transfer whose bytes are then attributed per member
+        explicitly (each member pays its own rows), so the ambient-trace
+        attribution here would double-charge the lead tenant."""
+        self._local.unmetered = getattr(self._local, "unmetered", 0) + 1
+        try:
+            yield
+        finally:
+            self._local.unmetered -= 1
+
+    def _metering(self) -> bool:
+        return not getattr(self._local, "unmetered", 0)
 
     def reset(self) -> None:
         self.solves = 0
@@ -101,14 +124,16 @@ class TransferLedger:
                 self.total[k] += v
         # per-tenant usage ledger (obs/slo.py): attribute via the calling
         # thread's trace tenancy — uploads happen inside backend.upload
-        obsslo.meter_bytes(obstrace.current_tenant_id(), h2d=nbytes)
+        if self._metering():
+            obsslo.meter_bytes(obstrace.current_tenant_id(), h2d=nbytes)
 
     def record_fetch(self, nbytes: int, msgs: int = 1) -> None:
         with self._lock:
             for k, v in (("d2h_bytes", nbytes), ("d2h_msgs", msgs)):
                 self.solve[k] += v
                 self.total[k] += v
-        obsslo.meter_bytes(obstrace.current_tenant_id(), d2h=nbytes)
+        if self._metering():
+            obsslo.meter_bytes(obstrace.current_tenant_id(), d2h=nbytes)
 
     def record_adopt(self, outcome: str) -> None:
         # encode-cache hit class rides on the solve's span tree (the

@@ -15,6 +15,7 @@ from __future__ import annotations
 import abc
 import logging
 import threading as _threading
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
@@ -698,6 +699,12 @@ def _unpack_flat(flat: np.ndarray, shapes: dict) -> dict:
     return res
 
 
+class _CohortOverflow(Exception):
+    """Internal: a fused cohort lane saturated its claim bucket. The member
+    replays through its full solo path (which owns the M-doubling ladder);
+    co-members keep their fused results. Never escapes the backend."""
+
+
 class AsyncSolve:
     """Handle for an in-flight solve: the kernel is dispatched and the packed
     output is streaming to the host; result() blocks, decodes, and returns
@@ -754,7 +761,12 @@ class TPUSolver(Solver):
             "sharded_fallbacks": 0, "shard_resume_solves": 0,
             "shard_resume_runs_skipped": 0,
             "event_stage_hits": 0, "event_stage_misses": 0,
+            "fused_dispatches": 0, "fused_members": 0,
         }
+        # cohort dispatch mesh (solve_cohort_async): lazy like _shard_mesh,
+        # but over ALL visible devices — the fused batch axis buckets to a
+        # multiple of the device count, so any width divides evenly
+        self._cohort_mesh_cache: object = None
         # streaming run-table staging (solver/streaming.py, SPEC.md
         # "Streaming semantics"): when on, each device solve first tries to
         # sync the arena's resident run tables via an edit-triplet scatter
@@ -933,6 +945,294 @@ class TPUSolver(Solver):
             return out
 
         return AsyncSolve(finish)
+
+    # -- cross-tenant fused cohort dispatch (SPEC.md "Cohort semantics") -----
+
+    def _cohort_mesh(self):
+        if self._cohort_mesh_cache is None:
+            from ..parallel.sharded import make_mesh
+
+            self._cohort_mesh_cache = make_mesh(axis="cohort")
+        return self._cohort_mesh_cache
+
+    def _cohort_prep(self, inp: SolverInput):
+        """Probe one member's fuse eligibility WITHOUT dispatching. Returns
+        the prepared per-member state, or None when the member must ride its
+        exact solo path (relax plan, fallback gate, sharded solve, arg
+        overflow) — the caller re-submits it through solve_async so every
+        ineligible member keeps byte-identical solo semantics."""
+        qinp = quantize_input(inp)
+        from . import relax as rx
+
+        if rx.plan(qinp) is not None:
+            return None
+        with obstrace.span("backend.encode"):
+            enc = encode(qinp)
+        if (
+            enc.group_fallback.any()
+            or enc.has_topology
+            or enc.has_affinity
+            or enc.G == 0
+        ):
+            return None
+        if self.shards >= 2:
+            # the mesh-sharded run-axis solve partitions ONE solve across
+            # the mesh; it cannot also carry a cohort batch axis
+            return None
+        try:
+            host_args, dims, prov = host_kernel_args(enc, self._bucket)
+        except UnpackableInput:
+            return None
+        total_pods = int(sum(len(p) for p in enc.group_pods))
+        M0 = initial_claim_bucket(total_pods, self.max_claims)
+        # exact fuse key: identical padded shapes/dtypes (one compiled
+        # executable), same zone-engine static, same claim bucket — the
+        # mux's quantum-bucket heuristic is re-verified here, exactly
+        fkey = (
+            tuple((a.shape, a.dtype.str) for a in host_args),
+            bool(enc.V > 0),
+            M0,
+        )
+        return {
+            "inp": inp, "qinp": qinp, "enc": enc, "host_args": host_args,
+            "dims": dims, "total_pods": total_pods, "M0": M0, "fkey": fkey,
+        }
+
+    def solve_cohort_async(self, inps, traces=None):
+        """Fused cohort entry point: dispatch MANY tenants' solves as one
+        vmapped kernel launch (parallel/sharded.batched_solve over the
+        frozen ARG_SPEC), then fan the fused result out to per-member
+        decode. Returns finish() -> list aligned with `inps`, each element
+        a SolverResult or the Exception that member's path raised — one
+        poison member never fails its co-members.
+
+        Members whose exact fuse key (padded shapes + zone-engine static +
+        claim bucket) doesn't match any co-member — or whose input needs a
+        solo-only path (relax, fallback gate, sharding) — are re-submitted
+        through solve_async and keep byte-identical solo semantics. Each
+        fused member's decode/explain/metering path replicates its solo
+        dispatch exactly (parity pinned by tests/test_cohort.py)."""
+        n = len(inps)
+        traces = list(traces) if traces is not None else [None] * n
+        solo: dict = {}
+        preps: list = [None] * n
+        groups: "OrderedDict[tuple, list]" = OrderedDict()
+        for i, inp in enumerate(inps):
+            with obstrace.attached(traces[i]):
+                try:
+                    preps[i] = self._cohort_prep(inp)
+                except Exception as e:  # noqa: BLE001 — isolate per member
+                    solo[i] = e
+                    continue
+            if preps[i] is not None:
+                groups.setdefault(preps[i]["fkey"], []).append(i)
+        for fkey, idxs in list(groups.items()):
+            if len(idxs) < 2:
+                del groups[fkey]
+        fused_idx = {i for idxs in groups.values() for i in idxs}
+        for i in range(n):
+            if i in fused_idx or i in solo:
+                continue
+            with obstrace.attached(traces[i]):
+                try:
+                    solo[i] = self.solve_async(inps[i])
+                except Exception as e:  # noqa: BLE001 — isolate per member
+                    solo[i] = e
+        finishers = []
+        for idxs in groups.values():
+            try:
+                finishers.append(self._cohort_dispatch(idxs, preps, traces))
+            except Exception as e:  # noqa: BLE001 — a whole-dispatch
+                # failure (wedge-class chaos, OOM) is every MEMBER's error,
+                # like a fenced device; attribution stays per member upstream
+                finishers.append(lambda _e=e, _ix=tuple(idxs):
+                                 {i: _e for i in _ix})
+
+        def finish():
+            results: list = [None] * n
+            fused_results: dict = {}
+            for g in finishers:
+                fused_results.update(g())
+            for i in range(n):
+                if i in fused_results:
+                    results[i] = fused_results[i]
+                    continue
+                h = solo.get(i)
+                if isinstance(h, BaseException):
+                    results[i] = h
+                    continue
+                try:
+                    with obstrace.attached(traces[i]):
+                        results[i] = h.result()
+                except Exception as e:  # noqa: BLE001 — per-member outcome
+                    results[i] = e
+            return results
+
+        return finish
+
+    def _cohort_dispatch(self, idxs, preps, traces):
+        """One fused launch for `idxs` (all sharing a fuse key): stack the
+        36 host arrays member-major, adopt the stack under the shared
+        cohort residency namespace (each tenant's own `bucket_key ns=`
+        buckets stay authoritative for solo replays), pad to the batch
+        bucket with a replicated member, vmap-solve, and start each lane's
+        packed d2h copy. Returns finish() -> {index: outcome}."""
+        import jax
+
+        from ..parallel.sharded import batch_bucket, batched_solve, pad_batch
+
+        mesh = self._cohort_mesh()
+        n_real = len(idxs)
+        lead = preps[idxs[0]]
+        zone = lead["fkey"][1]
+        M0 = lead["M0"]
+        # power-of-two cohort bucket (bounded compile count per fuse key),
+        # rounded to a multiple of the mesh width
+        B = batch_bucket(1 << (n_real - 1).bit_length(), mesh, mult=1)
+        arity = len(lead["host_args"])
+        stacked = tuple(
+            np.stack([preps[i]["host_args"][j] for i in idxs])
+            for j in range(arity)
+        )
+        faults.check("solver.device_hang", tag=self.fault_tag)
+        faults.check("solver.device_lost", tag=self.fault_tag)
+        self.ledger.begin_solve()
+        with obstrace.attached(traces[idxs[0]]), \
+                obstrace.span("cohort.dispatch"):
+            obstrace.annotate(
+                cohort_size=n_real, cohort_batch=B,
+                member_solve_ids=",".join(
+                    (traces[i].solve_id if traces[i] is not None else "-")
+                    for i in idxs
+                ),
+            )
+            with obstrace.span("backend.upload"):
+                if self.arena is not None:
+                    faults.check("solver.arena_corrupt", tag=self.fault_tag)
+                    # suppress the ambient-trace tenant attribution: ONE
+                    # stacked upload serves every member, and each member
+                    # is billed its own rows explicitly below
+                    with self.ledger.unmetered():
+                        args = self.arena.adopt(
+                            stacked, (None,) * arity, ns="__cohort__"
+                        )
+                    stale = self.arena.last_stale
+                else:
+                    with self.ledger.unmetered():
+                        args = _device_args(
+                            stacked, (None,) * arity, ledger=self.ledger
+                        )
+                    stale = tuple(range(arity))
+            # per-member h2d metering parity: a member pays exactly the
+            # bytes its solo dispatch would have uploaded for the entries
+            # this adopt found stale (its own rows of the stacked arrays)
+            from ..obs import slo as obsslo
+
+            for i in idxs:
+                obsslo.meter_bytes(
+                    getattr(preps[i]["enc"], "tenant_id", None),
+                    h2d=sum(
+                        int(preps[i]["host_args"][j].nbytes) for j in stale
+                    ),
+                )
+            args = pad_batch(args, B)
+            faults.check("solver.device_dispatch")
+            with obstrace.span("backend.dispatch"):
+                out = batched_solve(mesh, args, max_claims=M0,
+                                    zone_engine=zone)
+        self.stats["fused_dispatches"] += 1
+        self.stats["fused_members"] += n_real
+        flats = []
+        for k, i in enumerate(idxs):
+            lane = jax.tree_util.tree_map(lambda a, _k=k: a[_k], out)
+            flat_dev, unpack = self._pack_dispatch(
+                lane, total_pods=preps[i]["total_pods"]
+            )
+            flats.append((i, lane, flat_dev, unpack))
+
+        def finish():
+            results: dict = {}
+            replays: list = []
+            try:
+                for i, lane, flat_dev, unpack in flats:
+                    prep = preps[i]
+                    with obstrace.attached(traces[i]):
+                        try:
+                            results[i] = self._cohort_lane_finish(
+                                prep, lane, flat_dev, unpack, M0
+                            )
+                        except _CohortOverflow:
+                            replays.append(i)
+                        except Exception as e:  # noqa: BLE001 — poison
+                            results[i] = e  # member: only ITS lane fails
+            finally:
+                self.ledger.end_solve()
+            for i in replays:
+                # claim-slot saturation at M0: the solo path owns the
+                # doubling ladder — replay the member whole (its tenant-ns
+                # arena buckets are still authoritative, so no extra state)
+                with obstrace.attached(traces[i]):
+                    obstrace.annotate(cohort_overflow_replay=True)
+                    try:
+                        results[i] = self.solve_async(preps[i]["inp"]).result()
+                    except Exception as e:  # noqa: BLE001 — per-member
+                        results[i] = e
+            return results
+
+        return finish
+
+    def _cohort_lane_finish(self, prep, lane, flat_dev, unpack, M0: int):
+        """Fetch + decode ONE fused lane — the solo finish() path minus
+        resume/checkpoint (fused lanes never resume; solo replays still do)
+        and minus the in-place overflow ladder (raises _CohortOverflow so
+        the caller replays the member through solve_async)."""
+        enc, dims, qinp = prep["enc"], prep["dims"], prep["qinp"]
+        S, E, T, G = dims["S"], dims["E"], dims["T"], dims["G"]
+        Z, C = dims["Z"], dims["C"]
+        with obstrace.span("backend.fetch"):
+            flat = np.asarray(flat_dev)
+            self.ledger.record_fetch(flat.nbytes)
+            f = unpack(flat)
+            used = int(f["used"])
+            if used >= M0:
+                raise _CohortOverflow()
+            obstrace.annotate(fetch_bytes=int(flat.nbytes),
+                              claim_bucket_final=M0)
+        faults.check("solver.decode", tag=enc.tenant_id)
+        with obstrace.span("backend.decode"):
+            c_mask = _unpack_words(f["c_mask_words"], T)
+            c_zone, c_ct = unpack_zc_bits(f["c_zc_bits"], Z, C)
+            c_gmask = _unpack_gmask(f["c_gbits"], G)
+            if "entries" in f:
+                Ep_ = f["Ep"]
+                entries_p = f["entries"]
+                leftover_p = f["leftover"][:S]
+                c_cum = _claim_cum_from_entries(
+                    enc, entries_p, f["c_pool"], Ep_, M0
+                )
+                res = decode_delta(enc, entries_p, leftover_p, E, Ep_,
+                                   c_mask, c_zone, c_ct, f["c_pool"],
+                                   c_gmask, c_cum, used)
+            else:
+                res = decode(enc, f["take_e"][:S][:, :E], f["take_c"][:S],
+                             f["leftover"][:S], c_mask, c_zone, c_ct,
+                             f["c_pool"], c_gmask, f["c_cum"], used)
+        if res is None or not min_values_post_check(qinp, res):
+            self.stats["fallback_solves"] += 1
+            return self.fallback.solve(qinp)
+        self.stats["device_solves"] += 1
+        SOLVER_SOLVES.inc(backend="device")
+        if obsexplain.enabled():
+            # same EXPLAIN contract as a cold solo dispatch: the side
+            # kernel runs over this lane's device-resident take table, so
+            # the captured table is bit-identical to the solo one
+            try:
+                tbl = self._device_explain(enc, lane)
+            except Exception:  # noqa: BLE001 — never fails a solve
+                log.exception("explain: cohort device table dispatch failed")
+                tbl = None
+            obsexplain.capture(qinp, res, "tpu", enc=enc, table=tbl)
+        return res
 
     def _relax_dispatch(self, qinp, items_map, order, dropped):
         """Materialize + encode + dispatch one relax iteration. Returns

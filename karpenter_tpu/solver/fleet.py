@@ -310,6 +310,44 @@ class SolverFleet:
         self._place(entry)
         return ticket
 
+    def submit_cohort(self, members) -> List[SolveTicket]:
+        """Cohort seam for the tenant mux: the whole cohort places on ONE
+        owner so the fused dispatch stays fused (each member dict carries
+        inp / kind / rev / tenant_id / trace). Every member becomes its own
+        _FleetEntry with its own fleet ticket — a fence re-routes survivors
+        individually through the ordinary requeue path, so a cohort never
+        re-fuses across a failover and per-member delivery guarantees are
+        exactly the solo ones."""
+        if not members:
+            return []
+        with self._lock:
+            if self._closing:
+                raise ServiceStopped("solver fleet is closed")
+        entries: List[_FleetEntry] = []
+        tickets: List[SolveTicket] = []
+        for m in members:
+            inp = m["inp"]
+            kind = m.get("kind", PROVISIONING)
+            rev = m.get("rev")
+            if rev is None:
+                rev = getattr(inp, "state_rev", None)
+            tenant_id = m.get("tenant_id")
+            if tenant_id is None:
+                tenant_id = getattr(inp, "tenant_id", None)
+            ticket = SolveTicket(kind, rev=rev, tenant_id=tenant_id)
+            entry = _FleetEntry(ticket, inp=inp, kind=kind, rev=rev,
+                                tenant_id=tenant_id)
+            with obstrace.attached(m.get("trace")):
+                _mint_fleet_trace(entry)
+            entries.append(entry)
+            tickets.append(ticket)
+        with self._lock:
+            for entry in entries:
+                self._open.add(entry)
+                self.fleet_stats["fleet_submitted"] += 1
+        self._place_cohort(entries)
+        return tickets
+
     # -- routing / re-routing -------------------------------------------------
 
     def _pick_owner(self, kind: str) -> Optional[FleetOwner]:
@@ -379,6 +417,58 @@ class SolverFleet:
                            self._on_owner_done(o, e, t))
             if requeued:
                 FLEET_REQUEUED.inc(target="owner")
+            return
+
+    def _place_cohort(self, entries: List[_FleetEntry]) -> None:
+        """Place a fused cohort on one owner via its submit_cohort seam.
+        No healthy owner → members degrade individually (oracle); an owner
+        without the seam → members place solo (correct, unfused)."""
+        while True:
+            owner = self._pick_owner(entries[0].kind)
+            if owner is None:
+                for entry in entries:
+                    self._degrade(entry)
+                return
+            sub = getattr(owner.service, "submit_cohort", None)
+            if sub is None:
+                for entry in entries:
+                    self._place(entry)
+                return
+            try:
+                ots = sub([
+                    dict(inp=e.inp, kind=e.kind, rev=e.rev,
+                         tenant_id=e.tenant_id, trace=e.trace)
+                    for e in entries
+                ])
+            except ServiceStopped:
+                continue  # owner fenced between pick and submit; re-pick
+            with self._lock:
+                fenced_after = owner.fenced
+                flushes: list = []
+                for e, ot in zip(entries, ots):
+                    if not fenced_after:
+                        e.owner = owner
+                        e.owner_ticket = ot
+                        owner.outstanding[ot] = e
+                    fl = [x for (x, by_ot) in self._superseded_waiting
+                          if by_ot is ot]
+                    if fl:
+                        self._superseded_waiting = [
+                            (x, by_ot)
+                            for (x, by_ot) in self._superseded_waiting
+                            if by_ot is not ot
+                        ]
+                        flushes.extend((x, e) for x in fl)
+            for stale, by in flushes:
+                self._resolve(stale, error=Superseded(by=by.ticket))
+            for e, ot in zip(entries, ots):
+                if fenced_after:
+                    ot.on_done(lambda t, o=owner, en=e:
+                               self._on_owner_done(o, en, t,
+                                                   force_reroute=True))
+                else:
+                    ot.on_done(lambda t, o=owner, en=e:
+                               self._on_owner_done(o, en, t))
             return
 
     def _degrade(self, entry: _FleetEntry) -> None:

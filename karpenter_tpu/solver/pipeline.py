@@ -144,16 +144,20 @@ class SolveTicket:
 
 
 class _Request:
-    __slots__ = ("ticket", "inp", "fn", "rev", "trace", "queue_span")
+    __slots__ = ("ticket", "inp", "fn", "rev", "trace", "queue_span", "cohort")
 
-    def __init__(self, ticket: SolveTicket, inp=None, fn=None, rev=None,
-                 trace=None, queue_span=None):
+    def __init__(self, ticket: Optional[SolveTicket], inp=None, fn=None,
+                 rev=None, trace=None, queue_span=None, cohort=None):
         self.ticket = ticket
         self.inp = inp
         self.fn = fn  # generic device work: fn() dispatches, returns finish()
         self.rev = rev
         self.trace = trace  # obs.trace.Trace carried across both workers
         self.queue_span = queue_span  # started at submit, ended at dispatch pop
+        # fused cohort unit (submit_cohort): list of member _Requests that
+        # dispatch as ONE device launch; the unit itself has ticket=None and
+        # its members' tickets resolve individually at decode
+        self.cohort = cohort
 
 
 def _mint_trace(ticket: SolveTicket, kind: str):
@@ -244,19 +248,7 @@ class SolveService:
             # an owned trace into the active set (its ticket never delivers)
             tr, qspan = _mint_trace(ticket, kind)
             if kind == PROVISIONING:
-                q = self._pending[PROVISIONING]
-                keep: deque = deque()
-                while q:
-                    stale = q.popleft()
-                    if stale.ticket.tenant_id != tenant_id:
-                        keep.append(stale)
-                        continue
-                    self.stats["coalesced"] += 1
-                    SOLVE_COALESCED.inc(kind=kind)
-                    if stale.queue_span is not None:
-                        stale.queue_span.end("superseded")
-                    stale.ticket._deliver(error=Superseded(by=ticket))
-                q.extend(keep)
+                self._coalesce_locked(tenant_id, ticket)
             self._pending[kind].append(
                 _Request(ticket, inp=inp, rev=rev, trace=tr, queue_span=qspan)
             )
@@ -283,6 +275,84 @@ class SolveService:
             self.stats["submitted"] += 1
             self._cv.notify_all()
         return ticket
+
+    def submit_cohort(self, members) -> list:
+        """Queue a fused cohort: ONE device dispatch serves every member
+        (the tenant mux gathered them under the WFQ prefix rule; the
+        backend's solve_cohort_async fuses the launch — SPEC.md "Cohort
+        semantics"). Each member dict carries inp / kind / rev / tenant_id /
+        trace; one SolveTicket per member is returned, in order, and each
+        resolves individually at decode. Same-tenant provisioning
+        coalescing applies per member — a member's newer snapshot
+        supersedes queued requests exactly as a solo submit would,
+        including members of cohort units still queued."""
+        if not members:
+            return []
+        tickets: list = []
+        with self._cv:
+            if self._stopped:
+                raise ServiceStopped("solve service is closed")
+            reqs: list = []
+            for m in members:
+                inp = m["inp"]
+                kind = m.get("kind", PROVISIONING)
+                rev = m.get("rev")
+                if rev is None:
+                    rev = getattr(inp, "state_rev", None)
+                tenant_id = m.get("tenant_id")
+                if tenant_id is None:
+                    tenant_id = getattr(inp, "tenant_id", None)
+                ticket = SolveTicket(kind, rev=rev, tenant_id=tenant_id)
+                # adopt each member's own trace (minted by the mux), not the
+                # submitting thread's ambient one — per-member span trees
+                # must root and close independently of the fused dispatch
+                with obstrace.attached(m.get("trace")):
+                    tr, qspan = _mint_trace(ticket, kind)
+                if kind == PROVISIONING:
+                    self._coalesce_locked(tenant_id, ticket)
+                reqs.append(
+                    _Request(ticket, inp=inp, rev=rev, trace=tr,
+                             queue_span=qspan)
+                )
+                self.stats["submitted"] += 1
+                tickets.append(ticket)
+            self._pending[reqs[0].ticket.kind].append(
+                _Request(None, trace=reqs[0].trace, cohort=reqs)
+            )
+            self._cv.notify_all()
+        return tickets
+
+    def _supersede_locked(self, stale: _Request, ticket: SolveTicket) -> None:
+        self.stats["coalesced"] += 1
+        SOLVE_COALESCED.inc(kind=PROVISIONING)
+        if stale.queue_span is not None:
+            stale.queue_span.end("superseded")
+        stale.ticket._deliver(error=Superseded(by=ticket))
+
+    def _coalesce_locked(self, tenant_id, ticket: SolveTicket) -> None:
+        """Supersede every provisioning request still queued for this
+        tenant — plain requests AND members inside queued cohort units (a
+        unit emptied of all its members is dropped from the queue whole)."""
+        q = self._pending[PROVISIONING]
+        keep: deque = deque()
+        while q:
+            stale = q.popleft()
+            if stale.cohort is not None:
+                live = []
+                for m in stale.cohort:
+                    if m.ticket.tenant_id != tenant_id:
+                        live.append(m)
+                        continue
+                    self._supersede_locked(m, ticket)
+                stale.cohort = live
+                if live:
+                    keep.append(stale)
+                continue
+            if stale.ticket.tenant_id != tenant_id:
+                keep.append(stale)
+                continue
+            self._supersede_locked(stale, ticket)
+        q.extend(keep)
 
     # -- introspection -------------------------------------------------------
 
@@ -408,12 +478,14 @@ class SolveService:
             for q in self._pending.values():
                 while q:
                     req = q.popleft()
-                    if req.queue_span is not None:
-                        req.queue_span.end("stopped")
-                    if req.ticket._deliver(error=ServiceStopped(
-                        "solve service stopped before this request dispatched"
-                    )):
-                        self.stats["failed"] += 1
+                    for m in (req.cohort if req.cohort is not None else (req,)):
+                        if m.queue_span is not None:
+                            m.queue_span.end("stopped")
+                        if m.ticket._deliver(error=ServiceStopped(
+                            "solve service stopped before this request"
+                            " dispatched"
+                        )):
+                            self.stats["failed"] += 1
             self._cv.notify_all()
         for t in (self._dispatcher, self._decoder):
             t.join(timeout=drain_s)
@@ -470,33 +542,46 @@ class SolveService:
                     return
                 req = self._next_request_locked()
                 self._dispatching += 1
-                self._active.add(req.ticket)
-            if req.queue_span is not None:
-                req.queue_span.end()
+                if req.cohort is not None:
+                    for m in req.cohort:
+                        self._active.add(m.ticket)
+                else:
+                    self._active.add(req.ticket)
+            for m in (req.cohort if req.cohort is not None else (req,)):
+                if m.queue_span is not None:
+                    m.queue_span.end()
             # encode + dispatch OUTSIDE the lock: this is the stage-1 host
             # work that overlaps stage-2 device compute and stage-3 decode
             try:
-                with obstrace.attached(req.trace), \
-                        obstrace.span("pipeline.dispatch"):
-                    if req.fn is not None:
-                        finish = req.fn()
-                    else:
-                        solve_async = getattr(self.solver, "solve_async", None)
-                        if solve_async is not None:
-                            finish = solve_async(req.inp).result
+                if req.cohort is not None:
+                    finish = self._dispatch_cohort(req)
+                else:
+                    with obstrace.attached(req.trace), \
+                            obstrace.span("pipeline.dispatch"):
+                        if req.fn is not None:
+                            finish = req.fn()
                         else:
-                            # backend without an async seam (reference
-                            # oracle): the whole solve runs at decode, stage
-                            # overlap degrades gracefully to FIFO
-                            inp = req.inp
-                            finish = lambda _inp=inp: self.solver.solve(_inp)
+                            solve_async = getattr(
+                                self.solver, "solve_async", None
+                            )
+                            if solve_async is not None:
+                                finish = solve_async(req.inp).result
+                            else:
+                                # backend without an async seam (reference
+                                # oracle): the whole solve runs at decode,
+                                # stage overlap degrades gracefully to FIFO
+                                inp = req.inp
+                                finish = lambda _inp=inp: self.solver.solve(_inp)
             except BaseException as e:  # noqa: BLE001 — delivered to caller
+                members = req.cohort if req.cohort is not None else (req,)
                 with self._cv:
-                    self.stats["failed"] += 1
+                    self.stats["failed"] += len(members)
                     self._dispatching -= 1
-                    self._active.discard(req.ticket)
+                    for m in members:
+                        self._active.discard(m.ticket)
                     self._cv.notify_all()
-                req.ticket._deliver(error=e)
+                for m in members:
+                    m.ticket._deliver(error=e)
                 continue
             with self._cv:
                 self.stats["dispatched"] += 1
@@ -509,6 +594,47 @@ class SolveService:
             # is the one thread guaranteed to run while solves flow, so it
             # carries the throttled sampler (off the lock; never raises)
             obstelemetry.maybe_sample()
+
+    def _dispatch_cohort(self, unit: _Request):
+        """Stage-1 for a fused unit: one solve_cohort_async call covers
+        every member; the returned finish() yields member-aligned outcomes
+        (result or exception). A backend without the cohort seam degrades
+        to per-member solo dispatches that still share this one pipeline
+        slot — correctness is identical, only the fusion win is lost."""
+        members = unit.cohort
+        inps = [m.inp for m in members]
+        traces = [m.trace for m in members]
+        with obstrace.attached(unit.trace), obstrace.span("pipeline.dispatch"):
+            obstrace.annotate(cohort=len(members))
+            sc = getattr(self.solver, "solve_cohort_async", None)
+            if sc is not None:
+                return sc(inps, traces=traces)
+        handles: list = []
+        solve_async = getattr(self.solver, "solve_async", None)
+        for m in members:
+            with obstrace.attached(m.trace), obstrace.span("pipeline.dispatch"):
+                try:
+                    if solve_async is not None:
+                        handles.append(solve_async(m.inp).result)
+                    else:
+                        handles.append(lambda _inp=m.inp: self.solver.solve(_inp))
+                except Exception as e:  # noqa: BLE001 — per-member outcome
+                    handles.append(e)
+
+        def finish():
+            out: list = []
+            for m, h in zip(members, handles):
+                if isinstance(h, BaseException):
+                    out.append(h)
+                    continue
+                try:
+                    with obstrace.attached(m.trace):
+                        out.append(h())
+                except Exception as e:  # noqa: BLE001 — per-member outcome
+                    out.append(e)
+            return out
+
+        return finish
 
     def _next_peek_locked(self) -> Optional[str]:
         for kind in (PROVISIONING, DISRUPTION):
@@ -531,6 +657,9 @@ class SolveService:
                 self._decoding += 1
                 SOLVE_PIPELINE_DEPTH.set(len(self._inflight))
                 self._cv.notify_all()  # a dispatch slot just freed
+            if req.cohort is not None:
+                self._decode_cohort(req, finish)
+                continue
             try:
                 with obstrace.attached(req.trace), \
                         obstrace.span("pipeline.decode"):
@@ -548,3 +677,35 @@ class SolveService:
                 self._active.discard(req.ticket)
                 self._mark_idle_locked()
                 self._cv.notify_all()
+
+    def _decode_cohort(self, req: _Request, finish) -> None:
+        """Stage-3 for a fused unit: finish() returns member-aligned
+        outcomes; each member's ticket resolves individually (a member's
+        failure — poison replay exhausted, decode fault — never taints its
+        co-members' results)."""
+        members = req.cohort
+        try:
+            with obstrace.attached(req.trace), \
+                    obstrace.span("pipeline.decode"):
+                outcomes = finish()
+        except BaseException as e:  # noqa: BLE001 — delivered to callers
+            outcomes = [e] * len(members)
+        if not isinstance(outcomes, (list, tuple)) \
+                or len(outcomes) != len(members):
+            err = RuntimeError("cohort finish returned misaligned outcomes")
+            outcomes = [err] * len(members)
+        for m, oc in zip(members, outcomes):
+            if isinstance(oc, BaseException):
+                with self._cv:
+                    self.stats["failed"] += 1
+                m.ticket._deliver(error=oc)
+            else:
+                with self._cv:
+                    self.stats["completed"] += 1
+                m.ticket._deliver(result=oc)
+        with self._cv:
+            self._decoding -= 1
+            for m in members:
+                self._active.discard(m.ticket)
+            self._mark_idle_locked()
+            self._cv.notify_all()

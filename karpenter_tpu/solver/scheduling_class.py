@@ -333,6 +333,53 @@ class ClassAwareSolver:
 
         return _Deferred(run)
 
+    def solve_cohort_async(self, inps, traces=None):
+        """Cohort seam: engaged members (gang/priority semantics) run the
+        class path — they cannot fuse, their solve is a multi-round plan —
+        while the flat remainder rides the inner backend's fused cohort
+        entry point. Outcome list order matches `inps`."""
+        n = len(inps)
+        traces = list(traces) if traces is not None else [None] * n
+        inner_sc = getattr(self.inner, "solve_cohort_async", None)
+        engaged = [i for i in range(n) if self._engaged(inps[i])]
+        handles: dict = {}
+        for i in engaged:
+            with obstrace.attached(traces[i]):
+                try:
+                    handles[i] = self.solve_async(inps[i])
+                except Exception as e:  # noqa: BLE001 — per-member outcome
+                    handles[i] = e
+        flat = [i for i in range(n) if i not in handles]
+        flat_fin = None
+        if flat and inner_sc is not None:
+            flat_fin = inner_sc([inps[i] for i in flat],
+                                traces=[traces[i] for i in flat])
+        elif flat:
+            for i in flat:
+                with obstrace.attached(traces[i]):
+                    try:
+                        handles[i] = self.solve_async(inps[i])
+                    except Exception as e:  # noqa: BLE001
+                        handles[i] = e
+
+        def finish():
+            results: list = [None] * n
+            if flat_fin is not None:
+                for i, oc in zip(flat, flat_fin()):
+                    results[i] = oc
+            for i, h in handles.items():
+                if isinstance(h, BaseException):
+                    results[i] = h
+                    continue
+                try:
+                    with obstrace.attached(traces[i]):
+                        results[i] = h.result()
+                except Exception as e:  # noqa: BLE001 — per-member outcome
+                    results[i] = e
+            return results
+
+        return finish
+
     # -- class passes --------------------------------------------------------
 
     def _decline(self, reason: str) -> None:

@@ -55,6 +55,9 @@ from collections import OrderedDict, deque
 from typing import Callable, Dict, List, Optional
 
 from ..metrics.registry import (
+    SOLVER_COHORT_POISON_REPLAYS,
+    SOLVER_COHORT_SIZE,
+    SOLVER_FUSED_DISPATCHES,
     TENANT_ADMISSION_REJECTS,
     TENANT_BREAKER_STATE,
     TENANT_DEGRADED,
@@ -215,9 +218,40 @@ class _TenantBreaker(CircuitBreaker):
                       failures=failures, threshold=self.threshold)
 
 
+def quantum_bucket(inp) -> tuple:
+    """Cheap fusion-eligibility key for a queued SolverInput: heads whose
+    padded kernel shapes could match share a bucket. The backend re-checks
+    the EXACT padded arg shapes before fusing (backend._cohort_prep's fuse
+    key), so this key only has to avoid gathering heads that can never
+    fuse — it rounds each population up to a coarse granularity rather
+    than reproducing the encode layer's bucketing."""
+
+    def up(n: int, m: int) -> int:
+        return ((int(n) + m - 1) // m) * m if n else 0
+
+    return (
+        up(len(getattr(inp, "pods", ()) or ()), 16),
+        up(len(getattr(inp, "nodes", ()) or ()), 16),
+        up(len(getattr(inp, "nodepools", ()) or ()), 4),
+        len(getattr(inp, "zones", ()) or ()),
+    )
+
+
+class _CohortSlot:
+    """One downstream slot shared by every member of a fused cohort: the
+    slot frees when the LAST member resolves (or lane-routes away), so a
+    fused dispatch occupies exactly the pipeline depth one solo dispatch
+    would — that is the whole throughput win."""
+
+    __slots__ = ("pending",)
+
+    def __init__(self, pending: int):
+        self.pending = pending
+
+
 class _MuxRequest:
     __slots__ = ("ticket", "inp", "fn", "kind", "rev", "trace", "qspan",
-                 "t0", "slotted", "vtag")
+                 "t0", "slotted", "vtag", "qkey", "cslot", "fused")
 
     def __init__(self, ticket: SolveTicket, inp=None, fn=None,
                  kind: str = PROVISIONING, rev=None, trace=None,
@@ -236,6 +270,9 @@ class _MuxRequest:
         # advancing virtual clock every scan would inflate a backlogged
         # light tenant's tag in lockstep with a heavy tenant's and starve it
         self.vtag: Optional[float] = None
+        self.qkey: Optional[tuple] = None  # quantum_bucket(inp); None for fns
+        self.cslot: Optional[_CohortSlot] = None  # shared slot when fused
+        self.fused = False  # dispatched as a cohort member (metrics tag)
 
 
 class _TenantState:
@@ -272,9 +309,18 @@ class TenantMux:
                  breaker_threshold: int = 3,
                  breaker_probe_s: float = 30.0,
                  clock=time.monotonic,
-                 own_service: bool = True):
+                 own_service: bool = True,
+                 cohort: bool = True,
+                 cohort_max: int = 8):
         if not len(registry):
             raise ValueError("TenantMux needs at least one registered tenant")
+        # fail-closed: a nonsensical cohort width is a config error, not a
+        # silent fall-back to solo dispatch
+        if int(cohort_max) < 1:
+            raise ValueError(
+                f"cohort_max must be >= 1, got {cohort_max}"
+            )
+        self._cohort_max = int(cohort_max) if cohort else 1
         self._service = service
         self.registry = registry
         self._clock = clock
@@ -310,6 +356,8 @@ class TenantMux:
             "degraded": 0,
             "rejected": 0,
             "mux_coalesced": 0,
+            "cohort_dispatches": 0,
+            "cohort_members": 0,
         }
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True, name="tenant-mux-dispatch"
@@ -375,6 +423,7 @@ class TenantMux:
             tr, qspan = self._mint_trace(ticket, kind)
             req = _MuxRequest(ticket, inp=inp, kind=kind, rev=rev, trace=tr,
                               qspan=qspan, t0=self._clock())
+            req.qkey = quantum_bucket(inp)
             if kind == PROVISIONING:
                 keep: deque = deque()
                 while state.queue:
@@ -427,11 +476,14 @@ class TenantMux:
     # -- WFQ dispatch --------------------------------------------------------
 
     def _pick_locked(self):
-        """Pop the next dispatchable request under the mux lock: the
+        """Pop the next dispatchable request(s) under the mux lock: the
         backlogged tenant with the smallest virtual finish whose path can
         act now (device path needs a downstream slot; the degrade path only
         needs its lane). Degraded heads route to the oracle lane in-line
-        and selection repeats. Returns (state, req) to forward, or None."""
+        and selection repeats. With cohorting on, a device-path winner is
+        extended into a fused cohort by continuing the SAME winner
+        simulation (_gather_cohort_locked). Returns a non-empty list of
+        (state, req) to forward together, or None."""
         while True:
             slot_free = self._inflight < self.max_inflight
             best = None
@@ -473,24 +525,86 @@ class TenantMux:
             state.vfinish = req.vtag
             self._vtime = max(self._vtime,
                               req.vtag - 1.0 / state.spec.weight)
+            picked = [(state, req)]
+            if self._cohort_max > 1 and req.inp is not None:
+                self._gather_cohort_locked(picked, req.qkey)
+            # the whole cohort consumes ONE downstream slot; a lone winner
+            # keeps the legacy per-request slot accounting byte-identical
             self._inflight += 1
-            req.slotted = True
-            if req.qspan is not None:
-                req.qspan.end()
-            return state, req
+            if len(picked) == 1:
+                req.slotted = True
+            else:
+                cslot = _CohortSlot(len(picked))
+                for _, r in picked:
+                    r.cslot = cslot
+                    r.fused = True
+            for _, r in picked:
+                if r.qspan is not None:
+                    r.qspan.end()
+            return picked
+
+    def _gather_cohort_locked(self, picked: list, qkey) -> None:
+        """Extend a WFQ winner into a fused cohort (SPEC.md "Cohort
+        semantics"): keep simulating the legacy scan — repeatedly take the
+        next smallest-virtual-finish head — and STOP at the first winner
+        that cannot ride the same fused dispatch (a tenant already in the
+        cohort, a device-bound closure, or a different quantum bucket).
+        The dispatch sequence is therefore exactly the legacy order, just
+        grouped into one launch, and virtual tags advance per MEMBER —
+        never per dispatch — so fusing cannot distort fairness. A
+        breaker-open winner was never going to the device: it lane-routes
+        (free from the pool's view) and gathering continues past it."""
+        in_cohort = {id(s) for s, _ in picked}
+        while len(picked) < self._cohort_max:
+            best = None
+            for idx, state in enumerate(self._tenants.values()):
+                if not state.queue:
+                    continue
+                head = state.queue[0]
+                if head.vtag is None:
+                    head.vtag = (max(self._vtime, state.vfinish)
+                                 + 1.0 / state.spec.weight)
+                if best is None or (head.vtag, idx) < (best[0], best[1]):
+                    best = (head.vtag, idx, state)
+            if best is None:
+                return
+            _, _, state = best
+            head = state.queue[0]
+            if id(state) in in_cohort or head.inp is None \
+                    or head.qkey != qkey:
+                return  # prefix rule: first non-fusable winner ends the scan
+            if not (state.breaker.peek_allow() and state.breaker.allow()):
+                req = state.queue.popleft()
+                TENANT_QUEUE_DEPTH.set(len(state.queue),
+                                       tenant=state.spec.tenant_id)
+                if req.qspan is not None:
+                    req.qspan.end("degraded")
+                self._lane_put_locked(state, req)
+                continue
+            req = state.queue.popleft()
+            TENANT_QUEUE_DEPTH.set(len(state.queue),
+                                   tenant=state.spec.tenant_id)
+            state.vfinish = req.vtag
+            self._vtime = max(self._vtime,
+                              req.vtag - 1.0 / state.spec.weight)
+            in_cohort.add(id(state))
+            picked.append((state, req))
 
     def _dispatch_loop(self) -> None:
         while True:
             with self._cv:
-                job = self._pick_locked()
-                while job is None:
+                jobs = self._pick_locked()
+                while jobs is None:
                     if self._closing:
                         return
                     self._cv.wait()
-                    job = self._pick_locked()
+                    jobs = self._pick_locked()
             # forward OUTSIDE the lock: service.submit runs coalescing
             # callbacks (and, fully degraded, even oracle solves) inline
-            self._forward(*job)
+            if len(jobs) == 1:
+                self._forward(*jobs[0])
+            else:
+                self._forward_cohort(jobs)
 
     def _forward(self, state: _TenantState, req: _MuxRequest) -> None:
         tid = state.spec.tenant_id
@@ -529,6 +643,67 @@ class TenantMux:
             lambda t, s=state, r=req: self._on_downstream_done(s, r, t)
         )
 
+    def _forward_cohort(self, jobs: list) -> None:
+        """Forward a fused cohort downstream as ONE dispatch. A downstream
+        without the cohort seam falls back to per-member solo forwards
+        (the shared cohort slot converts to per-member slots in place, so
+        accounting stays exact). Failure attribution is per member: a
+        whole-dispatch error charges each member's own breaker and replays
+        each on its own oracle lane, exactly as a solo failure would."""
+        sub = getattr(self._service, "submit_cohort", None)
+        if sub is None:
+            with self._cv:
+                for k, (_, r) in enumerate(jobs):
+                    r.cslot = None
+                    r.fused = False
+                    r.slotted = True
+                    if k > 0:
+                        self._inflight += 1
+            for state, req in jobs:
+                self._forward(state, req)
+            return
+        members = [
+            dict(inp=r.inp, kind=r.kind, rev=r.rev,
+                 tenant_id=s.spec.tenant_id, trace=r.trace)
+            for s, r in jobs
+        ]
+        try:
+            dtickets = sub(members)
+        except ServiceStopped as e:
+            for state, req in jobs:
+                self._finish(state, req, error=e)
+            return
+        except Exception as e:  # noqa: BLE001 — isolate: charge + degrade
+            for state, req in jobs:
+                self._on_device_failure(state, req, e)
+            return
+        SOLVER_FUSED_DISPATCHES.inc()
+        SOLVER_COHORT_SIZE.observe(float(len(jobs)))
+        with self._cv:
+            self.mux_stats["forwarded"] += len(jobs)
+            self.mux_stats["cohort_dispatches"] += 1
+            self.mux_stats["cohort_members"] += len(jobs)
+            flushes = []
+            for (_, req), dt in zip(jobs, dtickets):
+                self._fwd[dt] = req
+                fl = [(s2, r2) for (s2, r2, by) in self._superseded_waiting
+                      if by is dt]
+                if fl:
+                    self._superseded_waiting = [
+                        (s2, r2, by)
+                        for (s2, r2, by) in self._superseded_waiting
+                        if by is not dt
+                    ]
+                    flushes.extend(
+                        (s2, r2, req.ticket) for (s2, r2) in fl
+                    )
+        for s2, r2, by_ticket in flushes:
+            self._finish(s2, r2, error=Superseded(by=by_ticket))
+        for (state, req), dt in zip(jobs, dtickets):
+            dt.on_done(
+                lambda t, s=state, r=req: self._on_downstream_done(s, r, t)
+            )
+
     def _on_downstream_done(self, state: _TenantState, req: _MuxRequest,
                             dticket: SolveTicket) -> None:
         with self._cv:
@@ -563,8 +738,12 @@ class TenantMux:
                            err: BaseException) -> None:
         """Charge THIS tenant's breaker; replay inputs on THIS tenant's
         oracle rung (the solve still lands — poison degrades, never drops);
-        closures surface the failure verbatim."""
+        closures surface the failure verbatim. A failed COHORT member
+        charges only its own breaker and replays solo — co-members keep
+        their fused results untouched."""
         state.breaker.record_failure()
+        if req.fused and req.inp is not None:
+            SOLVER_COHORT_POISON_REPLAYS.inc(tenant=state.spec.tenant_id)
         if req.inp is None:
             self._finish(state, req, error=err)
             return
@@ -580,10 +759,21 @@ class TenantMux:
 
     # -- per-tenant oracle lane ----------------------------------------------
 
-    def _lane_put_locked(self, state: _TenantState, req: _MuxRequest) -> None:
-        if req.slotted:
+    def _release_slot_locked(self, req: _MuxRequest) -> None:
+        """Release whatever downstream slot this request holds: a fused
+        member releases its share of the cohort slot (the slot itself
+        frees with the LAST member); a solo request releases its own."""
+        if req.cslot is not None:
+            cs, req.cslot = req.cslot, None
+            cs.pending -= 1
+            if cs.pending == 0:
+                self._inflight -= 1
+        elif req.slotted:
             req.slotted = False
             self._inflight -= 1
+
+    def _lane_put_locked(self, state: _TenantState, req: _MuxRequest) -> None:
+        self._release_slot_locked(req)
         if req.inp is None:
             # device-bound closure with an open breaker: cannot replay —
             # mirror the fleet's no-healthy-owner contract
@@ -649,9 +839,7 @@ class TenantMux:
         if req in self._open:
             self._open.discard(req)
             state.open_count = max(0, state.open_count - 1)
-        if req.slotted:
-            req.slotted = False
-            self._inflight -= 1
+        self._release_slot_locked(req)
         if not delivered:
             return
         if error is None:

@@ -1,0 +1,393 @@
+"""Cross-tenant fused cohort dispatch (SPEC.md "Cohort semantics").
+
+The mux extends each WFQ winner into a same-quantum-bucket cohort that the
+backend serves with ONE kernel launch; these tests pin the contract: the
+grouped dispatch sequence is EXACTLY the legacy WFQ schedule (2:1 shares
+still converge, per-tenant FIFO holds, knob off is byte-identical to the
+single-head path); a poisoned cohort member charges only ITS tenant's
+breaker and replays on ITS oracle while co-members keep their fused
+results; quantum-bucket mismatches never fuse; the fused backend path
+decides bit-identically to solo dispatch (placements, claims, explain
+fingerprint, per-tenant metered bytes) across cohort sizes {1,2,4,8}; and
+padding the batch to its bucket moves zero extra host->device bytes.
+"""
+
+import dataclasses
+import random
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from karpenter_tpu.metrics.registry import (
+    SOLVER_COHORT_POISON_REPLAYS,
+    SOLVER_FUSED_DISPATCHES,
+    TENANT_METER_H2D_BYTES,
+)
+from karpenter_tpu.obs import explain as obsexplain
+from karpenter_tpu.parallel.sharded import pad_batch
+from karpenter_tpu.provisioning.scheduler import SolverInput, SolverResult
+from karpenter_tpu.solver.backend import TPUSolver
+from karpenter_tpu.solver.pipeline import DISRUPTION, SolveTicket
+from karpenter_tpu.solver.tenancy import TenantMux, quantum_bucket
+
+from tests.test_batched_consolidation import ZONES, mkpod, pool
+from tests.test_tenancy import FakeService, mkinput, mkregistry
+
+
+class FakeCohortService(FakeService):
+    """FakeService plus the cohort seam: submit_cohort records each fused
+    dispatch as a tuple of (tenant_id, pod_name) and delivers every member
+    exactly like submit would — honoring the gate and fail_marker per
+    member, so poison lands on one ticket while co-members succeed."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.cohorts = []  # one tuple of (tenant_id, pod_name) per dispatch
+
+    def submit_cohort(self, members):
+        assert self.gate.wait(10)
+        self.cohorts.append(tuple(
+            (m["tenant_id"], m["inp"].pods[0].meta.name) for m in members
+        ))
+        tickets = []
+        for m in members:
+            t = SolveTicket(m["kind"], rev=m.get("rev"),
+                            tenant_id=m["tenant_id"])
+            name = m["inp"].pods[0].meta.name
+            self.order.append((m["tenant_id"], name))
+            self.stats["submitted"] += 1
+            if self.fail_marker is not None and self.fail_marker in name:
+                t._deliver(error=RuntimeError(f"poisoned input {name}"))
+            else:
+                t._deliver(result=("solved", m["tenant_id"], name))
+            tickets.append(t)
+        return tickets
+
+
+# ------------------------------------------------------------ cohort picking
+
+
+def test_cohort_forms_across_tenants_with_fifo_preserved():
+    """Four equally-backlogged tenants, one downstream slot: the WFQ
+    prefix rule fuses one head from EACH tenant per round (the fifth
+    winner repeats a tenant and ends the scan), per-tenant FIFO survives
+    the grouping, and every ticket resolves."""
+    svc = FakeCohortService(size=1, depth=1, gated=True)
+    mux = TenantMux(svc, mkregistry(("a", 1.0), ("b", 1.0),
+                                    ("c", 1.0), ("d", 1.0)),
+                    own_service=True)
+    fused0 = SOLVER_FUSED_DISPATCHES.value()
+    try:
+        tickets = [mux.submit(mkinput("a-primer"), tenant_id="a",
+                              kind=DISRUPTION)]
+        time.sleep(0.05)  # primer holds the slot while the backlog builds
+        for i in range(6):
+            for t in "abcd":
+                tickets.append(mux.submit(mkinput(f"{t}-{i}"), tenant_id=t,
+                                          kind=DISRUPTION))
+        svc.gate.set()
+        for t in tickets:
+            assert t.result(timeout=10)
+        assert len(svc.cohorts) == 6
+        for co in svc.cohorts:
+            tids = [tid for tid, _ in co]
+            assert len(co) == 4 and len(set(tids)) == 4, svc.cohorts
+        for t in "abcd":
+            seq = [n for tid, n in svc.order if tid == t and "primer" not in n]
+            assert seq == [f"{t}-{i}" for i in range(6)]
+        assert mux.unresolved() == 0
+        assert mux.mux_stats["cohort_dispatches"] == 6
+        assert mux.mux_stats["cohort_members"] == 24
+        assert SOLVER_FUSED_DISPATCHES.value() == fused0 + 6
+    finally:
+        mux.close()
+
+
+def test_wfq_shares_converge_with_cohorting_on():
+    """The fused schedule is the legacy schedule, just grouped: with 2:1
+    weights the flattened forward order still carries 2 a's and 1 b in
+    every window, per-tenant FIFO holds, and fusing genuinely happened."""
+    svc = FakeCohortService(size=1, depth=1, gated=True)
+    mux = TenantMux(svc, mkregistry(("a", 2.0), ("b", 1.0)),
+                    own_service=True)
+    try:
+        tickets = [mux.submit(mkinput("a-primer"), tenant_id="a",
+                              kind=DISRUPTION)]
+        time.sleep(0.05)
+        for i in range(24):
+            tickets.append(mux.submit(mkinput(f"a-{i}"), tenant_id="a",
+                                      kind=DISRUPTION))
+        for i in range(12):
+            tickets.append(mux.submit(mkinput(f"b-{i}"), tenant_id="b",
+                                      kind=DISRUPTION))
+        svc.gate.set()
+        for t in tickets:
+            assert t.result(timeout=10)
+        order = [tid for tid, _ in svc.order][1:]  # drop the primer
+        assert len(order) == 36
+        for k in range(1, 13):
+            prefix = order[: 3 * k]
+            assert abs(prefix.count("a") - 2 * k) <= 1, (k, order)
+            assert abs(prefix.count("b") - k) <= 1, (k, order)
+        a_seq = [n for tid, n in svc.order if tid == "a" and "primer" not in n]
+        assert a_seq == [f"a-{i}" for i in range(24)]
+        b_seq = [n for tid, n in svc.order if tid == "b"]
+        assert b_seq == [f"b-{i}" for i in range(12)]
+        # the a,a,b,... interleave fuses the (a,b) adjacencies
+        assert any(len(c) == 2 for c in svc.cohorts)
+        assert mux.unresolved() == 0
+    finally:
+        mux.close()
+
+
+def test_single_tenant_cohort_of_one_rides_legacy_path():
+    """A lone backlogged tenant never fuses with itself: every dispatch is
+    the legacy single-head submit, in FIFO order, and the cohort seam is
+    never touched."""
+    svc = FakeCohortService(size=1, depth=1, gated=True)
+    mux = TenantMux(svc, mkregistry(("a", 1.0)), own_service=True)
+    try:
+        tickets = [mux.submit(mkinput("a-primer"), tenant_id="a",
+                              kind=DISRUPTION)]
+        time.sleep(0.05)
+        for i in range(8):
+            tickets.append(mux.submit(mkinput(f"a-{i}"), tenant_id="a",
+                                      kind=DISRUPTION))
+        svc.gate.set()
+        for t in tickets:
+            assert t.result(timeout=10)
+        assert svc.cohorts == []
+        assert mux.mux_stats["cohort_dispatches"] == 0
+        seq = [n for _, n in svc.order if "primer" not in n]
+        assert seq == [f"a-{i}" for i in range(8)]
+        assert mux.unresolved() == 0
+    finally:
+        mux.close()
+
+
+def test_cohort_knob_off_is_byte_identical_legacy():
+    """--solver-cohort=false must reproduce the legacy single-head path
+    exactly: the identical submission sequence yields the identical
+    forward order and results, with the cohort seam never called — while
+    the knob-on run over the same sequence does fuse."""
+
+    def run(cohort):
+        svc = FakeCohortService(size=1, depth=1, gated=True)
+        mux = TenantMux(svc, mkregistry(("a", 2.0), ("b", 1.0), ("c", 1.0)),
+                        own_service=True, cohort=cohort)
+        try:
+            tickets = [mux.submit(mkinput("a-primer"), tenant_id="a",
+                                  kind=DISRUPTION)]
+            time.sleep(0.05)
+            for i in range(8):
+                for t in "abc":
+                    tickets.append(mux.submit(mkinput(f"{t}-{i}"),
+                                              tenant_id=t, kind=DISRUPTION))
+            svc.gate.set()
+            results = [t.result(timeout=10) for t in tickets]
+            assert mux.unresolved() == 0
+            return svc.order, svc.cohorts, results
+        finally:
+            mux.close()
+
+    order_on, cohorts_on, res_on = run(True)
+    order_off, cohorts_off, res_off = run(False)
+    assert cohorts_off == []  # seam untouched with the knob off
+    assert cohorts_on  # ... and genuinely exercised with it on
+    assert order_off == order_on  # same schedule, just grouped
+    assert res_off == res_on
+
+
+def test_quantum_bucket_mismatch_never_fuses():
+    """Heads from different quantum buckets cannot share a fused launch:
+    interleaved small/large backlogs dispatch solo, losslessly."""
+    assert quantum_bucket(mkinput("x")) == quantum_bucket(
+        mkinput("y", cpu="250m"))
+    big = SolverInput(pods=[mkpod(f"big-{j}") for j in range(20)],
+                      nodes=[], nodepools=[pool()], zones=ZONES)
+    assert quantum_bucket(big) != quantum_bucket(mkinput("x"))
+    svc = FakeCohortService(size=1, depth=1, gated=True)
+    mux = TenantMux(svc, mkregistry(("a", 1.0), ("b", 1.0)),
+                    own_service=True)
+    try:
+        tickets = [mux.submit(mkinput("a-primer"), tenant_id="a",
+                              kind=DISRUPTION)]
+        time.sleep(0.05)
+        for i in range(3):
+            tickets.append(mux.submit(mkinput(f"a-{i}"), tenant_id="a",
+                                      kind=DISRUPTION))
+            big_i = SolverInput(
+                pods=[mkpod(f"b-{i}-{j}") for j in range(20)],
+                nodes=[], nodepools=[pool()], zones=ZONES,
+            )
+            tickets.append(mux.submit(big_i, tenant_id="b", kind=DISRUPTION))
+        svc.gate.set()
+        for t in tickets:
+            assert t.result(timeout=10)
+        assert svc.cohorts == []
+        assert mux.unresolved() == 0
+    finally:
+        mux.close()
+
+
+def test_cohort_max_fail_closed():
+    """A nonsensical cohort width is a config error at construction AND at
+    the flag parser — never a silent fall-back to solo dispatch."""
+    svc = FakeService()
+    with pytest.raises(ValueError):
+        TenantMux(svc, mkregistry(("a", 1.0)), cohort_max=0)
+    svc.close()
+    from karpenter_tpu.operator import options as opts
+    with pytest.raises(SystemExit):
+        opts.parse(["--solver-cohort-max", "0"])
+    o = opts.parse([])
+    assert o.solver_cohort is True  # default-on
+    assert o.solver_cohort_max == 8
+
+
+# --------------------------------------------------------- poison isolation
+
+
+def test_poison_cohort_member_charges_only_its_tenant():
+    """One poisoned member in a fused dispatch: only ITS tenant's breaker
+    is charged, it replays on ITS oracle (the solve still lands), the
+    co-member keeps its fused result, and the poison-replay counter names
+    the victim."""
+    svc = FakeCohortService(size=1, depth=1, gated=True,
+                            fail_marker="poison")
+    mux = TenantMux(svc, mkregistry(("a", 1.0), ("b", 1.0)),
+                    breaker_threshold=3, breaker_probe_s=60.0,
+                    own_service=True)
+    poison0 = SOLVER_COHORT_POISON_REPLAYS.value(tenant="a")
+    try:
+        primer = mux.submit(mkinput("b-primer"), tenant_id="b",
+                            kind=DISRUPTION)
+        time.sleep(0.05)
+        ta = mux.submit(mkinput("a-poison-0"), tenant_id="a",
+                        kind=DISRUPTION)
+        tb = mux.submit(mkinput("b-0"), tenant_id="b", kind=DISRUPTION)
+        svc.gate.set()
+        assert primer.result(timeout=10)
+        ra = ta.result(timeout=10)  # oracle replay: a real SolverResult
+        assert ra.claims and ra.claims[0].pod_uids == ["a-poison-0"]
+        assert tb.result(timeout=10) == ("solved", "b", "b-0")
+        assert svc.cohorts and len(svc.cohorts[0]) == 2, svc.cohorts
+        # the replay rode a's oracle lane, not the shared downstream
+        assert svc.order.count(("a", "a-poison-0")) == 1
+        assert SOLVER_COHORT_POISON_REPLAYS.value(tenant="a") == poison0 + 1
+        assert SOLVER_COHORT_POISON_REPLAYS.value(tenant="b") == 0
+        st = mux.tenant_stats()
+        assert st["b"]["breaker"] == "closed" and st["b"]["degraded"] == 0
+        assert st["a"]["degraded"] >= 1
+        assert st["a"]["failed"] == 0  # the poisoned solve still landed
+        assert mux.unresolved() == 0
+    finally:
+        mux.close()
+
+
+# ----------------------------------------------------- backend fusion parity
+
+
+def _rand_inp(rng, tag, npods):
+    pods = [mkpod(f"{tag}-{j}", cpu=rng.choice(["100m", "250m", "500m"]),
+                  mem=rng.choice(["256Mi", "512Mi"]))
+            for j in range(npods)]
+    return SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+
+
+@pytest.mark.parametrize("n", [1, 2, 4, 8])
+def test_cohort_backend_parity_matches_solo(n):
+    """Decision parity across cohort sizes: every fused member's
+    SolverResult, explain fingerprint, and per-tenant metered h2d bytes
+    are identical to a solo dispatch of the same input."""
+    rng = random.Random(100 + n)
+    npods = rng.choice([2, 3])
+    tenants = [f"co{n}t{i}" for i in range(n)]
+    inps = [dataclasses.replace(_rand_inp(rng, f"p{n}-{i}", npods),
+                                tenant_id=tenants[i])
+            for i in range(n)]
+    obsexplain.configure(enabled=True, top_k=8)
+    try:
+        fused = TPUSolver()
+        h2d0 = {t: TENANT_METER_H2D_BYTES.value(tenant=t) for t in tenants}
+        fin = fused.solve_cohort_async(inps)
+        outs = fin()
+        h2d_fused = {t: TENANT_METER_H2D_BYTES.value(tenant=t) - h2d0[t]
+                     for t in tenants}
+        assert all(isinstance(o, SolverResult) for o in outs), outs
+        assert fused.stats["fallback_solves"] == 0
+        assert fused.stats["device_solves"] == n
+        if n > 1:
+            assert fused.stats["fused_dispatches"] == 1
+            assert fused.stats["fused_members"] == n
+        # map each member's explain entry by its first pod uid (solo runs
+        # haven't populated the store yet)
+        store = obsexplain.store()
+        fused_fp = {}
+        for i in range(n):
+            hits = store.by_pod(inps[i].pods[0].meta.uid)
+            assert len(hits) == 1, (i, len(hits))
+            fused_fp[i] = hits[0]["fingerprint"]
+            assert fused_fp[i] is not None
+
+        solo = TPUSolver()
+        for i in range(n):
+            ref = solo.solve(inps[i])
+            h2d_solo = solo.ledger.solve["h2d_bytes"]
+            assert outs[i].placements == ref.placements, i
+            assert outs[i].claims == ref.claims, i
+            assert outs[i].errors == ref.errors, i
+            fp = store.recent(1)[0]["fingerprint"]
+            assert fp == fused_fp[i], i
+            if n > 1:
+                # fused attribution: each member is billed exactly the
+                # bytes its solo dispatch physically uploads
+                assert h2d_fused[tenants[i]] == h2d_solo, i
+        assert solo.stats["fallback_solves"] == 0
+    finally:
+        obsexplain.configure(enabled=False)
+
+
+def test_cohort_padding_adds_zero_ledger_bytes():
+    """Satellite: padding a 3-member cohort to its batch bucket of 4 must
+    move ZERO extra host->device bytes — the fused upload is exactly three
+    members' worth on the TransferLedger."""
+    rng = random.Random(7)
+    inps = [dataclasses.replace(_rand_inp(rng, f"pad-{i}", 2),
+                                tenant_id=f"pad{i}")
+            for i in range(3)]
+    solo = TPUSolver()
+    solo.solve(inps[0])
+    member_bytes = solo.ledger.total["h2d_bytes"]
+    assert member_bytes > 0
+    fused = TPUSolver()
+    outs = fused.solve_cohort_async(inps)()
+    assert all(isinstance(o, SolverResult) for o in outs)
+    assert fused.stats["fused_members"] == 3
+    assert fused.ledger.total["h2d_bytes"] == 3 * member_bytes
+
+
+def test_pad_batch_is_device_side_only():
+    """pad_batch replicates the last REAL lane on device: correct shapes
+    and values, and — once its jit is warm — no host->device transfer at
+    all (the transfer guard would throw)."""
+    batched = tuple(
+        jax.numpy.asarray(np.arange(6 * (k + 1), dtype=np.int32)
+                          .reshape(3, 2 * (k + 1)))
+        for k in range(2)
+    )
+    pad_batch(batched, 8)  # warm the shape's cached jit
+    shifted = tuple(a + 1 for a in batched)
+    with jax.transfer_guard("disallow"):
+        out = pad_batch(shifted, 8)
+    for a_in, a_out in zip(shifted, out):
+        assert a_out.shape == (8,) + a_in.shape[1:]
+        got = np.asarray(a_out)
+        np.testing.assert_array_equal(got[:3], np.asarray(a_in))
+        np.testing.assert_array_equal(
+            got[3:], np.broadcast_to(got[2:3], (5,) + got.shape[1:]))
+    # already at (or past) the bucket: the arrays pass through untouched
+    assert all(a is b for a, b in zip(pad_batch(batched, 3), batched))
+    assert all(a is b for a, b in zip(pad_batch(batched, 2), batched))

@@ -119,6 +119,20 @@ def test_streaming_series_are_registered():
         assert name in registered, f"{name} missing from the registry"
 
 
+def test_cohort_series_are_registered():
+    """ISSUE 16 acceptance: the fused-cohort dispatch series are part of
+    the /metrics contract — cohort width, fused-launch count, and the
+    per-tenant poison-replay counter are what the fusion dashboards and
+    the fairness alerts scrape, so pin their exact names."""
+    registered = {m.name for m in reg.REGISTRY.metrics}
+    for name in (
+        "karpenter_solver_cohort_size",
+        "karpenter_solver_fused_dispatches_total",
+        "karpenter_solver_cohort_poison_replays_total",
+    ):
+        assert name in registered, f"{name} missing from the registry"
+
+
 def test_every_reason_code_has_name_and_spec_row():
     """Every kernel reason code must have a decoder-side name AND a SPEC.md
     row — an undocumented code is a wire symbol operators cannot read."""

@@ -423,3 +423,44 @@ def test_json_formatter_exception_lines():
     out = json.loads(JsonLogFormatter().format(rec))
     assert "RuntimeError: boom" in out["exc"]
     assert "\n" not in json.dumps(out["msg"])  # one record = one line
+
+
+# ------------------------------------------------------- fused cohort dispatch
+
+
+def test_fused_cohort_dispatch_trace_completeness(tracing):
+    """A fused cohort dispatch emits exactly ONE cohort.dispatch span — on
+    the lead member's trace, carrying every member solve_id — while EACH
+    member keeps its own independently-rooted, independently-closing tree
+    with its own fetch/decode spans (SPEC.md "Cohort semantics")."""
+    from karpenter_tpu.provisioning.scheduler import SolverInput
+    from karpenter_tpu.solver.backend import TPUSolver
+    from tests.test_batched_consolidation import ZONES, mkpod, pool
+
+    inps = [
+        SolverInput(pods=[mkpod(f"co-{i}-a"), mkpod(f"co-{i}-b")],
+                    nodes=[], nodepools=[pool()], zones=ZONES)
+        for i in range(3)
+    ]
+    traces = [obstrace.begin(DISRUPTION) for _ in inps]
+    s = TPUSolver()
+    outs = s.solve_cohort_async(inps, traces=traces)()
+    assert s.stats["fused_dispatches"] == 1
+    assert s.stats["fused_members"] == 3
+    for tr, out in zip(traces, outs):
+        assert not isinstance(out, Exception), out
+        obstrace.finish(tr, "ok")
+    snaps = [tr.snapshot() for tr in traces]
+    for snap in snaps:
+        _assert_rooted(snap)
+        assert snap["done"] and snap["status"] == "ok"
+        names = {sp["name"] for sp in snap["spans"]}
+        # every member decodes on its OWN trace
+        assert {"backend.fetch", "backend.decode"} <= names, names
+    cds = [sp for snap in snaps for sp in snap["spans"]
+           if sp["name"] == "cohort.dispatch"]
+    assert len(cds) == 1, "exactly one fused-dispatch span across members"
+    assert cds[0]["attrs"]["cohort_size"] == 3
+    members = cds[0]["attrs"]["member_solve_ids"].split(",")
+    assert members == [tr.solve_id for tr in traces]
+    assert not obstrace.active_traces()

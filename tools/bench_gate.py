@@ -59,10 +59,22 @@ TOLERANCES: Dict[str, float] = {
     "upload_bytes_per_solve": 0.10,
     "decode_bytes_per_solve": 0.10,
     "arrival_batches_per_sec": 0.20,
+    # aggregate tenant throughput (ISSUE 16 cohort fusion): host-seam
+    # scheduling throughput is contention-noisy, give it tail-class slack
+    "aggregate_solves_per_sec": 0.30,
+    "tenant_aggregate_solves_per_sec": 0.30,
 }
 
 HIGHER_BETTER_PAT = re.compile(
     r"per_sec|_rate|rate_|hit|speedup|shrink|coverage")
+
+# explicit higher-is-better keys: direction must not depend on the name
+# pattern surviving a rename (the cohort-fusion acceptance gates on this)
+HIGHER_BETTER_KEYS = {
+    "aggregate_solves_per_sec",
+    "tenant_aggregate_solves_per_sec",
+    "cohort_size_mean",
+}
 
 
 def tolerance_for(key: str, default: float) -> float:
@@ -76,7 +88,7 @@ def tolerance_for(key: str, default: float) -> float:
 
 
 def higher_is_better(key: str) -> bool:
-    return bool(HIGHER_BETTER_PAT.search(key))
+    return key in HIGHER_BETTER_KEYS or bool(HIGHER_BETTER_PAT.search(key))
 
 
 def extract_metrics(record: object, prefix: str = "") -> Dict[str, float]:
