@@ -17,6 +17,8 @@ reconcile-from-state convergence the reference gets from re-listing the API.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import logging
 import os
 import pickle
 import tempfile
@@ -24,6 +26,8 @@ import time
 from typing import Optional, Tuple
 
 from . import store as st
+
+log = logging.getLogger("karpenter_tpu")
 
 SNAPSHOT_KINDS = (
     st.PODS,
@@ -45,6 +49,15 @@ SNAPSHOT_KINDS = (
 # field-metadata marker (api/objects.py) instead of a hardcoded name list —
 # new timestamp fields declared with the marker rebase automatically.
 SNAPSHOT_VERSION = 2
+
+# On-disk framing: magic + blake2b-16(payload) + payload. A torn or
+# bit-rotted snapshot is DETECTED at restore and skipped (boot proceeds
+# empty) instead of raising an UnpicklingError out of boot. Legacy files
+# (bare pickle, first byte \x80) restore unframed — the magic cannot
+# collide with a pickle protocol-2+ opcode stream.
+SNAP_MAGIC = b"KSNAPC1\n"
+_SNAP_DIGEST_SIZE = 16
+_SNAP_HDR = len(SNAP_MAGIC) + _SNAP_DIGEST_SIZE
 
 _CLOCK_FIELDS_CACHE: dict = {}
 
@@ -155,7 +168,15 @@ def save_snapshot(
     fd, tmp = tempfile.mkstemp(dir=d, prefix=".snap-")
     try:
         with os.fdopen(fd, "wb") as f:
+            f.write(SNAP_MAGIC)
+            f.write(hashlib.blake2b(
+                payload, digest_size=_SNAP_DIGEST_SIZE).digest())
             f.write(payload)
+            f.flush()
+            # fsync BEFORE the rename: without it a crash can leave the
+            # rename durable while the data is not — a torn/empty file
+            # surviving as the newest snapshot
+            os.fsync(f.fileno())
         if fence_token is None:
             os.replace(tmp, path)
             return True
@@ -203,8 +224,30 @@ def restore_snapshot(
     disruption lifetime math keep working after restore."""
     if not os.path.exists(path):
         return False
-    with open(path, "rb") as f:
-        payload = pickle.load(f)
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+        if raw.startswith(SNAP_MAGIC):
+            if len(raw) < _SNAP_HDR:
+                raise ValueError("truncated snapshot header")
+            digest = raw[len(SNAP_MAGIC):_SNAP_HDR]
+            blob = raw[_SNAP_HDR:]
+            if hashlib.blake2b(
+                    blob, digest_size=_SNAP_DIGEST_SIZE).digest() != digest:
+                raise ValueError("snapshot checksum mismatch")
+            payload = pickle.loads(blob)
+        else:
+            payload = pickle.loads(raw)  # legacy unframed snapshot
+        if not isinstance(payload, dict):
+            raise ValueError("snapshot payload is not a dict")
+    except Exception as e:  # noqa: BLE001 — a bad snapshot must not
+        # crash boot: the process starts empty and reconverges, which is
+        # strictly better than refusing to start at all
+        log.warning(
+            "snapshot restore skipped %s (%s: %s) — booting empty",
+            path, type(e).__name__, e,
+        )
+        return False
     snap_now = payload.get("now")
     # payloads without a clock reference (older format) must NOT be rebased:
     # defaulting the epoch to 0 would shift every timestamp by the restoring

@@ -25,6 +25,12 @@ Sites wired into production code:
   the arena must be invalidated and re-adopted).
 - ``cloud.create``          — KwokCloud.create_fleet, before the launch.
 - ``store.update``          — Store.update, before persistence.
+- ``vault.write``           — SolverStateVault.snapshot_now, before the
+  capture/write; a failure skips the snapshot (throttled WARN) and the
+  next interval retries.
+- ``vault.corrupt``         — SolverStateVault._read, before a candidate
+  file is parsed; lets chaos tests reject restore candidates without
+  hand-crafting broken bytes.
 
 Sites on the solver dispatch path accept an optional `tag` so a fleet of
 several solver instances can wedge ONE owner: `plan.wedge(site, tag="owner-0")`
@@ -66,6 +72,8 @@ SITES = (
     "solver.arena_corrupt",
     "cloud.create",
     "store.update",
+    "vault.write",
+    "vault.corrupt",
 )
 
 

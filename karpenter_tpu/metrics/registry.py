@@ -808,3 +808,55 @@ SOLVER_PERF_ANOMALY_STATE = REGISTRY.register(
         ("stage",),
     )
 )
+
+# --- durable solver resident state (solver/vault.py) -------------------------
+
+SOLVER_VAULT_SNAPSHOT_SECONDS = REGISTRY.register(
+    Histogram(
+        "karpenter_solver_vault_snapshot_seconds",
+        "Wall time of one vault snapshot (capture + pickle + fsync + "
+        "atomic rename), measured on the vault's background writer — the "
+        "solve path never blocks on it (SolverStateVault.snapshot_now)",
+    )
+)
+SOLVER_VAULT_BYTES = REGISTRY.register(
+    Gauge(
+        "karpenter_solver_vault_bytes",
+        "Size of the newest vault file on disk (header + checksummed "
+        "payload); tracks how much resident state a restore re-seeds",
+    )
+)
+SOLVER_VAULT_AGE = REGISTRY.register(
+    Gauge(
+        "karpenter_solver_vault_age_seconds",
+        "Age of the newest successful vault snapshot (refreshed on write "
+        "and on every /healthz scrape) — restart-to-first-solve is bounded "
+        "by the journal tail accumulated over this window, so a growing "
+        "age is a shrinking durability guarantee",
+    )
+)
+SOLVER_VAULT_RESTORE_SECONDS = REGISTRY.register(
+    Histogram(
+        "karpenter_solver_vault_restore_seconds",
+        "Wall time of one successful vault restore (candidate scan + "
+        "checksum verify + donor install + streaming/arena composition)",
+    )
+)
+SOLVER_VAULT_RESTORES = REGISTRY.register(
+    Counter(
+        "karpenter_solver_vault_restores_total",
+        "Successful vault restores (boot-time hydration plus fence-time "
+        "re-seeds in solver/fleet.py)",
+    )
+)
+SOLVER_VAULT_RESTORE_FAILURES = REGISTRY.register(
+    Counter(
+        "karpenter_solver_vault_restore_failures_total",
+        "Restore attempts where EVERY candidate file was rejected "
+        "(truncated / checksum mismatch / wrong journal epoch / seq or "
+        "store-rv cross-check) — the operator degraded to the cold "
+        "re-encode path and dumped the flight recorder "
+        "(reason=vault_restore_failed); an empty vault dir is a fresh "
+        "boot, not a failure, and does not count",
+    )
+)

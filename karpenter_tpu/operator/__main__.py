@@ -73,6 +73,9 @@ def serve_endpoints(port: int, health_port: int, enable_profiling: bool = False)
                     # streaming delta-solve health when the operator
                     # registered its provider (journal lag, re-baselines)
                     "streaming": obstelemetry.provider_result("streaming"),
+                    # solver vault health when a vault is wired (snapshot
+                    # age/size, restore counters — solver/vault.py)
+                    "vault": obstelemetry.provider_result("vault"),
                 }, default=str).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -278,6 +281,9 @@ def main(argv=None) -> int:
         solver_cohort_max=o.solver_cohort_max,
         solver_streaming=o.solver_streaming,
         streaming_epoch_every=o.streaming_epoch_every,
+        solver_vault_dir=o.solver_vault_dir or None,
+        vault_interval_s=o.vault_interval_s,
+        vault_keep=o.vault_keep,
     )
     serve_endpoints(o.metrics_port, o.health_probe_port,
                     enable_profiling=o.enable_profiling)

@@ -56,6 +56,7 @@ class Operator:
     recorder: Optional[object] = None  # events/recorder.py Recorder
     preemption: Optional[object] = None  # provisioning/preemption.py
     streaming: Optional[object] = None  # solver/streaming.py StreamingSolver
+    vault: Optional[object] = None  # solver/vault.py SolverStateVault
 
 
 def new_kwok_operator(
@@ -100,6 +101,9 @@ def new_kwok_operator(
     solver_cohort_max: int = 8,
     solver_streaming: bool = False,
     streaming_epoch_every: int = 64,
+    solver_vault_dir: Optional[str] = None,
+    vault_interval_s: float = 5.0,
+    vault_keep: int = 3,
 ) -> Operator:
     store = shared_store if shared_store is not None else st.Store()
     # the operator's clock is authoritative for every age stamp, including a
@@ -276,6 +280,40 @@ def new_kwok_operator(
             # a fence invalidates the owner's arena: the streaming model
             # re-baselines so replays never extend presumed-resident state
             fleet.fence_listeners.append(streaming.on_fence)
+    vault = None
+    if solver_vault_dir:
+        # durable SOLVER resident state (solver/vault.py, ISSUE 17): async
+        # snapshots of the device-facing model into the vault dir, restored
+        # HERE — before any controller runs — so the first encode adopts
+        # the previous process's tables. Fail-closed off: with no dir the
+        # vault object never exists and every path below is byte-identical.
+        from ..solver.vault import SolverStateVault
+
+        def _arena_of():
+            obj = solver
+            while obj is not None:
+                d = getattr(obj, "__dict__", None) or {}
+                if "arena" in d:
+                    return d["arena"]
+                obj = d.get("inner")
+            return None
+
+        vault = SolverStateVault(
+            solver_vault_dir,
+            interval_s=vault_interval_s,
+            keep=vault_keep,
+            journal=cluster.journal,
+            store=store,
+            streaming=streaming,
+            arena_fn=_arena_of,
+            clock=clock,
+        )
+        vault.restore(install=True)
+        obstelemetry.register_provider("vault", vault.health)
+        if fleet is not None:
+            # fence recovery re-seeds from the vault instead of degrading
+            # cold (solver/fleet.py _fence)
+            fleet.vault = vault
     from ..events.recorder import Recorder
     from ..provisioning.preemption import PreemptionController
 
@@ -388,6 +426,10 @@ def new_kwok_operator(
                 fence=(lambda: elector.fence_token) if elector is not None else None,
             )
         )
+    if vault is not None:
+        from ..solver.vault import VaultController
+
+        manager.register(VaultController(vault))
     if compile_cache_dir:
         # persistent XLA compilation cache: compilations (jit AND the AOT
         # prewarm's) are keyed by HLO hash on disk, so a restarted replica
@@ -442,4 +484,5 @@ def new_kwok_operator(
         recorder=recorder,
         preemption=preemption,
         streaming=streaming,
+        vault=vault,
     )

@@ -200,6 +200,17 @@ class Options:
     # (kwok ConfigMap-backup analog, kwok/ec2/ec2.go:112-232); empty = off
     snapshot_path: str = ""
     snapshot_interval_s: float = 5.0
+    # durable SOLVER resident state (solver/vault.py): async snapshots of
+    # the device-facing model (encode donors, arena manifest, journal seq)
+    # into this directory, restored at boot / fence so restart-to-first-
+    # solve is journal-lag-bounded. Empty = vault off (fail-closed: the
+    # byte-identical pre-vault path; the interval/keep knobs then must not
+    # pretend to be in effect)
+    solver_vault_dir: str = ""
+    # seconds between vault snapshots (> 0, validated at startup)
+    vault_interval_s: float = 5.0
+    # newest vault files retained on disk (>= 1, validated at startup)
+    vault_keep: int = 3
     # cross-process HA: flock'd lease file shared by replicas (empty = the
     # in-process lease, single-process HA only)
     lease_path: str = ""
@@ -373,6 +384,23 @@ def parse(argv: Optional[Sequence[str]] = None, cls=Options) -> Options:
             f"(got {epoch}); it is the applied-batch count between the "
             "streaming model's full parity checks, 0 = never "
             "(solver/streaming.py)"
+        )
+    # vault knob sanity (same fail-closed rule): a zero/negative snapshot
+    # cadence or retention would spin the writer or delete every snapshot —
+    # refuse startup instead of degrading durability silently
+    vinterval = getattr(out, "vault_interval_s", None)
+    if vinterval is not None and float(vinterval) <= 0:
+        raise SystemExit(
+            "refusing to start: --vault-interval-s must be > 0 "
+            f"(got {vinterval}); it is the seconds between solver vault "
+            "snapshots (solver/vault.py)"
+        )
+    vkeep = getattr(out, "vault_keep", None)
+    if vkeep is not None and int(vkeep) < 1:
+        raise SystemExit(
+            "refusing to start: --vault-keep must be >= 1 "
+            f"(got {vkeep}); it is the newest vault snapshots retained on "
+            "disk (solver/vault.py)"
         )
     # health-plane knob sanity (same fail-closed rule as everything above)
     budget = getattr(out, "arena_budget_mb", None)

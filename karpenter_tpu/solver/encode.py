@@ -710,6 +710,60 @@ def _core_key(pods_f: List[Pod], inp: SolverInput) -> Tuple[tuple, np.ndarray]:
     )
 
 
+# Catalog CONTENT fingerprint (solver/vault.py): the cache key's catalog
+# segment compares instance types BY OBJECT ID (cheap, and pinned entries
+# make ids safe within a process) — but ids mean nothing across a process
+# boundary, so vault donors are re-keyed by this content hash instead.
+# Memoized on pools_key (which embeds the type ids, so a hit proves the
+# same live objects → same content) and bounded; computed only on the
+# cache-INSERT path, never per solve.
+_CAT_FP_CACHE: Dict[tuple, bytes] = {}
+_CAT_FP_CACHE_MAX = 8
+
+
+def _catalog_content_fp(pools_key: tuple, inp: SolverInput) -> bytes:
+    import hashlib
+
+    fp = _CAT_FP_CACHE.get(pools_key)
+    if fp is not None:
+        return fp
+    parts: List[tuple] = []
+    for p in inp.nodepools:
+        parts.append((
+            p.name,
+            p.weight,
+            _reqs_key(p.requirements),
+            tuple((t.key, t.value, t.effect) for t in p.taints),
+            tuple(
+                (
+                    it.name,
+                    tuple(sorted(it.capacity.items())),
+                    tuple(sorted(it.overhead.items())),
+                    _reqs_key(it.requirements),
+                    tuple(
+                        sorted(
+                            (o.zone, o.capacity_type, o.price, o.available)
+                            for o in it.offerings
+                        )
+                    ),
+                )
+                for it in p.instance_types
+            ),
+        ))
+    fp = hashlib.blake2b(repr(parts).encode(), digest_size=16).digest()
+    if len(_CAT_FP_CACHE) >= _CAT_FP_CACHE_MAX:
+        _CAT_FP_CACHE.pop(next(iter(_CAT_FP_CACHE)))
+    _CAT_FP_CACHE[pools_key] = fp
+    return fp
+
+
+def _sig_content_seq(group_pods: List[List[Pod]]) -> tuple:
+    """Ordered distinct signature CONTENT sequence of a group structure —
+    the process-portable twin of group_snums (interned numbers are
+    process-local; the signature tuples they intern are pure content)."""
+    return tuple(_pod_signature(pl[0]) for pl in group_pods)
+
+
 def encode(inp: SolverInput) -> EncodedInput:
     from . import encode_cache as ec
 
@@ -740,19 +794,31 @@ def encode(inp: SolverInput) -> EncodedInput:
         presort = ffd_sort_with_sigs(pods_f, presorted=False)
         structure = _group_structure(presort[0], presort[1])
         state_rev = getattr(inp, "state_rev", None)
+        cat_fp = _catalog_content_fp(key[2], inp)
         core = ec.try_patch(key, presort, structure, cache, state_rev)
+        if core is not None:
+            ec.STATS["patches"] += 1
+        elif ec._VAULT_DONORS:
+            # vault-restored donors (solver/vault.py) are keyed by CONTENT
+            # — signature sequence + catalog fingerprint — so a restarted
+            # process adopts its predecessor's tables instead of paying the
+            # cluster-size-bounded rebuild
+            core = ec.adopt_vault_donor(
+                key, structure, _sig_content_seq(structure[0]), cat_fp,
+                presort,
+            )
+            if core is not None:
+                ec.STATS["vault_adopts"] += 1
         if core is None:
             core = _build_core(inp, pods_f, presort, structure)
             ec.STATS["rebuilds"] += 1
-        else:
-            ec.STATS["patches"] += 1
         if len(cache) >= _CORE_CACHE_MAX:
             cache.pop(next(iter(cache)))
         # entry pins the instance-type objects whose ids appear in the key
         # (pods are pinned via core.group_pods), so ids can't be recycled
         # while the entry lives
         type_pins = tuple(it for p in inp.nodepools for it in p.instance_types)
-        cache[key] = (ids, core, type_pins, state_rev)
+        cache[key] = (ids, core, type_pins, state_rev, cat_fp)
     enc = _encode_with_nodes(core, inp)
     enc.tenant_id = tenant_id
     return enc

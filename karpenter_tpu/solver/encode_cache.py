@@ -35,9 +35,12 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, Optional
 
-# Visible counters for bench/tests: exact-key hits, successful patches, and
-# full rebuilds (the encoder bumps these; reset freely between measurements).
-STATS: Dict[str, int] = {"hits": 0, "patches": 0, "rebuilds": 0}
+# Visible counters for bench/tests: exact-key hits, successful patches,
+# full rebuilds, and vault-donor adoptions (the encoder bumps these; reset
+# freely between measurements).
+STATS: Dict[str, int] = {
+    "hits": 0, "patches": 0, "rebuilds": 0, "vault_adopts": 0,
+}
 
 
 def reset_stats() -> None:
@@ -134,6 +137,78 @@ def try_patch(key, presort, structure, core_cache, state_rev=None):
             sorted_uids=sorted_uids,
         )
     return None
+
+
+# --- vault donors (solver/vault.py restore path) ---------------------------
+#
+# A vault restore cannot re-insert cores into the live cache: `_core_key`
+# embeds pod/type OBJECT IDS and interned signature NUMBERS, both of which
+# are process-local. Instead, restored cores park here keyed by CONTENT —
+# the ordered distinct pod-signature sequence plus the catalog content
+# fingerprint (encode._catalog_content_fp) and the cheap key segments — and
+# the encoder consults this registry only after an exact hit AND a patch
+# both miss. Adoption re-stamps the process-local fields (run split, pod
+# lists, interned snums, sig epoch, core_rev) exactly like try_patch, so an
+# adopted core is indistinguishable from a fresh build downstream. Content
+# keying makes donors self-verifying: a donor whose pods or catalog no
+# longer match simply never matches, so a stale vault can slow a restart
+# but can never change a decision.
+
+_VAULT_DONORS: Dict[tuple, object] = {}
+
+
+def _donor_key(sig_seq, ds_key, zones, cts, policy, cat_fp) -> tuple:
+    return (sig_seq, ds_key, zones, cts, policy, cat_fp)
+
+
+def install_vault_donors(donors) -> int:
+    """Install exported donor records (vault.export_encode_donors). Each is
+    guarded independently — one malformed record never aborts a restore."""
+    n = 0
+    for d in donors or ():
+        try:
+            _VAULT_DONORS[_donor_key(
+                d["sig_seq"], d["ds_key"], d["zones"], d["cts"],
+                d["policy"], d["cat_fp"],
+            )] = d["core"]
+            n += 1
+        except Exception:  # noqa: BLE001 — skip, don't abort the restore
+            continue
+    return n
+
+
+def clear_vault_donors() -> None:
+    _VAULT_DONORS.clear()
+
+
+def adopt_vault_donor(key, structure, sig_seq, cat_fp, presort):
+    """Match the current encode against the donor registry by content and
+    return a fully re-stamped core, or None. Mirrors try_patch's replace()
+    but additionally re-stamps group_snums/sig_epoch (interned numbers are
+    process-local) and takes a FRESH core_rev — the donor's provenance
+    chain died with its process, so arena consumers must treat adopted
+    tables as new content."""
+    donor = _VAULT_DONORS.get(
+        _donor_key(sig_seq, key[3], key[4], key[5], key[6], cat_fp)
+    )
+    if donor is None:
+        return None
+    group_pods, run_group, run_count, group_snums = structure
+    if donor.group_req.shape[0] != len(group_pods):
+        return None  # content key collision paranoia: shapes must agree
+    _pods_sorted, _sigs, sorted_uids, interned = presort
+    from . import encode as enc
+
+    return dataclasses.replace(
+        donor,
+        group_pods=group_pods,
+        run_group=run_group,
+        run_count=run_count,
+        sorted_uids=sorted_uids,
+        group_snums=group_snums if interned else (),
+        sig_epoch=enc._SIG_EPOCH if interned else -1,
+        core_rev=next_core_rev(),
+    )
 
 
 # --- run-list prefix identity (checkpointed-scan resume) -------------------

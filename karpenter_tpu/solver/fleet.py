@@ -218,6 +218,7 @@ class SolverFleet:
         fence_drain_s: float = 0.25,
         instance_types: Optional[Sequence] = None,
         start_monitor: bool = False,
+        vault=None,
     ):
         self.size = max(1, int(size))
         self.depth = depth
@@ -231,6 +232,11 @@ class SolverFleet:
             lambda: default_canary_input(instance_types)
         )
         self._canary_cache = None
+        # durable resident state (solver/vault.py): when wired, a fence
+        # re-seeds the encode caches from the newest snapshot and — with
+        # zero healthy owners left — tries to revive a fenced owner so
+        # survivors restore warm instead of degrading to the cold oracle
+        self.vault = vault
         self._oracle = ReferenceSolver()
         self._lock = threading.Lock()
         self._rr = 0  # round-robin cursor for disruption-class routing
@@ -248,6 +254,7 @@ class SolverFleet:
             "recoveries": 0,
             "canary_probes": 0,
             "canary_misses": 0,
+            "vault_restores": 0,
         }
         # fence notifications (solver/streaming.py): called AFTER an owner's
         # arena is invalidated, with the fence reason — the streaming model
@@ -609,6 +616,42 @@ class SolverFleet:
                 listener(reason)
             except Exception:  # noqa: BLE001 — diagnostics never abort
                 log.exception("solver fleet: fence listener failed")
+        # durable resident state (solver/vault.py): the arena invalidation
+        # and the streaming re-baseline above just wiped the warm state the
+        # survivors' new owner needs — re-seed the encode caches from the
+        # newest snapshot so requeued solves adopt instead of rebuilding
+        if self.vault is not None:
+            try:
+                report = self.vault.restore(install=True)
+            except Exception:  # noqa: BLE001 — recovery must not depend
+                log.exception("solver fleet: vault restore failed during "
+                              "fence recovery — continuing cold")
+                report = None
+            if report is not None:
+                with self._lock:
+                    self.fleet_stats["vault_restores"] += 1
+                log.info(
+                    "solver fleet: fence recovery restored vault seq=%d "
+                    "(%d donor core(s)) for %s's survivors",
+                    report.seq, report.donors_installed, owner.name,
+                )
+            if self.healthy_owners() == 0:
+                # last owner down: with a vault in hand, a revived owner
+                # serving warm beats the cold oracle degrade — try a direct
+                # canary on each fenced owner before the survivors re-route
+                for cand in self.owners:
+                    if self._direct_canary(cand):
+                        cand.breaker.record_success()
+                        self._unfence(cand)
+                        obstelemetry.note_event(
+                            "fleet_vault_revive", owner=cand.name,
+                        )
+                        log.info(
+                            "solver fleet: revived %s via vault-backed "
+                            "fence recovery", cand.name,
+                        )
+                        break
+                    cand.breaker.record_failure()
         for entry in survivors:  # original submission order
             if not entry.ticket.done():
                 self._reroute(entry)
@@ -668,12 +711,12 @@ class SolverFleet:
         FLEET_CANARY_LATENCY.observe(time.monotonic() - t0, owner=owner.name)
         return "ok"
 
-    def _probe_fenced(self, owner: FleetOwner) -> str:
-        """Half-open recovery probe (injected-clock schedule): a DIRECT
-        canary solve on a sacrificial thread — never a shared dispatcher —
-        so a still-wedged owner costs one daemon thread, not a pipeline."""
-        if not owner.breaker.allow():
-            return "fenced"
+    def _direct_canary(self, owner: FleetOwner) -> bool:
+        """Deadline-bounded canary solve DIRECTLY on the owner's solver, on
+        a sacrificial daemon thread — never a shared dispatcher — so a
+        still-wedged owner costs one thread, not a pipeline. Shared by the
+        half-open recovery probe and the fence-time vault revive path;
+        breaker accounting is the caller's."""
         box: dict = {}
         done = threading.Event()
         inp = self._canary_input()
@@ -689,7 +732,13 @@ class SolverFleet:
         t = threading.Thread(target=run, daemon=True,
                              name=f"fleet-probe-{owner.name}")
         t.start()
-        if not done.wait(self.canary_deadline_s) or "error" in box:
+        return done.wait(self.canary_deadline_s) and "error" not in box
+
+    def _probe_fenced(self, owner: FleetOwner) -> str:
+        """Half-open recovery probe (injected-clock schedule)."""
+        if not owner.breaker.allow():
+            return "fenced"
+        if not self._direct_canary(owner):
             owner.breaker.record_failure()  # half-open -> re-open
             return "fenced"
         owner.breaker.record_success()

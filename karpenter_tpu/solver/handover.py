@@ -1,0 +1,158 @@
+"""Blue/green solver handover over the TenantMux seam (ISSUE 17).
+
+Upgrade protocol (solver/SPEC.md "Durability semantics"):
+
+  1. **restore** — the green (incoming) side hydrates from the
+     SolverStateVault: encode-core donors installed, streaming cursor
+     cross-checked, so its first encode adopts the blue side's tables
+     instead of paying the cluster-size-bounded rebuild.
+  2. **prewarm** — best-effort AOT warmup from the persistent compile
+     cache (backend.warmup / prewarm_aot when the green solver exposes
+     them), so takeover does not eat a first-call compile.
+  3. **shadow parity** — each shadow input solves on BOTH sides
+     (directly on the solvers — shadow work must not consume mux
+     tickets) and the explain-record fingerprints (obs/explain.py) are
+     diffed. ANY mismatch aborts the handover with the first-divergence
+     paths; the blue side keeps serving.
+  4. **cutover** — TenantMux.swap_downstream retargets the mux at the
+     green service, drains the blue side's in-flight tickets (they
+     resolve through their existing callbacks — zero drops), then closes
+     it. Tickets still queued at the mux simply forward green from the
+     swap onward.
+
+The whole run is observable: a `handover` trace span, `note_event`
+breadcrumbs per step, and a report dict the caller (bench --restore-suite,
+tests) asserts `dropped == 0` against.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..obs import explain as obsexplain
+from ..obs import telemetry as obstelemetry
+from ..obs import trace as obstrace
+
+log = logging.getLogger("karpenter_tpu")
+
+
+class HandoverAborted(Exception):
+    """Shadow parity failed — the green side must not take over."""
+
+
+def solve_fingerprint(solver, inp) -> str:
+    """Explain-record fingerprint of one solver's decision on one input.
+    The record derives from (encode structure, placements); the encode
+    structure is a pure function of the input, so two solvers' fingerprints
+    agree iff their DECISIONS agree."""
+    from .encode import encode, quantize_input
+
+    res = solver.solve(inp)
+    enc = encode(quantize_input(inp))
+    return obsexplain.fingerprint(obsexplain.build_record(enc, res, k=4))
+
+
+class BlueGreenHandover:
+    """One zero-downtime handover: restore → prewarm → shadow parity →
+    cutover. Construct with the live mux and the already-built green
+    service; `run()` returns the report (and raises HandoverAborted before
+    touching the mux when parity fails)."""
+
+    def __init__(self, mux, green_service, vault=None,
+                 clock=time.monotonic):
+        self.mux = mux
+        self.green = green_service
+        self.vault = vault
+        self.clock = clock
+
+    # -- steps ----------------------------------------------------------------
+
+    def restore(self) -> Optional[dict]:
+        if self.vault is None:
+            return None
+        report = self.vault.restore(install=True)
+        return report.as_dict() if report is not None else None
+
+    def prewarm(self) -> bool:
+        """Best-effort AOT prewarm of the green solver from the persistent
+        compile cache; absence of the seam (host-only solver, reference
+        backend) is not a failure — takeover just pays a first-call."""
+        solver = getattr(self.green, "solver", None)
+        for name in ("prewarm_aot", "warmup"):
+            fn = getattr(solver, name, None)
+            if fn is None:
+                continue
+            try:
+                fn()
+                return True
+            except Exception as e:  # noqa: BLE001 — prewarm is advisory
+                log.warning(
+                    "handover: green prewarm via %s failed (%s: %s)",
+                    name, type(e).__name__, e,
+                )
+        return False
+
+    def prove_parity(self, shadow_inputs: Sequence) -> List[dict]:
+        """Solve every shadow input on both sides; return the mismatches
+        (empty = parity proven). Solves go directly to the solvers so the
+        shadow stream consumes no mux tickets and charges no tenant."""
+        blue_solver = self.mux.solver
+        green_solver = getattr(self.green, "solver", self.green)
+        mismatches: List[dict] = []
+        for i, inp in enumerate(shadow_inputs):
+            blue_fp = solve_fingerprint(blue_solver, inp)
+            green_fp = solve_fingerprint(green_solver, inp)
+            if blue_fp != green_fp:
+                mismatches.append(
+                    {"shadow": i, "blue": blue_fp, "green": green_fp}
+                )
+        return mismatches
+
+    # -- the protocol ---------------------------------------------------------
+
+    def run(self, shadow_inputs: Sequence = (),
+            drain_s: float = 5.0) -> Dict[str, object]:
+        """Execute the full protocol. Raises HandoverAborted (blue keeps
+        serving, green untouched by the mux) when any shadow input's
+        decision diverges; otherwise cuts over and returns the report —
+        `report["dropped"]` is the zero-drop acceptance gate."""
+        t0 = self.clock()
+        with obstrace.span("handover"):
+            restored = self.restore()
+            prewarmed = self.prewarm()
+            mismatches = self.prove_parity(shadow_inputs)
+            if mismatches:
+                obstelemetry.note_event(
+                    "handover_aborted", mismatches=len(mismatches),
+                )
+                raise HandoverAborted(
+                    f"shadow parity failed on {len(mismatches)}/"
+                    f"{len(shadow_inputs)} input(s): {mismatches[0]}"
+                )
+            swap = self.mux.swap_downstream(
+                self.green, own=True, drain_s=drain_s
+            )
+        report = {
+            "restored": restored,
+            "prewarmed": prewarmed,
+            "shadows": len(shadow_inputs),
+            "mismatches": 0,
+            "swap": swap,
+            # undrained tickets are the only way the protocol can drop
+            # work — the acceptance gate asserts this is 0
+            "dropped": int(swap["timeouts"]),
+            "duration_s": self.clock() - t0,
+        }
+        obstelemetry.note_event(
+            "handover_complete", shadows=len(shadow_inputs),
+            dropped=report["dropped"],
+        )
+        log.info(
+            "handover: green took over (%d shadow(s) parity-proven, "
+            "%d drained, %d dropped, %.2fs)",
+            len(shadow_inputs), swap["drained"], report["dropped"],
+            report["duration_s"],
+        )
+        return report

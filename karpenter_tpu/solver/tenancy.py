@@ -909,6 +909,69 @@ class TenantMux:
     def streaming_stats(self) -> Dict[str, float]:
         return self._service.streaming_stats()
 
+    # -- blue/green handover (solver/handover.py) ----------------------------
+
+    def swap_downstream(self, new_service, own: bool = True,
+                        drain_s: float = 5.0) -> Dict[str, object]:
+        """Atomically retarget the mux at a NEW downstream service (the
+        blue/green cutover seam). Zero-drop contract: requests already
+        forwarded to the old service stay mapped in `_fwd` and resolve
+        through their existing on_done callbacks — the old service is
+        DRAINED (bounded by `drain_s`) before it is closed, because closing
+        it with work in flight would deliver ServiceStopped, which the mux
+        treats as an infra error rather than replaying. Requests still
+        queued at the mux never see the swap at all: the dispatcher reads
+        `self._service` at forward time, so from the swap onward every
+        forward lands on the new service.
+
+        Returns a report dict: tickets drained from the old service, drain
+        timeouts (unresolved when the budget expired), and whether the old
+        service was closed here."""
+        with self._cv:
+            if self._closing:
+                raise ServiceStopped("tenant mux is closed")
+            old_service = self._service
+            old_own = self._own_service
+            pending_old = list(self._fwd)
+            self._service = new_service
+            self._own_service = own
+            self.max_inflight = max(1, (getattr(new_service, "size", 1)
+                                        * getattr(new_service, "depth", 2)))
+            self._cv.notify_all()
+        deadline = self._clock() + max(0.0, drain_s)
+        timeouts = 0
+        for dt in pending_old:
+            remaining = deadline - self._clock()
+            if dt.done():
+                continue
+            if remaining <= 0:
+                timeouts += 1
+                continue
+            try:
+                dt.result(timeout=remaining)
+            except TimeoutError:
+                timeouts += 1
+            except Exception:  # noqa: BLE001 — an error delivery still
+                pass  # resolves the ticket; the mux callback handled it
+        closed = False
+        if old_own and timeouts == 0:
+            # fully drained: the old service can die without a single
+            # ServiceStopped reaching a mux ticket
+            old_service.close()
+            closed = True
+        elif old_own:
+            # stragglers keep the old service alive; closing it now WOULD
+            # drop them — leave it to the caller (handover reports this)
+            log.warning(
+                "tenant mux: downstream swap left %d ticket(s) undrained "
+                "after %.1fs — old service left running", timeouts, drain_s,
+            )
+        return {
+            "drained": len(pending_old) - timeouts,
+            "timeouts": timeouts,
+            "old_service_closed": closed,
+        }
+
     # -- shutdown ------------------------------------------------------------
 
     def close(self) -> None:

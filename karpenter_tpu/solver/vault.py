@@ -1,0 +1,622 @@
+"""SolverStateVault: durable solver resident state (ISSUE 17).
+
+Streaming delta-solve (solver/streaming.py) made the solver STATEFUL —
+resident encode cores, arena residency classes, checkpoint rings, a journal
+cursor — so a process restart or TPU maintenance event costs a full
+re-encode + AOT re-prewarm before the first decision lands. This module
+makes restart-to-first-solve journal-lag-bounded instead of
+cluster-size-bounded: a periodic *async* snapshot of the device-facing
+resident model, written atomically to local disk off the hot path, plus a
+restore path that re-seeds the encode caches and composes with the
+streaming model's re-baseline machinery.
+
+What a vault file holds (version 1):
+
+  - the journal cursor (`seq` — the StreamingSolver's applied seq, or the
+    journal head when no streaming model is wired) and the store's
+    resource-version high-water mark, stamped for restore cross-checks;
+  - encode-core DONORS: every cached `_EncodeCore` exported with its pod
+    lists stripped and re-keyed by CONTENT — the ordered distinct pod
+    signature sequence plus a content fingerprint of the catalog segment —
+    because the live cache key embeds process-local object ids and interned
+    signature numbers that mean nothing across a process boundary
+    (encode_cache.install_vault_donors / adopt_vault_donor);
+  - an arena MANIFEST: accounted bytes per (residency class, tenant) and
+    per-bucket content digests (args / checkpoint ring / relax ladders).
+    HBM buffers die with their process, so the manifest is verification
+    and observability, not buffer state: a restored process re-adopts
+    residency on its first solve (one packed cold upload), and a
+    same-process restore whose live arena disagrees with the manifest
+    invalidates it rather than trust unknowable residency;
+  - tenant namespaces, so multi-tenant donor installs land per tenant.
+
+Restore semantics (solver/SPEC.md "Durability semantics"): candidates are
+scanned newest-first; a truncated / checksum-mismatched / wrong-epoch /
+seq-ahead / store-behind file is SKIPPED (counted, flight-dumped as
+`vault_restore_failed` when nothing restorable remains) — the operator
+degrades to the cold re-encode path, never crashes, and never serves stale
+decisions: donors are additionally content-verified at encode time, so a
+donor that no longer matches the live pod/catalog content simply misses.
+
+Fault sites (faults.py): `vault.write` fires before each snapshot write —
+a failure skips the snapshot with a throttled WARN and the next interval
+retries; `vault.corrupt` fires in the file-read path so chaos tests can
+reject candidates without hand-crafting broken bytes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import logging
+import os
+import pickle
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import faults
+from ..metrics.registry import (
+    SOLVER_VAULT_AGE,
+    SOLVER_VAULT_BYTES,
+    SOLVER_VAULT_RESTORE_FAILURES,
+    SOLVER_VAULT_RESTORE_SECONDS,
+    SOLVER_VAULT_RESTORES,
+    SOLVER_VAULT_SNAPSHOT_SECONDS,
+)
+from ..obs import telemetry as obstelemetry
+from ..obs import trace as obstrace
+
+log = logging.getLogger("karpenter_tpu")
+
+VAULT_MAGIC = b"KVAULT1\n"
+VAULT_VERSION = 1
+_DIGEST_SIZE = 16
+_HDR = len(VAULT_MAGIC) + _DIGEST_SIZE
+
+
+class VaultCorrupt(Exception):
+    """A vault candidate that must be skipped: truncated, checksum
+    mismatch, unpicklable, or failing a restore cross-check."""
+
+
+@dataclasses.dataclass
+class RestoreReport:
+    """What one successful restore did (surfaced on /healthz + dumps)."""
+
+    path: str
+    seq: int
+    store_rv: Optional[int]
+    donors_installed: int
+    streaming: str  # "tail" | "rebaseline" | "baseline" | "none"
+    arena: str  # "resident" | "cold" | "none"
+    age_s: float
+    skipped: List[Tuple[str, str]]  # (file, reason) for rejected candidates
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# -- capture helpers ----------------------------------------------------------
+
+
+def export_encode_donors() -> List[dict]:
+    """Export every cached `_EncodeCore` (default + per-tenant namespaces)
+    as a process-portable donor record. The live cache key is useless
+    across processes — it embeds pod/type object ids and interned signature
+    numbers — so each donor is re-keyed by CONTENT: the ordered distinct
+    pod signature sequence (computed from the group representatives while
+    they are still alive) plus the catalog content fingerprint the encoder
+    stamped on the entry. Pod lists and the O(pods) run split are stripped:
+    the [G]/[T]/[P] tables are pure functions of (signature sequence,
+    catalog segment) and the adopter rebuilds the rest from its own pods.
+    """
+    import numpy as np
+
+    from . import encode as em
+    from . import encode_cache as ec
+
+    donors: List[dict] = []
+    namespaces = [(None, em._CORE_CACHE)]
+    namespaces += [(tid, c) for tid, c in ec._TENANT_CORE_CACHES.items()]
+    for tenant_id, cache in namespaces:
+        for key, ent in list(cache.items()):
+            core = ent[1]
+            cat_fp = ent[4] if len(ent) > 4 else None
+            if cat_fp is None or not core.group_snums:
+                continue  # no content key / batch-local sigs: not portable
+            try:
+                sig_seq = tuple(
+                    em._pod_signature(pl[0]) for pl in core.group_pods
+                )
+                stripped = dataclasses.replace(
+                    core,
+                    group_pods=[],
+                    run_group=np.zeros(0, np.int32),
+                    run_count=np.zeros(0, np.int32),
+                    sorted_uids=core.sorted_uids[:0],
+                )
+                donors.append({
+                    "tenant_id": tenant_id,
+                    "sig_seq": sig_seq,
+                    "ds_key": key[3],
+                    "zones": key[4],
+                    "cts": key[5],
+                    "policy": key[6],
+                    "cat_fp": cat_fp,
+                    "core": stripped,
+                })
+            except Exception:  # noqa: BLE001 — one bad entry never aborts
+                log.exception("solver vault: donor export skipped one core")
+    return donors
+
+
+def arena_manifest(arena) -> Optional[dict]:
+    """Content manifest of an ArgumentArena's residency: accounted bytes
+    per (class, tenant) plus per-bucket entry digests and the checkpoint /
+    ladder digest sets. Digests only — device buffers cannot be persisted;
+    the manifest lets a restore REPORT what residency existed and lets a
+    same-process restore detect divergence (and invalidate) instead of
+    trusting unknowable buffers."""
+    if arena is None:
+        return None
+    try:
+        buckets: Dict[str, list] = {}
+        for key, rec in arena._buckets.items():
+            _, tags = rec
+            buckets[repr(key)] = [
+                tag[1].hex() if tag is not None and tag[1] is not None
+                else None
+                for tag in tags
+            ]
+        ladders = sorted(
+            dig.hex() for (dig, _arr) in arena._ladders.values()
+        )
+        ckpts = {repr(k): len(v) for k, v in arena._ckpts.items()}
+        return {
+            "total_bytes": int(arena.total_bytes()),
+            "classes": {
+                f"{cls}/{ten}": int(nb)
+                for (cls, ten), nb in sorted(arena.bytes_by_class().items())
+            },
+            "buckets": buckets,
+            "ladder_digests": ladders,
+            "checkpoints": ckpts,
+        }
+    except Exception:  # noqa: BLE001 — manifest is observability, not state
+        log.exception("solver vault: arena manifest capture failed")
+        return None
+
+
+class SolverStateVault:
+    """Periodic async snapshots + cross-checked restore of the solver's
+    resident state. Construction creates the vault directory; nothing is
+    written until `snapshot_now()` / `maybe_snapshot()` runs, and nothing
+    anywhere consults the vault unless one is explicitly wired — vault-off
+    deployments are byte-identical to the pre-vault path."""
+
+    def __init__(
+        self,
+        directory: str,
+        interval_s: float = 5.0,
+        keep: int = 3,
+        epoch: str = "default",
+        journal=None,
+        store=None,
+        streaming=None,
+        arena_fn: Optional[Callable[[], object]] = None,
+        clock=time.monotonic,
+        warn_every_s: float = 30.0,
+    ):
+        if interval_s <= 0:
+            raise ValueError(f"vault interval must be > 0, got {interval_s}")
+        if keep < 1:
+            raise ValueError(f"vault keep must be >= 1, got {keep}")
+        self.dir = os.path.abspath(directory)
+        os.makedirs(self.dir, exist_ok=True)
+        self.interval_s = float(interval_s)
+        self.keep = int(keep)
+        # the vault's journal-identity stamp: a file captured against one
+        # journal/store lineage must not restore into another (the
+        # "wrong-journal-epoch" rejection class)
+        self.epoch = epoch
+        self.journal = journal
+        self.store = store
+        self.streaming = streaming
+        self.arena_fn = arena_fn
+        self.clock = clock
+        self.warn_every_s = float(warn_every_s)
+        self._lock = threading.Lock()
+        self._inflight = False
+        self._n = 0
+        self._last_attempt_at: Optional[float] = None
+        self._last_snapshot_at: Optional[float] = None
+        self._last_warn_at: Optional[float] = None
+        self._last_path: Optional[str] = None
+        self._last_bytes = 0
+        self._last_seq = 0
+        self.last_restore: Optional[RestoreReport] = None
+        self.stats: Dict[str, int] = {
+            "snapshots": 0,
+            "write_failures": 0,
+            "restores": 0,
+            "restore_failures": 0,
+            "donors_installed": 0,
+        }
+
+    # -- capture / snapshot ---------------------------------------------------
+
+    def capture(self) -> dict:
+        """Assemble the snapshot payload from the live resident state.
+        Quick host work only (donor export walks the bounded core caches;
+        the arena manifest hexes already-computed digests) — the expensive
+        pickle + fsync happen in the caller, off the solve path."""
+        from . import encode_cache as ec
+
+        seq = 0
+        if self.streaming is not None:
+            seq = int(self.streaming.snapshot()["applied_seq"])
+        elif self.journal is not None:
+            seq = int(self.journal.rev())
+        return {
+            "version": VAULT_VERSION,
+            "epoch": self.epoch,
+            "seq": seq,
+            "store_rv": (
+                int(self.store.current_rv()) if self.store is not None
+                else None
+            ),
+            "captured_at": self.clock(),
+            "donors": export_encode_donors(),
+            "arena": arena_manifest(
+                self.arena_fn() if self.arena_fn is not None else None
+            ),
+            "tenants": sorted(ec._TENANT_CORE_CACHES),
+            "core_rev": ec._CORE_REV,
+        }
+
+    def snapshot_now(self) -> Optional[str]:
+        """Capture + atomic checksummed write (tmp, fsync, rename), prune
+        to `keep`. Returns the written path, or None on failure — failures
+        WARN at most every `warn_every_s` and never propagate: the solver
+        keeps serving and the next interval retries."""
+        t0 = time.perf_counter()
+        try:
+            faults.check("vault.write")
+            payload = self.capture()
+            blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+            digest = hashlib.blake2b(blob, digest_size=_DIGEST_SIZE).digest()
+            with self._lock:
+                self._n += 1
+                n = self._n
+            final = os.path.join(
+                self.dir, f"vault-{payload['seq']:012d}-{n:06d}.vlt"
+            )
+            fd, tmp = tempfile.mkstemp(dir=self.dir, prefix=".vault-")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(VAULT_MAGIC)
+                    f.write(digest)
+                    f.write(blob)
+                    f.flush()
+                    # fsync BEFORE the rename: a crash between write and
+                    # rename must never leave a torn file as the newest
+                    # candidate (same hardening as controllers/snapshot.py)
+                    os.fsync(f.fileno())
+                os.replace(tmp, final)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+            self._prune()
+        except Exception as e:  # noqa: BLE001 — snapshots must never crash
+            with self._lock:
+                self.stats["write_failures"] += 1
+                now = self.clock()
+                warn = (
+                    self._last_warn_at is None
+                    or now - self._last_warn_at >= self.warn_every_s
+                )
+                if warn:
+                    self._last_warn_at = now
+            if warn:
+                log.warning(
+                    "solver vault: snapshot failed (%s: %s) — serving "
+                    "continues; next interval retries",
+                    type(e).__name__, e,
+                )
+            obstelemetry.note_event(
+                "vault_write_failed", error=type(e).__name__
+            )
+            return None
+        nbytes = _HDR + len(blob)
+        SOLVER_VAULT_SNAPSHOT_SECONDS.observe(time.perf_counter() - t0)
+        SOLVER_VAULT_BYTES.set(float(nbytes))
+        SOLVER_VAULT_AGE.set(0.0)
+        with self._lock:
+            self.stats["snapshots"] += 1
+            self._last_snapshot_at = self.clock()
+            self._last_path = final
+            self._last_bytes = nbytes
+            self._last_seq = payload["seq"]
+        return final
+
+    def maybe_snapshot(self) -> bool:
+        """Interval-gated ASYNC snapshot: spawns one background writer at
+        most every `interval_s` (failures included — a failing disk retries
+        at the cadence, it does not spin). Returns True when a snapshot was
+        started. This is the hot-path entry: it costs two clock reads and a
+        thread spawn per interval, nothing per solve."""
+        with self._lock:
+            if self._inflight:
+                return False
+            now = self.clock()
+            if (
+                self._last_attempt_at is not None
+                and now - self._last_attempt_at < self.interval_s
+            ):
+                return False
+            self._last_attempt_at = now
+            self._inflight = True
+        threading.Thread(
+            target=self._snapshot_worker, daemon=True, name="solver-vault"
+        ).start()
+        return True
+
+    def _snapshot_worker(self) -> None:
+        try:
+            self.snapshot_now()
+        finally:
+            with self._lock:
+                self._inflight = False
+
+    # -- files ----------------------------------------------------------------
+
+    def candidates(self) -> List[str]:
+        """Vault files newest-first (the seq+counter filename sorts
+        lexicographically = numerically)."""
+        try:
+            names = [
+                n for n in os.listdir(self.dir)
+                if n.startswith("vault-") and n.endswith(".vlt")
+            ]
+        except OSError:
+            return []
+        return [os.path.join(self.dir, n) for n in sorted(names, reverse=True)]
+
+    def _prune(self) -> None:
+        for path in self.candidates()[self.keep:]:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def _read(self, path: str) -> dict:
+        faults.check("vault.corrupt")
+        with open(path, "rb") as f:
+            raw = f.read()
+        if len(raw) < _HDR or not raw.startswith(VAULT_MAGIC):
+            raise VaultCorrupt(
+                f"{os.path.basename(path)}: truncated or bad magic"
+            )
+        digest, blob = raw[len(VAULT_MAGIC):_HDR], raw[_HDR:]
+        if hashlib.blake2b(blob, digest_size=_DIGEST_SIZE).digest() != digest:
+            raise VaultCorrupt(
+                f"{os.path.basename(path)}: checksum mismatch"
+            )
+        try:
+            payload = pickle.loads(blob)
+        except Exception as e:  # noqa: BLE001 — any decode failure is corrupt
+            raise VaultCorrupt(
+                f"{os.path.basename(path)}: unpicklable "
+                f"({type(e).__name__}: {e})"
+            ) from e
+        if not isinstance(payload, dict) or payload.get("version") != VAULT_VERSION:
+            raise VaultCorrupt(
+                f"{os.path.basename(path)}: unknown payload version "
+                f"{payload.get('version') if isinstance(payload, dict) else '?'}"
+            )
+        return payload
+
+    # -- restore --------------------------------------------------------------
+
+    def _cross_check(self, payload: dict) -> None:
+        """The seq/state_rev cross-check table (SPEC.md "Durability
+        semantics"): any mismatch rejects the candidate, which forces the
+        clean re-baseline / cold re-encode path rather than risking
+        decisions derived from another lineage or a future the live
+        process has not reached."""
+        if payload.get("epoch") != self.epoch:
+            raise VaultCorrupt(
+                f"journal epoch mismatch (vault {payload.get('epoch')!r}, "
+                f"live {self.epoch!r})"
+            )
+        if self.journal is not None and payload["seq"] > self.journal.rev():
+            raise VaultCorrupt(
+                f"vault seq {payload['seq']} ahead of live journal "
+                f"{self.journal.rev()} (journal lineage reset?)"
+            )
+        rv = payload.get("store_rv")
+        if (
+            self.store is not None and rv is not None
+            and self.store.current_rv() < rv
+        ):
+            raise VaultCorrupt(
+                f"store rv {self.store.current_rv()} behind vault rv {rv} "
+                "(older store snapshot restored?)"
+            )
+
+    def _compose_streaming(self, payload: dict) -> str:
+        """Compose with the streaming model: when the live model has
+        already folded past the vault's seq the journal tail covers the
+        gap ('tail' — pump() folds the rest); an attached model BEHIND the
+        vault seq is a mismatch and is forced onto a clean re-baseline; a
+        fresh model baselines on its first pump anyway."""
+        s = self.streaming
+        if s is None:
+            return "none"
+        if not getattr(s, "_attached", False):
+            return "baseline"
+        if s.snapshot()["applied_seq"] < payload["seq"]:
+            s.force_rebaseline("vault_seq_mismatch")
+            return "rebaseline"
+        return "tail"
+
+    def _compose_arena(self, payload: dict) -> str:
+        """Verify live arena residency against the vaulted manifest. HBM
+        buffers never survive a process, so a fresh process reports 'cold'
+        (first solve re-adopts with one packed upload); a live arena whose
+        digests diverge from the manifest is invalidated — residency the
+        vault cannot vouch for is residency the next dispatch must not
+        trust."""
+        manifest = payload.get("arena")
+        arena = self.arena_fn() if self.arena_fn is not None else None
+        if arena is None or manifest is None:
+            return "none"
+        live = arena_manifest(arena)
+        if live is None or not live["buckets"]:
+            return "cold"
+        if (
+            live["buckets"] == manifest.get("buckets")
+            and live["ladder_digests"] == manifest.get("ladder_digests")
+        ):
+            return "resident"
+        try:
+            arena.invalidate()
+        except Exception:  # noqa: BLE001 — best-effort on divergence
+            log.exception("solver vault: arena invalidate failed")
+        return "cold"
+
+    def restore(self, install: bool = True) -> Optional[RestoreReport]:
+        """Scan candidates newest-first; the first one that reads clean AND
+        passes the cross-checks is restored (encode donors installed,
+        streaming/arena composed). Corrupt or mismatched candidates are
+        skipped; if none survives, the failure is counted, flight-dumped
+        (`vault_restore_failed`), and None returned — the caller proceeds
+        on the cold path. An EMPTY vault directory returns None silently:
+        a first boot is not a failure."""
+        from . import encode_cache as ec
+
+        t0 = time.perf_counter()
+        skipped: List[Tuple[str, str]] = []
+        with obstrace.span("vault.restore"):
+            for path in self.candidates():
+                try:
+                    payload = self._read(path)
+                    self._cross_check(payload)
+                except VaultCorrupt as e:
+                    skipped.append((os.path.basename(path), str(e)))
+                    continue
+                except Exception as e:  # noqa: BLE001 — torn reads, OS
+                    # errors, injected faults: one bad candidate is a skip,
+                    # never a boot failure
+                    skipped.append((
+                        os.path.basename(path),
+                        f"{type(e).__name__}: {e}",
+                    ))
+                    continue
+                installed = 0
+                if install:
+                    installed = ec.install_vault_donors(payload["donors"])
+                streaming = self._compose_streaming(payload)
+                arena = self._compose_arena(payload)
+                age = max(0.0, self.clock() - payload.get("captured_at", 0.0))
+                report = RestoreReport(
+                    path=path,
+                    seq=int(payload["seq"]),
+                    store_rv=payload.get("store_rv"),
+                    donors_installed=installed,
+                    streaming=streaming,
+                    arena=arena,
+                    age_s=age,
+                    skipped=skipped,
+                )
+                SOLVER_VAULT_RESTORES.inc()
+                SOLVER_VAULT_RESTORE_SECONDS.observe(time.perf_counter() - t0)
+                with self._lock:
+                    self.stats["restores"] += 1
+                    self.stats["donors_installed"] += installed
+                    self.last_restore = report
+                obstelemetry.note_event(
+                    "vault_restore", seq=report.seq,
+                    donors=installed, streaming=streaming, arena=arena,
+                )
+                log.info(
+                    "solver vault: restored %s (seq=%d, %d donor core(s), "
+                    "streaming=%s, arena=%s%s)",
+                    os.path.basename(path), report.seq, installed, streaming,
+                    arena,
+                    f", {len(skipped)} corrupt candidate(s) skipped"
+                    if skipped else "",
+                )
+                return report
+        if skipped:
+            # candidates existed and ALL were rejected: that is the
+            # corruption-fallback path the operator must survive loudly
+            SOLVER_VAULT_RESTORE_FAILURES.inc()
+            with self._lock:
+                self.stats["restore_failures"] += 1
+            obstelemetry.note_event(
+                "vault_restore_failed", candidates=len(skipped),
+                first_error=skipped[0][1],
+            )
+            try:
+                obstrace.dump(
+                    "vault_restore_failed", candidates=len(skipped),
+                    first_error=skipped[0][1],
+                )
+            except Exception:  # noqa: BLE001 — diagnostics never abort boot
+                log.exception("solver vault: restore-failure dump failed")
+            log.warning(
+                "solver vault: restore FAILED — %d candidate(s) rejected "
+                "(%s) — degrading to the cold re-encode path",
+                len(skipped), skipped[0][1],
+            )
+        return None
+
+    # -- introspection --------------------------------------------------------
+
+    def vault_age_s(self) -> Optional[float]:
+        with self._lock:
+            if self._last_snapshot_at is None:
+                return None
+            return max(0.0, self.clock() - self._last_snapshot_at)
+
+    def health(self) -> dict:
+        """The /healthz "vault" object (registered as a telemetry provider
+        by the operator) — also refreshes the age gauge so scrapes between
+        snapshots see the true staleness."""
+        age = self.vault_age_s()
+        if age is not None:
+            SOLVER_VAULT_AGE.set(age)
+        with self._lock:
+            return {
+                "dir": self.dir,
+                "interval_s": self.interval_s,
+                "keep": self.keep,
+                "epoch": self.epoch,
+                "age_s": age,
+                "last_seq": self._last_seq,
+                "last_bytes": self._last_bytes,
+                "last_restore": (
+                    self.last_restore.as_dict()
+                    if self.last_restore is not None else None
+                ),
+                **self.stats,
+            }
+
+
+class VaultController:
+    """Controller-loop adapter: one `maybe_snapshot()` poke per reconcile.
+    The snapshot itself runs on the vault's own daemon thread, so the
+    controller tick — and the solve path it shares a loop with — never
+    blocks on capture, pickling, or fsync."""
+
+    name = "solver-vault"
+
+    def __init__(self, vault: SolverStateVault):
+        self.vault = vault
+
+    def reconcile(self) -> bool:
+        self.vault.maybe_snapshot()
+        return False  # snapshots are not cluster progress

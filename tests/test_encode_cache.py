@@ -109,7 +109,8 @@ class TestPatchTransparency:
         inp = _inp(_pods("hit", (4, 3, 2, 2)))
         a = em.encode(inp)
         b = em.encode(inp)
-        assert ec.STATS == {"hits": 1, "patches": 0, "rebuilds": 1}, ec.STATS
+        assert ec.STATS == {"hits": 1, "patches": 0, "rebuilds": 1,
+                            "vault_adopts": 0}, ec.STATS
         assert_encoded_equal(a, b)
 
     def test_patched_equals_fresh_field_by_field(self):
@@ -159,7 +160,8 @@ class TestInvalidation:
         em._CORE_CACHE.clear()
         ec.reset_stats()
         em.encode(_inp(_pods("seed", counts)))
-        assert ec.STATS == {"hits": 0, "patches": 0, "rebuilds": 1}
+        assert ec.STATS == {"hits": 0, "patches": 0, "rebuilds": 1,
+                            "vault_adopts": 0}
 
     def test_new_signature_rebuilds(self):
         self._seed()
@@ -193,7 +195,8 @@ class TestInvalidation:
             SolverInput(pods=srt, nodes=[], nodepools=[pool()], zones=ZONES,
                         presorted=True)
         )
-        assert ec.STATS == {"hits": 0, "patches": 0, "rebuilds": 1}, ec.STATS
+        assert ec.STATS == {"hits": 0, "patches": 0, "rebuilds": 1,
+                            "vault_adopts": 0}, ec.STATS
         assert len(em._CORE_CACHE) == n  # never cached, never a donor
 
 

@@ -133,6 +133,23 @@ def test_cohort_series_are_registered():
         assert name in registered, f"{name} missing from the registry"
 
 
+def test_vault_series_are_registered():
+    """ISSUE 17 acceptance: the solver-vault series are part of the
+    /metrics contract — snapshot latency/size/age, restore latency, and
+    the restore/failure counters are what the durability dashboards and
+    the vault-staleness alert scrape, so pin their exact names."""
+    registered = {m.name for m in reg.REGISTRY.metrics}
+    for name in (
+        "karpenter_solver_vault_snapshot_seconds",
+        "karpenter_solver_vault_bytes",
+        "karpenter_solver_vault_age_seconds",
+        "karpenter_solver_vault_restore_seconds",
+        "karpenter_solver_vault_restores_total",
+        "karpenter_solver_vault_restore_failures_total",
+    ):
+        assert name in registered, f"{name} missing from the registry"
+
+
 def test_every_reason_code_has_name_and_spec_row():
     """Every kernel reason code must have a decoder-side name AND a SPEC.md
     row — an undocumented code is a wire symbol operators cannot read."""

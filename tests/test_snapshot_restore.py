@@ -289,3 +289,62 @@ def test_condition_since_rebases_across_restore(tmp_path):
     n2 = op2.store.get(st.NODES, "sick")
     age = clock_lo() - n2.condition_since["Unhealthy"]
     assert 9 <= age <= 12, f"condition age skewed after restore: {age}"
+
+
+def test_torn_snapshot_is_detected_and_boot_proceeds_empty(tmp_path):
+    """A crash mid-write (or bit rot) must be DETECTED at restore via the
+    checksum frame and skipped — the process boots empty and reconverges
+    instead of raising an UnpicklingError out of boot."""
+    op = boot(tmp_path)
+    op.store.create(st.NODEPOOLS, mkpool())
+    op.store.create(st.PODS, mkpod("p0", cpu="500m"))
+    op.manager.settle()
+    op.clock.advance(10)
+    op.manager.tick()
+    path = tmp_path / "snap.bin"
+    raw = path.read_bytes()
+    path.write_bytes(raw[: len(raw) // 2])  # torn mid-payload
+
+    op2 = boot(tmp_path)  # must not raise
+    assert op2.store.list(st.PODS) == []
+    assert op2.store.list(st.NODEPOOLS) == []
+
+
+def test_checksum_flip_is_detected_and_boot_proceeds_empty(tmp_path):
+    from karpenter_tpu.controllers.snapshot import restore_snapshot
+
+    op = boot(tmp_path)
+    op.store.create(st.NODEPOOLS, mkpool())
+    op.manager.settle()
+    op.clock.advance(10)
+    op.manager.tick()
+    path = tmp_path / "snap.bin"
+    raw = bytearray(path.read_bytes())
+    raw[-1] ^= 0xFF  # one flipped payload byte
+    path.write_bytes(bytes(raw))
+
+    op2 = new_kwok_operator(clock=FakeClock())
+    assert not restore_snapshot(op2.store, op2.cloud, str(path))
+    assert op2.store.list(st.NODEPOOLS) == []
+
+
+def test_legacy_unframed_snapshot_still_restores(tmp_path):
+    """Pre-framing snapshot files are bare pickle (first byte \\x80) — they
+    must keep restoring so an upgraded binary can boot from a file the old
+    binary wrote."""
+    from karpenter_tpu.controllers.snapshot import _SNAP_HDR, restore_snapshot
+
+    op = boot(tmp_path)
+    op.store.create(st.NODEPOOLS, mkpool())
+    op.manager.settle()
+    op.clock.advance(10)
+    op.manager.tick()
+    path = tmp_path / "snap.bin"
+    raw = path.read_bytes()
+    path.write_bytes(raw[_SNAP_HDR:])  # strip the frame: legacy layout
+
+    op2 = new_kwok_operator(clock=FakeClock())
+    assert restore_snapshot(op2.store, op2.cloud, str(path))
+    assert {p.meta.name for p in op2.store.list(st.NODEPOOLS)} == {
+        p.meta.name for p in op.store.list(st.NODEPOOLS)
+    }

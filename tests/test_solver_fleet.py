@@ -413,3 +413,109 @@ def test_soak_suite_smoke_short_trace():
     assert out["soak_failovers"] >= 1
     assert out["solves_per_sec"] > 0
     assert out["failover_recovery_ms"] >= 0
+
+
+# ---------------------------------------------------------------- vault (ISSUE 17)
+
+
+def _warm_vault(tmp_path):
+    """Warm the process encode cache with one core and snapshot it, the way
+    a serving operator's VaultController would have."""
+    from karpenter_tpu.solver import encode as em
+    from karpenter_tpu.solver.encode import quantize_input
+    from karpenter_tpu.solver.vault import SolverStateVault
+
+    em.encode(quantize_input(mkinput("vault-warm")))
+    vault = SolverStateVault(str(tmp_path))
+    assert vault.snapshot_now() is not None
+    return vault
+
+
+def test_device_lost_fence_with_vault_restores_zero_drops(tmp_path):
+    """solver.device_lost fences an owner while a vault is wired: the fence
+    path re-seeds the encode caches from the newest snapshot
+    (fleet_stats["vault_restores"]) and every solve before and after the
+    fence completes on the surviving owner — zero drops."""
+    vault = _warm_vault(tmp_path)
+    fleet, solvers, _ = mkfleet(size=2, fence_after_misses=1)
+    fleet.vault = vault
+    plan = faults.FaultPlan(seed=5)
+    try:
+        with faults.active(plan):
+            pre = [fleet.submit(mkinput(f"pre{i}"), kind=DISRUPTION)
+                   for i in range(4)]
+            for t in pre:
+                assert t.result(timeout=10).claims
+            # the maintenance event lands AFTER the pre-fence traffic: the
+            # next canary draws it and fences owner-0
+            plan.fail_n(
+                "solver.device_lost", 1,
+                faults.DeviceLost("maintenance (injected)"), tag="owner-0",
+            )
+            assert fleet.probe_once()["owner-0"] == "fenced"
+            post = [fleet.submit(mkinput(f"post{i}"), kind=DISRUPTION)
+                    for i in range(4)]
+            for t in post:
+                assert t.result(timeout=10).claims
+        assert fleet.fleet_stats["vault_restores"] == 1
+        assert vault.stats["restores"] == 1
+        assert vault.stats["donors_installed"] >= 1
+        assert fleet.unresolved() == 0  # zero dropped solves
+        assert fleet.stats["oracle_degraded"] == 0
+    finally:
+        fleet.close()
+
+
+def test_all_owners_lost_with_vault_revives_instead_of_oracle(tmp_path):
+    """Fleet-wide device_lost (a maintenance event hitting every owner) with
+    a vault in hand: the LAST fence finds zero healthy owners and revives a
+    fenced owner through a direct canary + vault restore instead of
+    degrading every subsequent solve to the cold oracle."""
+    vault = _warm_vault(tmp_path)
+    fleet, solvers, _ = mkfleet(size=2, fence_after_misses=1)
+    fleet.vault = vault
+    # exactly one device_lost per owner's fencing canary; the revive canary
+    # that follows draws from an empty script and succeeds
+    plan = faults.FaultPlan(seed=5).script(
+        "solver.device_lost", faults.DeviceLost, faults.DeviceLost,
+    )
+    try:
+        with faults.active(plan):
+            fleet.probe_once()
+            assert fleet.healthy_owners() == 1  # revived, not zero
+            res = fleet.submit(mkinput("revived")).result(timeout=10)
+            assert res.claims and res.claims[0].pod_uids == ["revived"]
+        assert fleet.fleet_stats["vault_restores"] >= 1
+        assert fleet.stats["oracle_degraded"] == 0
+        assert fleet.unresolved() == 0
+    finally:
+        fleet.close()
+
+
+def test_vault_write_fault_mid_soak_keeps_serving(tmp_path):
+    """Chaos soak: vault.write fails mid-run — snapshots SKIP (counted,
+    throttled WARN) while every solve keeps landing, and the next cadence
+    retry succeeds once the fault clears."""
+    from karpenter_tpu.solver import encode as em
+    from karpenter_tpu.solver.encode import quantize_input
+    from karpenter_tpu.solver.vault import SolverStateVault
+
+    em.encode(quantize_input(mkinput("soak-warm")))
+    vault = SolverStateVault(str(tmp_path))
+    fleet, _, _ = mkfleet(size=2)
+    fleet.vault = vault
+    plan = faults.FaultPlan(seed=5).fail_n(
+        "vault.write", 2, OSError("disk full (injected)")
+    )
+    try:
+        with faults.active(plan):
+            for step in range(6):
+                t = fleet.submit(mkinput(f"soak{step}"), kind=DISRUPTION)
+                assert t.result(timeout=10).claims  # serving never stops
+                vault.snapshot_now()  # the controller cadence
+        assert vault.stats["write_failures"] == 2
+        assert vault.stats["snapshots"] == 4  # retries landed post-fault
+        assert len(vault.candidates()) >= 1
+        assert fleet.unresolved() == 0
+    finally:
+        fleet.close()

@@ -1,0 +1,465 @@
+"""Durable solver resident state (solver/vault.py + solver/handover.py).
+
+ISSUE 17 acceptance surface:
+- donor round trip: a vault written by one "process" re-seeds a fresh
+  process's encode cache, and the adopted core is bit-identical to a cold
+  build (a stale vault may cost time, never change a decision);
+- corruption fallback: truncated / checksum-flipped / wrong-epoch /
+  seq-ahead candidates are SKIPPED — restore degrades to the cold path
+  with a `vault_restore_failed` flight dump, never a crash;
+- chaos: a `vault.write` fault skips the snapshot with a throttled WARN
+  and the next attempt retries; serving never stops;
+- blue/green: TenantMux.swap_downstream drains before closing (zero
+  drops) and BlueGreenHandover aborts on shadow-parity divergence with
+  the blue side untouched.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu import faults
+from karpenter_tpu.obs import trace as obstrace
+from karpenter_tpu.obs.recorder import FlightRecorder
+from karpenter_tpu.provisioning.scheduler import SolverInput
+from karpenter_tpu.solver import encode as em
+from karpenter_tpu.solver import encode_cache as ec
+from karpenter_tpu.solver.backend import ReferenceSolver
+from karpenter_tpu.solver.handover import (
+    BlueGreenHandover,
+    HandoverAborted,
+    solve_fingerprint,
+)
+from karpenter_tpu.solver.pipeline import DISRUPTION, SolveService
+from karpenter_tpu.solver.tenancy import TenantMux, TenantRegistry, TenantSpec
+from karpenter_tpu.solver.vault import (
+    VAULT_MAGIC,
+    SolverStateVault,
+    VaultController,
+    export_encode_donors,
+)
+
+from tests.test_encode_cache import _inp, _nodes, _pods, assert_encoded_equal
+from tests.test_zone_device import ZONES, pool
+
+
+def _simulate_restart():
+    """Everything process-local dies with the process: core caches, the
+    catalog-fingerprint memo, tenant namespaces, installed donors, stats.
+    Only the vault files on disk survive."""
+    em._CORE_CACHE.clear()
+    em._CAT_FP_CACHE.clear()
+    ec._TENANT_CORE_CACHES.clear()
+    ec.clear_vault_donors()
+    ec.reset_stats()
+
+
+@pytest.fixture(autouse=True)
+def _clean_encode_state():
+    _simulate_restart()
+    yield
+    _simulate_restart()
+    faults.use(None)
+
+
+# -- donor round trip ---------------------------------------------------------
+
+
+class TestDonorRoundTrip:
+    def test_restored_encode_adopts_and_matches_cold_build(self, tmp_path):
+        """The tentpole property: encode warm, snapshot, 'restart', restore,
+        re-encode with all-new pod objects (same uids — object ids and
+        interned signature numbers are process-local and must not matter).
+        The first encode must ADOPT the vault donor instead of rebuilding,
+        and the result must equal a cold build field by field."""
+        counts = (4, 3, 2, 2)
+        enc_cold = em.encode(_inp(_pods("rt", counts)))
+        assert ec.STATS["rebuilds"] == 1
+
+        vault = SolverStateVault(str(tmp_path), interval_s=1.0)
+        assert vault.snapshot_now() is not None
+
+        _simulate_restart()
+        restorer = SolverStateVault(str(tmp_path), interval_s=1.0)
+        report = restorer.restore(install=True)
+        assert report is not None and report.donors_installed == 1
+        assert report.skipped == []
+
+        enc2 = em.encode(_inp(_pods("rt", counts)))
+        assert ec.STATS == {"hits": 0, "patches": 0, "rebuilds": 0,
+                            "vault_adopts": 1}, ec.STATS
+        assert_encoded_equal(enc2, enc_cold)
+
+    def test_adopted_core_is_a_patch_donor_for_deltas(self, tmp_path):
+        """After adoption the entry is a first-class cache citizen: pod-set
+        deltas inside the signature universe patch off it."""
+        em.encode(_inp(_pods("pd", (4, 3, 2, 2))))
+        vault = SolverStateVault(str(tmp_path))
+        vault.snapshot_now()
+        _simulate_restart()
+        SolverStateVault(str(tmp_path)).restore(install=True)
+        em.encode(_inp(_pods("pd", (4, 3, 2, 2))))
+        assert ec.STATS["vault_adopts"] == 1
+        delta = em.encode(_inp(_pods("pd2", (2, 5, 1, 3))))
+        assert ec.STATS["patches"] == 1, ec.STATS
+        _simulate_restart()
+        assert_encoded_equal(delta, em.encode(_inp(_pods("pd2", (2, 5, 1, 3)))))
+
+    def test_content_mismatch_never_adopts(self, tmp_path):
+        """A donor whose catalog content diverges from the live input must
+        MISS (rebuild), not serve stale tables — the self-verification that
+        makes a stale vault a slowdown, never a wrong decision."""
+        em.encode(_inp(_pods("cm", (3, 2, 2, 1))))
+        vault = SolverStateVault(str(tmp_path))
+        vault.snapshot_now()
+        _simulate_restart()
+        SolverStateVault(str(tmp_path)).restore(install=True)
+        # same pods, different catalog (weight changes the content fp)
+        em.encode(_inp(_pods("cm", (3, 2, 2, 1)), nodepools=[pool(weight=5)]))
+        assert ec.STATS["vault_adopts"] == 0
+        assert ec.STATS["rebuilds"] == 1, ec.STATS
+
+    def test_export_strips_pod_scale_state(self):
+        em.encode(_inp(_pods("ex", (5, 4, 3, 2))))
+        donors = export_encode_donors()
+        assert len(donors) == 1
+        core = donors[0]["core"]
+        assert core.group_pods == []
+        assert len(core.run_group) == 0 and len(core.run_count) == 0
+        assert len(core.sorted_uids) == 0
+        assert donors[0]["cat_fp"] is not None
+        assert len(donors[0]["sig_seq"]) == len(core.group_snums)
+
+
+# -- vault files: atomicity, pruning, cadence ---------------------------------
+
+
+class TestVaultFiles:
+    def test_snapshot_writes_atomically_and_prunes(self, tmp_path):
+        em.encode(_inp(_pods("at", (2, 2, 1, 1))))
+        vault = SolverStateVault(str(tmp_path), keep=2)
+        paths = [vault.snapshot_now() for _ in range(4)]
+        assert all(p is not None for p in paths)
+        names = sorted(os.listdir(tmp_path))
+        # no temp files left behind, pruned to keep=2, newest survive
+        assert all(n.startswith("vault-") and n.endswith(".vlt")
+                   for n in names), names
+        assert len(names) == 2
+        assert vault.candidates()[0] == paths[-1]
+        with open(paths[-1], "rb") as f:
+            assert f.read(len(VAULT_MAGIC)) == VAULT_MAGIC
+
+    def test_maybe_snapshot_interval_gates(self, tmp_path):
+        clk = [0.0]
+        vault = SolverStateVault(str(tmp_path), interval_s=5.0,
+                                 clock=lambda: clk[0])
+        assert vault.maybe_snapshot() is True
+        deadline = time.monotonic() + 5.0
+        while vault._inflight and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert vault.stats["snapshots"] == 1
+        assert vault.maybe_snapshot() is False  # inside the interval
+        clk[0] = 5.1
+        assert vault.maybe_snapshot() is True
+
+    def test_controller_adapter_pokes_the_vault(self, tmp_path):
+        vault = SolverStateVault(str(tmp_path), interval_s=0.001)
+        ctrl = VaultController(vault)
+        assert ctrl.reconcile() is False
+        deadline = time.monotonic() + 5.0
+        while not vault.stats["snapshots"] and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert vault.stats["snapshots"] == 1
+
+
+# -- corruption fallback ------------------------------------------------------
+
+
+class TestCorruptionFallback:
+    def _vaulted(self, tmp_path, tag="cf"):
+        em.encode(_inp(_pods(tag, (3, 2, 2, 1))))
+        vault = SolverStateVault(str(tmp_path))
+        path = vault.snapshot_now()
+        assert path is not None
+        return path
+
+    def _assert_cold_fallback(self, tmp_path, tag, **vault_kw):
+        """Restore must return None (counted + dumped), and the process
+        must serve from the cold path with the exact cold-boot decision."""
+        rec_dir = tmp_path / "flight"
+        rec_dir.mkdir()
+        obstrace.configure(enabled=True,
+                           recorder=FlightRecorder(dir=str(rec_dir)))
+        try:
+            _simulate_restart()
+            restorer = SolverStateVault(str(tmp_path), **vault_kw)
+            assert restorer.restore(install=True) is None
+            assert restorer.stats["restore_failures"] == 1
+            dumps = list(rec_dir.glob("*")) if rec_dir.exists() else []
+            assert any("vault_restore_failed" in p.name for p in dumps), dumps
+            # cold path still serves, decision-identical to a cold boot
+            got = em.encode(_inp(_pods(tag, (3, 2, 2, 1))))
+            assert ec.STATS["vault_adopts"] == 0
+            assert ec.STATS["rebuilds"] == 1
+            _simulate_restart()
+            assert_encoded_equal(got, em.encode(_inp(_pods(tag, (3, 2, 2, 1)))))
+        finally:
+            obstrace.configure(enabled=False, recorder=None)
+
+    def test_truncated_vault_degrades_to_cold(self, tmp_path):
+        path = self._vaulted(tmp_path, "tr")
+        with open(path, "rb") as f:
+            raw = f.read()
+        with open(path, "wb") as f:
+            f.write(raw[: len(raw) // 2])
+        self._assert_cold_fallback(tmp_path, "tr")
+
+    def test_checksum_flip_degrades_to_cold(self, tmp_path):
+        path = self._vaulted(tmp_path, "ck")
+        with open(path, "rb") as f:
+            raw = bytearray(f.read())
+        raw[-1] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(bytes(raw))
+        self._assert_cold_fallback(tmp_path, "ck")
+
+    def test_wrong_journal_epoch_degrades_to_cold(self, tmp_path):
+        self._vaulted(tmp_path, "ep")
+        self._assert_cold_fallback(tmp_path, "ep", epoch="other-lineage")
+
+    def test_seq_ahead_of_journal_degrades_to_cold(self, tmp_path):
+        class _Journal:
+            def __init__(self, rev):
+                self._rev = rev
+
+            def rev(self):
+                return self._rev
+
+        em.encode(_inp(_pods("sq", (3, 2, 2, 1))))
+        writer = SolverStateVault(str(tmp_path), journal=_Journal(40))
+        assert writer.snapshot_now() is not None
+        _simulate_restart()
+        # the live journal restarted behind the vault's cursor: lineage reset
+        behind = SolverStateVault(str(tmp_path), journal=_Journal(7))
+        assert behind.restore(install=True) is None
+        assert behind.stats["restore_failures"] == 1
+        # a journal AT the vault seq restores fine
+        level = SolverStateVault(str(tmp_path), journal=_Journal(40))
+        assert level.restore(install=True) is not None
+
+    def test_store_rv_behind_vault_degrades_to_cold(self, tmp_path):
+        class _Store:
+            def __init__(self, rv):
+                self._rv = rv
+
+            def current_rv(self):
+                return self._rv
+
+        em.encode(_inp(_pods("rv", (3, 2, 2, 1))))
+        assert SolverStateVault(
+            str(tmp_path), store=_Store(90)
+        ).snapshot_now() is not None
+        _simulate_restart()
+        older = SolverStateVault(str(tmp_path), store=_Store(12))
+        assert older.restore(install=True) is None
+        newer = SolverStateVault(str(tmp_path), store=_Store(90))
+        assert newer.restore(install=True) is not None
+
+    def test_newest_corrupt_falls_back_to_older_good_candidate(self, tmp_path):
+        em.encode(_inp(_pods("fb", (3, 2, 2, 1))))
+        vault = SolverStateVault(str(tmp_path), keep=3)
+        vault.snapshot_now()
+        newest = vault.snapshot_now()
+        with open(newest, "wb") as f:
+            f.write(b"garbage")
+        _simulate_restart()
+        report = SolverStateVault(str(tmp_path)).restore(install=True)
+        assert report is not None and report.donors_installed == 1
+        assert [os.path.basename(newest)] == [n for n, _ in report.skipped]
+
+    def test_empty_vault_dir_is_a_silent_fresh_boot(self, tmp_path):
+        vault = SolverStateVault(str(tmp_path))
+        assert vault.restore(install=True) is None
+        assert vault.stats["restore_failures"] == 0
+
+
+# -- chaos: fault sites -------------------------------------------------------
+
+
+class TestVaultFaults:
+    def test_write_fault_skips_snapshot_and_next_attempt_retries(self, tmp_path):
+        em.encode(_inp(_pods("wf", (2, 2, 1, 1))))
+        vault = SolverStateVault(str(tmp_path))
+        plan = faults.FaultPlan(seed=3)
+        plan.fail_n("vault.write", 2, OSError("disk full (injected)"))
+        with faults.active(plan):
+            assert vault.snapshot_now() is None
+            assert vault.snapshot_now() is None
+            # serving continues while writes fail
+            em.encode(_inp(_pods("wf2", (1, 2, 1, 1))))
+            # the plan expires: the retry lands
+            assert vault.snapshot_now() is not None
+        assert plan.fired["vault.write"] == 2
+        assert vault.stats["write_failures"] == 2
+        assert vault.stats["snapshots"] == 1
+        assert len(vault.candidates()) == 1
+
+    def test_write_warn_is_throttled(self, tmp_path, caplog):
+        clk = [0.0]
+        vault = SolverStateVault(str(tmp_path), clock=lambda: clk[0],
+                                 warn_every_s=30.0)
+        plan = faults.FaultPlan()
+        plan.fail_n("vault.write", 3, OSError("injected"))
+        with faults.active(plan), caplog.at_level("WARNING", "karpenter_tpu"):
+            vault.snapshot_now()
+            clk[0] = 5.0
+            vault.snapshot_now()  # inside the throttle window: silent
+            clk[0] = 40.0
+            vault.snapshot_now()  # window elapsed: warns again
+        warns = [r for r in caplog.records if "snapshot failed" in r.message]
+        assert len(warns) == 2, [r.message for r in warns]
+        assert vault.stats["write_failures"] == 3
+
+    def test_corrupt_fault_rejects_candidates(self, tmp_path):
+        em.encode(_inp(_pods("cf2", (2, 2, 1, 1))))
+        vault = SolverStateVault(str(tmp_path))
+        vault.snapshot_now()
+        _simulate_restart()
+        plan = faults.FaultPlan()
+        plan.script("vault.corrupt",
+                    faults.FaultError("injected torn read"))
+        restorer = SolverStateVault(str(tmp_path))
+        with faults.active(plan):
+            assert restorer.restore(install=True) is None
+        assert restorer.stats["restore_failures"] == 1
+        # the fault cleared: the same file restores
+        assert restorer.restore(install=True) is not None
+
+
+# -- blue/green handover ------------------------------------------------------
+
+
+class _SlowSolver(ReferenceSolver):
+    def __init__(self, delay_s=0.02):
+        super().__init__()
+        self.delay_s = delay_s
+        self.solves = 0
+
+    def solve(self, inp):
+        self.solves += 1
+        time.sleep(self.delay_s)
+        return super().solve(inp)
+
+
+class _DivergentSolver(ReferenceSolver):
+    """Drops one placement: a green build whose DECISIONS differ."""
+
+    def solve(self, inp):
+        res = super().solve(inp)
+        if res.placements:
+            res.placements = dict(res.placements)
+            res.placements.pop(next(iter(res.placements)))
+        return res
+
+
+def _solver_input(tag, counts=(3, 2, 2, 1)):
+    return SolverInput(pods=_pods(tag, counts), nodes=_nodes(),
+                       nodepools=[pool()], zones=ZONES)
+
+
+def _mux(solver):
+    registry = TenantRegistry([
+        TenantSpec("t0", weight=1.0, max_queue_depth=128)
+    ])
+    return TenantMux(SolveService(solver), registry, own_service=True)
+
+
+class TestHandover:
+    def test_swap_downstream_drains_before_closing_zero_drops(self):
+        blue_solver = _SlowSolver()
+        mux = _mux(blue_solver)
+        green = SolveService(ReferenceSolver())
+        inp = _solver_input("sw")
+        try:
+            tickets = [mux.submit(inp, tenant_id="t0", kind=DISRUPTION)
+                       for _ in range(8)]
+            rep = mux.swap_downstream(green, own=True, drain_s=60.0)
+            assert rep["timeouts"] == 0
+            assert rep["old_service_closed"] is True
+            tickets += [mux.submit(inp, tenant_id="t0", kind=DISRUPTION)
+                        for _ in range(3)]
+            for t in tickets:
+                t.result(timeout=60)  # every ticket resolves: zero drops
+            assert mux._service is green
+        finally:
+            mux.close()
+
+    def test_full_handover_protocol_zero_drops(self, tmp_path):
+        em.encode(_inp(_pods("ho", (2, 2, 1, 1))))
+        SolverStateVault(str(tmp_path)).snapshot_now()
+        _simulate_restart()
+        mux = _mux(_SlowSolver())
+        green = SolveService(ReferenceSolver())
+        inp = _solver_input("ho2")
+        try:
+            tickets = [mux.submit(inp, tenant_id="t0", kind=DISRUPTION)
+                       for _ in range(6)]
+            ho = BlueGreenHandover(
+                mux, green, vault=SolverStateVault(str(tmp_path))
+            )
+            rep = ho.run(shadow_inputs=[inp], drain_s=60.0)
+            assert rep["dropped"] == 0
+            assert rep["mismatches"] == 0
+            assert rep["restored"] is not None
+            assert rep["restored"]["donors_installed"] == 1
+            tickets.append(mux.submit(inp, tenant_id="t0", kind=DISRUPTION))
+            for t in tickets:
+                t.result(timeout=60)
+            assert mux._service is green
+        finally:
+            mux.close()
+
+    def test_parity_mismatch_aborts_with_blue_untouched(self):
+        mux = _mux(ReferenceSolver())
+        blue = mux._service
+        green = SolveService(_DivergentSolver())
+        inp = _solver_input("pa")
+        try:
+            with pytest.raises(HandoverAborted):
+                BlueGreenHandover(mux, green).run(shadow_inputs=[inp])
+            # blue keeps serving: the mux never saw the swap
+            assert mux._service is blue
+            mux.submit(inp, tenant_id="t0", kind=DISRUPTION).result(timeout=60)
+        finally:
+            mux.close()
+            green.close()
+
+    def test_solve_fingerprint_separates_decisions(self):
+        inp = _solver_input("fp")
+        same = solve_fingerprint(ReferenceSolver(), inp)
+        assert same == solve_fingerprint(ReferenceSolver(), inp)
+        assert same != solve_fingerprint(_DivergentSolver(), inp)
+
+
+# -- config validation --------------------------------------------------------
+
+
+class TestConfig:
+    def test_bad_knobs_fail_closed(self, tmp_path):
+        with pytest.raises(ValueError):
+            SolverStateVault(str(tmp_path), interval_s=0)
+        with pytest.raises(ValueError):
+            SolverStateVault(str(tmp_path), keep=0)
+
+    def test_health_surface(self, tmp_path):
+        clk = [100.0]
+        vault = SolverStateVault(str(tmp_path), clock=lambda: clk[0])
+        em.encode(_inp(_pods("hs", (2, 1, 1, 1))))
+        vault.snapshot_now()
+        clk[0] = 107.5
+        h = vault.health()
+        assert h["age_s"] == pytest.approx(7.5)
+        assert h["snapshots"] == 1 and h["write_failures"] == 0
+        assert h["last_bytes"] > 0
