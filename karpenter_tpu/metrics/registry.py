@@ -452,8 +452,9 @@ FLEET_HEALTHY = REGISTRY.register(
         "karpenter_solver_fleet_healthy",
         "Healthy (unfenced) solve owners: the unlabeled series carries the "
         "fleet-wide count, the owner-labeled series carries each owner's "
-        "0/1 health bit",
-        ("owner",),
+        "0/1 health bit (host-labeled under federation — empty host label "
+        "keeps single-host series identity unchanged)",
+        ("owner", "host"),
     )
 )
 FLEET_FAILOVER = REGISTRY.register(
@@ -461,7 +462,7 @@ FLEET_FAILOVER = REGISTRY.register(
         "karpenter_solver_failover_total",
         "Owner fencing events: the canary watchdog (or a breaker trip) "
         "declared an owner unhealthy and re-routed its work",
-        ("owner",),
+        ("owner", "host"),
     )
 )
 FLEET_REQUEUED = REGISTRY.register(
@@ -470,7 +471,7 @@ FLEET_REQUEUED = REGISTRY.register(
         "In-flight or queued solves re-routed off a fenced owner onto a "
         "healthy owner or degraded to the oracle (none dropped, none run "
         "twice — first-wins ticket delivery)",
-        ("target",),
+        ("target", "host"),
     )
 )
 FLEET_CANARY_LATENCY = REGISTRY.register(
@@ -478,7 +479,49 @@ FLEET_CANARY_LATENCY = REGISTRY.register(
         "karpenter_solver_canary_latency_seconds",
         "Liveness-probe canary solve latency per owner (a miss — deadline "
         "expiry — records a breaker failure instead of observing here)",
-        ("owner",),
+        ("owner", "host"),
+    )
+)
+
+# -- federation (solver/federation.py; ISSUE 18 — same naming rule as the
+#    fleet series: no _tpu segment, routing is backend-neutral) ---------------
+
+FEDERATION_HOSTS_HEALTHY = REGISTRY.register(
+    Gauge(
+        "karpenter_federation_hosts_healthy",
+        "Unfenced federation hosts: the unlabeled series carries the "
+        "federation-wide count, the host-labeled series each host's 0/1 "
+        "health bit (mirrors karpenter_solver_fleet_healthy one layer up)",
+        ("host",),
+    )
+)
+FEDERATION_TENANT_MOVES = REGISTRY.register(
+    Counter(
+        "karpenter_federation_tenant_moves_total",
+        "Tenant re-homings observed at route time (consistent-hash ring "
+        "membership changed between two routes of the same tenant) — the "
+        "ring's bounded-disruption guarantee makes this ~K/N per host "
+        "change, and the drift test pins that bound",
+        ("tenant",),
+    )
+)
+FEDERATION_REPLICATION_LAG = REGISTRY.register(
+    Gauge(
+        "karpenter_federation_journal_replication_lag",
+        "Journal events replicated to a peer but not yet acknowledged "
+        "(drained) by it: unlabeled = worst peer, peer-labeled = per peer. "
+        "Bounds the re-baseline gap a surviving host must close on "
+        "cross-host failover",
+        ("peer",),
+    )
+)
+FEDERATION_FAILOVERS = REGISTRY.register(
+    Counter(
+        "karpenter_federation_cross_host_failovers_total",
+        "Host fencing events at the federation router: the fenced host left "
+        "the ring and its outstanding solves were requeued, in submission "
+        "order, onto the surviving hosts",
+        ("host",),
     )
 )
 SOLVER_DEADLINE_LEAKED_THREADS = REGISTRY.register(

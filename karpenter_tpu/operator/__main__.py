@@ -76,6 +76,9 @@ def serve_endpoints(port: int, health_port: int, enable_profiling: bool = False)
                     # solver vault health when a vault is wired (snapshot
                     # age/size, restore counters — solver/vault.py)
                     "vault": obstelemetry.provider_result("vault"),
+                    # federation health when a router is wired (healthy
+                    # hosts, replication lag — solver/federation.py)
+                    "federation": obstelemetry.provider_result("federation"),
                 }, default=str).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -284,6 +287,9 @@ def main(argv=None) -> int:
         solver_vault_dir=o.solver_vault_dir or None,
         vault_interval_s=o.vault_interval_s,
         vault_keep=o.vault_keep,
+        federation_hosts=o.federation_hosts,
+        federation_self=o.federation_self,
+        journal_replicate=o.journal_replicate,
     )
     serve_endpoints(o.metrics_port, o.health_probe_port,
                     enable_profiling=o.enable_profiling)

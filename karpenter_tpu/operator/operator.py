@@ -57,6 +57,8 @@ class Operator:
     preemption: Optional[object] = None  # provisioning/preemption.py
     streaming: Optional[object] = None  # solver/streaming.py StreamingSolver
     vault: Optional[object] = None  # solver/vault.py SolverStateVault
+    federation: Optional[object] = None  # solver/federation.py FederationRouter
+    replicator: Optional[object] = None  # solver/federation.py JournalReplicator
 
 
 def new_kwok_operator(
@@ -104,6 +106,9 @@ def new_kwok_operator(
     solver_vault_dir: Optional[str] = None,
     vault_interval_s: float = 5.0,
     vault_keep: int = 3,
+    federation_hosts: str = "",
+    federation_self: str = "",
+    journal_replicate: bool = False,
 ) -> Operator:
     store = shared_store if shared_store is not None else st.Store()
     # the operator's clock is authoritative for every age stamp, including a
@@ -216,6 +221,7 @@ def new_kwok_operator(
             canary_deadline_s=canary_deadline_s,
             fence_after_misses=fence_after_misses,
             start_monitor=True,
+            host=federation_self if federation_hosts else "",
         )
         fleet = solve_service
     elif solver_pipeline:
@@ -314,6 +320,36 @@ def new_kwok_operator(
             # fence recovery re-seeds from the vault instead of degrading
             # cold (solver/fleet.py _fence)
             fleet.vault = vault
+    federation = None
+    replicator = None
+    if federation_hosts and solve_service is not None:
+        # federated solver fleets (solver/federation.py, ISSUE 18): this
+        # process's whole fleet/mux stack becomes ONE host of a federation;
+        # tenants consistent-hash across hosts and a host loss requeues its
+        # outstanding solves onto survivors in submission order. Fail-closed
+        # off: with no host list the router never exists, the controllers
+        # hold the fleet/pipeline/mux directly, byte-identical.
+        from ..solver.federation import FederationRouter, JournalReplicator
+
+        if journal_replicate:
+            peers = [
+                h for h in federation_hosts.split(",")
+                if h.strip() and h.strip() != federation_self
+            ]
+            if peers:
+                replicator = JournalReplicator(
+                    cluster.journal, peers=[p.strip() for p in peers],
+                )
+        federation = FederationRouter(
+            federation_hosts, self_host=federation_self,
+            clock=clock, replicator=replicator,
+        )
+        federation.attach(federation_self, solve_service)
+        obstelemetry.register_provider("federation", federation.health)
+        # the controllers now submit THROUGH the router: local un-tenanted
+        # traffic still lands on this host (route(None) = self), federated
+        # tenants ride to whichever host attach() wires in
+        solve_service = federation
     from ..events.recorder import Recorder
     from ..provisioning.preemption import PreemptionController
 
@@ -485,4 +521,6 @@ def new_kwok_operator(
         preemption=preemption,
         streaming=streaming,
         vault=vault,
+        federation=federation,
+        replicator=replicator,
     )

@@ -211,6 +211,19 @@ class Options:
     vault_interval_s: float = 5.0
     # newest vault files retained on disk (>= 1, validated at startup)
     vault_keep: int = 3
+    # federated solver fleets (solver/federation.py): comma-separated host
+    # names forming the federation; tenants consistent-hash onto hosts and
+    # cross-host failover requeues a fenced host's solves onto survivors.
+    # Empty = federation off (fail-closed: no router constructed, the
+    # byte-identical single-host path)
+    federation_hosts: str = ""
+    # this process's host name — required when --federation-hosts is set,
+    # must be a member of it (validated fail-closed at startup)
+    federation_self: str = ""
+    # replicate the ClusterJournal tail to peer hosts so a host loss
+    # re-baselines its tenants on a peer from replicated state; requires
+    # --federation-hosts (replication without a federation is a typo)
+    journal_replicate: bool = False
     # cross-process HA: flock'd lease file shared by replicas (empty = the
     # in-process lease, single-process HA only)
     lease_path: str = ""
@@ -402,6 +415,47 @@ def parse(argv: Optional[Sequence[str]] = None, cls=Options) -> Options:
             f"(got {vkeep}); it is the newest vault snapshots retained on "
             "disk (solver/vault.py)"
         )
+    # federation knob sanity (same fail-closed rule): a federation with no
+    # self identity, a self host outside the member list, or replication
+    # without a federation would misroute tenants or silently replicate to
+    # nobody — refuse startup with the exact fix instead
+    fhosts = (getattr(out, "federation_hosts", "") or "").strip()
+    fself = (getattr(out, "federation_self", "") or "").strip()
+    freplicate = bool(getattr(out, "journal_replicate", False))
+    if fhosts:
+        from ..solver.federation import FederationConfigError, parse_hosts
+
+        try:
+            members = parse_hosts(fhosts)
+        except FederationConfigError as e:
+            raise SystemExit(f"refusing to start: {e}") from None
+        if not fself:
+            raise SystemExit(
+                "refusing to start: --federation-hosts is set but "
+                "--federation-self is empty; every federated process must "
+                "name itself so tenant routing knows which host it is "
+                "(solver/federation.py)"
+            )
+        if fself not in members:
+            raise SystemExit(
+                f"refusing to start: --federation-self {fself!r} is not a "
+                f"member of --federation-hosts {members}; a process outside "
+                "the ring would strand every tenant hashed to it "
+                "(solver/federation.py)"
+            )
+    else:
+        if fself:
+            raise SystemExit(
+                "refusing to start: --federation-self is set but "
+                "--federation-hosts is empty; a self identity without a "
+                "federation is a typo'd deploy (solver/federation.py)"
+            )
+        if freplicate:
+            raise SystemExit(
+                "refusing to start: --journal-replicate requires "
+                "--federation-hosts; replicating the journal tail with no "
+                "peer hosts replicates to nobody (solver/federation.py)"
+            )
     # health-plane knob sanity (same fail-closed rule as everything above)
     budget = getattr(out, "arena_budget_mb", None)
     if budget is not None and int(budget) < 0:

@@ -1,0 +1,338 @@
+"""Virtual multi-process host mesh: subprocess workers for the multi-host
+solve paths (ISSUE 18; SPEC.md "Federation semantics").
+
+A TPU pod slice runs one jax process per host; this module is the
+hardware-free stand-in that keeps the multi-process code paths runnable and
+benchable on a dev box. Each worker is a REAL separate process (fresh
+interpreter, own jax runtime pinned to CPU, own memory) speaking a
+length-prefixed pickle protocol over its stdin/stdout pipes. Two job kinds:
+
+- ``ffd_blocks`` — the mesh-solve leg: the worker scans its contiguous
+  slice of the run-axis blocks (the same vmap-of-``ffd_solve`` lane body
+  ``ffd_solve_sharded`` runs per device) and returns the lane-local
+  FFDOutput; the parent stitches blocks host-side exactly as it would for
+  an in-process mesh (backend._shard_stitch).
+- ``solve`` — the federation leg: the worker holds a resident
+  ReferenceSolver and serves whole solves, so a FederationRouter's hosts
+  are genuinely separate processes and a host kill is a real SIGKILL.
+
+The broadcast tables of an ``ffd_blocks`` job are cached worker-side under
+a caller-chosen ``ctx`` token (the pipe analog of argument-arena
+residency): repeat dispatches against the same context ship only the run
+blocks.
+
+jax fixes its device list at first backend init, so a parent that already
+initialized jax can never emulate N hosts in-process — the subprocess
+boundary here is load-bearing, not a convenience.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional
+
+_LEN = struct.Struct("<Q")
+
+
+class WorkerDead(RuntimeError):
+    """The worker process is gone (EOF/broken pipe mid-call): the caller
+    must treat every outstanding job on this worker as failed and fail the
+    host over — jobs are never silently retried here."""
+
+
+def _write_frame(fh, obj) -> None:
+    blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    fh.write(_LEN.pack(len(blob)))
+    fh.write(blob)
+    fh.flush()
+
+
+def _read_exact(fh, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = fh.read(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _read_frame(fh):
+    head = _read_exact(fh, _LEN.size)
+    if head is None:
+        return None
+    blob = _read_exact(fh, _LEN.unpack(head)[0])
+    if blob is None:
+        return None
+    return pickle.loads(blob)
+
+
+# -- worker side --------------------------------------------------------------
+
+
+def _handle_ffd_blocks(job, ctx_cache: dict, jit_cache: dict):
+    """Scan this worker's run blocks: vmap the UNJITTED ffd_solve lane over
+    the [nb, Sblk] block axis with the broadcast tables closed over — the
+    same lane body ffd_solve_sharded traces per mesh device."""
+    import functools
+
+    import jax
+    import numpy as np
+
+    from ..solver.tpu.ffd import ffd_solve
+
+    ctx = job.get("ctx")
+    rest = job.get("rest")
+    if rest is not None and ctx is not None:
+        ctx_cache[ctx] = rest
+    elif rest is None:
+        rest = ctx_cache[ctx]
+    rg = np.asarray(job["rg"])
+    rc = np.asarray(job["rc"])
+    max_claims = int(job["max_claims"])
+    key = (
+        ctx, max_claims, rg.shape,
+        tuple((a.shape, str(a.dtype)) for a in rest),
+    )
+    fn = jit_cache.get(key)
+    if fn is None:
+        lane = functools.partial(
+            ffd_solve.__wrapped__, max_claims=max_claims, zone_engine=False
+        )
+        fn = jax.jit(jax.vmap(lambda g, c: lane(g, c, *rest)))
+        jit_cache[key] = fn
+    out = fn(rg, rc)
+    return jax.tree_util.tree_map(np.asarray, out)
+
+
+def worker_main(stdin=None, stdout=None) -> int:
+    """Job loop: read a frame, run it, answer {"ok": ..., ...}. stdout is
+    the protocol channel — anything chatty must go to stderr."""
+    inb = stdin if stdin is not None else sys.stdin.buffer
+    outb = stdout if stdout is not None else sys.stdout.buffer
+    solver = None
+    ctx_cache: dict = {}
+    jit_cache: dict = {}
+    while True:
+        job = _read_frame(inb)
+        if job is None or job.get("kind") == "exit":
+            return 0
+        try:
+            kind = job.get("kind")
+            if kind == "ping":
+                result = {"pid": os.getpid()}
+            elif kind == "ffd_blocks":
+                result = _handle_ffd_blocks(job, ctx_cache, jit_cache)
+            elif kind == "solve":
+                if solver is None:
+                    from ..solver.backend import ReferenceSolver
+
+                    solver = ReferenceSolver()
+                result = solver.solve(job["inp"])
+                # simulated device-residency window: a TPU host spends most
+                # of each solve waiting on the device with its CPU free —
+                # the federation bench uses this so host scaling is
+                # measurable even on a single-core dev box (where N
+                # CPU-bound workers would just time-share one core)
+                device_ms = job.get("device_ms")
+                if device_ms:
+                    import time
+
+                    time.sleep(float(device_ms) / 1000.0)
+            else:
+                raise ValueError(f"unknown job kind: {kind!r}")
+            reply = {"ok": True, "result": result}
+        except BaseException as e:  # noqa: BLE001 — reply, don't die
+            reply = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        _write_frame(outb, reply)
+
+
+# -- parent side --------------------------------------------------------------
+
+
+class WorkerProc:
+    """One worker host: a subprocess with its own jax runtime (CPU-pinned)
+    behind a framed pickle pipe. Calls serialize per worker; workers are
+    independent, so a pool issues to all of them concurrently."""
+
+    def __init__(self, name: str = "host", env: Optional[Dict[str, str]] = None):
+        self.name = name
+        wenv = os.environ.copy()
+        # the worker is a virtual HOST: its jax world is its own CPU device,
+        # never the parent's accelerator (which the parent may hold open)
+        wenv["JAX_PLATFORMS"] = "cpu"
+        wenv.pop("XLA_FLAGS", None)
+        if env:
+            wenv.update(env)
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "karpenter_tpu.parallel.hostmesh"],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            env=wenv,
+        )
+        self._lock = threading.Lock()
+        self._ctx_seen: set = set()
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def call(self, job: dict):
+        """Round-trip one job; raises WorkerDead on a broken pipe/EOF (a
+        killed host), RuntimeError on a job-level failure."""
+        return self._roundtrip(
+            pickle.dumps(job, protocol=pickle.HIGHEST_PROTOCOL)
+        )
+
+    def call_pickled(self, blob: bytes):
+        """Round-trip a PRE-SERIALIZED job frame: a caller issuing the same
+        job many times (the federation soak's churn loop) pays the pickle
+        cost once instead of per call — the parent's GIL share per solve
+        drops to the pipe write."""
+        return self._roundtrip(blob)
+
+    def _roundtrip(self, blob: bytes):
+        with self._lock:
+            if not self.alive():
+                raise WorkerDead(f"{self.name}: worker exited")
+            try:
+                self.proc.stdin.write(_LEN.pack(len(blob)))
+                self.proc.stdin.write(blob)
+                self.proc.stdin.flush()
+                reply = _read_frame(self.proc.stdout)
+            except (BrokenPipeError, OSError) as e:
+                raise WorkerDead(f"{self.name}: {e}") from e
+        if reply is None:
+            raise WorkerDead(f"{self.name}: EOF mid-call")
+        if not reply.get("ok"):
+            raise RuntimeError(f"{self.name}: {reply.get('error')}")
+        return reply.get("result")
+
+    def kill(self) -> None:
+        try:
+            self.proc.kill()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        if self.alive():
+            try:
+                with self._lock:
+                    _write_frame(self.proc.stdin, {"kind": "exit"})
+            except (BrokenPipeError, OSError):
+                pass
+        try:
+            self.proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            self.kill()
+
+
+def _tree_concat(parts: list):
+    """Concatenate a list of structurally-identical (possibly nested)
+    namedtuple-of-ndarray trees along axis 0 — the parent-side gather that
+    reassembles per-worker block slices into the [Nd, ...] stacked shape
+    ffd_solve_sharded would have returned."""
+    import numpy as np
+
+    first = parts[0]
+    if hasattr(first, "_fields"):
+        return type(first)(*(
+            _tree_concat([getattr(p, f) for p in parts])
+            for f in first._fields
+        ))
+    return np.concatenate([np.asarray(p) for p in parts], axis=0)
+
+
+class HostMeshPool:
+    """N worker hosts forming a virtual 1-D host mesh over the run axis.
+
+    `scatter_blocks` splits the [Nd, Sblk] block tables into contiguous
+    per-host slices (the process-major layout make_process_mesh pins),
+    dispatches them concurrently, and gathers the lane outputs back into
+    one [Nd, ...] FFDOutput tree for the parent's stitch. Broadcast tables
+    ride once per (host, ctx) and are served from the worker-side cache on
+    repeat dispatches."""
+
+    def __init__(self, n_hosts: int = 2, name_prefix: str = "host"):
+        if n_hosts < 1:
+            raise ValueError("n_hosts must be >= 1")
+        self.workers: List[WorkerProc] = [
+            WorkerProc(f"{name_prefix}{i}") for i in range(n_hosts)
+        ]
+
+    @property
+    def width(self) -> int:
+        return len(self.workers)
+
+    def ping_all(self) -> List[dict]:
+        return [w.call({"kind": "ping"}) for w in self.workers]
+
+    def scatter_blocks(self, rgb, rcb, rest: tuple, max_claims: int,
+                       ctx: Optional[str] = None):
+        import numpy as np
+
+        rgb = np.asarray(rgb)
+        rcb = np.asarray(rcb)
+        Nd = int(rgb.shape[0])
+        n = self.width
+        if Nd % n:
+            raise ValueError(f"{Nd} blocks do not divide across {n} hosts")
+        per = Nd // n
+        results: list = [None] * n
+        errors: list = []
+
+        def _dispatch(i: int) -> None:
+            w = self.workers[i]
+            send_rest = rest
+            if ctx is not None and ctx in w._ctx_seen:
+                send_rest = None
+            try:
+                results[i] = w.call({
+                    "kind": "ffd_blocks",
+                    "rg": rgb[i * per:(i + 1) * per],
+                    "rc": rcb[i * per:(i + 1) * per],
+                    "rest": send_rest,
+                    "ctx": ctx,
+                    "max_claims": int(max_claims),
+                })
+                if ctx is not None:
+                    w._ctx_seen.add(ctx)
+            except BaseException as e:  # noqa: BLE001 — gathered below
+                errors.append((i, e))
+
+        threads = [
+            threading.Thread(target=_dispatch, args=(i,), daemon=True)
+            for i in range(n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0][1]
+        return _tree_concat(results)
+
+    def solve(self, host: int, inp):
+        return self.workers[host].call({"kind": "solve", "inp": inp})
+
+    def kill(self, host: int) -> None:
+        self.workers[host].kill()
+
+    def close(self) -> None:
+        for w in self.workers:
+            w.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+if __name__ == "__main__":
+    sys.exit(worker_main())

@@ -156,6 +156,163 @@ def pad_batch(batched_args: tuple, batch: int) -> tuple:
     return tuple(fn(tuple(batched_args)))
 
 
+# -- process-spanning meshes (ISSUE 18; SPEC.md "Federation semantics") ------
+#
+# Everything above assumes jax.process_count() == 1: jax.devices() is the
+# whole world and any contiguous slice of it is a valid 1-D mesh. On a pod
+# slice (jax.distributed initialized, SNIPPETS [2]) jax.devices() is the
+# GLOBAL device list in process-major order, and a mesh that does not take
+# the same number of devices from every process silently places some
+# processes' addressable shards under another process's blocks — the solve
+# "works" and returns garbage block boundaries. These helpers are the
+# fail-closed construction path: a grid the processes cannot divide evenly
+# raises a typed MeshConstructionError instead of building a wrong mesh.
+
+
+class MeshConstructionError(RuntimeError):
+    """Process-spanning mesh construction failed fail-closed: the requested
+    device grid cannot be divided evenly across the participating processes
+    (or the sharding arguments to a mesh call were inconsistent). Callers
+    must fall back to the single-process path or fix the topology — never
+    proceed with a silently-wrong mesh."""
+
+
+def init_distributed(coordinator_address: str, num_processes: int,
+                     process_id: int) -> None:
+    """jax.distributed.initialize wrapper for the multi-host mesh solve.
+
+    Must run before the first jax backend touch (jax fixes its device list
+    at first init). Raises MeshConstructionError when the runtime has no
+    distributed support rather than letting a later mesh build half-connect.
+    """
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except Exception as e:  # noqa: BLE001 — typed fail-closed surface
+        raise MeshConstructionError(
+            f"jax.distributed.initialize failed for "
+            f"{coordinator_address} ({process_id}/{num_processes}): {e}"
+        ) from e
+
+
+def make_process_mesh(n_shards: Optional[int] = None, axis: str = "shards"):
+    """1-D mesh whose `axis` spans every participating process, plus the
+    contiguous block range this process owns.
+
+    Returns `(mesh, (lo, hi))`: blocks `[lo, hi)` of the `n_shards`-wide
+    grid are addressable from THIS process (its rows of a
+    `PartitionSpec(axis, None)` array live on local devices). Single-process
+    degenerates to `make_mesh` with the full range — byte-identical to the
+    legacy path.
+
+    Fail-closed validation (the satellite contract): with
+    `jax.process_count() > 1`, every process must contribute the same
+    number of devices and `n_shards` must divide evenly across processes;
+    anything else raises MeshConstructionError instead of building a mesh
+    whose block boundaries straddle process boundaries."""
+    nproc = int(jax.process_count())
+    if nproc <= 1:
+        m = make_mesh(n_shards, axis=axis)
+        return m, (0, int(m.devices.size))
+    devs = jax.devices()  # global, process-major
+    by_proc: dict = {}
+    for d in devs:
+        by_proc.setdefault(int(d.process_index), []).append(d)
+    sizes = {p: len(v) for p, v in sorted(by_proc.items())}
+    if len(set(sizes.values())) != 1:
+        raise MeshConstructionError(
+            f"devices do not divide the grid: per-process device counts "
+            f"are uneven ({sizes}) — a 1-D run axis cannot split into "
+            f"equal contiguous per-process blocks"
+        )
+    total = len(devs)
+    n = int(n_shards) if n_shards else total
+    if n % nproc:
+        raise MeshConstructionError(
+            f"devices do not divide the grid: n_shards={n} is not a "
+            f"multiple of process_count={nproc}"
+        )
+    per = n // nproc
+    if per > min(sizes.values()):
+        raise MeshConstructionError(
+            f"devices do not divide the grid: n_shards={n} needs {per} "
+            f"devices per process but processes hold "
+            f"{min(sizes.values())}"
+        )
+    # process-major contiguous layout: process p owns blocks
+    # [p*per, (p+1)*per) — exactly the run-block slices the host-side
+    # stitch walks left-to-right (backend._shard_stitch)
+    chosen = []
+    for p in sorted(by_proc):
+        chosen.extend(by_proc[p][:per])
+    mesh = Mesh(np.asarray(chosen), (axis,))
+    pid = int(jax.process_index())
+    return mesh, (pid * per, (pid + 1) * per)
+
+
+def put_process_sharded(mesh: Mesh, arr, lo: int, hi: int):
+    """Adopt a `[Nd, ...]` block-partitioned array onto a process-spanning
+    mesh by uploading ONLY the local partition's run blocks.
+
+    Each process device_puts rows `[lo, hi)` onto its own mesh devices and
+    assembles the global array from the single-device shards
+    (jax.make_array_from_single_device_arrays) — no process materializes or
+    uploads another host's blocks, which is what keeps per-process arena
+    residency bounded by the local partition. Single-process falls through
+    to a plain sharded device_put (identical placement, one call)."""
+    axis = mesh.axis_names[0]
+    spec = P(axis, *([None] * (arr.ndim - 1)))
+    sharding = NamedSharding(mesh, spec)
+    if int(jax.process_count()) <= 1:
+        return jax.device_put(arr, sharding)
+    local = [d for d in mesh.devices.flat
+             if int(d.process_index) == int(jax.process_index())]
+    if len(local) != hi - lo:
+        raise MeshConstructionError(
+            f"local partition [{lo}, {hi}) does not match the "
+            f"{len(local)} local mesh devices"
+        )
+    shards = [jax.device_put(np.asarray(arr[i:i + 1]), d)
+              for i, d in zip(range(lo, hi), local)]
+    return jax.make_array_from_single_device_arrays(
+        tuple(arr.shape), sharding, shards
+    )
+
+
+def mesh_sharded_call(mesh: Mesh, fn, in_shardings=None, out_shardings=None):
+    """Compile `fn` for `mesh` with explicit shardings, or fall back to
+    shard_map when no shardings are given (SNIPPETS [3] idiom).
+
+    Passing exactly ONE of in_shardings/out_shardings is the classic
+    half-specified pjit bug — the unspecified side gets inferred layouts
+    that differ across jax versions — so it raises MeshConstructionError:
+    pass both sharding arguments or omit them to use the shard_map
+    fallback. The fallback maps `fn` per-shard over the mesh's first axis
+    (inputs and outputs block-partitioned on their leading dim), which is
+    the portable path for runtimes whose pjit cannot place a
+    process-spanning NamedSharding."""
+    if (in_shardings is None) != (out_shardings is None):
+        raise MeshConstructionError(
+            "one-sided shardings: pass both sharding arguments or omit "
+            "them to use the shard_map fallback"
+        )
+    if in_shardings is not None:
+        return jax.jit(
+            fn, in_shardings=in_shardings, out_shardings=out_shardings
+        )
+    from jax.experimental.shard_map import shard_map
+
+    axis = mesh.axis_names[0]
+    spec = P(axis)
+    mapped = shard_map(
+        fn, mesh=mesh, in_specs=spec, out_specs=spec, check_rep=False
+    )
+    return jax.jit(mapped)
+
+
 def replicate_args(args: tuple, batch: int, sharding=None) -> tuple:
     """Tile single-solve args to a batch (test/dryrun helper).
 

@@ -784,6 +784,17 @@ class TPUSolver(Solver):
         self.shards = max(0, int(shards))
         self._shard_mesh_cache: object = False  # False = not yet probed
         self._shard_prewarmed: set = set()  # mesh device-set tokens AOT'd
+        # multi-host run-axis solve (ISSUE 18, SPEC.md "Federation
+        # semantics"): host_mesh, when set to a parallel/hostmesh
+        # HostMeshPool, scatters the run blocks to subprocess worker hosts
+        # instead of a local device mesh — the virtual stand-in for a
+        # jax.distributed pod slice. _shard_local_blocks is the contiguous
+        # [lo, hi) block range THIS process owns on a process-spanning mesh
+        # (per-process arena adoption uploads only that partition);
+        # _process_mesh_error records a fail-closed mesh decline.
+        self.host_mesh = None
+        self._shard_local_blocks: Optional[Tuple[int, int]] = None
+        self._process_mesh_error: Optional[str] = None
         # on-device decode (tpu/ffd.compact_takes + decode_delta): fetch the
         # take tables as a packed claim-delta instead of dense grids;
         # false = dense uint16 packing (debug escape hatch / parity oracle)
@@ -835,13 +846,33 @@ class TPUSolver(Solver):
         try:
             import jax
 
-            from ..parallel.sharded import make_mesh
+            from ..parallel.sharded import (
+                MeshConstructionError,
+                make_mesh,
+                make_process_mesh,
+            )
 
             limit = min(self.shards, len(jax.devices()), 16)
             n = 1
             while n * 2 <= limit:
                 n *= 2
-            if n >= 2:
+            nproc = int(jax.process_count())
+            if nproc > 1:
+                # true multi-host mesh (ISSUE 18): the run axis spans every
+                # jax process. Construction is fail-closed — a grid the
+                # processes cannot divide evenly raises the typed error,
+                # which DECLINES to the single-device path (decision-
+                # identical) rather than building a wrong mesh; the error
+                # text is kept for /healthz + debugging.
+                try:
+                    if n >= max(2, nproc):
+                        mesh, self._shard_local_blocks = make_process_mesh(
+                            n, axis="shards"
+                        )
+                except MeshConstructionError as e:
+                    self._process_mesh_error = str(e)
+                    mesh = None
+            elif n >= 2:
                 mesh = make_mesh(n, axis="shards")
         except Exception:
             mesh = None
@@ -2081,7 +2112,7 @@ class TPUSolver(Solver):
         # changes so a wedged solve leaves residency untouched.
         faults.check("solver.device_hang", tag=self.fault_tag)
         faults.check("solver.device_lost", tag=self.fault_tag)
-        if self.shards >= 2:
+        if self.shards >= 2 or self.host_mesh is not None:
             # mesh-sharded run-axis solve; declines (inexpressible carry
             # combine, no usable mesh, stitch overflow) fall through to the
             # single-device path below — trivially decision-identical
@@ -2493,6 +2524,10 @@ class TPUSolver(Solver):
         single-device path (decline reasons that reflect an inexpressible
         carry combine are counted — no-mesh is not a fallback, it is the
         normal shape of a 1-device rig)."""
+        if self.host_mesh is not None:
+            return self._hostmesh_solve_async(
+                enc, host_args, dims, prov, self.host_mesh
+            )
         mesh = self._shard_mesh()
         if mesh is None:
             return None
@@ -2526,7 +2561,34 @@ class TPUSolver(Solver):
         shardings = (blocked, blocked) + (repl,) * (len(host_args) - 2)
         self.ledger.begin_solve()
         key = None
-        if self.arena is not None:
+        try:
+            nproc = int(jax.process_count())
+        except Exception:  # noqa: BLE001 — backendless probe
+            nproc = 1
+        if nproc > 1 and self._shard_local_blocks is not None:
+            # per-process adoption (ISSUE 18, SPEC.md "Federation
+            # semantics"): each process uploads ONLY its local partition's
+            # run blocks (put_process_sharded assembles the global array
+            # from per-process single-device shards); the replicated core
+            # tables device_put once per process. Resume/shard donor
+            # records stay off (key=None) — they assume whole-axis
+            # residency, which no single process holds on a pod slice.
+            from ..parallel.sharded import put_process_sharded
+
+            lo, hi = self._shard_local_blocks
+            args = (
+                put_process_sharded(mesh, rgb, lo, hi),
+                put_process_sharded(mesh, rcb, lo, hi),
+            ) + tuple(jax.device_put(a, repl) for a in sh_args[2:])
+            local_bytes = (
+                rgb[lo:hi].nbytes + rcb[lo:hi].nbytes
+                + sum(a.nbytes for a in sh_args[2:])
+            )
+            self.ledger.record_upload(
+                local_bytes, len(sh_args), msgs=len(sh_args),
+                shard_bytes=rgb[lo:hi].nbytes + rcb[lo:hi].nbytes,
+            )
+        elif self.arena is not None:
             args = self.arena.adopt(sh_args, prov, sharding=shardings,
                                     ns=enc.tenant_id)
             key = self.arena.bucket_key(sh_args, shardings, ns=enc.tenant_id)
@@ -2564,10 +2626,12 @@ class TPUSolver(Solver):
         return finish
 
     def _sharded_finish(self, enc, host_args, dims, mesh, args, out, M0,
-                        key) -> Optional[SolverResult]:
+                        key, redispatch=None) -> Optional[SolverResult]:
         """Stitch loop with claim-overflow doubling (mirrors the cold
         finish): a saturated stitch redispatches the whole sharded solve at
-        the doubled bucket against the same resident args."""
+        the doubled bucket against the same resident args. `redispatch(M)`
+        overrides the in-process mesh launch — the virtual host mesh
+        re-scatters the blocks to its worker processes instead."""
         from .tpu.ffd import ffd_solve_sharded
 
         M, cur = M0, out
@@ -2579,7 +2643,10 @@ class TPUSolver(Solver):
                 return None  # true overflow — replay on the fallback chain
             M = min(M * 2, self.max_claims)
             faults.check("solver.device_dispatch")
-            cur = ffd_solve_sharded(*args, max_claims=M, zone_engine=False)
+            cur = (
+                redispatch(M) if redispatch is not None
+                else ffd_solve_sharded(*args, max_claims=M, zone_engine=False)
+            )
         take_e_p, take_c_p, leftover_p, P, fixup, carries = res
         self.stats["sharded_solves"] += 1
         self.stats["shard_fixup_runs"] += fixup
@@ -2590,6 +2657,72 @@ class TPUSolver(Solver):
         self._record_shard(enc, key, M, dims["S"], len(carries),
                            carries, take_e_p, take_c_p, leftover_p)
         return res_out
+
+    def _hostmesh_solve_async(self, enc, host_args, dims, prov, pool):
+        """Dispatch one solve across the VIRTUAL host mesh
+        (parallel/hostmesh.HostMeshPool): subprocess worker hosts each scan
+        a contiguous slice of the run-axis blocks — the hardware-free
+        analog of a process-spanning device mesh — and the parent stitches
+        the gathered lanes with the SAME accept/replay proof as the
+        in-process mesh (_shard_stitch), so decision identity carries over
+        unchanged. Same decline rules as the device mesh; the replay
+        escape hatch runs on the parent's own device. Broadcast tables ride
+        the pipe once per residency context (the worker-side ctx cache is
+        the pipe analog of arena adoption)."""
+        Nd = pool.width
+        S = dims["S"]
+        Sp = int(host_args[0].shape[0])
+        if Nd < 2 or enc.V > 0 or enc.Q > 0:
+            self._shard_decline()
+            return None
+        if S < Nd or Sp % Nd:
+            self._shard_decline()
+            return None
+        import jax
+
+        from ..parallel.sharded import make_mesh
+        from .encode import mesh_run_blocks
+
+        SOLVER_MESH_DEVICES.set(Nd)
+        rgb, rcb = mesh_run_blocks(
+            np.asarray(host_args[0]), np.asarray(host_args[1]), Nd
+        )
+        rest = tuple(np.asarray(a) for a in host_args[2:])
+        sh_args = (rgb, rcb) + rest
+        # replay/resume device args live on the PARENT (1-device mesh):
+        # the stitch's sequential escape hatch is host-side either way
+        local_mesh = make_mesh(1, axis="shards")
+        args = tuple(jax.device_put(a) for a in sh_args)
+        ctx = None
+        if self.arena is not None:
+            key = self.arena.bucket_key(
+                sh_args, ("hostmesh", Nd), ns=enc.tenant_id
+            )
+            ctx = f"hm{abs(hash(key)):x}"
+        self.ledger.begin_solve()
+        self.ledger.record_upload(
+            sum(a.nbytes for a in sh_args), len(sh_args), msgs=len(sh_args),
+            shard_bytes=rgb.nbytes + rcb.nbytes,
+        )
+        total_pods = int(sum(len(p) for p in enc.group_pods))
+        M0 = initial_claim_bucket(total_pods, self.max_claims)
+
+        def redispatch(M):
+            faults.check("solver.device_dispatch")
+            return pool.scatter_blocks(rgb, rcb, rest, max_claims=M, ctx=ctx)
+
+        out = redispatch(M0)
+
+        def finish() -> Optional[SolverResult]:
+            try:
+                return self._sharded_finish(
+                    enc, host_args, dims, local_mesh, args, out, M0, None,
+                    redispatch=redispatch,
+                )
+            finally:
+                self.ledger.end_solve()
+
+        return finish
 
     def _shard_stitch(self, enc, host_args, dims, mesh, args, out, M):
         """Fetch the lane-local outputs and stitch blocks left-to-right

@@ -1,0 +1,586 @@
+"""Federated solver fleets: tenant routing, journal replication, cross-host
+failover (ISSUE 18; SPEC.md "Federation semantics").
+
+One process — one mesh, one journal, one TenantMux — caps aggregate
+throughput and resident-state capacity at a single host. The federation
+layer lifts that cap by composing EXISTING seams instead of inventing new
+ones: each *host* runs today's SolverFleet/TenantMux stack behind the
+submit/submit_fn SolveService surface, and this module adds exactly three
+things on top:
+
+- **Routing** (`HashRing` + `FederationRouter.route`): tenants
+  consistent-hash to hosts, so adding/removing a host moves only ~1/N of
+  the tenants (vnode ring — the classic bounded-disruption placement).
+  A tenant's home host owns its queue, its arena residency namespace, and
+  its journal cursor; `tenant_id=None` (un-federated local traffic) always
+  routes to the self host, which is what keeps the knobs-off and
+  single-host paths byte-identical.
+- **Replication** (`JournalReplicator`): the ClusterJournal tail streams
+  to peer-held replica buffers via a synchronous journal tap, objects
+  deep-copied at event time (replication is a wire: the peer must see the
+  event-time object, never a live reference). A host loss re-baselines the
+  tenant on a peer from the replicated tail — journal-lag-bounded — rather
+  than re-encoding the world.
+- **Failover** (`FederationRouter.fail_host`): fencing a host removes it
+  from the ring and requeues its outstanding facade tickets onto the
+  survivors IN SUBMISSION ORDER. All of a tenant's outstanding work lived
+  on its one home host, so per-tenant FIFO survives the move; facade
+  tickets are first-wins, so a zombie host's late result can never
+  double-act. This composes with (does not replace) the intra-host
+  fence/requeue + vault-revive machinery: the fleet handles an OWNER loss
+  inside a host, the router handles the HOST loss.
+
+Hosts here are in-process service objects (tests), subprocess workers
+behind pipes (bench's virtual 4-host soak, parallel/hostmesh.py), or — on
+real deployments — whatever transport presents the SolveService surface.
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import inspect
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from ..metrics.registry import (
+    FEDERATION_FAILOVERS,
+    FEDERATION_HOSTS_HEALTHY,
+    FEDERATION_REPLICATION_LAG,
+    FEDERATION_TENANT_MOVES,
+)
+from .pipeline import DISRUPTION, PROVISIONING, SolveTicket
+
+
+class FederationConfigError(ValueError):
+    """Fail-closed federation configuration: bad host list, self host not a
+    member, replication without a federation. Raised at construction so a
+    typo'd deploy dies at boot, not at the first failover."""
+
+
+class FederationMisroute(RuntimeError):
+    """A submission routed to a host this process has no transport to (an
+    unattached peer). Fail-closed: serving another host's tenant silently
+    would fork its journal cursor and arena residency — the caller must
+    fix placement or fence the peer."""
+
+
+def parse_hosts(spec: str) -> List[str]:
+    """Validate a `--federation-hosts` list: comma-separated, non-empty,
+    unique host names. Raises FederationConfigError (fail-closed) on any
+    malformed entry."""
+    hosts = [h.strip() for h in (spec or "").split(",") if h.strip()]
+    if not hosts:
+        raise FederationConfigError(
+            "federation host list is empty — pass host names as "
+            "'h0,h1,...' or leave federation off"
+        )
+    if len(set(hosts)) != len(hosts):
+        raise FederationConfigError(f"duplicate federation hosts in {spec!r}")
+    return hosts
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes: `route(key)` walks
+    clockwise from sha1(key) to the next vnode. Stability contract (pinned
+    by tests/test_federation.py): removing a host only re-homes keys that
+    lived on it; adding a host steals ~1/N of the keyspace from the
+    incumbents and moves nothing between surviving hosts."""
+
+    def __init__(self, hosts, vnodes: int = 64):
+        self.vnodes = max(1, int(vnodes))
+        self._hosts: List[str] = []
+        self._ring: List[tuple] = []  # sorted [(point, host)]
+        for h in hosts:
+            self.add(h)
+
+    @staticmethod
+    def _point(key: str) -> int:
+        return int.from_bytes(
+            hashlib.sha1(key.encode()).digest()[:8], "big"
+        )
+
+    @property
+    def hosts(self) -> List[str]:
+        return list(self._hosts)
+
+    def add(self, host: str) -> None:
+        if host in self._hosts:
+            return
+        self._hosts.append(host)
+        for v in range(self.vnodes):
+            self._ring.append((self._point(f"{host}#{v}"), host))
+        self._ring.sort()
+
+    def remove(self, host: str) -> None:
+        if host not in self._hosts:
+            return
+        self._hosts.remove(host)
+        self._ring = [(p, h) for p, h in self._ring if h != host]
+
+    def route(self, key: str) -> str:
+        if not self._ring:
+            raise FederationConfigError("hash ring has no hosts")
+        point = self._point(key)
+        # binary search for the first vnode clockwise of `point`
+        lo, hi = 0, len(self._ring)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self._ring[mid][0] < point:
+                lo = mid + 1
+            else:
+                hi = mid
+        return self._ring[lo % len(self._ring)][1]
+
+
+class _Outstanding:
+    """One facade ticket's live routing record: enough to replay the
+    submission verbatim on a survivor when its home host is fenced."""
+
+    __slots__ = ("facade", "job", "tenant_id", "host", "requeued")
+
+    def __init__(self, facade: SolveTicket, job: Callable, tenant_id, host):
+        self.facade = facade
+        self.job = job  # job(service) -> inner SolveTicket
+        self.tenant_id = tenant_id
+        self.host = host
+        self.requeued = False
+
+
+class JournalReplicator:
+    """Replicates the ClusterJournal tail to peer hosts.
+
+    Registered as a synchronous journal tap (state/cluster.py
+    ClusterJournal.add_tap): every stamped event is deep-copied at event
+    time and appended to each peer's bounded replica buffer. Peers
+    acknowledge by draining (`drain_peer`); `lag()` is the seq distance
+    between the journal head and the slowest peer's ack — the
+    `karpenter_federation_journal_replication_lag` gauge.
+
+    Consistency model (SPEC.md "Federation semantics"): the replica is a
+    TAIL, not a base — a peer re-baselines by folding the tail onto its
+    newest base snapshot (vault / store snapshot), exactly as the
+    streaming model folds its own journal. A peer attached from the
+    journal's birth holds the whole world (`rebuild_store` — the parity
+    leg tests pin decision-identity through it)."""
+
+    def __init__(self, journal, peers, maxlen: int = 4096,
+                 clock=time.monotonic):
+        if not peers:
+            raise FederationConfigError(
+                "journal replication needs at least one peer host"
+            )
+        self._journal = journal
+        self._peers = list(peers)
+        self._lock = threading.Lock()
+        self.maxlen = max(1, int(maxlen))
+        self._tails: Dict[str, deque] = {p: deque() for p in self._peers}
+        base = journal.rev()
+        self._acked: Dict[str, int] = {p: base for p in self._peers}
+        self._head = base
+        self.stats = {"replicated_events": 0, "overflows": 0}
+        journal.add_tap(self._on_event)
+
+    @property
+    def peers(self) -> List[str]:
+        return list(self._peers)
+
+    def _on_event(self, ev) -> None:
+        # deep-copy ONCE per event (the wire frame), shared by every peer
+        # buffer — peers never mutate replica objects, they fold copies
+        obj = copy.deepcopy(ev.obj)
+        frame = type(ev)(ev.seq, ev.event, ev.kind, ev.key, obj)
+        with self._lock:
+            self._head = ev.seq
+            self.stats["replicated_events"] += 1
+            for p in self._peers:
+                tail = self._tails[p]
+                tail.append(frame)
+                if len(tail) > self.maxlen:
+                    tail.popleft()
+                    self.stats["overflows"] += 1
+        self._export()
+
+    def drain_peer(self, peer: str) -> List:
+        """The peer applies its replica tail: returns the buffered events
+        in order and advances the peer's ack to the journal head."""
+        with self._lock:
+            tail = self._tails[peer]
+            out = list(tail)
+            tail.clear()
+            self._acked[peer] = out[-1].seq if out else self._head
+        self._export()
+        return out
+
+    def tail(self, peer: str) -> List:
+        """Non-destructive view of a peer's replica buffer."""
+        with self._lock:
+            return list(self._tails[peer])
+
+    def lag(self, peer: Optional[str] = None) -> int:
+        with self._lock:
+            if peer is not None:
+                return max(0, self._head - self._acked[peer])
+            return max(
+                (max(0, self._head - a) for a in self._acked.values()),
+                default=0,
+            )
+
+    def _export(self) -> None:
+        FEDERATION_REPLICATION_LAG.set(float(self.lag()))
+        for p in self._peers:
+            FEDERATION_REPLICATION_LAG.set(float(self.lag(p)), peer=p)
+
+    def rebuild_store(self, peer: str, store=None):
+        """Fold a peer's replica tail into a store — the re-baseline leg a
+        surviving host runs for an adopted tenant. With no base store the
+        tail must cover the world (peer attached from journal birth)."""
+        from ..controllers import store as st
+
+        target = store if store is not None else st.Store()
+        for ev in self.tail(peer):
+            obj = copy.deepcopy(ev.obj)
+            if ev.event == "DELETED":
+                try:
+                    target.delete(ev.kind, obj.meta.name, obj.meta.namespace)
+                except Exception:  # noqa: BLE001 — delete of a never-seen key
+                    pass
+                continue
+            try:
+                if target.try_get(ev.kind, obj.meta.name,
+                                  obj.meta.namespace) is None:
+                    target.create(ev.kind, obj)
+                else:
+                    target.update(ev.kind, obj)
+            except Exception:  # noqa: BLE001 — replica fold is best-effort
+                pass
+        return target
+
+
+def _accepts_tenant_kw(fn) -> bool:
+    try:
+        return "tenant_id" in inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return True
+
+
+class FederationRouter:
+    """SolveService-compatible facade over a federation of host stacks.
+
+    `submit`/`submit_fn` route by tenant (consistent hash), record an
+    outstanding entry on the home host, and forward the inner ticket's
+    resolution to a facade ticket. `fail_host` fences a host: ring
+    removal + submission-ordered requeue of its outstanding entries onto
+    the survivors (0 dropped by construction — every facade either already
+    resolved or is resubmitted; first-wins delivery de-duplicates a zombie
+    host's late result). `attach` wires a host name to a transport — the
+    self host's local stack always, in-process peers in tests, pipe-backed
+    workers in the bench soak."""
+
+    def __init__(self, hosts, self_host: str, clock=time.monotonic,
+                 replicator: Optional[JournalReplicator] = None,
+                 own_services: bool = False):
+        if isinstance(hosts, str):
+            hosts = parse_hosts(hosts)
+        else:
+            hosts = list(hosts)
+            if not hosts:
+                raise FederationConfigError("federation host list is empty")
+        if self_host not in hosts:
+            raise FederationConfigError(
+                f"self host {self_host!r} is not in the federation "
+                f"host list {hosts}"
+            )
+        self.all_hosts = list(hosts)
+        self.self_host = self_host
+        self.clock = clock
+        self.replicator = replicator
+        self._own = bool(own_services)
+        self._ring = HashRing(hosts)
+        self._failed: set = set()
+        self._services: Dict[str, object] = {}
+        self._svc_tenant_kw: Dict[str, tuple] = {}
+        self._outstanding: Dict[str, deque] = {h: deque() for h in hosts}
+        self._placement: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        self.stats: Dict[str, int] = {
+            "routes": 0,
+            "requeued": 0,
+            "dropped": 0,
+            "cross_host_failovers": 0,
+            "tenant_moves": 0,
+            "misroutes": 0,
+        }
+        self._export()
+
+    # -- wiring ---------------------------------------------------------------
+
+    def attach(self, host: str, service) -> None:
+        if host not in self.all_hosts:
+            raise FederationConfigError(
+                f"cannot attach unknown host {host!r}"
+            )
+        with self._lock:
+            self._services[host] = service
+            self._svc_tenant_kw[host] = (
+                _accepts_tenant_kw(service.submit),
+                _accepts_tenant_kw(service.submit_fn),
+            )
+
+    def healthy_hosts(self) -> List[str]:
+        with self._lock:
+            return [h for h in self.all_hosts if h not in self._failed]
+
+    # -- routing --------------------------------------------------------------
+
+    def route(self, tenant_id: Optional[str]) -> str:
+        """The home host for a tenant. `None` — un-federated local traffic
+        (the operator's own controllers) — is ALWAYS the self host: the
+        federation never re-homes work that was never a tenant's."""
+        with self._lock:
+            return self._route_locked(tenant_id)
+
+    def _route_locked(self, tenant_id: Optional[str]) -> str:
+        self.stats["routes"] += 1
+        if tenant_id is None:
+            return self.self_host
+        host = self._ring.route(tenant_id)
+        prev = self._placement.get(tenant_id)
+        if prev is not None and prev != host:
+            self.stats["tenant_moves"] += 1
+            FEDERATION_TENANT_MOVES.inc(tenant=tenant_id)
+        self._placement[tenant_id] = host
+        return host
+
+    # -- submission seam ------------------------------------------------------
+
+    def submit(self, inp, kind: str = PROVISIONING, rev=None,
+               tenant_id: Optional[str] = None) -> SolveTicket:
+        if tenant_id is None:
+            tenant_id = getattr(inp, "tenant_id", None)
+        facade = SolveTicket(kind, rev=rev, tenant_id=tenant_id)
+
+        def job(svc, host):
+            if self._svc_tenant_kw[host][0]:
+                return svc.submit(inp, kind, rev=rev, tenant_id=tenant_id)
+            return svc.submit(inp, kind, rev=rev)
+
+        self._dispatch(facade, job, tenant_id)
+        return facade
+
+    def submit_fn(self, dispatch_fn: Callable, kind: str = DISRUPTION,
+                  tenant_id: Optional[str] = None) -> SolveTicket:
+        facade = SolveTicket(kind, tenant_id=tenant_id)
+
+        def job(svc, host):
+            if self._svc_tenant_kw[host][1]:
+                return svc.submit_fn(dispatch_fn, kind, tenant_id=tenant_id)
+            return svc.submit_fn(dispatch_fn, kind)
+
+        self._dispatch(facade, job, tenant_id)
+        return facade
+
+    def _dispatch(self, facade: SolveTicket, job, tenant_id,
+                  requeue: bool = False) -> None:
+        with self._lock:
+            host = self._route_locked(tenant_id)
+            svc = self._services.get(host)
+        if svc is None:
+            self.stats["misroutes"] += 1
+            facade._deliver(error=FederationMisroute(
+                f"tenant {tenant_id!r} is homed on {host!r}, which has no "
+                f"attached transport here"
+            ))
+            return
+        rec = _Outstanding(facade, job, tenant_id, host)
+        try:
+            inner = job(svc, host)
+        except Exception as e:  # noqa: BLE001 — submission-time host loss
+            if not requeue and self._is_host_loss(e):
+                # the pipe/service died under the submit: fence the host
+                # and re-dispatch THIS facade with the survivors' ring
+                self.fail_host(host, reason=f"submit: {e}")
+                if not facade.done():
+                    self._dispatch(facade, job, tenant_id, requeue=True)
+                return
+            facade._deliver(error=e)
+            return
+        with self._lock:
+            if rec.host in self._failed:
+                # fenced between route and submit: the requeue pass missed
+                # this record, replay it ourselves (first-wins dedups)
+                rec.requeued = True
+            else:
+                self._outstanding[rec.host].append(rec)
+        inner.on_done(lambda t, r=rec: self._on_inner_done(r, t))
+        if rec.requeued and not facade.done():
+            self._dispatch(facade, job, tenant_id, requeue=True)
+
+    @staticmethod
+    def _is_host_loss(e: BaseException) -> bool:
+        """Submission failures that mean THE HOST is gone (fence + requeue)
+        rather than this request being bad (deliver the error)."""
+        from ..parallel.hostmesh import WorkerDead
+        from .pipeline import ServiceStopped
+
+        return isinstance(e, (WorkerDead, ServiceStopped, BrokenPipeError,
+                              ConnectionError, OSError))
+
+    def _on_inner_done(self, rec: _Outstanding, inner: SolveTicket) -> None:
+        err = inner.error()
+        with self._lock:
+            host_down = rec.host in self._failed or rec.requeued
+            try:
+                self._outstanding[rec.host].remove(rec)
+            except ValueError:
+                pass
+        if err is not None and host_down:
+            # a fenced host's error resolution (ServiceStopped, broken
+            # pipe): the requeue pass owns this facade now — swallowing
+            # here is what makes failover drop-free instead of error-free
+            return
+        if err is not None and self._is_host_loss(err):
+            # the host died UNDER this in-flight solve: re-insert the record
+            # at the head (it was the oldest outstanding — FIFO) and fence,
+            # which requeues it together with everything queued behind it
+            with self._lock:
+                if rec.host not in self._failed:
+                    self._outstanding[rec.host].appendleft(rec)
+            self.fail_host(rec.host, reason=f"inner: {err}")
+            if not rec.requeued and not rec.facade.done():
+                # fencing refused (last healthy host) — surface the loss
+                with self._lock:
+                    try:
+                        self._outstanding[rec.host].remove(rec)
+                    except ValueError:
+                        pass
+                rec.facade._deliver(error=err)
+            return
+        if err is not None:
+            rec.facade._deliver(error=err)
+        else:
+            try:
+                rec.facade._deliver(result=inner.result(0))
+            except BaseException as e:  # noqa: BLE001 — late error surface
+                rec.facade._deliver(error=e)
+
+    # -- failover -------------------------------------------------------------
+
+    def fail_host(self, host: str, reason: str = "") -> int:
+        """Fence a host: remove it from the ring and requeue its
+        outstanding submissions, IN ORDER, onto the survivors. Returns the
+        number of requeued entries. Idempotent per host."""
+        with self._lock:
+            if host in self._failed or host not in self.all_hosts:
+                return 0
+            if len(self._failed) + 1 >= len(self.all_hosts):
+                # fencing the LAST healthy host would strand every facade
+                # with no requeue target — keep serving on it (mirrors the
+                # fleet's zero-healthy revive posture)
+                return 0
+            self._failed.add(host)
+            self._ring.remove(host)
+            pending = list(self._outstanding[host])
+            self._outstanding[host].clear()
+            for rec in pending:
+                rec.requeued = True
+            self.stats["cross_host_failovers"] += 1
+        FEDERATION_FAILOVERS.inc(host=host)
+        for rec in pending:
+            if rec.facade.done():
+                continue
+            self.stats["requeued"] += 1
+            self._dispatch(rec.facade, rec.job, rec.tenant_id, requeue=True)
+        self._export()
+        return len(pending)
+
+    def restore_host(self, host: str) -> None:
+        """Unfence a recovered host: back into the ring; its former tenants
+        re-home on their next route (counted as tenant moves)."""
+        with self._lock:
+            if host not in self._failed:
+                return
+            self._failed.discard(host)
+            self._ring.add(host)
+        self._export()
+
+    # -- introspection / service surface --------------------------------------
+
+    def _export(self) -> None:
+        healthy = self.healthy_hosts()
+        FEDERATION_HOSTS_HEALTHY.set(float(len(healthy)))
+        for h in self.all_hosts:
+            FEDERATION_HOSTS_HEALTHY.set(
+                1.0 if h in healthy else 0.0, host=h
+            )
+
+    def federation_stats(self) -> Dict[str, object]:
+        with self._lock:
+            out = dict(self.stats)
+            out["hosts"] = len(self.all_hosts)
+            out["hosts_healthy"] = len(
+                [h for h in self.all_hosts if h not in self._failed]
+            )
+            out["outstanding"] = sum(
+                len(q) for q in self._outstanding.values()
+            )
+        if self.replicator is not None:
+            out["replication_lag"] = self.replicator.lag()
+        return out
+
+    def health(self) -> Dict[str, object]:
+        """Telemetry-provider payload for /healthz (mirrors streaming's):
+        degraded when any host is fenced."""
+        s = self.federation_stats()
+        s["state"] = "ok" if s["hosts_healthy"] == s["hosts"] else "warn"
+        return s
+
+    def unresolved(self) -> int:
+        with self._lock:
+            return sum(
+                0 if r.facade.done() else 1
+                for q in self._outstanding.values() for r in q
+            )
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            svcs = list(self._services.items())
+            failed = set(self._failed)
+        depth = 0
+        for host, svc in svcs:
+            if host in failed:
+                continue
+            try:
+                depth += int(svc.queue_depth())
+            except Exception:  # noqa: BLE001 — a dying peer reads as empty
+                pass
+        return depth
+
+    def occupancy(self) -> float:
+        svc = self._services.get(self.self_host)
+        try:
+            return float(svc.occupancy()) if svc is not None else 0.0
+        except Exception:  # noqa: BLE001
+            return 0.0
+
+    def close(self) -> None:
+        with self._lock:
+            svcs = list(self._services.values())
+            self._services.clear()
+        if self._own:
+            for svc in svcs:
+                try:
+                    svc.close()
+                except Exception:  # noqa: BLE001 — best-effort teardown
+                    pass
+
+    def __getattr__(self, name):
+        # introspection passthrough to the SELF host's stack (stats,
+        # solver, resume_stats, ...) — mirrors TenantView's posture; the
+        # routing surface above is always handled by the router itself
+        svc = self._services.get(self.self_host)
+        if svc is None:
+            raise AttributeError(name)
+        return getattr(svc, name)

@@ -219,7 +219,13 @@ class SolverFleet:
         instance_types: Optional[Sequence] = None,
         start_monitor: bool = False,
         vault=None,
+        host: str = "",
     ):
+        # federation host identity (solver/federation.py): rides onto the
+        # fleet series as a `host` label; empty (the single-host default)
+        # is dropped from both series keys and exposition, so a
+        # non-federated deploy's series are byte-identical to before
+        self.host = host
         self.size = max(1, int(size))
         self.depth = depth
         self.clock = clock
@@ -375,7 +381,7 @@ class SolverFleet:
             owner = self._pick_owner(entry.kind)
             if owner is None:
                 if requeued and entry.fn is None:
-                    FLEET_REQUEUED.inc(target="oracle")
+                    FLEET_REQUEUED.inc(target="oracle", host=self.host)
                 self._degrade(entry)
                 return
             try:
@@ -423,7 +429,7 @@ class SolverFleet:
                 ot.on_done(lambda t, o=owner, e=entry:
                            self._on_owner_done(o, e, t))
             if requeued:
-                FLEET_REQUEUED.inc(target="owner")
+                FLEET_REQUEUED.inc(target="owner", host=self.host)
             return
 
     def _place_cohort(self, entries: List[_FleetEntry]) -> None:
@@ -572,7 +578,7 @@ class SolverFleet:
             self.fleet_stats["failovers"] += 1
             survivors = list(owner.outstanding.values())
             owner.outstanding.clear()
-        FLEET_FAILOVER.inc(owner=owner.name)
+        FLEET_FAILOVER.inc(owner=owner.name, host=self.host)
         obstelemetry.note_event("fleet_fence", owner=owner.name, reason=reason)
         log.warning(
             "solver fleet: FENCING %s (%s) — stopping its service, "
@@ -708,7 +714,8 @@ class SolverFleet:
                 return "fenced"
             return "miss"
         owner.breaker.record_success()
-        FLEET_CANARY_LATENCY.observe(time.monotonic() - t0, owner=owner.name)
+        FLEET_CANARY_LATENCY.observe(time.monotonic() - t0, owner=owner.name,
+                                     host=self.host)
         return "ok"
 
     def _direct_canary(self, owner: FleetOwner) -> bool:
@@ -785,9 +792,9 @@ class SolverFleet:
         with self._lock:
             healthy = sum(1 for o in self.owners if not o.fenced)
             bits = [(o.name, 0.0 if o.fenced else 1.0) for o in self.owners]
-        FLEET_HEALTHY.set(float(healthy))
+        FLEET_HEALTHY.set(float(healthy), host=self.host)
         for name, bit in bits:
-            FLEET_HEALTHY.set(bit, owner=name)
+            FLEET_HEALTHY.set(bit, owner=name, host=self.host)
 
     def healthy_owners(self) -> int:
         with self._lock:

@@ -184,22 +184,42 @@ class ClusterJournal:
         self._floor = 0
         self.overflows = 0
         self.applied_rev = 0
+        # secondary consumers (solver/federation.py JournalReplicator): taps
+        # see every STAMPED event regardless of attach state, because the
+        # drain() buffer is single-consumer — a tap must never share it
+        self._taps: List = []
         store.watch(None, self._on_event)
+
+    def add_tap(self, fn) -> None:
+        """Register a secondary event consumer called with every stamped
+        JournalEvent. Taps run synchronously under the store's watch
+        dispatch and hold a LIVE obj reference — a tap that needs the
+        event-time object must copy it before returning."""
+        with self._lock:
+            self._taps.append(fn)
 
     def _on_event(self, event: str, kind: str, obj) -> None:
         with self._lock:
             self._seq += 1
+            seq = self._seq
+            taps = list(self._taps)
             if not self._attached:
                 self._floor = self._seq
-                return
-            key = f"{obj.meta.namespace}/{obj.meta.name}"
-            self._events.append(
-                JournalEvent(self._seq, event, kind, key, obj)
-            )
-            if len(self._events) > self.maxlen:
-                dropped = self._events.popleft()
-                self._floor = dropped.seq
-                self.overflows += 1
+                ev = None
+            else:
+                key = f"{obj.meta.namespace}/{obj.meta.name}"
+                ev = JournalEvent(self._seq, event, kind, key, obj)
+                self._events.append(ev)
+                if len(self._events) > self.maxlen:
+                    dropped = self._events.popleft()
+                    self._floor = dropped.seq
+                    self.overflows += 1
+        if taps:
+            if ev is None:
+                key = f"{obj.meta.namespace}/{obj.meta.name}"
+                ev = JournalEvent(seq, event, kind, key, obj)
+            for fn in taps:
+                fn(ev)
 
     def rev(self) -> int:
         """Monotonic seq of the newest store event (the journal state_rev)."""

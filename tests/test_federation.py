@@ -1,0 +1,473 @@
+"""Federated solver fleets (ISSUE 18; SPEC.md "Federation semantics").
+
+Pins the four federation contracts:
+- consistent-hash routing is STABLE under membership change: removing a
+  host re-homes only its own tenants, adding one steals ~1/N — surviving
+  hosts never shuffle tenants among themselves;
+- cross-host failover drops NOTHING and preserves per-tenant FIFO: a
+  fenced host's outstanding solves requeue onto survivors in submission
+  order, and a zombie host's late results are dead (first-wins facades);
+- journal replication is an event-time wire: the replica tail rebuilds a
+  peer store decision-identical to the lost host's, immune to later
+  mutation of the live objects;
+- knobs off = no router exists and the single-process path is untouched
+  (the fail-closed boot validations refuse every half-configured deploy).
+"""
+
+import dataclasses as dc
+import io
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api.objects import ObjectMeta, Pod
+from karpenter_tpu.controllers import store as st
+from karpenter_tpu.parallel import hostmesh as hm
+from karpenter_tpu.provisioning.scheduler import SolverInput
+from karpenter_tpu.solver.backend import ReferenceSolver
+from karpenter_tpu.solver.federation import (
+    FederationConfigError,
+    FederationMisroute,
+    FederationRouter,
+    HashRing,
+    JournalReplicator,
+    parse_hosts,
+)
+from karpenter_tpu.solver.pipeline import (
+    DISRUPTION,
+    PROVISIONING,
+    SolveService,
+    SolveTicket,
+)
+from karpenter_tpu.state.cluster import ClusterJournal
+from karpenter_tpu.utils.resources import Resources
+
+from tests.test_solver_parity import ZONES, pool
+
+
+def mkpod(name, cpu="500m", mem="512Mi"):
+    return Pod(meta=ObjectMeta(name=name, uid=name),
+               requests=Resources.parse({"cpu": cpu, "memory": mem}))
+
+
+def small_input(num_pods=6):
+    sizes = [("250m", "512Mi"), ("500m", "1Gi"), ("1", "2Gi")]
+    pods = [mkpod(f"p{i:03d}", *sizes[i % len(sizes)])
+            for i in range(num_pods)]
+    return SolverInput(pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)
+
+
+# ---------------------------------------------------------------- hash ring
+
+
+class TestHashRing:
+    def test_remove_moves_only_the_removed_hosts_tenants(self):
+        ring = HashRing(["h0", "h1", "h2", "h3"])
+        homes = {f"t{i}": ring.route(f"t{i}") for i in range(400)}
+        ring.remove("h2")
+        for t, old in homes.items():
+            new = ring.route(t)
+            if old == "h2":
+                assert new != "h2"
+            else:
+                # survivors never shuffle tenants among themselves
+                assert new == old, f"{t} moved {old} -> {new} on h2 removal"
+
+    def test_add_steals_a_bounded_fraction(self):
+        ring = HashRing(["h0", "h1", "h2", "h3"])
+        homes = {f"t{i}": ring.route(f"t{i}") for i in range(400)}
+        ring.add("h4")
+        moved = sum(1 for t, old in homes.items() if ring.route(t) != old)
+        # ~1/5 expected; 2x slack bounds vnode variance without flaking
+        assert moved <= 2 * 400 // 5, f"{moved}/400 moved on host add"
+        for t, old in homes.items():
+            new = ring.route(t)
+            assert new in (old, "h4"), f"{t} moved {old} -> {new}, not to h4"
+
+    def test_route_is_deterministic_and_order_insensitive(self):
+        a = HashRing(["h0", "h1", "h2"])
+        b = HashRing(["h2", "h1", "h0"])
+        for i in range(50):
+            assert a.route(f"t{i}") == b.route(f"t{i}")
+        with pytest.raises(FederationConfigError):
+            HashRing([]).route("t0")
+
+    def test_parse_hosts_fail_closed(self):
+        assert parse_hosts("a, b,c") == ["a", "b", "c"]
+        with pytest.raises(FederationConfigError):
+            parse_hosts("")
+        with pytest.raises(FederationConfigError):
+            parse_hosts("a,a")
+
+
+# ----------------------------------------------------- router construction
+
+
+class _FakeHost:
+    """Deterministic inner service: records arrivals in order, resolves a
+    ticket only when the test says so."""
+
+    def __init__(self, name):
+        self.name = name
+        self.received = []  # (tenant_id, payload) in arrival order
+        self.tickets = []
+
+    def submit(self, inp, kind=PROVISIONING, rev=None, tenant_id=None):
+        t = SolveTicket(kind, rev=rev, tenant_id=tenant_id)
+        self.received.append((tenant_id, inp))
+        self.tickets.append(t)
+        return t
+
+    def submit_fn(self, dispatch_fn, kind=DISRUPTION, tenant_id=None):
+        return self.submit(dispatch_fn, kind=kind, tenant_id=tenant_id)
+
+    def queue_depth(self):
+        return sum(1 for t in self.tickets if not t.done())
+
+    def occupancy(self):
+        return 0.0
+
+    def close(self):
+        pass
+
+
+def _tenants_on(router, host, n, universe=500):
+    out = [f"t{i}" for i in range(universe)
+           if router._ring.route(f"t{i}") == host]
+    assert len(out) >= n, f"universe too small for {n} tenants on {host}"
+    return out[:n]
+
+
+class TestRouterConfig:
+    def test_self_must_be_member(self):
+        with pytest.raises(FederationConfigError):
+            FederationRouter(["h0", "h1"], self_host="h9")
+
+    def test_attach_unknown_host_rejected(self):
+        r = FederationRouter(["h0"], self_host="h0")
+        with pytest.raises(FederationConfigError):
+            r.attach("h9", _FakeHost("h9"))
+
+    def test_unattached_route_is_typed_misroute(self):
+        r = FederationRouter(["h0", "h1"], self_host="h0")
+        r.attach("h0", _FakeHost("h0"))
+        # a tenant homed on the UNATTACHED peer must fail closed, not be
+        # served locally (that would fork the peer's journal cursor)
+        tn = next(f"t{i}" for i in range(200) if r.route(f"t{i}") == "h1")
+        t = r.submit("job", kind=DISRUPTION, tenant_id=tn)
+        assert isinstance(t.error(), FederationMisroute)
+        assert r.federation_stats()["misroutes"] == 1
+
+    def test_untenanted_traffic_stays_local(self):
+        r = FederationRouter(["h0", "h1", "h2"], self_host="h1")
+        assert r.route(None) == "h1"
+
+
+# ------------------------------------------------------- failover contract
+
+
+class TestCrossHostFailover:
+    def _rig(self):
+        hosts = ["h0", "h1", "h2"]
+        router = FederationRouter(hosts, self_host="h0")
+        fakes = {h: _FakeHost(h) for h in hosts}
+        for h, f in fakes.items():
+            router.attach(h, f)
+        return router, fakes
+
+    def test_zero_drops_and_per_tenant_fifo(self):
+        router, fakes = self._rig()
+        ta, tb = _tenants_on(router, "h1", 2)
+        # interleaved per-tenant streams, all homed on h1
+        facades = [
+            router.submit(f"{tn}#{k}", kind=DISRUPTION, tenant_id=tn)
+            for k in range(3) for tn in (ta, tb)
+        ]
+        assert fakes["h1"].received == [
+            (tn, f"{tn}#{k}") for k in range(3) for tn in (ta, tb)
+        ]
+        requeued = router.fail_host("h1", reason="test")
+        assert requeued == 6
+        assert router.healthy_hosts() == ["h0", "h2"]
+        # every tenant re-homed onto ONE survivor, streams in FIFO order
+        for tn in (ta, tb):
+            new_home = router.route(tn)
+            assert new_home in ("h0", "h2")
+            got = [tag for (t, tag) in fakes[new_home].received if t == tn]
+            assert got == [f"{tn}#{k}" for k in range(3)], got
+        # resolve the survivors' tickets: every facade resolves, 0 dropped
+        for h in ("h0", "h2"):
+            for t in fakes[h].tickets:
+                t._deliver(result=f"ok-by-{h}")
+        for f in facades:
+            assert f.result(timeout=5).startswith("ok-by-")
+        assert router.federation_stats()["dropped"] == 0
+
+    def test_zombie_host_results_are_dead(self):
+        router, fakes = self._rig()
+        (tn,) = _tenants_on(router, "h1", 1)
+        facade = router.submit("job", kind=DISRUPTION, tenant_id=tn)
+        router.fail_host("h1", reason="test")
+        new_home = router.route(tn)
+        fakes[new_home].tickets[-1]._deliver(result="survivor")
+        # the fenced host answers LATE: first-wins must keep the survivor's
+        for t in fakes["h1"].tickets:
+            t._deliver(result="zombie")
+        assert facade.result(timeout=5) == "survivor"
+
+    def test_fenced_host_errors_are_swallowed(self):
+        router, fakes = self._rig()
+        (tn,) = _tenants_on(router, "h1", 1)
+        facade = router.submit("job", kind=DISRUPTION, tenant_id=tn)
+        router.fail_host("h1", reason="test")
+        for t in fakes["h1"].tickets:
+            t._deliver(error=RuntimeError("host torn down"))
+        assert not facade.done()  # the requeued copy owns the facade now
+        new_home = router.route(tn)
+        fakes[new_home].tickets[-1]._deliver(result="ok")
+        assert facade.result(timeout=5) == "ok"
+
+    def test_in_flight_host_loss_requeues_not_drops(self):
+        """The host dies UNDER an in-flight solve (WorkerDead surfaces on
+        the inner ticket before anyone called fail_host): the router must
+        fence the host itself and requeue — the facade never sees the
+        pipe error."""
+        router, fakes = self._rig()
+        (tn,) = _tenants_on(router, "h1", 1)
+        facade = router.submit("job", kind=DISRUPTION, tenant_id=tn)
+        fakes["h1"].tickets[0]._deliver(
+            error=hm.WorkerDead("h1: EOF mid-call"))
+        assert "h1" not in router.healthy_hosts()
+        assert not facade.done()
+        new_home = router.route(tn)
+        fakes[new_home].tickets[-1]._deliver(result="ok")
+        assert facade.result(timeout=5) == "ok"
+        assert router.federation_stats()["cross_host_failovers"] == 1
+
+    def test_last_healthy_host_is_never_fenced(self):
+        router, fakes = self._rig()
+        router.fail_host("h0")
+        router.fail_host("h1")
+        assert router.healthy_hosts() == ["h2"]
+        router.fail_host("h2")  # refused: never strand the ring
+        assert router.healthy_hosts() == ["h2"]
+        # and an in-flight loss on the last host SURFACES the error
+        (tn,) = _tenants_on(router, "h2", 1, universe=2000)
+        facade = router.submit("job", kind=DISRUPTION, tenant_id=tn)
+        fakes["h2"].tickets[-1]._deliver(error=hm.WorkerDead("h2: gone"))
+        assert isinstance(facade.error(), hm.WorkerDead)
+
+    def test_tenant_moves_counted_on_rehome(self):
+        router, fakes = self._rig()
+        (tn,) = _tenants_on(router, "h1", 1)
+        router.route(tn)  # establish placement: first sight is not a move
+        before = router.federation_stats()["tenant_moves"]
+        router.fail_host("h1", reason="test")
+        router.route(tn)
+        assert router.federation_stats()["tenant_moves"] == before + 1
+
+    def test_restore_host_rejoins_the_ring(self):
+        router, fakes = self._rig()
+        router.fail_host("h1", reason="test")
+        assert "h1" not in router.healthy_hosts()
+        router.restore_host("h1")
+        assert router.healthy_hosts() == ["h0", "h1", "h2"]
+
+    def test_failover_composes_with_live_services(self):
+        # real SolveServices as hosts: fence one, resubmit, decisions land
+        hosts = ["h0", "h1"]
+        router = FederationRouter(hosts, self_host="h0", own_services=True)
+        for h in hosts:
+            router.attach(h, SolveService(ReferenceSolver()))
+        try:
+            inp = small_input()
+            (tn,) = _tenants_on(router, "h1", 1)
+            r1 = router.submit(
+                inp, kind=DISRUPTION, tenant_id=tn).result(timeout=30)
+            router.fail_host("h1", reason="test")
+            r2 = router.submit(
+                inp, kind=DISRUPTION, tenant_id=tn).result(timeout=30)
+            # the surviving peer reaches the lost host's exact decisions
+            assert r1.placements == r2.placements
+            assert router.federation_stats()["dropped"] == 0
+        finally:
+            router.close()
+
+
+# -------------------------------------------------------- journal replication
+
+
+class TestJournalReplication:
+    def _rig(self, maxlen=4096):
+        store = st.Store()
+        journal = ClusterJournal(store)
+        rep = JournalReplicator(journal, peers=["peer"], maxlen=maxlen)
+        return store, journal, rep
+
+    def test_tail_is_event_time_snapshot(self):
+        store, journal, rep = self._rig()
+        p = mkpod("a")
+        store.create(st.PODS, p)
+        p.requests = Resources.parse({"cpu": "8", "memory": "8Gi"})
+        tail = rep.tail("peer")
+        assert len(tail) == 1
+        # the replica holds the EVENT-TIME object, not the live reference
+        # (the journal's own events are level-triggered live refs)
+        assert tail[0].obj is not p
+        assert tail[0].obj.requests.get_("cpu") != p.requests.get_("cpu")
+
+    def test_lag_tracks_acks(self):
+        store, journal, rep = self._rig()
+        for i in range(3):
+            store.create(st.PODS, mkpod(f"p{i}"))
+        assert rep.lag("peer") == 3 and rep.lag() == 3
+        assert len(rep.drain_peer("peer")) == 3
+        assert rep.lag("peer") == 0
+        store.create(st.PODS, mkpod("late"))
+        assert rep.lag("peer") == 1
+
+    def test_catch_up_parity_is_decision_identical(self):
+        """The failover contract end to end: a peer re-baselined from the
+        replicated tail must make the SAME decisions the lost host would
+        have — same pods in, same placements out."""
+        store, journal, rep = self._rig()
+        inp = small_input(8)
+        for p in inp.pods:
+            store.create(st.PODS, p)
+        store.delete(st.PODS, inp.pods[-1].meta.name)
+        mut = store.get(st.PODS, inp.pods[0].meta.name)
+        mut.requests = Resources.parse({"cpu": "2", "memory": "4Gi"})
+        store.update(st.PODS, mut)
+
+        rebuilt = rep.rebuild_store("peer")
+        orig = sorted(store.list(st.PODS), key=lambda p: p.meta.name)
+        peer = sorted(rebuilt.list(st.PODS), key=lambda p: p.meta.name)
+        assert [p.meta.name for p in orig] == [p.meta.name for p in peer]
+
+        solver = ReferenceSolver()
+        res_orig = solver.solve(dc.replace(inp, pods=orig))
+        res_peer = solver.solve(dc.replace(inp, pods=peer))
+        assert res_orig.placements == res_peer.placements
+        assert res_orig.errors == res_peer.errors
+
+    def test_replication_needs_peers(self):
+        store = st.Store()
+        journal = ClusterJournal(store)
+        with pytest.raises(FederationConfigError):
+            JournalReplicator(journal, peers=[])
+
+    def test_bounded_tail_overflows_oldest_first(self):
+        store, journal, rep = self._rig(maxlen=2)
+        for i in range(5):
+            store.create(st.PODS, mkpod(f"p{i}"))
+        tail = rep.tail("peer")
+        assert [e.key for e in tail] == ["default/p3", "default/p4"]
+        assert rep.stats["overflows"] == 3
+
+
+# ----------------------------------------------- single-process parity path
+
+
+class TestSingleProcessParity:
+    def test_router_is_decision_identical_to_direct(self):
+        inp = small_input()
+        direct = SolveService(ReferenceSolver())
+        try:
+            want = direct.submit(inp, kind=DISRUPTION).result(timeout=30)
+        finally:
+            direct.close()
+        router = FederationRouter(["solo"], self_host="solo",
+                                  own_services=True)
+        router.attach("solo", SolveService(ReferenceSolver()))
+        try:
+            got = router.submit(inp, kind=DISRUPTION).result(timeout=30)
+        finally:
+            router.close()
+        assert want.placements == got.placements
+        assert want.errors == got.errors
+
+    def test_knobs_off_constructs_no_router(self):
+        from karpenter_tpu.operator.operator import new_kwok_operator
+
+        op = new_kwok_operator()
+        assert op.federation is None and op.replicator is None
+        # the submit seam is the plain pipeline service, not a facade
+        assert type(op.solve_service).__name__ == "SolveService"
+
+    def test_knobs_on_wires_router_and_replicator(self):
+        from karpenter_tpu.operator.operator import new_kwok_operator
+
+        op = new_kwok_operator(
+            federation_hosts="h0,h1", federation_self="h0",
+            journal_replicate=True,
+        )
+        assert type(op.federation).__name__ == "FederationRouter"
+        assert op.solve_service is op.federation
+        assert op.federation.route(None) == "h0"
+        assert op.replicator is not None and op.replicator.peers == ["h1"]
+
+    def test_boot_validations_fail_closed(self):
+        from karpenter_tpu.operator import options as opt
+
+        for argv in (
+            ["--federation-hosts", "h0,h1"],  # no self
+            ["--federation-hosts", "h0,h1", "--federation-self", "h9"],
+            ["--federation-self", "h0"],  # self without hosts
+            ["--journal-replicate", "true"],  # replication without hosts
+            ["--federation-hosts", "h0,h0", "--federation-self", "h0"],
+        ):
+            with pytest.raises(SystemExit):
+                opt.parse(argv)
+        o = opt.parse(["--federation-hosts", "h0,h1",
+                       "--federation-self", "h1",
+                       "--journal-replicate", "true"])
+        assert o.federation_self == "h1" and o.journal_replicate
+
+
+# ------------------------------------------------------- host mesh plumbing
+
+
+class TestHostMesh:
+    def test_worker_protocol_in_process(self):
+        """worker_main against in-memory pipes: ping, job-level error reply
+        (the worker must answer, not die), clean exit."""
+        inb = io.BytesIO()
+        for job in ({"kind": "ping"}, {"kind": "nope"}, {"kind": "exit"}):
+            hm._write_frame(inb, job)
+        inb.seek(0)
+        outb = io.BytesIO()
+        assert hm.worker_main(stdin=inb, stdout=outb) == 0
+        outb.seek(0)
+        ping = hm._read_frame(outb)
+        err = hm._read_frame(outb)
+        assert ping["ok"] and ping["result"]["pid"] > 0
+        assert not err["ok"] and "ValueError" in err["error"]
+
+    def test_tree_concat_reassembles_named_tuples(self):
+        import collections
+
+        T = collections.namedtuple("T", "a b")
+        parts = [
+            T(np.arange(2).reshape(2, 1), np.ones((2, 3))),
+            T(np.arange(2, 4).reshape(2, 1), np.zeros((2, 3))),
+        ]
+        out = hm._tree_concat(parts)
+        assert out.a.shape == (4, 1) and out.b.shape == (4, 3)
+        np.testing.assert_array_equal(out.a[:, 0], [0, 1, 2, 3])
+
+    def test_worker_death_is_typed(self):
+        w = hm.WorkerProc("t-dead")
+        try:
+            assert w.call({"kind": "ping"})["pid"] > 0
+            w.kill()
+            with pytest.raises(hm.WorkerDead):
+                w.call({"kind": "ping"})
+        finally:
+            w.close()
+
+    def test_pool_rejects_undividable_blocks(self):
+        pool_ = hm.HostMeshPool.__new__(hm.HostMeshPool)  # no subprocesses
+        pool_.workers = [object(), object(), object()]
+        with pytest.raises(ValueError, match="do not divide"):
+            pool_.scatter_blocks(np.zeros((4, 2, 3)), np.zeros((4, 2)),
+                                 rest=(), max_claims=8)

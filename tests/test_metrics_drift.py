@@ -150,6 +150,33 @@ def test_vault_series_are_registered():
         assert name in registered, f"{name} missing from the registry"
 
 
+def test_federation_series_are_registered():
+    """ISSUE 18 acceptance: the federation series are part of the /metrics
+    contract — healthy-host count, tenant re-homings, journal replication
+    lag, and cross-host failovers are what the federation dashboards and
+    the host-loss alert scrape, so pin their exact names. The existing
+    fleet series additionally carry a per-host label under federation;
+    empty host labels must keep single-host series identity unchanged."""
+    registered = {m.name for m in reg.REGISTRY.metrics}
+    for name in (
+        "karpenter_federation_hosts_healthy",
+        "karpenter_federation_tenant_moves_total",
+        "karpenter_federation_journal_replication_lag",
+        "karpenter_federation_cross_host_failovers_total",
+    ):
+        assert name in registered, f"{name} missing from the registry"
+    by_name = {m.name: m for m in reg.REGISTRY.metrics}
+    for name in (
+        "karpenter_solver_fleet_healthy",
+        "karpenter_solver_failover_total",
+        "karpenter_solver_requeued_solves_total",
+        "karpenter_solver_canary_latency_seconds",
+    ):
+        assert "host" in by_name[name].label_names, (
+            f"{name} lost its federation host label"
+        )
+
+
 def test_every_reason_code_has_name_and_spec_row():
     """Every kernel reason code must have a decoder-side name AND a SPEC.md
     row — an undocumented code is a wire symbol operators cannot read."""

@@ -208,3 +208,92 @@ def test_sharded_mixed_axis_scenario_matches_sequential():
             np.asarray(out.take_c)[b], np.asarray(seq.take_c))
         np.testing.assert_array_equal(
             np.asarray(out.state.c_zc_bits)[b], np.asarray(seq.state.c_zc_bits))
+
+
+class TestProcessMesh:
+    """Process-spanning mesh construction (ISSUE 18): single-process
+    degenerates to the legacy mesh; multi-process validation is fail-closed
+    (MeshConstructionError, never a silently-wrong mesh); the shard_map
+    fallback is decision-identical to the plain per-row program."""
+
+    def test_single_process_degenerates_to_make_mesh(self):
+        from karpenter_tpu.parallel.sharded import make_process_mesh
+
+        mesh, (lo, hi) = make_process_mesh(4)
+        assert mesh.devices.size == 4
+        assert (lo, hi) == (0, 4)  # one process owns the whole grid
+
+    def test_uneven_shard_split_fails_closed(self, monkeypatch):
+        from karpenter_tpu.parallel.sharded import (
+            MeshConstructionError,
+            make_process_mesh,
+        )
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        with pytest.raises(MeshConstructionError,
+                           match="not a multiple of process_count=2"):
+            make_process_mesh(3)
+
+    def test_oversubscribed_processes_fail_closed(self, monkeypatch):
+        from karpenter_tpu.parallel.sharded import (
+            MeshConstructionError,
+            make_process_mesh,
+        )
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        # 32 shards over 2 processes needs 16 devices per process; the
+        # virtual mesh holds 8 — must refuse, not build a straddling mesh
+        with pytest.raises(MeshConstructionError,
+                           match="devices per process but processes hold"):
+            make_process_mesh(32)
+
+    def test_one_sided_shardings_fail_closed(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from karpenter_tpu.parallel.sharded import (
+            MeshConstructionError,
+            mesh_sharded_call,
+        )
+
+        mesh = make_mesh(4)
+        sh = NamedSharding(mesh, P(mesh.axis_names[0]))
+        with pytest.raises(MeshConstructionError, match="one-sided"):
+            mesh_sharded_call(mesh, lambda x: x, in_shardings=sh)
+        with pytest.raises(MeshConstructionError, match="one-sided"):
+            mesh_sharded_call(mesh, lambda x: x, out_shardings=sh)
+
+    def test_shard_map_fallback_matches_plain_fn(self):
+        from karpenter_tpu.parallel.sharded import mesh_sharded_call
+
+        mesh = make_mesh(4, axis="shards")
+        x = np.arange(8 * 3, dtype=np.float32).reshape(8, 3)
+        fn = lambda a: a * 2.0 + 1.0  # noqa: E731 — per-shard body
+        out = mesh_sharded_call(mesh, fn)(x)
+        np.testing.assert_array_equal(np.asarray(out), fn(x))
+
+    def test_explicit_shardings_match_plain_fn(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from karpenter_tpu.parallel.sharded import mesh_sharded_call
+
+        mesh = make_mesh(4, axis="shards")
+        sh = NamedSharding(mesh, P("shards", None))
+        x = np.arange(8 * 3, dtype=np.float32).reshape(8, 3)
+        fn = lambda a: a * 3.0 - 2.0  # noqa: E731
+        out = mesh_sharded_call(mesh, fn, in_shardings=sh, out_shardings=sh)(x)
+        np.testing.assert_array_equal(np.asarray(out), fn(x))
+
+    def test_put_process_sharded_roundtrip(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from karpenter_tpu.parallel.sharded import (
+            make_process_mesh,
+            put_process_sharded,
+        )
+
+        mesh, (lo, hi) = make_process_mesh(4)
+        arr = np.arange(4 * 5, dtype=np.int32).reshape(4, 5)
+        dev = put_process_sharded(mesh, arr, lo, hi)
+        np.testing.assert_array_equal(np.asarray(dev), arr)
+        want = NamedSharding(mesh, P(mesh.axis_names[0], None))
+        assert dev.sharding.is_equivalent_to(want, arr.ndim)

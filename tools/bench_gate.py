@@ -72,6 +72,16 @@ TOLERANCES: Dict[str, float] = {
     "restart_to_first_solve_cold_ms": 0.30,
     "vault_snapshot_ms": 0.35,
     "handover_wall_ms": 0.35,
+    # federation (ISSUE 18): subprocess-host throughput is scheduler-noisy
+    # on shared runners (tail-class slack, higher-is-better via pattern /
+    # explicit keys below); failover recovery is single-shot wall-clock of
+    # a queue drain — lower-is-better, tail-class slack.
+    # federation_dropped_solves is asserted == 0 inside the suite (the
+    # gate skips <= 0 keys by design, so the suite itself is the gate).
+    "federated_solves_per_sec": 0.30,
+    "federated_solves_per_sec_1h": 0.30,
+    "scaling_efficiency_4h": 0.15,
+    "failover_recovery_ms": 0.35,
 }
 
 HIGHER_BETTER_PAT = re.compile(
@@ -83,6 +93,8 @@ HIGHER_BETTER_KEYS = {
     "aggregate_solves_per_sec",
     "tenant_aggregate_solves_per_sec",
     "cohort_size_mean",
+    # no "per_sec"/"speedup" token in the name — pin the direction
+    "scaling_efficiency_4h",
 }
 
 
