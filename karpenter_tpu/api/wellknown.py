@@ -20,6 +20,11 @@ OS_LABEL = "kubernetes.io/os"
 INITIALIZED_LABEL = "karpenter.sh/initialized"
 REGISTERED_LABEL = "karpenter.sh/registered"
 NODECLASS_LABEL = "karpenter.tpu/nodeclass"
+# Per-NodePool solver-backend override (solver/convex.py): "ffd" pins the
+# pool to the greedy device kernel, "convex" to the global ADMM backend;
+# absent = the operator-level --solver-backend default. Read off NodePool
+# metadata by the provisioner, carried on NodePoolSpec.solver_backend.
+SOLVER_BACKEND_LABEL = "karpenter.sh/solver-backend"
 
 # The exactly-three topology keys supported for topology spread
 # (website/.../scheduling.md:383-387).

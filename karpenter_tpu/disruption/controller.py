@@ -135,6 +135,12 @@ class DisruptionController:
             from .batched import BatchedConsolidationEvaluator
 
             self._batched = BatchedConsolidationEvaluator(inner)
+        # convex backend: one-shot whole-cluster consolidation proposals
+        # (solver/convex.py consolidate_global) — the probe ladder stays the
+        # fallback and the cross-check oracle when the global path declines
+        from ..solver.convex import find_convex
+
+        self._convex = find_convex(solver)
 
     # ------------------------------------------------------------------ main
 
@@ -332,6 +338,20 @@ class DisruptionController:
             if self._consolidation_enabled(c) and self._consolidate_after_ok(c)
         ]
         if method == "multi-consolidation":
+            # global path first: one ADMM program proposes the deletable
+            # SUBSET (not just cost-ordered prefixes, which the binary-search
+            # ladder is limited to) + one sequential verify = <=2 device
+            # dispatches per decision; any decline falls through to the
+            # probe ladder / sequential search unchanged
+            if self._convex is not None:
+                pool_g = consolidatable[: self.multi_node_max_candidates_batched]
+                if (
+                    len(pool_g) >= 2
+                    and self._max_budget_prefix(pool_g, method, budgets) >= 2
+                ):
+                    cmd = self._multi_global(pool_g, budgets, method)
+                    if cmd is not None:
+                        return cmd
             if self._batched is not None:
                 cmd = self._multi_batched(consolidatable, budgets)
                 if cmd is not NotImplemented:
@@ -549,6 +569,78 @@ class DisruptionController:
                     continue  # rejected replacement: skip this candidate/prefix
                 return Command(method, pool[:k], replacement_names=names)
         return None
+
+    def _multi_global(self, pool: List[Candidate], budgets, method: str):
+        """One-shot whole-cluster consolidation via the convex backend
+        (solver/convex.py consolidate_global): dispatch 1 proposes the
+        deletable candidate SUBSET — any subset, not just cost-ordered
+        prefixes, which the binary-search ladder structurally cannot find —
+        and dispatch 2 is ONE sequential `_simulate` that verifies the
+        proposal under the exact command-safety rules (no unschedulable
+        pods, <=1 replacement claim, cheaper) before anything is commanded.
+        Every decline (no convex layer wired / out-of-scope input /
+        non-convergence / budget trim below 2 / verify reject) returns None
+        and the probe ladder cross-checks as before."""
+        if self._convex is None:
+            return None
+
+        def bump(key: str) -> None:
+            self.stats[key] = self.stats.get(key, 0) + 1
+
+        bump("global_decisions")
+        if self._provisioner_helper is None:
+            self._provisioner_helper = Provisioner(
+                self.store, self.cluster, self.cloud_provider, self.solver,
+                batch_idle_s=0, batch_max_s=0, clock=self.clock,
+                preference_policy=self.preference_policy,
+            )
+        import dataclasses
+
+        pods = [
+            dataclasses.replace(p, node_name=None, phase="Pending")
+            for c in pool
+            for p in c.pods
+        ]
+        # candidates' nodes stay PRESENT: the global program models staying
+        # put as a priced column per candidate, so removal is a per-column
+        # decision instead of a pre-filtered universe
+        inp = self._provisioner_helper.build_input(pods)
+        cands_arg = [
+            (c.node.meta.name, c.price, frozenset(p.meta.uid for p in c.pods))
+            for c in pool
+        ]
+        try:
+            proposal = self._convex.consolidate_global(inp, cands_arg)
+        except Exception:
+            proposal = None
+        if proposal is None:
+            bump("global_declines")
+            return None
+        bump("global_dispatches")  # dispatch 1: the ADMM proposal
+        delete = set(proposal["delete"])
+        subset: List[Candidate] = []
+        for c in pool:  # cost order: greedy trim to the per-pool budgets
+            if c.node.meta.name in delete and self._within_budget(
+                subset + [c], method, budgets
+            ):
+                subset.append(c)
+        if len(subset) < 2:
+            bump("global_declines")
+            return None
+        ok, claim_res = self._simulate(
+            subset, allow_replacement=True, require_cheaper=True
+        )
+        bump("global_dispatches")  # dispatch 2: the sequential verify
+        if not ok:
+            bump("global_verify_rejects")
+            return None
+        try:
+            names = [self._create_replacement(claim_res)] if claim_res else []
+        except Exception:
+            bump("global_verify_rejects")
+            return None
+        bump("global_commands")
+        return Command(method, subset, replacement_names=names)
 
     def _single_batched(self, consolidatable: List[Candidate], budgets):
         """Chunked single-candidate verdicts in cost order; first acceptable

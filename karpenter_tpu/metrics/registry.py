@@ -903,3 +903,34 @@ SOLVER_VAULT_RESTORE_FAILURES = REGISTRY.register(
         "boot, not a failure, and does not count",
     )
 )
+
+# -- convex (global-optimization) solver backend (solver/convex.py) ---------
+SOLVER_CONVEX_SOLVES = REGISTRY.register(
+    Counter(
+        "karpenter_solver_convex_solves_total",
+        "ADMM solves that produced an accepted result, by path "
+        "(provision = full solve through the Solver seam, consolidate = "
+        "consolidate_global whole-cluster proposal); declines that "
+        "delegated verbatim to FFD count nothing",
+        ("path",),
+    )
+)
+SOLVER_CONVEX_FALLBACKS = REGISTRY.register(
+    Counter(
+        "karpenter_solver_convex_fallbacks_total",
+        "Convex solves that fell back LOUDLY to the FFD inner solver "
+        "after dispatch, by reason (nonconverged / invariant / min_values "
+        "/ device / consolidate_nonconverged) — each also leaves a flight "
+        "dump (reason=convex_fallback); a rising rate means the tolerance "
+        "or iteration budget no longer fits the fleet shape",
+        ("reason",),
+    )
+)
+SOLVER_CONVEX_ITERATIONS = REGISTRY.register(
+    Gauge(
+        "karpenter_solver_convex_iterations",
+        "ADMM iterations the most recent converged solve needed (scan "
+        "convergence latch) — trending toward --convex-max-iters predicts "
+        "imminent nonconverged fallbacks",
+    )
+)

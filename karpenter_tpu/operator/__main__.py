@@ -242,25 +242,32 @@ def main(argv=None) -> int:
     obstelemetry.configure(enabled=o.telemetry)
     obsanomaly.configure(enabled=o.telemetry, multiplier=o.anomaly_threshold)
     log = logging.getLogger("karpenter_tpu")
+    # "ffd" aliases "tpu" (the greedy device kernel); "convex" layers the
+    # global ADMM backend over that same kernel (solver/convex.py), so all
+    # three are device-backed — only "reference" runs the host oracle
+    device_backed = o.solver_backend in ("tpu", "ffd", "convex")
     solver = (
         TPUSolver(arena=o.solver_arena, resume=o.solver_resume,
                   ckpt_every=o.resume_checkpoint_interval,
                   device_decode=o.solver_device_decode,
                   relax_ladder=o.solver_relax_ladder,
                   arena_budget_mb=o.arena_budget_mb)
-        if o.solver_backend == "tpu"
+        if device_backed
         else ReferenceSolver()
     )
     op = new_kwok_operator(
         solver=solver,
+        solver_convex=o.solver_backend == "convex",
+        convex_max_iters=o.convex_max_iters,
+        convex_tolerance=o.convex_tolerance,
         batch_idle_s=o.batch_idle_duration_s,
         batch_max_s=o.batch_max_duration_s,
         rate_limits=o.kwok_rate_limits,
         preference_policy=o.preference_policy,
         snapshot_path=o.snapshot_path or None,
         snapshot_interval_s=o.snapshot_interval_s,
-        warm_start=o.warm_start and o.solver_backend == "tpu",
-        aot_prewarm=o.aot_prewarm and o.solver_backend == "tpu",
+        warm_start=o.warm_start and device_backed,
+        aot_prewarm=o.aot_prewarm and device_backed,
         prewarm_scale_pods=o.prewarm_scale_pods,
         compile_cache_dir=o.compile_cache_dir or None,
         leader_elect=o.leader_elect,

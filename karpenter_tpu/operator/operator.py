@@ -96,6 +96,9 @@ def new_kwok_operator(
     canary_deadline_s: float = 5.0,
     solver_preemption: bool = True,
     solver_gang: bool = True,
+    solver_convex: bool = False,
+    convex_max_iters: int = 400,
+    convex_tolerance: float = 1e-3,
     solver_tenants: str = "",
     tenant_weights: str = "",
     tenant_max_queue_depth: int = 64,
@@ -148,6 +151,17 @@ def new_kwok_operator(
     cloud_provider = decorate(cloud_provider)
     cluster = Cluster(store, clock=clock)
     solver = solver or ReferenceSolver()
+    if solver_convex:
+        # global-optimization backend (solver/convex.py): layered directly
+        # over the configured backend — INSIDE the resilience wrap so the
+        # invariant gate judges convex outputs and a device failure still
+        # walks the fallback chain. Off (the default) = this line never
+        # runs and the chain below is byte-identical.
+        from ..solver.convex import ConvexSolver
+
+        solver = ConvexSolver(
+            solver, max_iters=convex_max_iters, tolerance=convex_tolerance
+        )
     if resilient:
         # deadline + failure classification + invariant gate + circuit
         # breaker around whatever backend was configured; transparent on
@@ -196,6 +210,14 @@ def new_kwok_operator(
                 fresh: Solver = type(inner)()
             except Exception:  # noqa: BLE001 — degrade to the oracle owner
                 fresh = ReferenceSolver()
+            if solver_convex:
+                # failover owners carry the same backend choice as owner 0
+                from ..solver.convex import ConvexSolver
+
+                fresh = ConvexSolver(
+                    fresh, max_iters=convex_max_iters,
+                    tolerance=convex_tolerance,
+                )
             if resilient:
                 from ..solver.resilient import ResilientSolver
 

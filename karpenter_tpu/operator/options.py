@@ -71,8 +71,18 @@ class Options:
     slo_objectives: str = ""
     feature_gates: str = ""
     leader_elect: bool = True
-    # solver backend: tpu | reference
+    # solver backend: tpu | reference | ffd (alias of tpu: the greedy
+    # device kernel) | convex (solver/convex.py: the global-optimization
+    # ADMM backend layered over the device kernel; FFD remains the
+    # fallback and the per-NodePool default via wellknown.
+    # SOLVER_BACKEND_LABEL overrides)
     solver_backend: str = "tpu"
+    # convex backend iteration budget: the jitted ADMM scan length. A solve
+    # that has not converged within it falls back LOUDLY to FFD (counted +
+    # flight-dumped), so this bounds worst-case convex latency
+    convex_max_iters: int = 400
+    # convex convergence tolerance on max |dX| between ADMM iterates
+    convex_tolerance: float = 1e-3
     # resilient execution layer (solver/resilient.py): wrap the backend in
     # deadline + classification + invariant gate + circuit breaker
     solver_resilient: bool = True
@@ -289,6 +299,32 @@ def parse(argv: Optional[Sequence[str]] = None, cls=Options) -> Options:
             "refusing to start: --resume-checkpoint-interval must be >= 1 "
             f"(got {interval}); it is the number of FFD scan steps between "
             "checkpoint-ring snapshots (operator/options.py)"
+        )
+    # solver-backend knob sanity (same fail-closed rule): an unknown
+    # backend name must refuse startup, not silently run the default —
+    # "ffd" is an accepted alias of "tpu" (the greedy device kernel)
+    backend = getattr(out, "solver_backend", None)
+    if backend is not None and backend not in ("tpu", "reference", "ffd", "convex"):
+        raise SystemExit(
+            "refusing to start: --solver-backend must be one of "
+            f"tpu|reference|ffd|convex (got {backend}); ffd aliases tpu, "
+            "convex layers the global ADMM backend over it "
+            "(solver/convex.py)"
+        )
+    cvx_iters = getattr(out, "convex_max_iters", None)
+    if cvx_iters is not None and int(cvx_iters) < 1:
+        raise SystemExit(
+            "refusing to start: --convex-max-iters must be >= 1 "
+            f"(got {cvx_iters}); it is the jitted ADMM scan length — "
+            "non-convergence within it falls back to FFD "
+            "(solver/convex.py)"
+        )
+    cvx_tol = getattr(out, "convex_tolerance", None)
+    if cvx_tol is not None and float(cvx_tol) <= 0:
+        raise SystemExit(
+            "refusing to start: --convex-tolerance must be > 0 "
+            f"(got {cvx_tol}); it is the ADMM convergence threshold on "
+            "max |dX| between iterates (solver/convex.py)"
         )
     # fleet knob sanity (same fail-closed rule as the resume interval): a
     # zero/negative fleet size or fence threshold would wedge routing deep

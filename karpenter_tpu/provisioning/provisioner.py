@@ -114,6 +114,9 @@ class Provisioner:
                     instance_types=types,
                     limits=np_obj.limits,
                     usage=usage.get(np_obj.name, type(np_obj.limits)()),
+                    solver_backend=np_obj.meta.labels.get(
+                        wk.SOLVER_BACKEND_LABEL
+                    ),
                 )
             )
             for it in types:

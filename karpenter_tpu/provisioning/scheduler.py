@@ -64,6 +64,10 @@ class NodePoolSpec:
     instance_types: List[InstanceType]
     limits: Resources = field(default_factory=Resources)
     usage: Resources = field(default_factory=Resources)  # current aggregate
+    # per-pool backend override (wellknown.SOLVER_BACKEND_LABEL); None =
+    # operator default. Consulted only by the ConvexSolver selection gate —
+    # the FFD kernel and the oracle never read it.
+    solver_backend: Optional[str] = None
 
 
 @dataclass

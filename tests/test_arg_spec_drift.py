@@ -404,6 +404,28 @@ def test_streaming_entry_point_signatures():
     ) == ["self", "host_args", "prov", "sharding", "ns"]
 
 
+# -- convex ADMM backend (ISSUE 19) --------------------------------------------
+
+
+def test_convex_kernel_signature_matches_spec():
+    """admm_pack is a SIDE entry point (CLASS_ARG_SPEC precedent): its
+    tensor params are pinned by convex.CONVEX_ARG_SPEC — the arena keys
+    residency and prewarm_aot sizes shapes on that order — with the single
+    static trailing, and it must not widen the frozen 36-tensor FFD
+    contract."""
+    from karpenter_tpu.solver import convex
+
+    params = list(inspect.signature(convex.admm_pack.__wrapped__).parameters)
+    tensor = [p for p in params if p not in convex.CONVEX_STATICS]
+    assert tuple(tensor) == convex.CONVEX_ARG_SPEC, (
+        "admm_pack's positional tensor params drifted from CONVEX_ARG_SPEC"
+    )
+    assert params == tensor + list(convex.CONVEX_STATICS), (
+        "admm_pack: statics must trail the tensor args"
+    )
+    assert len(ffd.ARG_SPEC) == 36  # the convex backend rides a side table
+
+
 def test_explain_reasons_match_decoder_names():
     """The kernel-side enum and the decoder-side names (obs/explain) are one
     contract — a code without a name renders as 'codeN' in records, a name

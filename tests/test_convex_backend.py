@@ -1,0 +1,357 @@
+"""Convex (ADMM) solver backend: feasibility parity, quality dominance,
+loud fallback, disruption e2e, and knobs-off inertness.
+
+The convex backend (solver/convex.py) is ALLOWED to place differently
+from FFD — cheaper shapes are its point — but never invalidly (the same
+invariant gate + min-values post-check guard both backends), never with
+MORE nodes on known-optima fleets, and never silently: every decline or
+fallback is counted and the FFD result is returned verbatim.
+"""
+
+import random
+
+from karpenter_tpu.api import wellknown as wk
+from karpenter_tpu.cloudprovider.types import InstanceType, Offering
+from karpenter_tpu.provisioning.scheduler import ExistingNode, SolverInput
+from karpenter_tpu.scheduling.requirements import IN, Requirement, Requirements
+from karpenter_tpu.solver.backend import TPUSolver
+from karpenter_tpu.solver.convex import ConvexSolver, find_convex
+from karpenter_tpu.solver.encode import quantize_input
+from karpenter_tpu.solver.resilient import check_invariants
+from karpenter_tpu.utils.resources import Resources
+
+from tests.test_solver_parity import ZONES, mkpod, pool
+
+
+def mktype(name, cpu, mem_gib, price, ct="on-demand"):
+    reqs = Requirements.of(
+        Requirement.create(wk.INSTANCE_TYPE_LABEL, IN, [name]),
+        Requirement.create(wk.ARCH_LABEL, IN, ["amd64"]),
+        Requirement.create(wk.OS_LABEL, IN, ["linux"]),
+        Requirement.create(wk.ZONE_LABEL, IN, list(ZONES)),
+        Requirement.create(wk.CAPACITY_TYPE_LABEL, IN, [ct]),
+    )
+    cap = Resources.parse({"cpu": str(cpu), "memory": f"{mem_gib}Gi"})
+    cap["pods"] = 110
+    return InstanceType(
+        name=name, requirements=reqs, capacity=cap, overhead=Resources(),
+        offerings=[Offering(zone=z, capacity_type=ct, price=price)
+                   for z in ZONES],
+    )
+
+
+def mknode(name, zone="zone-1a", cpu="8", mem="32Gi", pods=110):
+    lab = {
+        wk.ZONE_LABEL: zone,
+        wk.HOSTNAME_LABEL: name,
+        wk.CAPACITY_TYPE_LABEL: "on-demand",
+        wk.ARCH_LABEL: "amd64",
+        wk.OS_LABEL: "linux",
+    }
+    free = Resources.parse({"cpu": cpu, "memory": mem})
+    free["pods"] = pods
+    return ExistingNode(id=name, labels=lab, taints=[], free=free)
+
+
+class TestFeasibilityParity:
+    """Randomized fleets: whatever the convex backend returns must pass
+    the SAME validity bar as FFD — zero invariant violations, zero
+    fallbacks (a fallback would mean the gate or convergence tripped)."""
+
+    def test_randomized_fleets_never_trip_the_gate(self):
+        rng = random.Random(20419)
+        for trial in range(6):
+            n_nodes = rng.randint(0, 3)
+            nodes = [
+                mknode(f"n{trial}-{j}", zone=ZONES[j % len(ZONES)],
+                       cpu=str(rng.choice([4, 8, 16])))
+                for j in range(n_nodes)
+            ]
+            pods = [
+                mkpod(f"t{trial}-p{i}", cpu=str(rng.choice([1, 2, 3])),
+                      mem=f"{rng.choice([1, 2, 4])}Gi")
+                for i in range(rng.randint(4, 24))
+            ]
+            inp = SolverInput(pods=pods, nodes=nodes, nodepools=[pool()],
+                              zones=ZONES,
+                              capacity_types=("on-demand", "spot"))
+            cv = ConvexSolver(TPUSolver())
+            res = cv.solve(inp)
+            assert check_invariants(quantize_input(inp), res) == [], (
+                trial, res.errors)
+            assert cv.convex_stats["convex_fallbacks"] == 0, (
+                trial, cv.convex_stats)
+            assert cv.convex_stats["convex_solves"] == 1, (
+                trial, cv.convex_stats)
+            # every pod accounted for: placed or carried as an error
+            placed = {u for u, t in res.placements.items() if t is not None}
+            errored = set(res.errors)
+            assert placed | errored >= {p.meta.uid for p in pods}
+
+    def test_existing_capacity_filled_first(self):
+        # two half-usable nodes + pods that split across them and one claim:
+        # sunk existing capacity must fill before any claim opens (the FFD
+        # kernel's own semantics, kept by the node-first rounding tier)
+        nodes = [mknode("n1"), mknode("n2", zone="zone-1b")]
+        pods = [mkpod(f"q{i:02d}", cpu="3", mem="4Gi") for i in range(8)]
+        inp = SolverInput(pods=pods, nodes=nodes, nodepools=[pool()],
+                          zones=ZONES, capacity_types=("on-demand", "spot"))
+        cv = ConvexSolver(TPUSolver())
+        res = cv.solve(inp)
+        assert not res.errors
+        on_node = [u for u, t in res.placements.items() if t[0] == "node"]
+        assert len(on_node) == 4  # 2 x 3cpu per 8cpu node
+        assert len(res.claims) == 1  # remainder packs onto ONE claim
+        assert cv.convex_stats["convex_fallbacks"] == 0
+
+
+class TestQualityDominance:
+    """Known-optima fleets: convex must never provision MORE nodes than
+    FFD, and must beat it where FFD's weight-greedy order is provably
+    suboptimal (the bench quality suite's rightsize config)."""
+
+    def _contention_input(self, n_pods=96):
+        boutique = mktype("boutique.xlarge", 4, 16, 1.0)
+        warehouse = mktype("warehouse.4xlarge", 16, 64, 0.9)
+        pools = [
+            pool("boutique", weight=100, types=[boutique]),
+            pool("warehouse", weight=0, types=[warehouse]),
+        ]
+        pods = [mkpod(f"w{i:03d}", cpu="1", mem="1Gi") for i in range(n_pods)]
+        return SolverInput(pods=pods, nodes=[], nodepools=pools, zones=ZONES,
+                           capacity_types=("on-demand",))
+
+    def test_uniform_fleet_ties_ffd(self):
+        # one pool, one shape: FFD is optimal; convex must tie, not scatter
+        t = mktype("std.xlarge", 4, 16, 1.0)
+        pods = [mkpod(f"u{i:02d}", cpu="1", mem="1Gi") for i in range(12)]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool(types=[t])],
+                          zones=ZONES, capacity_types=("on-demand",))
+        r_ffd = TPUSolver().solve(inp)
+        cv = ConvexSolver(TPUSolver())
+        r_cv = cv.solve(inp)
+        assert not r_ffd.errors and not r_cv.errors
+        assert len(r_cv.claims) == len(r_ffd.claims) == 3
+
+    def test_rightsize_contention_beats_ffd(self):
+        inp = self._contention_input()
+        r_ffd = TPUSolver().solve(inp)
+        cv = ConvexSolver(TPUSolver())
+        r_cv = cv.solve(inp)
+        assert not r_ffd.errors and not r_cv.errors
+        # FFD follows pool weight onto 4-cpu $1.00 nodes; the convex
+        # objective follows price onto 16-cpu $0.90 nodes
+        assert len(r_ffd.claims) == 24
+        assert len(r_cv.claims) == 6
+        assert cv.convex_stats["convex_fallbacks"] == 0
+
+    def test_convex_never_worse_on_catalog_fleets(self):
+        rng = random.Random(77)
+        for trial in range(3):
+            pods = [
+                mkpod(f"c{trial}-{i}", cpu=str(rng.choice([1, 2])),
+                      mem=f"{rng.choice([1, 2])}Gi")
+                for i in range(rng.randint(8, 32))
+            ]
+            inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()],
+                              zones=ZONES,
+                              capacity_types=("on-demand", "spot"))
+            r_ffd = TPUSolver().solve(inp)
+            cv = ConvexSolver(TPUSolver())
+            r_cv = cv.solve(inp)
+            assert not r_cv.errors
+            assert len(r_cv.claims) <= len(r_ffd.claims), (
+                trial, len(r_cv.claims), len(r_ffd.claims))
+
+
+class TestLoudFallback:
+    def test_nonconvergence_falls_back_loudly(self):
+        # max_iters=1 cannot converge on a real problem: the solve must
+        # complete via the FFD fallback AND the failure must be counted —
+        # never a silent quality downgrade
+        pods = [mkpod(f"p{i}", cpu="1", mem="1Gi") for i in range(12)]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()],
+                          zones=ZONES, capacity_types=("on-demand", "spot"))
+        cv = ConvexSolver(TPUSolver(), max_iters=1)
+        res = cv.solve(inp)
+        assert not res.errors  # the fallback FFD leg still solved it
+        assert cv.convex_stats["convex_fallbacks"] == 1
+        assert cv.convex_stats["convex_solves"] == 0
+
+    def test_per_pool_backend_label_declines(self):
+        # one pool pinned to ffd: the selection gate requires EVERY pool to
+        # resolve convex, so the solve delegates verbatim (counted decline)
+        p1 = pool("a")
+        p2 = pool("b")
+        p2.solver_backend = "ffd"
+        pods = [mkpod("p0"), mkpod("p1")]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[p1, p2],
+                          zones=ZONES, capacity_types=("on-demand", "spot"))
+        cv = ConvexSolver(TPUSolver())
+        res = cv.solve(inp)
+        assert not res.errors
+        assert cv.convex_stats["convex_declines"] == 1
+        assert cv.convex_stats["convex_solves"] == 0
+
+
+class TestConsolidateGlobal:
+    def test_one_shot_proposal_and_dispatch_budget(self):
+        t = mktype("std.4xlarge", 16, 64, 0.9)
+        nodes = [mknode(f"c{j}") for j in range(1, 4)]
+        nodes.append(mknode("surv", cpu="16", mem="64Gi"))
+        pods = [mkpod(f"m{j}{k}", cpu="1", mem="1Gi")
+                for j in range(3) for k in range(2)]
+        inp = SolverInput(pods=pods, nodes=nodes,
+                          nodepools=[pool(types=[t])], zones=ZONES,
+                          capacity_types=("on-demand",))
+        cv = ConvexSolver(TPUSolver())
+        dispatches = 0
+        inner = cv._dispatch
+
+        def counting(prob):
+            nonlocal dispatches
+            dispatches += 1
+            return inner(prob)
+
+        cv._dispatch = counting
+        cands = [(f"c{j}", 0.5,
+                  frozenset({f"m{j - 1}{k}" for k in range(2)}))
+                 for j in range(1, 4)]
+        proposal = cv.consolidate_global(inp, cands)
+        assert proposal is not None
+        assert sorted(proposal["delete"]) == ["c1", "c2", "c3"]
+        assert proposal["iterations"] > 0
+        assert dispatches == 1  # ONE device program for the whole decision
+        assert all(m < 0.2 for m in proposal["stay_mass"].values())
+
+    def test_infeasible_consolidation_declines(self):
+        # survivor too small for even two candidates' pods: no >=2-subset
+        # can empty, so the global pass must decline (probe ladder's job)
+        t = mktype("std.4xlarge", 16, 64, 0.9)
+        nodes = [mknode(f"c{j}") for j in range(1, 4)]
+        nodes.append(mknode("surv", cpu="2", mem="64Gi"))
+        pods = [mkpod(f"m{j}{k}", cpu="1", mem="1Gi")
+                for j in range(3) for k in range(2)]
+        inp = SolverInput(pods=pods, nodes=nodes,
+                          nodepools=[pool(types=[t])], zones=ZONES,
+                          capacity_types=("on-demand",))
+        cv = ConvexSolver(TPUSolver())
+        assert cv.consolidate_global(inp, [
+            (f"c{j}", 0.5, frozenset({f"m{j - 1}{k}" for k in range(2)}))
+            for j in range(1, 4)
+        ]) is None
+        assert cv.convex_stats["global_declines"] == 1
+
+
+class TestDisruptionE2E:
+    """The operator-level seam: solver_convex=True wires ConvexSolver
+    inside the resilience wrap and the disruption controller finds it for
+    the one-shot global pass; the probe ladder remains the cross-check."""
+
+    def _settle_consolidation(self, op):
+        from karpenter_tpu.controllers import store as st
+        from tests.test_e2e_kwok import mkpool, mkpod as e2epod
+
+        from karpenter_tpu.api.objects import TopologySpreadConstraint
+
+        op.store.create(st.NODEPOOLS, mkpool())
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.HOSTNAME_LABEL,
+            label_selector={"app": "x"},
+        )
+        for i in range(3):
+            op.store.create(
+                st.PODS,
+                e2epod(f"p{i}", cpu="200m", mem="256Mi",
+                       labels={"app": "x"}, topology_spread=[tsc]),
+            )
+        op.manager.settle()
+        assert len(op.store.list(st.NODES)) == 3
+        for i in range(3):
+            p = op.store.get(st.PODS, f"p{i}")
+            p.topology_spread = []
+            op.store.update(st.PODS, p)
+        op.clock.advance(30)
+        op.manager.settle()
+        nodes = op.store.list(st.NODES)
+        pods = op.store.list(st.PODS)
+        assert all(p.node_name for p in pods)
+        return len(nodes)
+
+    def test_convex_operator_consolidates_like_probe_ladder(self):
+        from karpenter_tpu.operator.operator import new_kwok_operator
+        from tests.test_e2e_kwok import FakeClock
+
+        results = {}
+        for convex in (False, True):
+            clock = FakeClock()
+            op = new_kwok_operator(clock=clock, solver_convex=convex)
+            op.clock = clock
+            results[convex] = self._settle_consolidation(op)
+            if convex:
+                from karpenter_tpu.disruption.controller import (
+                    DisruptionController,
+                )
+
+                cv = find_convex(op.provisioner.solver)
+                assert cv is not None
+                dc = next(c for c in op.manager.controllers
+                          if isinstance(c, DisruptionController))
+                assert dc._convex is cv
+        # both control loops converge the fleet to the same node count
+        assert results[True] == results[False] < 3
+
+
+class TestKnobsOffInertness:
+    def test_solver_convex_off_is_byte_identical(self):
+        # knob off: the operator must build the EXACT solver object graph
+        # it built before this feature existed — no wrapper in the chain
+        from karpenter_tpu.operator.operator import new_kwok_operator
+        from tests.test_e2e_kwok import FakeClock
+
+        from karpenter_tpu.disruption.controller import DisruptionController
+
+        op = new_kwok_operator(clock=FakeClock())
+        assert find_convex(op.provisioner.solver) is None
+        dc = next(c for c in op.manager.controllers
+                  if isinstance(c, DisruptionController))
+        assert dc._convex is None
+
+    def test_unselected_solve_is_inner_result_verbatim(self):
+        # default_backend="ffd": selection never engages; the wrapper must
+        # return the inner solver's result OBJECT, not a reconstruction
+        pods = [mkpod("p0"), mkpod("p1")]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()],
+                          zones=ZONES, capacity_types=("on-demand", "spot"))
+        inner = TPUSolver()
+        cv = ConvexSolver(inner, default_backend="ffd")
+        r_direct = inner.solve(inp)
+        r_wrapped = cv.solve(inp)
+        assert r_wrapped.placements == r_direct.placements
+        assert [c.requests for c in r_wrapped.claims] == [
+            c.requests for c in r_direct.claims]
+        assert cv.convex_stats["convex_solves"] == 0
+
+
+class TestMetricsWiring:
+    def test_convex_counters_move(self):
+        from karpenter_tpu.metrics import registry as reg
+
+        pods = [mkpod(f"p{i}", cpu="1", mem="1Gi") for i in range(8)]
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()],
+                          zones=ZONES, capacity_types=("on-demand", "spot"))
+        before = reg.REGISTRY.expose()
+        cv = ConvexSolver(TPUSolver())
+        res = cv.solve(inp)
+        assert not res.errors
+        after = reg.REGISTRY.expose()
+
+        def val(dump, needle):
+            return sum(
+                float(line.rsplit(" ", 1)[1])
+                for line in dump.splitlines()
+                if line.startswith(needle)
+            )
+
+        assert (val(after, "karpenter_solver_convex_solves_total")
+                > val(before, "karpenter_solver_convex_solves_total"))

@@ -177,6 +177,25 @@ def test_federation_series_are_registered():
         )
 
 
+def test_convex_series_are_registered():
+    """ISSUE 19 acceptance: the convex-backend series are part of the
+    /metrics contract — solve/fallback counters (fallbacks carry the
+    reason label the loud-fallback alert keys on) and the per-solve
+    iteration histogram are what the quality dashboards scrape, so pin
+    their exact names."""
+    registered = {m.name for m in reg.REGISTRY.metrics}
+    for name in (
+        "karpenter_solver_convex_solves_total",
+        "karpenter_solver_convex_fallbacks_total",
+        "karpenter_solver_convex_iterations",
+    ):
+        assert name in registered, f"{name} missing from the registry"
+    by_name = {m.name: m for m in reg.REGISTRY.metrics}
+    assert "reason" in by_name[
+        "karpenter_solver_convex_fallbacks_total"
+    ].label_names, "convex fallbacks lost their reason label"
+
+
 def test_every_reason_code_has_name_and_spec_row():
     """Every kernel reason code must have a decoder-side name AND a SPEC.md
     row — an undocumented code is a wire symbol operators cannot read."""

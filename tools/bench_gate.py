@@ -82,6 +82,15 @@ TOLERANCES: Dict[str, float] = {
     "federated_solves_per_sec_1h": 0.30,
     "scaling_efficiency_4h": 0.15,
     "failover_recovery_ms": 0.35,
+    # solver quality suite (ISSUE 19 convex backend): node counts are
+    # deterministic integers — any increase is a real packing regression,
+    # zero slack. Savings tracks the node counts (ratio of two integers,
+    # small slack for config drift); solve wall-clock is host-noisy.
+    "nodes_provisioned_ffd": 0.0,
+    "nodes_provisioned_convex": 0.0,
+    "consolidation_savings_pct": 0.10,
+    "convex_solve_ms": 0.35,
+    "admm_iterations_to_converge": 0.25,
 }
 
 HIGHER_BETTER_PAT = re.compile(
@@ -95,6 +104,9 @@ HIGHER_BETTER_KEYS = {
     "cohort_size_mean",
     # no "per_sec"/"speedup" token in the name — pin the direction
     "scaling_efficiency_4h",
+    # convex-vs-FFD consolidation win: bigger savings = better packing
+    # ("savings" matches no direction pattern — pin it)
+    "consolidation_savings_pct",
 }
 
 

@@ -1,0 +1,237 @@
+#!/usr/bin/env python3
+"""Explain-record diff between two solver backends on the SAME input.
+
+The explain subsystem (karpenter_tpu/obs/explain.py) derives a canonical
+per-solve record — per-pod chosen placement, per-group rejection table,
+preemptions — whose fingerprint is a stable content hash. This CLI solves
+one scenario with two backends (default: the FFD kernel vs the convex
+ADMM backend), builds both records on the host, and reports where the
+decisions diverge:
+
+    python tools/explain_diff.py --scenario rightsize
+    python tools/explain_diff.py --scenario uniform --json
+
+Output: both fingerprints, a per-pod decision table (chosen column per
+backend, agreement mark), and the first-divergence paths from
+explain.diff_records. Divergence is NOT failure — the convex backend is
+ALLOWED to pick cheaper shapes than FFD (that is its point); the table is
+how a human audits that the disagreement is an improvement, not a
+scattering. The quality suite (bench.py --quality-suite) embeds
+`diff_solves` output so every bench record carries the audit trail.
+
+Exit status: 0 always for successful runs (divergence is data, not an
+error), 2 on usage errors. Needs the repo importable (run from the repo
+root or with PYTHONPATH=.).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# -- self-contained scenario fixtures -----------------------------------------
+
+
+_ZONES = ("zone-1a", "zone-1b")
+
+
+def _mktype(name: str, cpu: int, mem_gib: int, price: float):
+    from karpenter_tpu.api import wellknown as wk
+    from karpenter_tpu.cloudprovider.types import InstanceType, Offering
+    from karpenter_tpu.scheduling.requirements import IN, Requirement, Requirements
+    from karpenter_tpu.utils.resources import Resources
+
+    reqs = Requirements.of(
+        Requirement.create(wk.INSTANCE_TYPE_LABEL, IN, [name]),
+        Requirement.create(wk.ARCH_LABEL, IN, ["amd64"]),
+        Requirement.create(wk.OS_LABEL, IN, ["linux"]),
+        Requirement.create(wk.ZONE_LABEL, IN, list(_ZONES)),
+        Requirement.create(wk.CAPACITY_TYPE_LABEL, IN, ["on-demand"]),
+    )
+    cap = Resources.parse({"cpu": str(cpu), "memory": f"{mem_gib}Gi"})
+    cap["pods"] = 110
+    return InstanceType(
+        name=name, requirements=reqs, capacity=cap, overhead=Resources(),
+        offerings=[Offering(zone=z, capacity_type="on-demand", price=price)
+                   for z in _ZONES],
+    )
+
+
+def _pool(name: str, weight: int, types: list):
+    from karpenter_tpu.api import wellknown as wk
+    from karpenter_tpu.provisioning.scheduler import NodePoolSpec
+    from karpenter_tpu.scheduling.requirements import IN, Requirement, Requirements
+    from karpenter_tpu.utils.resources import Resources
+
+    r = Requirements.of(Requirement.create(wk.NODEPOOL_LABEL, IN, [name]))
+    return NodePoolSpec(name=name, weight=weight, requirements=r, taints=[],
+                        instance_types=types, limits=Resources())
+
+
+def _mkpod(name: str, cpu: str, mem: str):
+    from karpenter_tpu.api.objects import ObjectMeta, Pod
+    from karpenter_tpu.utils.resources import Resources
+
+    return Pod(meta=ObjectMeta(name=name, uid=name),
+               requests=Resources.parse({"cpu": cpu, "memory": mem}))
+
+
+def _mknode(name: str, cpu: str, mem: str, zone: str = "zone-1a"):
+    from karpenter_tpu.api import wellknown as wk
+    from karpenter_tpu.provisioning.scheduler import ExistingNode
+    from karpenter_tpu.utils.resources import Resources
+
+    lab = {wk.ZONE_LABEL: zone, wk.HOSTNAME_LABEL: name,
+           wk.CAPACITY_TYPE_LABEL: "on-demand", wk.ARCH_LABEL: "amd64",
+           wk.OS_LABEL: "linux"}
+    free = Resources.parse({"cpu": cpu, "memory": mem})
+    free["pods"] = 110
+    return ExistingNode(id=name, labels=lab, taints=[], free=free)
+
+
+def build_scenario(name: str):
+    """Three canned shapes spanning the interesting decision space:
+
+    uniform   one pool, one 4-cpu shape, 12 x 1cpu pods — a known optimum
+              both backends must hit (3 claims), so the records should be
+              equivalent modulo claim numbering.
+    rightsize two pools in weight-vs-price contention: FFD follows pool
+              weight onto 4-cpu $1.00 nodes, the convex objective follows
+              price onto 16-cpu $0.90 nodes — maximal legitimate
+              divergence, the quality suite's savings config.
+    split     two half-full existing 8-cpu nodes plus 8 x 3cpu pods: both
+              backends must fill the sunk existing capacity first.
+    """
+    from karpenter_tpu.provisioning.scheduler import SolverInput
+
+    if name == "uniform":
+        pods = [_mkpod(f"u{i:02d}", "1", "1Gi") for i in range(12)]
+        pools = [_pool("general", 0, [_mktype("std.xlarge", 4, 16, 1.0)])]
+        return SolverInput(pods=pods, nodes=[], nodepools=pools,
+                           zones=_ZONES, capacity_types=("on-demand",))
+    if name == "rightsize":
+        pods = [_mkpod(f"w{i:03d}", "1", "1Gi") for i in range(96)]
+        pools = [
+            _pool("boutique", 100, [_mktype("boutique.xlarge", 4, 16, 1.0)]),
+            _pool("warehouse", 0, [_mktype("warehouse.4xlarge", 16, 64, 0.9)]),
+        ]
+        return SolverInput(pods=pods, nodes=[], nodepools=pools,
+                           zones=_ZONES, capacity_types=("on-demand",))
+    if name == "split":
+        pods = [_mkpod(f"q{i:02d}", "3", "4Gi") for i in range(8)]
+        nodes = [_mknode("n1", "8", "32Gi"),
+                 _mknode("n2", "8", "32Gi", zone="zone-1b")]
+        pools = [_pool("general", 0, [_mktype("std.4xlarge", 16, 64, 0.9)])]
+        return SolverInput(pods=pods, nodes=nodes, nodepools=pools,
+                           zones=_ZONES, capacity_types=("on-demand",))
+    raise ValueError(f"unknown scenario {name!r}")
+
+
+# -- the diff core (imported by bench.py --quality-suite) ----------------------
+
+
+def diff_solves(inp, solver_a, solver_b, label_a: str = "ffd",
+                label_b: str = "convex") -> dict:
+    """Solve `inp` with both backends, build the canonical explain record
+    for each on the host, and return the structured diff: fingerprints,
+    per-pod decision table, agreement count, and first-divergence paths.
+    Claim columns compare by (kind, index-within-backend) — claim numbering
+    is solver-order deterministic per backend, not comparable across
+    backends, so the table shows both and `agree` means literal equality.
+    """
+    from karpenter_tpu.obs import explain as obsexplain
+    from karpenter_tpu.solver.encode import encode, quantize_input
+
+    enc = encode(quantize_input(inp))
+    res_a = solver_a.solve(inp)
+    res_b = solver_b.solve(inp)
+    rec_a = obsexplain.build_record(enc, res_a)
+    rec_b = obsexplain.build_record(enc, res_b)
+    table: List[dict] = []
+    agree = 0
+    for uid in sorted(rec_a["pods"]):
+        ca = rec_a["pods"][uid]["chosen"]
+        cb = rec_b["pods"].get(uid, {}).get("chosen")
+        same = ca == cb
+        agree += int(same)
+        table.append({"pod": uid, label_a: ca, label_b: cb, "agree": same})
+    return {
+        "scenario_pods": len(table),
+        "pods_agree": agree,
+        "fingerprint_" + label_a: obsexplain.fingerprint(rec_a),
+        "fingerprint_" + label_b: obsexplain.fingerprint(rec_b),
+        "identical": obsexplain.fingerprint(rec_a) == obsexplain.fingerprint(rec_b),
+        "claims_" + label_a: len(res_a.claims),
+        "claims_" + label_b: len(res_b.claims),
+        "errors_" + label_a: len(res_a.errors),
+        "errors_" + label_b: len(res_b.errors),
+        "divergences": obsexplain.diff_records(rec_a, rec_b),
+        "table": table,
+    }
+
+
+def _fmt_chosen(c) -> str:
+    if c is None:
+        return "UNPLACED"
+    kind, ref = c
+    return f"{kind}:{ref}"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="explain_diff",
+        description="diff per-pod explain records between two backends")
+    ap.add_argument("--scenario", default="rightsize",
+                    choices=("uniform", "rightsize", "split"))
+    ap.add_argument("--backend-a", default="ffd", choices=("ffd", "reference"),
+                    help="baseline backend (default: ffd kernel)")
+    ap.add_argument("--convex-max-iters", type=int, default=400)
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full structured diff as one JSON object")
+    args = ap.parse_args(argv)
+    if args.convex_max_iters < 1:
+        print("explain_diff: --convex-max-iters must be >= 1", file=sys.stderr)
+        return 2
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from karpenter_tpu.solver.backend import ReferenceSolver, TPUSolver
+    from karpenter_tpu.solver.convex import ConvexSolver
+
+    inp = build_scenario(args.scenario)
+    solver_a = TPUSolver() if args.backend_a == "ffd" else ReferenceSolver()
+    solver_b = ConvexSolver(TPUSolver(), max_iters=args.convex_max_iters)
+    out = diff_solves(inp, solver_a, solver_b, label_a=args.backend_a)
+    out["scenario"] = args.scenario
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+
+    print(f"explain_diff: scenario={args.scenario} "
+          f"{args.backend_a} vs convex")
+    print(f"  fingerprints: {out['fingerprint_' + args.backend_a][:16]} vs "
+          f"{out['fingerprint_convex'][:16]}"
+          + ("  (identical)" if out["identical"] else ""))
+    print(f"  claims: {out['claims_' + args.backend_a]} vs "
+          f"{out['claims_convex']}   pods agreeing: "
+          f"{out['pods_agree']}/{out['scenario_pods']}")
+    width = max((len(r["pod"]) for r in out["table"]), default=3)
+    for r in out["table"]:
+        mark = " " if r["agree"] else "*"
+        print(f"  {mark} {r['pod']:<{width}}  "
+              f"{_fmt_chosen(r[args.backend_a]):<16} "
+              f"{_fmt_chosen(r['convex'])}")
+    if out["divergences"]:
+        print("  first-divergence paths:")
+        for d in out["divergences"][:12]:
+            print(f"    {d}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
