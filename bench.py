@@ -2632,6 +2632,240 @@ def bench_quality_suite() -> None:
     }))
 
 
+# -------------------------------------------------------- constraint suite
+
+
+def build_constraint_wide_input(num_pods: int = 4_800,
+                                pods_per_app: int = 40):
+    """Wide-constraint-axis fleet for the sparse engine measurements: one
+    zone-spread sig per `pods_per_app` pods, so V scales with the fleet
+    (~120 sigs at the default) while each run touches exactly one — the
+    low-density/wide-axis regime the density gate selects sparse for."""
+    from karpenter_tpu.api import wellknown as wk
+    from karpenter_tpu.api.objects import TopologySpreadConstraint
+
+    inp = build_input(num_pods)
+    for i, p in enumerate(inp.pods):
+        app = f"wide-{i // pods_per_app}"
+        p.meta.labels["app"] = app
+        p.topology_spread = [
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=wk.ZONE_LABEL,
+                label_selector={"app": app},
+            )
+        ]
+        p.node_selector = {}
+    return inp
+
+
+def _axis_eval_speedup(enc, Sp, rvi, max_m: int = 512) -> float:
+    """Dense-vs-sparse p50 of the per-step constraint-axis READ the engine
+    compacts: the allowance evaluation gathers the claim-flag table's
+    active columns ([M, K]) where the dense kernel scans full width
+    ([M, V]), batched over the fleet's real runs (real membership, real
+    index tables). This isolates the compacted computation — the whole-
+    scan wall clock is dominated by per-step fixed overhead on the host
+    backend, which would hide the axis term the engine removes."""
+    import jax
+    import jax.numpy as jnp
+
+    V = int(enc.V)
+    M = min(int(max_m), 512)
+    BIG = 1 << 20
+    rg = np.asarray(enc.run_group, np.int64)
+    act = np.asarray(enc.v_member, bool) | np.asarray(enc.v_owner, bool)
+    member = np.zeros((Sp, V), bool)
+    member[: rg.shape[0]] = act[rg]
+
+    @jax.jit
+    def dense(c_vm, member_j):
+        def one(m):
+            return jnp.min(jnp.where(m[None, :], 8 - c_vm, BIG), axis=1)
+        return jax.vmap(one)(member_j).sum()
+
+    @jax.jit
+    def sparse(c_vm, idx_j):
+        def one(row):
+            valid = row >= 0
+            cols = jnp.take(c_vm, jnp.where(valid, row, 0), axis=1)
+            return jnp.min(
+                jnp.where(valid[None, :], 8 - cols, BIG), axis=1)
+        return jax.vmap(one)(idx_j).sum()
+
+    c = jnp.zeros((M, V), jnp.int32)
+    mj, ij = jnp.asarray(member), jnp.asarray(rvi)
+    jax.block_until_ready(dense(c, mj))
+    jax.block_until_ready(sparse(c, ij))
+
+    def p50(fn, arg, iters=7):
+        ts = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(c, arg))
+            ts.append((time.perf_counter() - t0) * 1000)
+        return float(np.percentile(np.asarray(ts), 50))
+
+    td, tsp = p50(dense, mj), p50(sparse, ij)
+    print(f"[bench] axis eval ({Sp} runs, M={M}, V={V}, K={rvi.shape[1]}): "
+          f"dense={td:.2f}ms sparse={tsp:.3f}ms -> {td / tsp:.1f}x",
+          file=sys.stderr)
+    return td / tsp if tsp else 0.0
+
+
+def _constraint_run() -> dict:
+    """Sparse constraint engine suite (ISSUE 20): constrained e2e p50 on
+    the two BASELINE constrained configs, the constraint-density/
+    compaction measurements on the wide-axis fleet, and the mesh-sharded
+    constrained parity proof (the lifted V/Q declines)."""
+    import jax
+
+    from karpenter_tpu.metrics.registry import SOLVER_SHARDED_FALLBACK
+    from karpenter_tpu.solver.backend import (
+        TPUSolver,
+        host_kernel_args,
+        initial_claim_bucket,
+    )
+    from karpenter_tpu.solver.encode import (
+        constraint_density,
+        encode,
+        quantize_input,
+        sparse_run_tables,
+        use_sparse_constraints,
+    )
+
+    virtual = jax.devices()[0].platform == "cpu"
+    num_pods = int(os.environ.get("KTPU_BENCH_CONSTRAINT_PODS", "0")) or (
+        6_000 if virtual else 50_000
+    )
+
+    # -- constrained e2e p50: both BASELINE constrained configs, against
+    # the same-size unconstrained fleet (the ISSUE 20 targets are ratios)
+    base_p50 = _bench_config(
+        f"constraint base ({num_pods} pods)", build_input(num_pods), iters=3)
+    c3_p50 = _bench_config(
+        f"config3 zone-TSC ({num_pods} pods)",
+        build_config3_input(num_pods), iters=3)
+    c4_p50 = _bench_config(
+        f"config4 affinity ({num_pods} pods)",
+        build_config4_input(num_pods), iters=3)
+
+    # -- density + compaction on the wide-axis fleet -----------------------
+    wide = build_constraint_wide_input(min(num_pods, 4_800))
+    enc = encode(quantize_input(wide))
+    density = constraint_density(enc)
+    assert use_sparse_constraints(enc), (
+        f"wide fleet must gate sparse: V={enc.V} Q={enc.Q} "
+        f"density={density:.4f}"
+    )
+    args, _, _ = host_kernel_args(enc, TPUSolver._bucket)
+    Sp = int(args[0].shape[0])
+    _, rvi = sparse_run_tables(enc, Sp)
+    total_pods = int(sum(len(p) for p in enc.group_pods))
+    speedup = _axis_eval_speedup(
+        enc, Sp, rvi, initial_claim_bucket(total_pods, 8192))
+
+    # -- mesh-sharded constrained parity (the lifted decline) --------------
+    sp = TPUSolver(max_claims=8192)
+    s8 = TPUSolver(max_claims=8192, shards=8)
+    ref, got = sp.solve(wide), s8.solve(wide)
+    sharded_ok = (
+        got.placements == ref.placements
+        and s8.stats["sharded_solves"] >= 1
+        and s8.stats["sharded_fallbacks"] == 0
+    )
+    for reason in ("v_axis", "q_axis"):
+        assert SOLVER_SHARDED_FALLBACK.value(reason=reason) == 0, (
+            f"reserved sharded-fallback reason {reason!r} fired"
+        )
+    print(f"[bench] sharded constrained: parity={got.placements == ref.placements} "
+          f"sharded_solves={s8.stats['sharded_solves']} "
+          f"fallbacks={s8.stats['sharded_fallbacks']} "
+          f"fixup_runs={s8.stats['shard_fixup_runs']}", file=sys.stderr)
+
+    return {
+        "constrained_solve_p50_ms_config3": round(c3_p50, 2),
+        "constrained_solve_p50_ms_config4": round(c4_p50, 2),
+        "constrained_vs_base_ratio_config3": round(c3_p50 / base_p50, 3)
+        if base_p50 else 0.0,
+        "constrained_vs_base_ratio_config4": round(c4_p50 / base_p50, 3)
+        if base_p50 else 0.0,
+        "constraint_density": round(density, 4),
+        "sparse_speedup_x": round(speedup, 2),
+        "sharded_constrained_ok": int(sharded_ok),
+        "constraint_pods": num_pods,
+    }
+
+
+def bench_constraint_suite() -> None:
+    """CLI entry (--constraint-suite): run the sparse-constraint suite
+    standalone (parent picks the mesh env) and print ONE JSON line tagged
+    constraint_suite."""
+    import jax
+
+    out = _constraint_run()
+    # acceptance (ISSUE 20): the compacted axis evaluation must beat dense
+    # on the host backend; sharded constrained fleets must be served, not
+    # declined
+    if jax.devices()[0].platform == "cpu":
+        assert out["sparse_speedup_x"] >= 1.5, out
+    assert out["sharded_constrained_ok"] == 1, out
+    print(json.dumps({
+        "metric": "sparse_speedup_x",
+        "value": out["sparse_speedup_x"],
+        "unit": "x",
+        "constraint_suite": True,
+        **out,
+    }))
+
+
+def _constraint_metrics(timeout_s: float = None) -> dict:
+    """Parent half of the constraint suite: like _sharded_metrics, the
+    child must own its jax process so the 8-way virtual mesh can exist on
+    a host-only round — the sharded-constrained parity leg needs it."""
+    timeout_s = timeout_s or float(
+        os.environ.get("KTPU_BENCH_CONSTRAINT_TIMEOUT_S", "900"))
+    try:
+        env = dict(os.environ)
+        n_dev = probe_mesh_devices()
+        if n_dev < 2:
+            env["JAX_PLATFORMS"] = "cpu"
+            flags = env.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                env["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
+            print(f"[bench] constraint suite: {n_dev} device(s) visible -> "
+                  "host-side virtual 8-way mesh", file=sys.stderr)
+        rc, out, err = _run_probe(
+            [sys.executable, os.path.abspath(__file__), "--constraint-suite"],
+            timeout_s, env=env,
+        )
+        for line in err.strip().splitlines()[-10:]:
+            print(line, file=sys.stderr)
+        if rc is None:
+            print("[bench] constraint suite timed out; process group killed",
+                  file=sys.stderr)
+            return {}
+        for line in reversed(out.strip().splitlines()):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.pop("constraint_suite", False):
+                rec.pop("metric", None)
+                rec.pop("value", None)
+                rec.pop("unit", None)
+                return rec
+        print(f"[bench] constraint suite emitted no record (rc={rc})",
+              file=sys.stderr)
+        return {}
+    except Exception as e:  # noqa: BLE001 — the marker line must still emit
+        print(f"[bench] constraint metrics failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return {}
+
+
 def bench_encode_only(num_pods: int = 50_000) -> None:
     """CPU micro-bench of the HOST encode path alone (no device, no jax
     backend init): fresh full encode vs exact-key hit vs steady-state
@@ -2764,6 +2998,9 @@ def _dispatch() -> None:
     if "--quality-suite" in sys.argv[1:]:
         bench_quality_suite()
         return
+    if "--constraint-suite" in sys.argv[1:]:
+        bench_constraint_suite()
+        return
     # JAX_PLATFORMS pinned to host-only platforms means no accelerator can
     # EVER appear — the 4-attempt probe/backoff loop (~13 min) would be pure
     # waste. Fail fast with a reason distinct from a tunnel outage.
@@ -2780,7 +3017,7 @@ def _dispatch() -> None:
                    **_tenant_metrics(), **_explain_metrics(),
                    **_streaming_metrics(), **_telemetry_metrics(),
                    **_restore_metrics(), **_federation_metrics(),
-                   **_quality_metrics()},
+                   **_quality_metrics(), **_constraint_metrics()},
         )
         return
     plat = wait_for_backend()
@@ -2802,7 +3039,7 @@ def _dispatch() -> None:
                    **_tenant_metrics(), **_explain_metrics(),
                    **_streaming_metrics(), **_telemetry_metrics(),
                    **_restore_metrics(), **_federation_metrics(),
-                   **_quality_metrics()},
+                   **_quality_metrics(), **_constraint_metrics()},
         )
         return
     if plat.startswith("cpu"):
@@ -2818,7 +3055,7 @@ def _dispatch() -> None:
                    **_tenant_metrics(), **_explain_metrics(),
                    **_streaming_metrics(), **_telemetry_metrics(),
                    **_restore_metrics(), **_federation_metrics(),
-                   **_quality_metrics()},
+                   **_quality_metrics(), **_constraint_metrics()},
         )
         return
 
@@ -3109,6 +3346,10 @@ def _run(plat: str) -> None:
     # budget — convex may NEVER provision more nodes than FFD
     quality_keys = _quality_metrics()
 
+    # ---- sparse constraint engine (ISSUE 20): constrained-config p50s,
+    # axis compaction speedup, and the sharded-constrained parity proof
+    constraint_keys = _constraint_metrics()
+
     record = (
             {
                 "metric": "solve_p99_50k_pods_x_700_types",
@@ -3191,6 +3432,11 @@ def _run(plat: str) -> None:
                 # solver quality (ISSUE 19): convex-vs-FFD packing quality,
                 # savings direction pinned higher-is-better in bench_gate
                 **quality_keys,
+                # sparse constraint engine (ISSUE 20): constrained e2e
+                # p50s + ratios vs the unconstrained base, axis-eval
+                # compaction speedup (higher-is-better, pinned in
+                # bench_gate), sharded-constrained parity — MUST be 1
+                **constraint_keys,
                 "decode_bytes_per_solve": round(
                     e2e_solver.ledger.decode_bytes_per_solve, 1
                 ),

@@ -391,9 +391,13 @@ SOLVER_SHARD_FIXUP_RUNS = REGISTRY.register(
 SOLVER_SHARDED_FALLBACK = REGISTRY.register(
     Counter(
         "karpenter_solver_sharded_fallback_total",
-        "Sharded-solve requests that fell back to the single-device scan "
-        "(inexpressible carry combine: active domain event engine, block "
-        "misalignment, or claim-slot overflow during the stitch)",
+        "Sharded-solve requests that fell back to the single-device scan, "
+        "by decline reason: v_axis/q_axis (constraint axes a mesh path "
+        "cannot express — none remain since the sparse constraint engine "
+        "lifted the V/Q restriction), tiny_fleet (run axis narrower than "
+        "the mesh or block-misaligned), no_mesh (no usable multi-device "
+        "mesh behind a sharded request)",
+        label_names=("reason",),
     )
 )
 CONTROLLER_ERRORS = REGISTRY.register(

@@ -84,7 +84,7 @@ def _handle_ffd_blocks(job, ctx_cache: dict, jit_cache: dict):
     import jax
     import numpy as np
 
-    from ..solver.tpu.ffd import ffd_solve
+    from ..solver.tpu.ffd import ffd_solve, ffd_solve_sparse
 
     ctx = job.get("ctx")
     rest = job.get("rest")
@@ -95,18 +95,34 @@ def _handle_ffd_blocks(job, ctx_cache: dict, jit_cache: dict):
     rg = np.asarray(job["rg"])
     rc = np.asarray(job["rc"])
     max_claims = int(job["max_claims"])
+    zone = bool(job.get("zone_engine", False))
+    sq = job.get("sq")
+    sv = job.get("sv")
+    sparse_shapes = None
+    if sq is not None:
+        sq, sv = np.asarray(sq), np.asarray(sv)
+        sparse_shapes = (sq.shape, sv.shape)
     key = (
-        ctx, max_claims, rg.shape,
+        ctx, max_claims, rg.shape, zone, sparse_shapes,
         tuple((a.shape, str(a.dtype)) for a in rest),
     )
     fn = jit_cache.get(key)
     if fn is None:
-        lane = functools.partial(
-            ffd_solve.__wrapped__, max_claims=max_claims, zone_engine=False
-        )
-        fn = jax.jit(jax.vmap(lambda g, c: lane(g, c, *rest)))
+        if sq is not None:
+            lane = functools.partial(
+                ffd_solve_sparse.__wrapped__,
+                max_claims=max_claims, zone_engine=zone,
+            )
+            fn = jax.jit(jax.vmap(
+                lambda q, v, g, c: lane(q, v, g, c, *rest)))
+        else:
+            lane = functools.partial(
+                ffd_solve.__wrapped__,
+                max_claims=max_claims, zone_engine=zone,
+            )
+            fn = jax.jit(jax.vmap(lambda g, c: lane(g, c, *rest)))
         jit_cache[key] = fn
-    out = fn(rg, rc)
+    out = fn(sq, sv, rg, rc) if sq is not None else fn(rg, rc)
     return jax.tree_util.tree_map(np.asarray, out)
 
 
@@ -273,7 +289,8 @@ class HostMeshPool:
         return [w.call({"kind": "ping"}) for w in self.workers]
 
     def scatter_blocks(self, rgb, rcb, rest: tuple, max_claims: int,
-                       ctx: Optional[str] = None):
+                       ctx: Optional[str] = None, zone_engine: bool = False,
+                       sqb=None, svb=None):
         import numpy as np
 
         rgb = np.asarray(rgb)
@@ -299,6 +316,11 @@ class HostMeshPool:
                     "rest": send_rest,
                     "ctx": ctx,
                     "max_claims": int(max_claims),
+                    "zone_engine": bool(zone_engine),
+                    "sq": None if sqb is None
+                    else sqb[i * per:(i + 1) * per],
+                    "sv": None if svb is None
+                    else svb[i * per:(i + 1) * per],
                 })
                 if ctx is not None:
                     w._ctx_seen.add(ctx)

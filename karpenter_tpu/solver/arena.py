@@ -332,6 +332,14 @@ class ArgumentArena:
         # same preference fleet re-solving reuses the rung table with zero
         # upload. Dies with the bucket on invalidate(), like checkpoints.
         self._ladders: Dict[tuple, Tuple[bytes, object]] = {}
+        # sparse-constraint residency class (backend._sparse_arg): per-
+        # bucket device-resident run_q_idx/run_v_idx index-table pairs
+        # (SPEC.md "Sparse constraint semantics"), keyed on content digest
+        # — the staleness anchor is the encode core rev folded into the
+        # digest by the caller, so a re-encoded fleet whose constraint
+        # layout is unchanged reuses the tables with zero upload. Dies with
+        # the bucket on invalidate()/eviction, like ladders.
+        self._sparse: Dict[tuple, Tuple[bytes, object]] = {}
         # mesh-sharded residency class (backend._plan_shard_resume): one
         # record per sharded bucket holding the solve's block-boundary
         # carries (host numpy — the PER-DEVICE checkpoints of the sharded
@@ -365,6 +373,7 @@ class ArgumentArena:
         self._buckets.clear()
         self._ckpts.clear()
         self._ladders.clear()
+        self._sparse.clear()
         self._shards.clear()
         self._run_host.clear()
         self._bytes.clear()
@@ -416,6 +425,8 @@ class ArgumentArena:
         self._run_host.pop(key, None)
         for lk in [lk for lk in self._ladders if lk[0] == key]:
             self._ladders.pop(lk, None)
+        for sk in [sk for sk in self._sparse if sk[0] == key]:
+            self._sparse.pop(sk, None)
         self._bytes.pop(key, None)
         self.stats["evictions"] += 1
         SOLVER_ARENA_EVICTIONS.inc()
@@ -494,6 +505,41 @@ class ArgumentArena:
         None (the caller uploads and re-records)."""
         rec = self._ladders.get((key, host_table.shape))
         if rec is None or rec[0] != _digest(host_table):
+            return None
+        return rec[1]
+
+    @staticmethod
+    def _sparse_token(core_rev: int, run_q_idx: np.ndarray,
+                      run_v_idx: np.ndarray) -> bytes:
+        """Staleness token of a sparse index-table pair: the encode core
+        rev (any core rebuild — new signatures, new constraint interning —
+        mints a fresh rev) plus the content digests. A delta re-encode
+        that kept the constraint layout produces the same token and the
+        resident pair delta-uploads nothing."""
+        return (str(int(core_rev)).encode()
+                + _digest(run_q_idx) + _digest(run_v_idx))
+
+    def put_sparse(self, key: tuple, core_rev: int, run_q_idx: np.ndarray,
+                   run_v_idx: np.ndarray, dev_pair) -> None:
+        """Record a bucket's device-resident sparse constraint index pair
+        (one per bucket + shape — a bucket's fleet has one current
+        constraint layout)."""
+        shp = (run_q_idx.shape, run_v_idx.shape)
+        self._sparse[(key, shp)] = (
+            self._sparse_token(core_rev, run_q_idx, run_v_idx), dev_pair)
+        self._account(key, "sparse", sum(
+            _nbytes(d) for sk, v in self._sparse.items() if sk[0] == key
+            for d in v[1]))
+        self._enforce_budget(key)
+
+    def get_sparse(self, key: tuple, core_rev: int, run_q_idx: np.ndarray,
+                   run_v_idx: np.ndarray):
+        """The bucket's resident sparse index pair if its token matches,
+        else None (the caller uploads and re-records)."""
+        rec = self._sparse.get(
+            (key, (run_q_idx.shape, run_v_idx.shape)))
+        if rec is None or rec[0] != self._sparse_token(
+                core_rev, run_q_idx, run_v_idx):
             return None
         return rec[1]
 

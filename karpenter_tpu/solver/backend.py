@@ -742,8 +742,18 @@ class TPUSolver(Solver):
                  arena: bool = True, resume: bool = True,
                  ckpt_every: int = 16, ckpt_slots: int = 4,
                  device_decode: bool = True, relax_ladder: bool = True,
-                 shards: int = 0, arena_budget_mb: int = 0):
+                 shards: int = 0, arena_budget_mb: int = 0,
+                 sparse: str = "auto"):
         self.max_claims = max_claims
+        # sparse constraint engine (SPEC.md "Sparse constraint semantics"):
+        # "auto" compacts the V/Q axes into run-major index tables when the
+        # fleet's constraint density clears encode.use_sparse_constraints;
+        # "on" forces compaction for any constrained fleet, "off" keeps the
+        # dense tables (debug escape hatch / parity oracle). Decisions are
+        # bit-identical either way — the knob only changes evaluation cost.
+        if sparse not in ("auto", "on", "off"):
+            raise ValueError(f"sparse must be auto/on/off, got {sparse!r}")
+        self.sparse = sparse
         if fallback is None:
             # fallback chain: native C++ core (compiled-class speed), which
             # itself degrades to the python oracle for constructs neither
@@ -762,6 +772,7 @@ class TPUSolver(Solver):
             "shard_resume_runs_skipped": 0,
             "event_stage_hits": 0, "event_stage_misses": 0,
             "fused_dispatches": 0, "fused_members": 0,
+            "sparse_dispatches": 0,
         }
         # cohort dispatch mesh (solve_cohort_async): lazy like _shard_mesh,
         # but over ALL visible devices — the fused batch axis buckets to a
@@ -1498,12 +1509,21 @@ class TPUSolver(Solver):
             lad_host[:S_orig] = ladder_rows
             dev_lad = self._ladder_arg(host_args, lad_host,
                                        ns=enc2.tenant_id)
+            sparse_dev = None
+            if self._sparse_gate(enc2):
+                from .encode import sparse_run_tables
+
+                sq, sv = sparse_run_tables(
+                    enc2, Sp, run_ladder=lad_host[:S_orig])
+                sparse_dev = self._sparse_arg(host_args, enc2, sq, sv,
+                                              ns=enc2.tenant_id)
         M0 = initial_claim_bucket(n_orig, self.max_claims)
         obstrace.annotate(ladder=True, ladder_rungs=int(Lmax),
                           claim_bucket=M0)
         with obstrace.span("backend.dispatch"):
             flat_dev, unpack, _ = self._ladder_kernel(enc2, dev_lad, args, M0,
-                                                      n_orig)
+                                                      n_orig,
+                                                      sparse=sparse_dev)
         return {
             "enc": enc2,
             "args": args,
@@ -1514,6 +1534,7 @@ class TPUSolver(Solver):
             "M0": M0,
             "n_orig": n_orig,
             "rungs": int(Lmax),
+            "sparse": sparse_dev,
         }
 
     def _ladder_arg(self, host_args, lad_host: np.ndarray, ns=None):
@@ -1538,12 +1559,18 @@ class TPUSolver(Solver):
         return dev
 
     def _ladder_kernel(self, enc: EncodedInput, dev_lad, args, M: int,
-                       n_orig: int):
-        from .tpu.ffd import ffd_solve_ladder
+                       n_orig: int, sparse=None):
+        from .tpu.ffd import ffd_solve_ladder, ffd_solve_ladder_sparse
 
         faults.check("solver.device_dispatch")
-        out = ffd_solve_ladder(dev_lad, *args, max_claims=M,
-                               zone_engine=enc.V > 0)
+        if sparse is not None:
+            self.stats["sparse_dispatches"] += 1
+            out = ffd_solve_ladder_sparse(
+                dev_lad, sparse[0], sparse[1], *args,
+                max_claims=M, zone_engine=enc.V > 0)
+        else:
+            out = ffd_solve_ladder(dev_lad, *args, max_claims=M,
+                                   zone_engine=enc.V > 0)
         flat_dev, unpack = self._pack_dispatch(out, total_pods=n_orig)
         return flat_dev, unpack, out
 
@@ -1571,7 +1598,8 @@ class TPUSolver(Solver):
                     break
                 M = min(M * 2, self.max_claims)
                 fd, up, _ = self._ladder_kernel(
-                    enc, lad["dev_lad"], lad["args"], M, lad["n_orig"]
+                    enc, lad["dev_lad"], lad["args"], M, lad["n_orig"],
+                    sparse=lad.get("sparse"),
                 )
                 flat = np.asarray(fd)
                 self.ledger.record_fetch(flat.nbytes)
@@ -1831,8 +1859,11 @@ class TPUSolver(Solver):
             # mesh-sharded entry point: lower once per mesh (keyed on the
             # device set — a resized slice must relower) with sharding-
             # carrying ShapeDtypeStructs so the AOT executable bakes in the
-            # same GSPMD partitioning production dispatches request. Only
-            # zone_engine=False exists sharded (V>0 fleets decline).
+            # same GSPMD partitioning production dispatches request.
+            # zone_engine=True lanes (V>0 fleets shard since the sparse
+            # constraint engine lifted the V/Q decline) compile on first
+            # dispatch — the zoned sharded bucket is rare enough that
+            # prewarming it would double this loop for cold rigs.
             token = tuple(int(d.id) for d in mesh.devices.flat)
             Nd = int(mesh.devices.size)
             Sp = specs[0].shape[0]
@@ -1875,22 +1906,81 @@ class TPUSolver(Solver):
         avoids recompilation storms)."""
         return max(floor, ((n + mult - 1) // mult) * mult)
 
+    def _sparse_gate(self, enc: EncodedInput) -> bool:
+        """Whether this solve evaluates constraints through the compacted
+        V/Q index tables (SPEC.md "Sparse constraint semantics")."""
+        if self.sparse == "off":
+            return False
+        from .encode import use_sparse_constraints
+
+        if self.sparse == "on":
+            return (enc.Q + enc.V) > 0
+        return use_sparse_constraints(enc)
+
+    def _sparse_arg(self, host_args, enc: EncodedInput,
+                    run_q_idx: np.ndarray, run_v_idx: np.ndarray,
+                    sharding=None, dev_sharding=None, ns=None):
+        """Upload (or reuse) the sparse constraint index pair. Like
+        run_ladder tables, the pair is a per-bucket arena side-residency
+        class (solver/arena.py _sparse) keyed by the arg bucket + a
+        staleness token of (encode core rev, content digests) — the core
+        rev is the delta-upload anchor: a patch-hit re-encode keeps the
+        rev, so try_patch solves ship zero sparse-table bytes."""
+        import jax
+
+        if self.arena is not None:
+            key = self.arena.bucket_key(host_args, sharding, ns=ns)
+            dev = self.arena.get_sparse(key, enc.core_rev, run_q_idx,
+                                        run_v_idx)
+            if dev is not None:
+                return dev
+            dev = (jax.device_put(run_q_idx, dev_sharding),
+                   jax.device_put(run_v_idx, dev_sharding))
+            self.ledger.record_upload(
+                run_q_idx.nbytes + run_v_idx.nbytes, 2, msgs=2)
+            self.arena.put_sparse(key, enc.core_rev, run_q_idx, run_v_idx,
+                                  dev)
+            return dev
+        dev = (jax.device_put(run_q_idx, dev_sharding),
+               jax.device_put(run_v_idx, dev_sharding))
+        self.ledger.record_upload(
+            run_q_idx.nbytes + run_v_idx.nbytes, 2, msgs=2)
+        return dev
+
     def _dispatch(self, enc: EncodedInput, args, M: int, harvest: bool = False,
-                  total_pods: Optional[int] = None):
+                  total_pods: Optional[int] = None, sparse=None):
         """Dispatch kernel + output packing; start the device→host copy.
         Returns (flat_device_array, unpack_fn, out, ring). `harvest` (and
         the resume knob) selects ffd_solve_ckpt so the solve also produces
         a device-resident checkpoint ring for later suffix resumes — the
-        ring never crosses the tunnel."""
-        from .tpu.ffd import ffd_solve, ffd_solve_ckpt
+        ring never crosses the tunnel. `sparse` is the device-resident
+        (run_q_idx, run_v_idx) pair, or None for dense V/Q evaluation."""
+        from .tpu.ffd import (
+            ffd_solve,
+            ffd_solve_ckpt,
+            ffd_solve_ckpt_sparse,
+            ffd_solve_sparse,
+        )
 
         faults.check("solver.device_dispatch")
         ring = None
+        if sparse is not None:
+            self.stats["sparse_dispatches"] += 1
         if harvest and self.resume:
-            out, ring = ffd_solve_ckpt(
-                *args, max_claims=M, zone_engine=enc.V > 0,
-                ckpt_every=self.ckpt_every, n_ckpt=self.ckpt_slots,
-            )
+            if sparse is not None:
+                out, ring = ffd_solve_ckpt_sparse(
+                    sparse[0], sparse[1], *args,
+                    max_claims=M, zone_engine=enc.V > 0,
+                    ckpt_every=self.ckpt_every, n_ckpt=self.ckpt_slots,
+                )
+            else:
+                out, ring = ffd_solve_ckpt(
+                    *args, max_claims=M, zone_engine=enc.V > 0,
+                    ckpt_every=self.ckpt_every, n_ckpt=self.ckpt_slots,
+                )
+        elif sparse is not None:
+            out = ffd_solve_sparse(sparse[0], sparse[1], *args,
+                                   max_claims=M, zone_engine=enc.V > 0)
         else:
             out = ffd_solve(*args, max_claims=M, zone_engine=enc.V > 0)
         flat_dev, unpack = self._pack_dispatch(out, total_pods=total_pods)
@@ -2146,6 +2236,15 @@ class TPUSolver(Solver):
                 args = self.arena.adopt(host_args, prov, ns=enc.tenant_id)
             else:
                 args = _device_args(host_args, prov, ledger=self.ledger)
+            sparse_host = None
+            sparse_dev = None
+            if self._sparse_gate(enc):
+                from .encode import sparse_run_tables
+
+                sparse_host = sparse_run_tables(
+                    enc, int(host_args[0].shape[0]))
+                sparse_dev = self._sparse_arg(
+                    host_args, enc, *sparse_host, ns=enc.tenant_id)
         S, E, T, G = dims["S"], dims["E"], dims["T"], dims["G"]
         Z, C = dims["Z"], dims["C"]
         total_pods = int(sum(len(p) for p in enc.group_pods))
@@ -2163,11 +2262,13 @@ class TPUSolver(Solver):
         with obstrace.span("backend.dispatch"):
             if plan is not None:
                 flat_dev, unpack, out, ring = self._dispatch_resume(
-                    enc, args, host_args, plan, M0, S, total_pods=total_pods
+                    enc, args, host_args, plan, M0, S,
+                    total_pods=total_pods, sparse_host=sparse_host,
                 )
             else:
                 flat_dev, unpack, out, ring = self._dispatch(
-                    enc, args, M0, harvest=True, total_pods=total_pods
+                    enc, args, M0, harvest=True, total_pods=total_pods,
+                    sparse=sparse_dev,
                 )
 
         def finish() -> Optional[SolverResult]:
@@ -2214,7 +2315,8 @@ class TPUSolver(Solver):
                             return None  # true overflow — replay on fallback
                         M = min(M * 2, self.max_claims)
                         fd, up, cur_out, cur_ring = self._dispatch(
-                            enc, args, M, harvest=True, total_pods=total_pods
+                            enc, args, M, harvest=True,
+                            total_pods=total_pods, sparse=sparse_dev,
                         )
                         flat = np.asarray(fd)
                         self.ledger.record_fetch(flat.nbytes)
@@ -2376,14 +2478,16 @@ class TPUSolver(Solver):
         return {"k": k, "init": init, "rec": rec, "key": key, "ctx_sig": ctx}
 
     def _dispatch_resume(self, enc: EncodedInput, args, host_args, plan,
-                         M: int, S: int, total_pods: Optional[int] = None):
+                         M: int, S: int, total_pods: Optional[int] = None,
+                         sparse_host=None):
         """Dispatch only runs[k:] on top of the planned checkpoint. The 34
         non-run args are the arena-resident buffers (zero upload — the
         unchanged prefix ships nothing); only the two tiny suffix run
-        arrays cross the tunnel."""
+        arrays (plus, under the sparse gate, their constraint index rows)
+        cross the tunnel."""
         import jax
 
-        from .tpu.ffd import ffd_resume
+        from .tpu.ffd import ffd_resume, ffd_resume_sparse
 
         faults.check("solver.device_dispatch")
         k = plan["k"]
@@ -2395,11 +2499,26 @@ class TPUSolver(Solver):
         dev_sg = jax.device_put(sg)
         dev_sc = jax.device_put(sc)
         self.ledger.record_upload(sg.nbytes + sc.nbytes, 2, msgs=2)
-        out, ring = ffd_resume(
-            plan["init"], dev_sg, dev_sc, *args[2:],
-            max_claims=M, zone_engine=enc.V > 0,
-            ckpt_every=self.ckpt_every, n_ckpt=self.ckpt_slots,
-        )
+        if sparse_host is not None:
+            rqi, rvi = sparse_host
+            sq = np.full((Sp2, rqi.shape[1]), -1, rqi.dtype)
+            sv = np.full((Sp2, rvi.shape[1]), -1, rvi.dtype)
+            sq[: S - k] = rqi[k:S]
+            sv[: S - k] = rvi[k:S]
+            dev_sq, dev_sv = jax.device_put(sq), jax.device_put(sv)
+            self.ledger.record_upload(sq.nbytes + sv.nbytes, 2, msgs=2)
+            self.stats["sparse_dispatches"] += 1
+            out, ring = ffd_resume_sparse(
+                plan["init"], dev_sq, dev_sv, dev_sg, dev_sc, *args[2:],
+                max_claims=M, zone_engine=enc.V > 0,
+                ckpt_every=self.ckpt_every, n_ckpt=self.ckpt_slots,
+            )
+        else:
+            out, ring = ffd_resume(
+                plan["init"], dev_sg, dev_sc, *args[2:],
+                max_claims=M, zone_engine=enc.V > 0,
+                ckpt_every=self.ckpt_every, n_ckpt=self.ckpt_slots,
+            )
         flat_dev, unpack = self._pack_dispatch(out, total_pods=total_pods)
         return flat_dev, unpack, out, ring
 
@@ -2482,9 +2601,15 @@ class TPUSolver(Solver):
     _SHARD_CLAIM_FIELDS = ("c_cum", "c_mask", "c_zc_bits", "c_gbits",
                            "c_pool", "c_cm", "c_co", "c_vm", "c_vo")
 
-    def _shard_decline(self) -> None:
+    def _shard_decline(self, reason: str) -> None:
+        """Count a sharded-solve decline with its diagnosable reason:
+        tiny_fleet (run axis narrower than the mesh / block-misaligned),
+        no_mesh (sharded request without a usable multi-device mesh),
+        v_axis / q_axis (reserved — the sparse constraint engine lifted
+        the V/Q restriction, so nothing emits these today; a future
+        inexpressible-carry construct would)."""
         self.stats["sharded_fallbacks"] += 1
-        SOLVER_SHARDED_FALLBACK.inc()
+        SOLVER_SHARDED_FALLBACK.inc(reason=reason)
 
     def _shard_bases(self, host_args) -> dict:
         """The non-zero initial values of the scan carry (state0 seeds
@@ -2534,21 +2659,14 @@ class TPUSolver(Solver):
         Nd = int(mesh.devices.size)
         S = dims["S"]
         Sp = int(host_args[0].shape[0])
-        if enc.V > 0 or enc.Q > 0:
-            # the domain event engine / hostname-constraint allowances read
-            # cross-block state the accept conditions don't bound — the
-            # carry combine is inexpressible for these fleets (soft-spread
-            # relax-ladder fleets land here too; SPEC.md lists the rules)
-            self._shard_decline()
-            return None
         if S < Nd or Sp % Nd:
-            self._shard_decline()
+            self._shard_decline("tiny_fleet")
             return None
         import jax
         from jax.sharding import NamedSharding, PartitionSpec
 
-        from .encode import mesh_run_blocks
-        from .tpu.ffd import ffd_solve_sharded
+        from .encode import mesh_run_blocks, sparse_run_tables
+        from .tpu.ffd import ffd_solve_sharded, ffd_solve_sharded_sparse
 
         Sblk = Sp // Nd
         SOLVER_MESH_DEVICES.set(Nd)
@@ -2605,20 +2723,45 @@ class TPUSolver(Solver):
             )
             self.ledger.record_upload(up, len(sh_args), msgs=len(sh_args),
                                       shard_bytes=up_shard)
+        zone = enc.V > 0
+        sparse = None
+        if nproc == 1 and self._sparse_gate(enc):
+            # compacted constraint tables partitioned over the shard axis
+            # (each lane reads only its block's index rows); the federated
+            # multi-process path keeps dense V/Q evaluation — same
+            # decisions, and per-process partial adoption of side tables
+            # isn't worth the seam
+            rqi, rvi = sparse_run_tables(enc, Sp)
+            sqb = np.ascontiguousarray(rqi.reshape(Nd, Sblk, -1))
+            svb = np.ascontiguousarray(rvi.reshape(Nd, Sblk, -1))
+            blocked3 = NamedSharding(mesh, PartitionSpec("shards", None,
+                                                         None))
+            dev_pair = self._sparse_arg(
+                sh_args, enc, sqb, svb, sharding=shardings,
+                dev_sharding=blocked3, ns=enc.tenant_id)
+            sparse = {"host": (rqi, rvi), "dev": dev_pair}
         total_pods = int(sum(len(p) for p in enc.group_pods))
         M0 = initial_claim_bucket(total_pods, self.max_claims)
         plan = self._plan_shard_resume(enc, key, M0, S, Nd, Sblk)
         if plan is not None:
             return self._dispatch_shard_resume(
-                enc, host_args, dims, mesh, args, plan, M0, Nd, Sblk
+                enc, host_args, dims, mesh, args, plan, M0, Nd, Sblk,
+                sparse=sparse,
             )
         faults.check("solver.device_dispatch")
-        out = ffd_solve_sharded(*args, max_claims=M0, zone_engine=False)
+        if sparse is not None:
+            self.stats["sparse_dispatches"] += 1
+            out = ffd_solve_sharded_sparse(
+                sparse["dev"][0], sparse["dev"][1], *args,
+                max_claims=M0, zone_engine=zone)
+        else:
+            out = ffd_solve_sharded(*args, max_claims=M0, zone_engine=zone)
 
         def finish() -> Optional[SolverResult]:
             try:
                 return self._sharded_finish(
-                    enc, host_args, dims, mesh, args, out, M0, key
+                    enc, host_args, dims, mesh, args, out, M0, key,
+                    sparse=sparse,
                 )
             finally:
                 self.ledger.end_solve()
@@ -2626,27 +2769,35 @@ class TPUSolver(Solver):
         return finish
 
     def _sharded_finish(self, enc, host_args, dims, mesh, args, out, M0,
-                        key, redispatch=None) -> Optional[SolverResult]:
+                        key, redispatch=None,
+                        sparse=None) -> Optional[SolverResult]:
         """Stitch loop with claim-overflow doubling (mirrors the cold
         finish): a saturated stitch redispatches the whole sharded solve at
         the doubled bucket against the same resident args. `redispatch(M)`
         overrides the in-process mesh launch — the virtual host mesh
         re-scatters the blocks to its worker processes instead."""
-        from .tpu.ffd import ffd_solve_sharded
+        from .tpu.ffd import ffd_solve_sharded, ffd_solve_sharded_sparse
 
+        zone = enc.V > 0
         M, cur = M0, out
         while True:
-            res = self._shard_stitch(enc, host_args, dims, mesh, args, cur, M)
+            res = self._shard_stitch(enc, host_args, dims, mesh, args, cur,
+                                     M, sparse=sparse)
             if res is not None:
                 break
             if M >= self.max_claims:
                 return None  # true overflow — replay on the fallback chain
             M = min(M * 2, self.max_claims)
             faults.check("solver.device_dispatch")
-            cur = (
-                redispatch(M) if redispatch is not None
-                else ffd_solve_sharded(*args, max_claims=M, zone_engine=False)
-            )
+            if redispatch is not None:
+                cur = redispatch(M)
+            elif sparse is not None:
+                cur = ffd_solve_sharded_sparse(
+                    sparse["dev"][0], sparse["dev"][1], *args,
+                    max_claims=M, zone_engine=zone)
+            else:
+                cur = ffd_solve_sharded(*args, max_claims=M,
+                                        zone_engine=zone)
         take_e_p, take_c_p, leftover_p, P, fixup, carries = res
         self.stats["sharded_solves"] += 1
         self.stats["shard_fixup_runs"] += fixup
@@ -2672,16 +2823,16 @@ class TPUSolver(Solver):
         Nd = pool.width
         S = dims["S"]
         Sp = int(host_args[0].shape[0])
-        if Nd < 2 or enc.V > 0 or enc.Q > 0:
-            self._shard_decline()
+        if Nd < 2:
+            self._shard_decline("no_mesh")
             return None
         if S < Nd or Sp % Nd:
-            self._shard_decline()
+            self._shard_decline("tiny_fleet")
             return None
         import jax
 
         from ..parallel.sharded import make_mesh
-        from .encode import mesh_run_blocks
+        from .encode import mesh_run_blocks, sparse_run_tables
 
         SOLVER_MESH_DEVICES.set(Nd)
         rgb, rcb = mesh_run_blocks(
@@ -2689,6 +2840,18 @@ class TPUSolver(Solver):
         )
         rest = tuple(np.asarray(a) for a in host_args[2:])
         sh_args = (rgb, rcb) + rest
+        zone = enc.V > 0
+        sparse = None
+        sqb = svb = None
+        if self._sparse_gate(enc):
+            rqi, rvi = sparse_run_tables(enc, Sp)
+            Sblk = Sp // Nd
+            sqb = np.ascontiguousarray(rqi.reshape(Nd, Sblk, -1))
+            svb = np.ascontiguousarray(rvi.reshape(Nd, Sblk, -1))
+            # parent-side stitch replays device_put block rows on demand;
+            # cold redispatches go back through the worker pool, so no
+            # parent device pair is needed
+            sparse = {"host": (rqi, rvi), "dev": None}
         # replay/resume device args live on the PARENT (1-device mesh):
         # the stitch's sequential escape hatch is host-side either way
         local_mesh = make_mesh(1, axis="shards")
@@ -2709,7 +2872,9 @@ class TPUSolver(Solver):
 
         def redispatch(M):
             faults.check("solver.device_dispatch")
-            return pool.scatter_blocks(rgb, rcb, rest, max_claims=M, ctx=ctx)
+            return pool.scatter_blocks(rgb, rcb, rest, max_claims=M,
+                                       ctx=ctx, zone_engine=zone,
+                                       sqb=sqb, svb=svb)
 
         out = redispatch(M0)
 
@@ -2717,14 +2882,15 @@ class TPUSolver(Solver):
             try:
                 return self._sharded_finish(
                     enc, host_args, dims, local_mesh, args, out, M0, None,
-                    redispatch=redispatch,
+                    redispatch=redispatch, sparse=sparse,
                 )
             finally:
                 self.ledger.end_solve()
 
         return finish
 
-    def _shard_stitch(self, enc, host_args, dims, mesh, args, out, M):
+    def _shard_stitch(self, enc, host_args, dims, mesh, args, out, M,
+                      sparse=None):
         """Fetch the lane-local outputs and stitch blocks left-to-right
         under the running TRUE carry P. Returns (take_e [Sp, Ep], take_c
         [Sp, M], leftover [Sp], final carry dict, fixup_runs, block-boundary
@@ -2747,14 +2913,31 @@ class TPUSolver(Solver):
               sufficient for slot-clamp equivalence: a lane clamped by
               slots_left must end at used == M, so an unsaturated lane was
               never clamped, and the bound keeps the sequential scan
-              unclamped too.
+              unclamped too;
+          (e) no spread counter the block's groups TOUCH (V sigs they are
+              member or owner of) moved from its seed, and no touched sig
+              gained a committed owner zone — the lane evaluated domain
+              admission/placement against the seed counters, so untouched
+              rows mean it saw true spread state (per-block touch masks,
+              SPEC.md "Sparse constraint semantics");
+          (f) no touched positive-affinity (kind-2) Q sig has membership
+              or ownership recorded on a PREFIX claim — kind-2 is the one
+              hostname-constraint rule whose allowance reads CROSS-claim
+              sums (tot_m_q / c_pos bootstrap), so prefix-claim columns the
+              lane could not see force a replay; kinds 0/1 read only
+              per-claim local counters, covered by (a) and (b).
         Otherwise the block REPLAYS via ffd_resume from P — sequentially
         exact by construction — and its replayed real runs count into the
         fix-up gauge."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec
 
-        from .tpu.ffd import ARG_INDEX, FFDState, ffd_resume
+        from .tpu.ffd import (
+            ARG_INDEX,
+            FFDState,
+            ffd_resume,
+            ffd_resume_sparse,
+        )
 
         INT32_MAX_NP = np.int32(2**31 - 1)
         h = jax.tree_util.tree_map(np.asarray, out)
@@ -2774,6 +2957,19 @@ class TPUSolver(Solver):
         group_compat_t = np.asarray(host_args[ARG_INDEX["group_compat_t"]])
         pool_limit = np.asarray(host_args[ARG_INDEX["pool_limit"]])
         finite_pool = (pool_limit < INT32_MAX_NP).any(axis=1)
+        # per-block constraint touch masks (conditions (e)/(f)): which V/Q
+        # sigs each block's groups can read — any prefix movement of a
+        # touched sig forces a replay, while fleets whose blocks touch
+        # DISJOINT sigs (the common constraint-heavy shape: many apps,
+        # each spreading only itself) stitch without serializing
+        has_vq = enc.V > 0 or enc.Q > 0
+        if has_vq:
+            v_act = (np.asarray(host_args[ARG_INDEX["v_member"]], bool)
+                     | np.asarray(host_args[ARG_INDEX["v_owner"]], bool))
+            q_act = (np.asarray(host_args[ARG_INDEX["q_member"]], bool)
+                     | np.asarray(host_args[ARG_INDEX["q_owner"]], bool))
+            q_kind2 = np.asarray(host_args[ARG_INDEX["q_kind"]]) == 2
+        zone = enc.V > 0
         repl = NamedSharding(mesh, PartitionSpec())
         rows_e = []
         rows_c = []
@@ -2800,7 +2996,22 @@ class TPUSolver(Solver):
                 elif (finite_pool[:, None]
                       & (P["p_usage"] != bases["p_usage"])).any():
                     replay = True  # (c)
-                elif offset > 0:
+                if not replay and has_vq:
+                    gs = np.unique(rg[d][real])
+                    v_t = v_act[gs].any(axis=0) if v_act.size else \
+                        np.zeros(0, bool)
+                    if v_t.any() and (
+                            (P["v_count"][v_t]
+                             != bases["v_count"][v_t]).any()
+                            or P["v_owner_z"][v_t].any()):
+                        replay = True  # (e)
+                    else:
+                        q2_t = (q_act[gs].any(axis=0) & q_kind2
+                                if q_act.size else np.zeros(0, bool))
+                        if q2_t.any() and (P["c_cm"][:, q2_t].any()
+                                           or P["c_co"][:, q2_t].any()):
+                            replay = True  # (f)
+                if not replay and offset > 0:
                     open_m = np.flatnonzero(P["c_pool"] >= 0)
                     if open_m.size:
                         # (a) superset fit: claim survives if EVERY nonzero
@@ -2855,11 +3066,25 @@ class TPUSolver(Solver):
                     + rg[d].nbytes + rc[d].nbytes,
                     len(P) + 2, msgs=3,
                 )
-                r_out, _ = ffd_resume(
-                    init, dev_sg, dev_sc, *args[2:],
-                    max_claims=M, zone_engine=False,
-                    ckpt_every=self.ckpt_every, n_ckpt=self.ckpt_slots,
-                )
+                if sparse is not None:
+                    rqi, rvi = sparse["host"]
+                    sq_blk = rqi.reshape(Nd, Sblk, -1)[d]
+                    sv_blk = rvi.reshape(Nd, Sblk, -1)[d]
+                    dev_sq = jax.device_put(sq_blk, repl)
+                    dev_sv = jax.device_put(sv_blk, repl)
+                    self.ledger.record_upload(
+                        sq_blk.nbytes + sv_blk.nbytes, 2, msgs=2)
+                    r_out, _ = ffd_resume_sparse(
+                        init, dev_sq, dev_sv, dev_sg, dev_sc, *args[2:],
+                        max_claims=M, zone_engine=zone,
+                        ckpt_every=self.ckpt_every, n_ckpt=self.ckpt_slots,
+                    )
+                else:
+                    r_out, _ = ffd_resume(
+                        init, dev_sg, dev_sc, *args[2:],
+                        max_claims=M, zone_engine=zone,
+                        ckpt_every=self.ckpt_every, n_ckpt=self.ckpt_slots,
+                    )
                 rh = jax.tree_util.tree_map(np.asarray, r_out)
                 self.ledger.record_fetch(
                     sum(x.nbytes
@@ -2922,6 +3147,7 @@ class TPUSolver(Solver):
             "run_ident": ident,
             "M": M,
             "n_shards": Nd,
+            "zone_engine": enc.V > 0,
             "ctx_sig": ctx,
             "carries": carries,
             "take_e": np.asarray(take_e_p),
@@ -2942,7 +3168,8 @@ class TPUSolver(Solver):
         from .tpu.ffd import ARG_INDEX
 
         rec = self.arena.get_shard_record(key)
-        if rec is None or rec["M"] != M0 or rec["n_shards"] != Nd:
+        if rec is None or rec["M"] != M0 or rec["n_shards"] != Nd \
+                or rec.get("zone_engine", False) != (enc.V > 0):
             return None
         ctx = self.arena.context_signature(
             key, exclude=(ARG_INDEX["run_group"], ARG_INDEX["run_count"])
@@ -2961,7 +3188,7 @@ class TPUSolver(Solver):
         return {"b": b, "carry": rec["carries"][b - 1], "rec": rec}
 
     def _dispatch_shard_resume(self, enc, host_args, dims, mesh, args, plan,
-                               M: int, Nd: int, Sblk: int):
+                               M: int, Nd: int, Sblk: int, sparse=None):
         """Replay only blocks [b:] as ONE replicated ffd_resume from the
         recorded block-boundary carry; rows [0, b*Sblk) splice from the
         donor record. Composes suffix resume with sharding: the per-device
@@ -2969,7 +3196,7 @@ class TPUSolver(Solver):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec
 
-        from .tpu.ffd import FFDState, ffd_resume
+        from .tpu.ffd import FFDState, ffd_resume, ffd_resume_sparse
 
         faults.check("solver.device_dispatch")
         b = plan["b"]
@@ -2988,11 +3215,24 @@ class TPUSolver(Solver):
             sum(v.nbytes for v in carry.values()) + sg.nbytes + sc.nbytes,
             len(carry) + 2, msgs=3,
         )
-        out, _ = ffd_resume(
-            init, dev_sg, dev_sc, *args[2:],
-            max_claims=M, zone_engine=False,
-            ckpt_every=self.ckpt_every, n_ckpt=self.ckpt_slots,
-        )
+        zone = enc.V > 0
+        if sparse is not None:
+            rqi, rvi = sparse["host"]
+            dev_sq = jax.device_put(np.ascontiguousarray(rqi[k:Sp]), repl)
+            dev_sv = jax.device_put(np.ascontiguousarray(rvi[k:Sp]), repl)
+            self.ledger.record_upload(
+                rqi[k:Sp].nbytes + rvi[k:Sp].nbytes, 2, msgs=2)
+            out, _ = ffd_resume_sparse(
+                init, dev_sq, dev_sv, dev_sg, dev_sc, *args[2:],
+                max_claims=M, zone_engine=zone,
+                ckpt_every=self.ckpt_every, n_ckpt=self.ckpt_slots,
+            )
+        else:
+            out, _ = ffd_resume(
+                init, dev_sg, dev_sc, *args[2:],
+                max_claims=M, zone_engine=zone,
+                ckpt_every=self.ckpt_every, n_ckpt=self.ckpt_slots,
+            )
 
         def finish() -> Optional[SolverResult]:
             try:
@@ -3006,17 +3246,27 @@ class TPUSolver(Solver):
                 if int(rh.state.used) >= M:
                     # suffix overflowed the donor's bucket: redo COLD
                     # sharded at the doubled bucket (resident args reused)
-                    from .tpu.ffd import ffd_solve_sharded
+                    from .tpu.ffd import (
+                        ffd_solve_sharded,
+                        ffd_solve_sharded_sparse,
+                    )
 
                     if M >= self.max_claims:
                         return None
                     M2 = min(M * 2, self.max_claims)
                     faults.check("solver.device_dispatch")
-                    cold = ffd_solve_sharded(
-                        *args, max_claims=M2, zone_engine=False
-                    )
+                    if sparse is not None:
+                        cold = ffd_solve_sharded_sparse(
+                            sparse["dev"][0], sparse["dev"][1], *args,
+                            max_claims=M2, zone_engine=zone,
+                        )
+                    else:
+                        cold = ffd_solve_sharded(
+                            *args, max_claims=M2, zone_engine=zone
+                        )
                     return self._sharded_finish(
-                        enc, host_args, dims, mesh, args, cold, M2, None
+                        enc, host_args, dims, mesh, args, cold, M2, None,
+                        sparse=sparse,
                     )
                 rec = plan["rec"]
                 pre_c = rec["take_c"][:k]

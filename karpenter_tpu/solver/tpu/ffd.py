@@ -444,6 +444,8 @@ def _ffd_scan(
     ckpt_every: int = 0,
     n_ckpt: int = 0,
     run_ladder=None,  # [S, L] i32 — per-run relax rung groups (-1 pad)
+    run_q_idx=None,  # [S, Kq] i32 — per-run active Q-sig indices (-1 pad)
+    run_v_idx=None,  # [S, Kv] i32 — per-run active V-sig indices (-1 pad)
 ):
     E, R = node_free.shape
     G, T = group_compat_t.shape
@@ -491,7 +493,7 @@ def _ffd_scan(
         """[...] u32 joint bits -> [..., Z] bool zone marginals."""
         return (bits[..., None] & zone_col_mask) != 0
 
-    def step_body(st: FFDState, g, count):
+    def step_body(st: FFDState, g, count, q_row=None, v_row=None):
         req = group_req[g]  # [R]
         compat_t = group_compat_t[g]  # [T]
         g_zc = group_zc_bits[g]  # u32
@@ -505,6 +507,63 @@ def _ffd_scan(
         on_device = group_device[g]
         remaining0 = jnp.where(on_device, count, 0).astype(jnp.int32)
 
+        # --- compacted constraint view (sparse V/Q-axis evaluation) ------
+        # With run-major index tables present, the fast branch evaluates
+        # hostname (Q) and zone-sig (V) state over ONLY the run's active
+        # columns. The gathered member/owner flags mask -1 padding to
+        # False, and a non-member/non-owner column contributes exactly the
+        # neutral element everywhere (BIG to allowance mins, 0 to count
+        # updates) — which is why any SUPERSET gather list is bit-identical
+        # to the dense full-width evaluation. Scatter indices map padding
+        # out of range so mode="drop" discards it.
+        sparse = q_row is not None
+        if sparse:
+            qvalid = q_row >= 0  # [Kq]
+            qi = jnp.where(qvalid, q_row, 0)
+            qsc = jnp.where(qvalid, q_row, Q)  # pad -> OOB, dropped
+            m_g = member_g[qi] & qvalid
+            o_g = owner_g[qi] & qvalid
+            kq = q_kind[qi]
+            cq = q_cap[qi]
+            vvalid = v_row >= 0  # [Kv]
+            vi = jnp.where(vvalid, v_row, 0)
+            vsc = jnp.where(vvalid, v_row, V)
+            m_v = member_v[vi] & vvalid
+            o_v = owner_v[vi] & vvalid
+            vk = v_kind[vi]
+            Qw = q_row.shape[0]
+        else:
+            m_g, o_g, kq, cq = member_g, owner_g, q_kind, q_cap
+            m_v, o_v, vk = member_v, owner_v, v_kind
+            Qw = Q
+
+        def q_cols(a):
+            """[X, Q] counters -> the run's active columns [X, Kq]."""
+            return jnp.take(a, qi, axis=1) if sparse else a
+
+        def q_add(a, vals):
+            """Add gathered-width count deltas back into [X, Q] state."""
+            return a.at[:, qsc].add(vals, mode="drop") if sparse else a + vals
+
+        def q_open(a, vals, is_new):
+            """Claim-open rows: dense REPLACES the (known-zero) row, the
+            sparse form scatter-adds onto it — identical on int zeros."""
+            if sparse:
+                return a.at[:, qsc].add(
+                    jnp.where(is_new[:, None], vals, 0), mode="drop"
+                )
+            return jnp.where(is_new[:, None], vals, a)
+
+        def v_add(a, vals):
+            return a.at[:, vsc].add(vals, mode="drop") if sparse else a + vals
+
+        def v_open(a, vals, is_new):
+            if sparse:
+                return a.at[:, vsc].add(
+                    jnp.where(is_new[:, None], vals, 0), mode="drop"
+                )
+            return jnp.where(is_new[:, None], vals, a)
+
         # fresh-node allowance under hostname constraints (counts start at
         # 0). Kind-2 (positive hostname affinity) is EXCLUDED here — at
         # cm=0 it would zero every fresh claim, but its real semantics is a
@@ -512,16 +571,18 @@ def _ffd_scan(
         # anywhere (the group co-locates on it, self-satisfying the term),
         # zero otherwise (a fresh claim can never already hold members).
         fresh_allow = _hostname_allowance(
-            jnp.zeros((1, Q), jnp.int32),
-            jnp.zeros((1, Q), jnp.int32),
-            q_kind,
-            q_cap,
-            member_g,
-            owner_g & (q_kind != 2),
+            jnp.zeros((1, Qw), jnp.int32),
+            jnp.zeros((1, Qw), jnp.int32),
+            kq,
+            cq,
+            m_g,
+            o_g & (kq != 2),
         )[0]
-        owned2 = owner_g & (q_kind == 2)  # [Q]
-        tot_m_q = jnp.sum(st.e_cm, axis=0) + jnp.sum(st.c_cm, axis=0)  # [Q]
-        boot_ok = jnp.all(~owned2 | (member_g & (tot_m_q == 0)))
+        owned2 = o_g & (kq == 2)  # [Kq]
+        tot_m_q = jnp.sum(q_cols(st.e_cm), axis=0) + jnp.sum(
+            q_cols(st.c_cm), axis=0
+        )  # [Kq]
+        boot_ok = jnp.all(~owned2 | (m_g & (tot_m_q == 0)))
 
         def count_contrib(take_e, take_c, c_zc_after):
             """[Z] recorded-pod count deltas: node domains + claims whose
@@ -554,15 +615,16 @@ def _ffd_scan(
             # ---- 1. existing nodes ----------------------------------------
             e_base = _fit_count(node_free, st.e_cum, req)
             e_base = jnp.where(node_compat[g], e_base, 0)
-            owner_nb = owner_g & (q_kind != 2)
+            owner_nb = o_g & (kq != 2)
+            e_cm_k = q_cols(st.e_cm)
             e_allow_nb = _hostname_allowance(
-                st.e_cm, st.e_co, q_kind, q_cap, member_g, owner_nb
+                e_cm_k, q_cols(st.e_co), kq, cq, m_g, owner_nb
             )
             # kind-2 component derived from the SAME counts (owner_g =
             # owner_nb | owned2), so the allowance kernel runs once per axis
             e_pos = jnp.min(
                 jnp.where(
-                    owned2[None, :], jnp.where(st.e_cm > 0, BIG, 0), BIG
+                    owned2[None, :], jnp.where(e_cm_k > 0, BIG, 0), BIG
                 ),
                 axis=1,
             ).astype(jnp.int32)
@@ -577,10 +639,15 @@ def _ffd_scan(
             )
             take_e, remaining = _pour(e_cap, remaining)
             e_cum = st.e_cum + take_e[:, None] * req[None, :]
-            e_cm = st.e_cm + take_e[:, None] * member_g[None, :].astype(jnp.int32)
-            e_co = st.e_co + (
-                (take_e[:, None] > 0) & owner_g[None, :] & (q_kind[None, :] == 1)
-            ).astype(jnp.int32)
+            e_cm = q_add(
+                st.e_cm, take_e[:, None] * m_g[None, :].astype(jnp.int32)
+            )
+            e_co = q_add(
+                st.e_co,
+                (
+                    (take_e[:, None] > 0) & o_g[None, :] & (kq[None, :] == 1)
+                ).astype(jnp.int32),
+            )
 
             # ---- 2. open claims -------------------------------------------
             A_bits = offer_zc_bits & g_zc  # [T] u32
@@ -594,12 +661,13 @@ def _ffd_scan(
             node_ok = is_open & pair_ok & pool_ok  # [M]
             k_nt = jnp.where(fit_nt & node_ok[:, None], k_nt, 0)
             c_base = jnp.max(k_nt, axis=1)  # [M]
+            c_cm_k = q_cols(st.c_cm)
             c_allow_nb = _hostname_allowance(
-                st.c_cm, st.c_co, q_kind, q_cap, member_g, owner_nb
+                c_cm_k, q_cols(st.c_co), kq, cq, m_g, owner_nb
             )
             c_pos = jnp.min(
                 jnp.where(
-                    owned2[None, :], jnp.where(st.c_cm > 0, BIG, 0), BIG
+                    owned2[None, :], jnp.where(c_cm_k > 0, BIG, 0), BIG
                 ),
                 axis=1,
             ).astype(jnp.int32)
@@ -625,11 +693,18 @@ def _ffd_scan(
             c_gbits = st.c_gbits | jnp.where(
                 added[:, None], gword[None, :], jnp.uint32(0)
             )
-            c_cm = st.c_cm + take_c[:, None] * member_g[None, :].astype(jnp.int32)
-            c_co = st.c_co + (
-                added[:, None] & owner_g[None, :] & (q_kind[None, :] == 1)
-            ).astype(jnp.int32)
-            c_vm = st.c_vm + take_c[:, None] * member_v[None, :].astype(jnp.int32)
+            c_cm = q_add(
+                st.c_cm, take_c[:, None] * m_g[None, :].astype(jnp.int32)
+            )
+            c_co = q_add(
+                st.c_co,
+                (
+                    added[:, None] & o_g[None, :] & (kq[None, :] == 1)
+                ).astype(jnp.int32),
+            )
+            c_vm = v_add(
+                st.c_vm, take_c[:, None] * m_v[None, :].astype(jnp.int32)
+            )
 
             # ---- 3. new claims, pool by pool in priority order ------------
             def open_pool(p, carry):
@@ -698,24 +773,24 @@ def _ffd_scan(
                     c_zc_bits = jnp.where(is_new, new_bits, c_zc_bits)
                     c_gbits = jnp.where(is_new[:, None], gword[None, :], c_gbits)
                     c_pool = jnp.where(is_new, p, c_pool)
-                    c_cm = jnp.where(
-                        is_new[:, None],
-                        take_j[:, None] * member_g[None, :].astype(jnp.int32),
+                    c_cm = q_open(
                         c_cm,
+                        take_j[:, None] * m_g[None, :].astype(jnp.int32),
+                        is_new,
                     )
-                    c_co = jnp.where(
-                        is_new[:, None],
+                    c_co = q_open(
+                        c_co,
                         (
                             (take_j[:, None] > 0)
-                            & owner_g[None, :]
-                            & (q_kind[None, :] == 1)
+                            & o_g[None, :]
+                            & (kq[None, :] == 1)
                         ).astype(jnp.int32),
-                        c_co,
+                        is_new,
                     )
-                    c_vm = jnp.where(
-                        is_new[:, None],
-                        take_j[:, None] * member_v[None, :].astype(jnp.int32),
+                    c_vm = v_open(
                         c_vm,
+                        take_j[:, None] * m_v[None, :].astype(jnp.int32),
+                        is_new,
                     )
                     p_usage = p_usage.at[p].add((charge_one * n_new).astype(jnp.int32))
                     take_new = take_new + take_j
@@ -760,7 +835,15 @@ def _ffd_scan(
             # zone-sig membership counts (this group may match other pods'
             # selectors even without owning a constraint)
             contrib = count_contrib(take_e, take_c_total, c_zc_bits)
-            v_count = st.v_count + member_v.astype(jnp.int32)[:, None] * contrib[None, :]
+            if sparse:
+                v_count = st.v_count.at[vsc, :].add(
+                    m_v.astype(jnp.int32)[:, None] * contrib[None, :],
+                    mode="drop",
+                )
+            else:
+                v_count = st.v_count + (
+                    m_v.astype(jnp.int32)[:, None] * contrib[None, :]
+                )
 
             new_state = FFDState(
                 e_cum=e_cum, c_cum=c_cum, c_mask=c_mask, c_zc_bits=c_zc_bits,
@@ -1574,16 +1657,23 @@ def _ffd_scan(
         # step even with zero zone constraints in the input.
         if not zone_engine:
             return fast(st)
-        constrained = jnp.any(v_owner[g]) | jnp.any(member_v & (v_kind == 1))
+        # the gathered flags cover every sig the group is member/owner of,
+        # so the compacted dispatch test matches the dense one exactly
+        constrained = jnp.any(o_v) | jnp.any(m_v & (vk == 1))
         return jax.lax.cond(constrained, zoned, fast, st)
 
+    sparse = run_q_idx is not None
+
     def step(st: FFDState, run):
-        g, count = run
+        if sparse:
+            g, count, qr, vr = run
+        else:
+            (g, count), qr, vr = run, None, None
         # padded runs (count == 0) skip the whole body — bucketed S padding
         # costs ~nothing at runtime
         new_st, (te, tc, lo) = jax.lax.cond(
             count > 0,
-            lambda s: step_body(s, g, count),
+            lambda s: step_body(s, g, count, qr, vr),
             lambda s: (
                 s,
                 (
@@ -1622,7 +1712,13 @@ def _ffd_scan(
         Lw = run_ladder.shape[1]
 
         def step_ladder(st: FFDState, run):
-            g, count, lrow = run
+            if sparse:
+                # the run's index rows are the union over base + rung
+                # groups (encode.sparse_run_tables ladder mode), so the
+                # same gathered view is a correct superset for every rung
+                g, count, lrow, qr, vr = run
+            else:
+                (g, count, lrow), qr, vr = run, None, None
 
             def cascade(st_in):
                 # every iteration either places >= 1 pod (and pods place at
@@ -1648,7 +1744,7 @@ def _ffd_scan(
                     cnt = jnp.where(is_base, remaining, jnp.int32(1))
                     new_st, (te, tc, lo) = jax.lax.cond(
                         valid,
-                        lambda s: step_body(s, g_cur, cnt),
+                        lambda s: step_body(s, g_cur, cnt, qr, vr),
                         lambda s: (
                             s,
                             (
@@ -1704,7 +1800,11 @@ def _ffd_scan(
             )
 
         state, ys = jax.lax.scan(
-            step_ladder, state, (run_group, run_count, run_ladder)
+            step_ladder,
+            state,
+            (run_group, run_count, run_ladder, run_q_idx, run_v_idx)
+            if sparse
+            else (run_group, run_count, run_ladder),
         )
         take_e, take_c, leftover = ys
         out = FFDOutput(
@@ -1724,8 +1824,12 @@ def _ffd_scan(
 
         def step_ck(carry, run):
             st, ring_st, pref = carry
-            g, count, i = run
-            new_st, ys_i = step(st, (g, count))
+            if sparse:
+                g, count, qr, vr, i = run
+                new_st, ys_i = step(st, (g, count, qr, vr))
+            else:
+                g, count, i = run
+                new_st, ys_i = step(st, (g, count))
             pos = i + jnp.int32(1)
             write = (pos % ckpt_every) == 0
             slot = ((pos // ckpt_every) - 1) % n_ckpt
@@ -1739,11 +1843,20 @@ def _ffd_scan(
         (state, ring_states, prefix), ys = jax.lax.scan(
             step_ck,
             (state, ring0, prefix0),
-            (run_group, run_count, jnp.arange(S, dtype=jnp.int32)),
+            (run_group, run_count, run_q_idx, run_v_idx,
+             jnp.arange(S, dtype=jnp.int32))
+            if sparse
+            else (run_group, run_count, jnp.arange(S, dtype=jnp.int32)),
         )
         ring = CheckpointRing(states=ring_states, prefix=prefix)
     else:
-        state, ys = jax.lax.scan(step, state, (run_group, run_count))
+        state, ys = jax.lax.scan(
+            step,
+            state,
+            (run_group, run_count, run_q_idx, run_v_idx)
+            if sparse
+            else (run_group, run_count),
+        )
     if emit_takes:
         take_e, take_c, leftover = ys
     else:
@@ -2256,6 +2369,538 @@ def ffd_solve_sharded(
 
 
 # ---------------------------------------------------------------------------
+# Sparse constraint engine: compacted V/Q-axis evaluation (ISSUE 20)
+# ---------------------------------------------------------------------------
+#
+# Constraint-heavy fleets (zone topology spread, pod affinity) paid dense
+# rent: every run's fast branch evaluated full-width [E, Q]/[M, Q] hostname
+# allowances and [M, V]/[V, Z] spread-count updates even though a run's
+# group touches only a handful of sigs. The sparse entry points below take
+# two LEADING run-major index tables (encode.sparse_run_tables) — per-run
+# active-constraint index lists, -1 padded to a quantum-bucketed width — and
+# the scan gathers just those columns, with masked scatter-adds writing the
+# deltas back. The gathered member/owner flags make any superset list exact
+# (a non-member column contributes the neutral element everywhere), so the
+# sparse leg is bit-identical to the dense kernel, the native host mirror,
+# and the oracle (3-leg parity; tests/test_sparse_constraints.py). The
+# frozen ARG_SPEC 36 is untouched: like run_ladder and init_state, the
+# index tables LEAD the signature as side entries (SPARSE_ARG_SPEC), so the
+# arena's per-entry residency and the AOT shape table stay valid.
+
+# Side-table tensor names (tests/test_arg_spec_drift.py pins the sparse
+# kernel signatures against this table the same way ARG_SPEC pins
+# ffd_solve's). Widths Kq/Kv are quantum-bucketed (encode.SPARSE_IDX_MULT)
+# so compile buckets stay shared across fleets of similar density.
+SPARSE_ARG_SPEC = (
+    "run_q_idx",  # [S, Kq] i32 — per-run active hostname-sig indices (-1 pad)
+    "run_v_idx",  # [S, Kv] i32 — per-run active zone-sig indices (-1 pad)
+)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_claims", "emit_takes", "zone_engine")
+)
+def ffd_solve_sparse(
+    run_q_idx,  # [S, Kq] i32 — leading side table (SPARSE_ARG_SPEC)
+    run_v_idx,  # [S, Kv] i32
+    run_group,
+    run_count,
+    group_req,
+    group_compat_t,
+    group_zc_bits,
+    group_pool,
+    group_pair_nok,
+    group_device,
+    type_alloc,
+    type_charge,
+    offer_zc_bits,
+    pool_type,
+    pool_zc_bits,
+    pool_daemon,
+    pool_limit,
+    pool_usage0,
+    node_free,
+    node_compat,
+    q_member,
+    q_owner,
+    q_kind,
+    q_cap,
+    node_q_member,
+    node_q_owner,
+    v_member,
+    v_owner,
+    v_kind,
+    v_cap,
+    v_primary,
+    v_aff,
+    v_count0,
+    node_zone,
+    zone_col_mask,
+    node_dom2,
+    col_axis,
+    group_daxis,
+    *,
+    max_claims: int,
+    emit_takes: bool = True,
+    zone_engine: bool = True,
+) -> FFDOutput:
+    """ffd_solve with compacted V/Q-axis evaluation — decision-identical,
+    pays for constraint density instead of constraint existence."""
+    out, _ = _ffd_scan(
+        run_group,
+        run_count,
+        group_req,
+        group_compat_t,
+        group_zc_bits,
+        group_pool,
+        group_pair_nok,
+        group_device,
+        type_alloc,
+        type_charge,
+        offer_zc_bits,
+        pool_type,
+        pool_zc_bits,
+        pool_daemon,
+        pool_limit,
+        pool_usage0,
+        node_free,
+        node_compat,
+        q_member,
+        q_owner,
+        q_kind,
+        q_cap,
+        node_q_member,
+        node_q_owner,
+        v_member,
+        v_owner,
+        v_kind,
+        v_cap,
+        v_primary,
+        v_aff,
+        v_count0,
+        node_zone,
+        zone_col_mask,
+        node_dom2,
+        col_axis,
+        group_daxis,
+        max_claims=max_claims,
+        emit_takes=emit_takes,
+        zone_engine=zone_engine,
+        run_q_idx=run_q_idx,
+        run_v_idx=run_v_idx,
+    )
+    return out
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_claims", "emit_takes", "zone_engine",
+                     "ckpt_every", "n_ckpt"),
+)
+def ffd_solve_ckpt_sparse(
+    run_q_idx,
+    run_v_idx,
+    run_group,
+    run_count,
+    group_req,
+    group_compat_t,
+    group_zc_bits,
+    group_pool,
+    group_pair_nok,
+    group_device,
+    type_alloc,
+    type_charge,
+    offer_zc_bits,
+    pool_type,
+    pool_zc_bits,
+    pool_daemon,
+    pool_limit,
+    pool_usage0,
+    node_free,
+    node_compat,
+    q_member,
+    q_owner,
+    q_kind,
+    q_cap,
+    node_q_member,
+    node_q_owner,
+    v_member,
+    v_owner,
+    v_kind,
+    v_cap,
+    v_primary,
+    v_aff,
+    v_count0,
+    node_zone,
+    zone_col_mask,
+    node_dom2,
+    col_axis,
+    group_daxis,
+    *,
+    max_claims: int,
+    emit_takes: bool = True,
+    zone_engine: bool = True,
+    ckpt_every: int = 16,
+    n_ckpt: int = 4,
+):
+    """ffd_solve_ckpt with compacted V/Q-axis evaluation. The harvested
+    ring is interchangeable with the dense one (the carry IS the decision
+    state and decisions are identical), so dense and sparse dispatches may
+    resume from each other's checkpoints."""
+    return _ffd_scan(
+        run_group,
+        run_count,
+        group_req,
+        group_compat_t,
+        group_zc_bits,
+        group_pool,
+        group_pair_nok,
+        group_device,
+        type_alloc,
+        type_charge,
+        offer_zc_bits,
+        pool_type,
+        pool_zc_bits,
+        pool_daemon,
+        pool_limit,
+        pool_usage0,
+        node_free,
+        node_compat,
+        q_member,
+        q_owner,
+        q_kind,
+        q_cap,
+        node_q_member,
+        node_q_owner,
+        v_member,
+        v_owner,
+        v_kind,
+        v_cap,
+        v_primary,
+        v_aff,
+        v_count0,
+        node_zone,
+        zone_col_mask,
+        node_dom2,
+        col_axis,
+        group_daxis,
+        max_claims=max_claims,
+        emit_takes=emit_takes,
+        zone_engine=zone_engine,
+        ckpt_every=ckpt_every,
+        n_ckpt=n_ckpt,
+        run_q_idx=run_q_idx,
+        run_v_idx=run_v_idx,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_claims", "emit_takes", "zone_engine",
+                     "ckpt_every", "n_ckpt"),
+)
+def ffd_resume_sparse(
+    init_state,  # FFDState pytree — a checkpoint from a prefix-valid solve
+    run_q_idx,  # [S', Kq] i32 — suffix rows of the solve's index table
+    run_v_idx,  # [S', Kv] i32
+    run_group,
+    run_count,
+    group_req,
+    group_compat_t,
+    group_zc_bits,
+    group_pool,
+    group_pair_nok,
+    group_device,
+    type_alloc,
+    type_charge,
+    offer_zc_bits,
+    pool_type,
+    pool_zc_bits,
+    pool_daemon,
+    pool_limit,
+    pool_usage0,
+    node_free,
+    node_compat,
+    q_member,
+    q_owner,
+    q_kind,
+    q_cap,
+    node_q_member,
+    node_q_owner,
+    v_member,
+    v_owner,
+    v_kind,
+    v_cap,
+    v_primary,
+    v_aff,
+    v_count0,
+    node_zone,
+    zone_col_mask,
+    node_dom2,
+    col_axis,
+    group_daxis,
+    *,
+    max_claims: int,
+    emit_takes: bool = True,
+    zone_engine: bool = True,
+    ckpt_every: int = 16,
+    n_ckpt: int = 4,
+):
+    """ffd_resume with compacted V/Q-axis evaluation over the suffix."""
+    return _ffd_scan(
+        run_group,
+        run_count,
+        group_req,
+        group_compat_t,
+        group_zc_bits,
+        group_pool,
+        group_pair_nok,
+        group_device,
+        type_alloc,
+        type_charge,
+        offer_zc_bits,
+        pool_type,
+        pool_zc_bits,
+        pool_daemon,
+        pool_limit,
+        pool_usage0,
+        node_free,
+        node_compat,
+        q_member,
+        q_owner,
+        q_kind,
+        q_cap,
+        node_q_member,
+        node_q_owner,
+        v_member,
+        v_owner,
+        v_kind,
+        v_cap,
+        v_primary,
+        v_aff,
+        v_count0,
+        node_zone,
+        zone_col_mask,
+        node_dom2,
+        col_axis,
+        group_daxis,
+        max_claims=max_claims,
+        emit_takes=emit_takes,
+        zone_engine=zone_engine,
+        init_state=init_state,
+        ckpt_every=ckpt_every,
+        n_ckpt=n_ckpt,
+        run_q_idx=run_q_idx,
+        run_v_idx=run_v_idx,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_claims", "emit_takes", "zone_engine")
+)
+def ffd_solve_ladder_sparse(
+    run_ladder,  # [S, L] i32 — rung groups per run (-1 pad), leading axis
+    run_q_idx,  # [S, Kq] i32 — index rows UNIONED over base + rung groups
+    run_v_idx,  # [S, Kv] i32
+    run_group,
+    run_count,
+    group_req,
+    group_compat_t,
+    group_zc_bits,
+    group_pool,
+    group_pair_nok,
+    group_device,
+    type_alloc,
+    type_charge,
+    offer_zc_bits,
+    pool_type,
+    pool_zc_bits,
+    pool_daemon,
+    pool_limit,
+    pool_usage0,
+    node_free,
+    node_compat,
+    q_member,
+    q_owner,
+    q_kind,
+    q_cap,
+    node_q_member,
+    node_q_owner,
+    v_member,
+    v_owner,
+    v_kind,
+    v_cap,
+    v_primary,
+    v_aff,
+    v_count0,
+    node_zone,
+    zone_col_mask,
+    node_dom2,
+    col_axis,
+    group_daxis,
+    *,
+    max_claims: int,
+    emit_takes: bool = True,
+    zone_engine: bool = True,
+) -> FFDOutput:
+    """ffd_solve_ladder with compacted V/Q-axis evaluation. Each run's
+    index rows are the UNION of active sigs over its base group and every
+    materialized rung group (encode.sparse_run_tables ladder mode), so the
+    one gathered view is a correct superset at every cascade level."""
+    out, _ = _ffd_scan(
+        run_group,
+        run_count,
+        group_req,
+        group_compat_t,
+        group_zc_bits,
+        group_pool,
+        group_pair_nok,
+        group_device,
+        type_alloc,
+        type_charge,
+        offer_zc_bits,
+        pool_type,
+        pool_zc_bits,
+        pool_daemon,
+        pool_limit,
+        pool_usage0,
+        node_free,
+        node_compat,
+        q_member,
+        q_owner,
+        q_kind,
+        q_cap,
+        node_q_member,
+        node_q_owner,
+        v_member,
+        v_owner,
+        v_kind,
+        v_cap,
+        v_primary,
+        v_aff,
+        v_count0,
+        node_zone,
+        zone_col_mask,
+        node_dom2,
+        col_axis,
+        group_daxis,
+        max_claims=max_claims,
+        emit_takes=emit_takes,
+        zone_engine=zone_engine,
+        run_ladder=run_ladder,
+        run_q_idx=run_q_idx,
+        run_v_idx=run_v_idx,
+    )
+    return out
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_claims", "emit_takes", "zone_engine")
+)
+def ffd_solve_sharded_sparse(
+    run_q_idx,  # [Nd, Sblk, Kq] i32 — index tables partitioned like the runs
+    run_v_idx,  # [Nd, Sblk, Kv] i32
+    run_group,  # [Nd, Sblk] i32 — contiguous run blocks, one per mesh device
+    run_count,  # [Nd, Sblk] i32
+    group_req,
+    group_compat_t,
+    group_zc_bits,
+    group_pool,
+    group_pair_nok,
+    group_device,
+    type_alloc,
+    type_charge,
+    offer_zc_bits,
+    pool_type,
+    pool_zc_bits,
+    pool_daemon,
+    pool_limit,
+    pool_usage0,
+    node_free,
+    node_compat,
+    q_member,
+    q_owner,
+    q_kind,
+    q_cap,
+    node_q_member,
+    node_q_owner,
+    v_member,
+    v_owner,
+    v_kind,
+    v_cap,
+    v_primary,
+    v_aff,
+    v_count0,
+    node_zone,
+    zone_col_mask,
+    node_dom2,
+    col_axis,
+    group_daxis,
+    *,
+    max_claims: int,
+    emit_takes: bool = True,
+    zone_engine: bool = True,
+) -> FFDOutput:
+    """ffd_solve_sharded with compacted V/Q-axis evaluation: the two index
+    tables carry the same leading [Nd, Sblk] block axis as the run arrays
+    (they are run-major, so they partition identically over the mesh's
+    "shards" axis), the other 34 broadcast replicated. This is the entry
+    point that lets the mesh-sharded path accept V>0/Q>0 fleets — each
+    lane runs the same compacted scan from its block-local carry, and the
+    host stitch's spread-counter triggers (backend._shard_stitch) decide
+    accept vs fixup replay. zone_engine should be True iff V > 0, exactly
+    like the one-device dispatch."""
+
+    def lane(rqi, rvi, rg, rc):
+        out, _ = _ffd_scan(
+            rg,
+            rc,
+            group_req,
+            group_compat_t,
+            group_zc_bits,
+            group_pool,
+            group_pair_nok,
+            group_device,
+            type_alloc,
+            type_charge,
+            offer_zc_bits,
+            pool_type,
+            pool_zc_bits,
+            pool_daemon,
+            pool_limit,
+            pool_usage0,
+            node_free,
+            node_compat,
+            q_member,
+            q_owner,
+            q_kind,
+            q_cap,
+            node_q_member,
+            node_q_owner,
+            v_member,
+            v_owner,
+            v_kind,
+            v_cap,
+            v_primary,
+            v_aff,
+            v_count0,
+            node_zone,
+            zone_col_mask,
+            node_dom2,
+            col_axis,
+            group_daxis,
+            max_claims=max_claims,
+            emit_takes=emit_takes,
+            zone_engine=zone_engine,
+            run_q_idx=rqi,
+            run_v_idx=rvi,
+        )
+        return out
+
+    return jax.vmap(lane)(run_q_idx, run_v_idx, run_group, run_count)
+
+
+# ---------------------------------------------------------------------------
 # Scheduling classes: priority preemption + atomic gangs (ISSUE 9)
 # ---------------------------------------------------------------------------
 #
@@ -2546,6 +3191,21 @@ ffd_solve_ladder = _telemetry.instrument(
     arg_names=("run_ladder",) + tuple(ARG_SPEC))
 ffd_solve_sharded = _telemetry.instrument(
     "ffd_solve_sharded", ffd_solve_sharded, arg_names=ARG_SPEC)
+ffd_solve_sparse = _telemetry.instrument(
+    "ffd_solve_sparse", ffd_solve_sparse,
+    arg_names=tuple(SPARSE_ARG_SPEC) + tuple(ARG_SPEC))
+ffd_solve_ckpt_sparse = _telemetry.instrument(
+    "ffd_solve_ckpt_sparse", ffd_solve_ckpt_sparse,
+    arg_names=tuple(SPARSE_ARG_SPEC) + tuple(ARG_SPEC))
+ffd_resume_sparse = _telemetry.instrument(
+    "ffd_resume_sparse", ffd_resume_sparse,
+    arg_names=("init_state",) + tuple(SPARSE_ARG_SPEC) + tuple(ARG_SPEC))
+ffd_solve_ladder_sparse = _telemetry.instrument(
+    "ffd_solve_ladder_sparse", ffd_solve_ladder_sparse,
+    arg_names=("run_ladder",) + tuple(SPARSE_ARG_SPEC) + tuple(ARG_SPEC))
+ffd_solve_sharded_sparse = _telemetry.instrument(
+    "ffd_solve_sharded_sparse", ffd_solve_sharded_sparse,
+    arg_names=tuple(SPARSE_ARG_SPEC) + tuple(ARG_SPEC))
 gang_commit = _telemetry.instrument("gang_commit", gang_commit)
 preemption_plan = _telemetry.instrument("preemption_plan", preemption_plan)
 explain_pack = _telemetry.instrument("explain_pack", explain_pack)

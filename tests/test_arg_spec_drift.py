@@ -437,3 +437,59 @@ def test_explain_reasons_match_decoder_names():
     assert codes == sorted(codes) == list(range(len(codes))), (
         "reason codes must stay dense and ordered — precedence is the wire"
     )
+
+
+# -- sparse constraint engine (ISSUE 20) --------------------------------------
+
+
+def test_sparse_arg_spec_is_pinned():
+    """SPARSE_ARG_SPEC is the wire layout of the compacted V/Q side tables:
+    run_q_idx then run_v_idx, each a -1-padded [S, K] i32 CSR-style index
+    table. The arena's "sparse" residency class and every sparse entry
+    point bind these two positionally ahead of ARG_SPEC — pin the order."""
+    assert ffd.SPARSE_ARG_SPEC == ("run_q_idx", "run_v_idx")
+
+
+SPARSE_LEADS = {
+    "ffd_solve_sparse": ((), STATICS),
+    "ffd_solve_ckpt_sparse": ((), RESUME_STATICS),
+    "ffd_resume_sparse": (("init_state",), RESUME_STATICS),
+    "ffd_solve_ladder_sparse": (("run_ladder",), STATICS),
+    "ffd_solve_sharded_sparse": ((), STATICS),
+}
+
+
+def test_sparse_entry_points_share_the_tensor_contract():
+    """Every sparse entry point takes its dense twin's lead (init_state /
+    run_ladder), then SPARSE_ARG_SPEC, then the SAME 36 ARG_SPEC tensors,
+    statics trailing — so backend's _sparse_arg can prepend the resident
+    sparse pair to the arena's args splice without re-deriving order, and
+    the sharded path's [Nd, Sblk, K] blocks keep their names/positions."""
+    for fn, (lead, statics) in SPARSE_LEADS.items():
+        params = list(
+            inspect.signature(getattr(ffd, fn).__wrapped__).parameters
+        )
+        tensor = [p for p in params if p not in statics]
+        assert tuple(tensor) == lead + ffd.SPARSE_ARG_SPEC + ffd.ARG_SPEC, (
+            f"{fn}'s tensor params drifted from SPARSE_ARG_SPEC + ARG_SPEC"
+        )
+        assert params == tensor + list(statics), (
+            f"{fn}: statics must trail as ({', '.join(statics)})"
+        )
+
+
+def test_sparse_width_bucketing_is_pinned():
+    """Sparse index widths quantize (mult=floor=8) so repeat solves with a
+    drifting active-pair count reuse one compiled shape; the density gate's
+    constants are part of the dispatch contract (SPEC.md "Sparse constraint
+    semantics") — a silent change re-gates production fleets."""
+    from karpenter_tpu.solver import encode
+
+    assert encode.SPARSE_IDX_MULT == 8
+    assert encode.SPARSE_IDX_FLOOR == 8
+    assert encode.SPARSE_MIN_SIGS == 8
+    assert encode.SPARSE_DENSITY_MAX == 0.25
+    assert encode._sparse_width(0) == 8
+    assert encode._sparse_width(8) == 8
+    assert encode._sparse_width(9) == 16
+    assert encode._sparse_width(17) == 24

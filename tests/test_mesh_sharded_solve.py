@@ -13,9 +13,11 @@ forces --xla_force_host_platform_device_count=8):
 - composition with the relax ladder (preference fleets) and with
   checkpointed suffix resume (append-tail re-solves hit the block-boundary
   carries);
-- the forced-fallback class: fleets whose carry combine is inexpressible
-  (zone/capacity-type domain engine, V > 0) decline INTO the counted
-  fallback and still decide identically via the single-device path.
+- constrained fleets (V > 0 / Q > 0): the sparse constraint engine
+  (ISSUE 20) extended the stitch with per-block touch-mask triggers, so
+  these fleets SHARD — the old v_axis/q_axis declines no longer fire; the
+  remaining decline class (tiny fleets, no mesh) counts with a {reason}
+  label on karpenter_solver_sharded_fallback_total.
 """
 
 import random
@@ -131,8 +133,9 @@ class TestShardedComposition:
     def test_relax_fleet_parity_under_shards(self):
         """Respect-mode preference fleets: the relax loop's materialized
         solves route through the same sharded seam; zone-preference
-        materializations carry V > 0 signatures and must decline into the
-        counted fallback while deciding identically."""
+        materializations carry V > 0 signatures and — since the sparse
+        constraint lift — shard like any other fleet, deciding
+        identically."""
         tsc = TopologySpreadConstraint(
             max_skew=1, topology_key=wk.ZONE_LABEL,
             label_selector={"app": "w"}, when_unsatisfiable="ScheduleAnyway",
@@ -146,25 +149,62 @@ class TestShardedComposition:
         _assert_same(s.solve(inp), base, "relax")
 
 
-class TestShardedFallback:
-    def test_inexpressible_carry_declines_and_counts(self):
-        """Zone-spread fleet (V > 0): the carry combine is inexpressible, so
-        the sharded path must decline up front, count the fallback, and let
-        the single-device kernel serve the solve — identical decisions."""
+class TestShardedConstrained:
+    """The sparse-constraint lift: V > 0 / Q > 0 fleets SHARD. Before the
+    sparse engine these declined up-front (the carry combine was treated as
+    inexpressible); now the stitch's touch-mask triggers (conditions (e)
+    touched-V-sig seed movement, (f) kind-2 prefix-claim coupling) replay
+    exactly the interacting blocks and decisions stay bit-identical."""
+
+    def _zone_fleet(self, n=24):
         tsc = TopologySpreadConstraint(
             max_skew=1, topology_key=wk.ZONE_LABEL,
             label_selector={"app": "w"},
         )
-        pods = [mkpod(f"v{i}", cpu="2", mem="4Gi", labels={"app": "w"},
-                      topology_spread=[tsc]) for i in range(9)]
+        return [mkpod(f"v{i:02d}", cpu="2", mem="4Gi",
+                      labels={"app": "w"}, topology_spread=[tsc])
+                for i in range(n)]
+
+    def test_zone_spread_fleet_shards_after_sparse_lift(self):
+        """Zone-spread fleet (V > 0): served BY the mesh path, zero
+        fallbacks, identical decisions — the headline acceptance of the
+        lift (no v_axis/q_axis declines remain)."""
+        # filler signatures so the run axis splits across all 8 shards
+        pods = self._zone_fleet(9) + _random_fleet(random.Random(3), 40)
         inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()],
                           zones=ZONES)
         base = TPUSolver().solve(inp)
         s = TPUSolver(shards=8)
-        _assert_same(s.solve(inp), base, "V-decline")
-        assert s.stats["sharded_fallbacks"] >= 1, s.stats
-        assert s.stats["sharded_solves"] == 0, s.stats
-        assert s.stats["device_solves"] == 1, s.stats
+        _assert_same(s.solve(inp), base, "V-shard")
+        assert s.stats["sharded_solves"] == 1, s.stats
+        assert s.stats["sharded_fallbacks"] == 0, s.stats
+
+    @pytest.mark.parametrize("n", MESH_SIZES)
+    def test_constrained_parity_across_mesh_sizes(self, n):
+        """Mixed TSC + affinity fleet: parity across every mesh size with
+        the sparse engine gated on (auto) — the ISSUE 20 acceptance sweep."""
+        from karpenter_tpu.api.objects import PodAffinityTerm
+
+        anti = PodAffinityTerm(label_selector={"app": "solo"},
+                               topology_key=wk.ZONE_LABEL, anti=True)
+        pods = (
+            self._zone_fleet(12)
+            + [mkpod(f"a{i}", cpu="1", mem="2Gi", labels={"app": "solo"},
+                     affinity_terms=[anti]) for i in range(5)]
+            + _random_fleet(random.Random(17), 50)
+        )
+        nodes = [mknode(f"n{i}", ZONES[i % 3]) for i in range(4)]
+        inp = SolverInput(pods=pods, nodes=nodes, nodepools=[pool()],
+                          zones=ZONES)
+        base = TPUSolver().solve(inp)
+        s = TPUSolver(shards=n)
+        _assert_same(s.solve(inp), base, f"constrained shards={n}")
+        if n >= 2:
+            assert s.stats["sharded_solves"] == 1, s.stats
+            assert s.stats["sharded_fallbacks"] == 0, s.stats
+
+
+class TestShardedFallback:
 
     def test_tiny_fleet_declines_below_mesh_width(self):
         """Fewer real runs than devices: nothing to partition — decline

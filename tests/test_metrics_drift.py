@@ -196,6 +196,19 @@ def test_convex_series_are_registered():
     ].label_names, "convex fallbacks lost their reason label"
 
 
+def test_sharded_fallback_reason_label():
+    """ISSUE 20 acceptance: the sharded-fallback counter grew a {reason}
+    label (tiny_fleet / no_mesh live; v_axis / q_axis reserved — nothing
+    emits them since the sparse-constraint lift). Alerts key on the label,
+    so its presence is part of the /metrics contract."""
+    by_name = {m.name: m for m in reg.REGISTRY.metrics}
+    m = by_name.get("karpenter_solver_sharded_fallback_total")
+    assert m is not None, "sharded fallback counter missing"
+    assert "reason" in m.label_names, (
+        "sharded fallbacks lost their reason label"
+    )
+
+
 def test_every_reason_code_has_name_and_spec_row():
     """Every kernel reason code must have a decoder-side name AND a SPEC.md
     row — an undocumented code is a wire symbol operators cannot read."""

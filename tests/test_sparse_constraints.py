@@ -1,0 +1,280 @@
+"""Sparse constraint engine (ISSUE 20): compacted V/Q-axis evaluation.
+
+Four contracts, pinned here:
+
+- the CSR wire layout of encode.sparse_run_tables — run-major [Sp, K] i32
+  index tables, -1 padded, quantum-bucketed width, padding rows inert, and
+  ladder rows the UNION over base + rung groups (any superset list is
+  decision-identical because the kernel re-gathers membership through the
+  index);
+- the density gate (use_sparse_constraints) boundaries: combined width
+  floor SPARSE_MIN_SIGS and the SPARSE_DENSITY_MAX fraction, both exact;
+- randomized 3-leg parity: the sparse kernel leg must be DECISION-IDENTICAL
+  to the dense leg and the host oracle across spread-only, affinity-only,
+  and mixed fleets (mesh-sharded constrained parity lives in
+  test_mesh_sharded_solve.py);
+- the explain-flags memo keyed (id(group_pods), core_rev): a recycled id()
+  from a collected encoding must never serve stale flags.
+"""
+
+import gc
+import random
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import wellknown as wk
+from karpenter_tpu.api.objects import PodAffinityTerm, TopologySpreadConstraint
+from karpenter_tpu.provisioning.scheduler import SolverInput
+from karpenter_tpu.solver import encode as enc_mod
+from karpenter_tpu.solver.backend import ReferenceSolver, TPUSolver
+from karpenter_tpu.solver.encode import (
+    SPARSE_DENSITY_MAX,
+    SPARSE_IDX_FLOOR,
+    SPARSE_MIN_SIGS,
+    constraint_density,
+    encode,
+    quantize_input,
+    sparse_run_tables,
+    use_sparse_constraints,
+)
+
+from tests.test_zone_device import ZONES, mknode, mkpod, pool
+
+
+def _fake_enc(rg, Q=0, V=0, q_act=None, v_act=None):
+    """Minimal enc stand-in for the pure-numpy table builders: member
+    carries the activity, owner stays empty (the builders OR them)."""
+    G = int(np.asarray(rg).max(initial=-1)) + 1
+    zq = np.zeros((G, Q), bool)
+    zv = np.zeros((G, V), bool)
+    return SimpleNamespace(
+        Q=Q, V=V, run_group=np.asarray(rg, np.int32),
+        q_member=zq if q_act is None else np.asarray(q_act, bool),
+        q_owner=np.zeros_like(zq if q_act is None else np.asarray(q_act)),
+        v_member=zv if v_act is None else np.asarray(v_act, bool),
+        v_owner=np.zeros_like(zv if v_act is None else np.asarray(v_act)),
+    )
+
+
+class TestSparseTableLayout:
+    def test_csr_rows_list_active_sigs_in_order(self):
+        q_act = np.zeros((3, 10), bool)
+        q_act[0, [1, 9]] = True          # 2 actives
+        q_act[2, :9] = True              # 9 actives -> width buckets to 16
+        enc = _fake_enc([0, 1, 2, 0], Q=10, q_act=q_act)
+        rqi, rvi = sparse_run_tables(enc, Sp=8)
+        assert rqi.shape == (8, 16) and rqi.dtype == np.int32
+        assert rqi[0, :2].tolist() == [1, 9] and (rqi[0, 2:] == -1).all()
+        assert (rqi[1] == -1).all()      # inactive group: inert row
+        assert rqi[2, :9].tolist() == list(range(9))
+        assert (rqi[3] == rqi[0]).all()  # same group, same row
+        assert (rqi[4:] == -1).all()     # Sp padding rows are inert
+        # V axis absent: floor-width all-(-1) placeholder, never gathered
+        assert rvi.shape == (8, SPARSE_IDX_FLOOR) and (rvi == -1).all()
+
+    def test_owner_only_sigs_are_listed(self):
+        """Ownership without membership (anti-affinity owners) must appear
+        in the index list — the kernel needs the column to scatter owner
+        state even when the group never counts as a member."""
+        enc = _fake_enc([0], V=9)
+        enc.v_owner[0, 7] = True
+        rqi, rvi = sparse_run_tables(enc, Sp=1)
+        assert rvi[0, 0] == 7 and (rvi[0, 1:] == -1).all()
+
+    def test_ladder_rows_union_base_and_rung_groups(self):
+        q_act = np.zeros((4, 12), bool)
+        q_act[0, 2] = True               # base group of run 0
+        q_act[1, 5] = True               # rung group
+        q_act[2, 11] = True              # second rung group
+        enc = _fake_enc([0, 3], Q=12, q_act=q_act)
+        lad = np.array([[1, 2], [-1, -1]], np.int32)
+        rqi, _ = sparse_run_tables(enc, Sp=2, run_ladder=lad)
+        assert rqi[0, :3].tolist() == [2, 5, 11], (
+            "ladder row must union base + every materialized rung group"
+        )
+        assert (rqi[1] == -1).all()      # -1 rungs contribute nothing
+
+
+class TestDensityGate:
+    def test_below_min_sigs_stays_dense(self):
+        enc = _fake_enc(np.arange(8), Q=7)  # zero density, but too narrow
+        assert constraint_density(enc) == 0.0
+        assert use_sparse_constraints(enc) is False
+
+    def test_density_boundary_is_exact(self):
+        # S=8 runs x (Q+V)=8 sigs: 16 active pairs sit exactly ON the gate
+        q_act = np.zeros((8, 8), bool)
+        q_act.reshape(-1)[:16] = True
+        enc = _fake_enc(np.arange(8), Q=8, q_act=q_act.copy())
+        assert constraint_density(enc) == pytest.approx(SPARSE_DENSITY_MAX)
+        assert use_sparse_constraints(enc) is True
+        q_act.reshape(-1)[16] = True     # one pair above: dense wins
+        enc2 = _fake_enc(np.arange(8), Q=8, q_act=q_act)
+        assert use_sparse_constraints(enc2) is False
+
+    def test_gate_on_real_constrained_fleet(self):
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.ZONE_LABEL,
+            label_selector={"app": "w"},
+        )
+        pods = [mkpod(f"g{i}", labels={"app": "w"}, topology_spread=[tsc])
+                for i in range(4)]
+        pods += [mkpod(f"f{i:02d}", cpu=f"{1 + i % 4}") for i in range(30)]
+        pods += [
+            mkpod(f"v{i}", labels={"app": f"solo{i}"}, affinity_terms=[
+                PodAffinityTerm(label_selector={"app": f"solo{i}"},
+                                topology_key=wk.ZONE_LABEL, anti=True)])
+            for i in range(7)
+        ]
+        enc = encode(quantize_input(SolverInput(
+            pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)))
+        assert enc.Q + enc.V >= SPARSE_MIN_SIGS
+        assert 0.0 < constraint_density(enc) <= SPARSE_DENSITY_MAX
+        assert use_sparse_constraints(enc) is True
+
+
+# -- randomized 3-leg parity --------------------------------------------------
+
+
+def _assert_same(a, b, tag):
+    assert a.placements == b.placements, f"{tag}: placements diverge"
+    assert set(a.errors) == set(b.errors), f"{tag}: errors diverge"
+    assert len(a.claims) == len(b.claims), f"{tag}: claim count diverges"
+    for i, (ca, cb) in enumerate(zip(a.claims, b.claims)):
+        assert ca.pod_uids == cb.pod_uids, f"{tag}: claim {i} pods"
+        assert sorted(ca.instance_type_names) == sorted(
+            cb.instance_type_names
+        ), f"{tag}: claim {i} types"
+
+
+def _spread_fleet(rng, n_apps):
+    pods = []
+    for a in range(n_apps):
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.ZONE_LABEL,
+            label_selector={"app": f"s{a}"},
+        )
+        for j in range(rng.randint(3, 5)):
+            pods.append(mkpod(
+                f"s{a}-{j}", cpu=rng.choice(["1", "2"]), mem="2Gi",
+                labels={"app": f"s{a}"}, topology_spread=[tsc]))
+    return pods
+
+
+def _affinity_fleet(rng, n):
+    pods = []
+    for i in range(n):
+        anti = PodAffinityTerm(label_selector={"app": f"a{i}"},
+                               topology_key=wk.ZONE_LABEL, anti=True)
+        pods.append(mkpod(f"a{i}", cpu="1", mem="1Gi",
+                          labels={"app": f"a{i}"}, affinity_terms=[anti]))
+    return pods
+
+
+def _filler(rng, n):
+    return [mkpod(f"p{i:03d}", cpu=rng.choice(["500m", "1", "2", "3"]),
+                  mem=rng.choice(["1Gi", "2Gi", "4Gi"])) for i in range(n)]
+
+
+class TestThreeLegParity:
+    """Host oracle vs dense kernel vs sparse kernel: all three legs must
+    decide identically — the sparse tables are an indexing of the SAME
+    constraint state, never a relaxation."""
+
+    def _run(self, pods, nodes, tag):
+        inp = SolverInput(pods=pods, nodes=nodes, nodepools=[pool()],
+                          zones=ZONES)
+        host = ReferenceSolver().solve(inp)
+        dense = TPUSolver(sparse="off")
+        sparse = TPUSolver(sparse="on")
+        _assert_same(dense.solve(inp), host, f"{tag}: dense-vs-host")
+        _assert_same(sparse.solve(inp), host, f"{tag}: sparse-vs-host")
+        assert dense.stats["sparse_dispatches"] == 0, dense.stats
+        assert sparse.stats["sparse_dispatches"] == 1, sparse.stats
+
+    def test_spread_fleet_parity(self):
+        rng = random.Random(20)
+        self._run(_spread_fleet(rng, 6) + _filler(rng, 12), [], "spread")
+
+    def test_affinity_fleet_parity(self):
+        rng = random.Random(21)
+        self._run(_affinity_fleet(rng, 8) + _filler(rng, 12), [], "affinity")
+
+    def test_mixed_fleet_parity_with_existing_nodes(self):
+        rng = random.Random(22)
+        pods = (_spread_fleet(rng, 5) + _affinity_fleet(rng, 6)
+                + _filler(rng, 16))
+        nodes = [mknode(f"n{i}", ZONES[i % 3]) for i in range(5)]
+        self._run(pods, nodes, "mixed")
+
+    def test_auto_gate_skips_tiny_constraint_axes(self):
+        """auto on a fleet under the width floor must take the dense path
+        (no sparse dispatch) and still decide with the oracle."""
+        rng = random.Random(23)
+        pods = _spread_fleet(rng, 2) + _filler(rng, 10)
+        inp = SolverInput(pods=pods, nodes=[], nodepools=[pool()],
+                          zones=ZONES)
+        s = TPUSolver(sparse="auto")
+        _assert_same(s.solve(inp), ReferenceSolver().solve(inp), "auto-tiny")
+        assert s.stats["sparse_dispatches"] == 0, s.stats
+
+    def test_sparse_knob_validates(self):
+        with pytest.raises(ValueError):
+            TPUSolver(sparse="sometimes")
+
+
+# -- explain-flags memo: id() reuse guard -------------------------------------
+
+
+def test_explain_flags_cache_id_reuse():
+    """The memo key is (id(group_pods), core_rev). A collected encoding's
+    id() can be recycled by a NEW group_pods list at the same address — if
+    the key were id alone, the new encoding would inherit the old flags.
+    Pin the guard two ways: a planted same-id/stale-rev entry must MISS,
+    and a collect/re-allocate loop must always observe fresh flags."""
+    from karpenter_tpu.solver.encode import _EXPLAIN_FLAGS_CACHE, explain_tables
+
+    tsc = TopologySpreadConstraint(max_skew=1, topology_key=wk.ZONE_LABEL,
+                                   label_selector={"app": "w"})
+
+    def build(spread):
+        kw = {"topology_spread": [tsc], "labels": {"app": "w"}} if spread else {}
+        pods = [mkpod(f"e{i}", **kw) for i in range(3)]
+        return encode(quantize_input(SolverInput(
+            pods=pods, nodes=[], nodepools=[pool()], zones=ZONES)))
+
+    # 1. planted stale entry: same id(group_pods), predecessor core_rev,
+    #    flags that are obviously wrong — the rev in the key must force a
+    #    fresh compute instead of serving the plant
+    enc = build(spread=False)
+    G = int(enc.group_req.shape[0])
+    _EXPLAIN_FLAGS_CACHE.clear()
+    plant = (np.ones(G, bool), np.ones(G, bool))
+    _EXPLAIN_FLAGS_CACHE[(id(enc.group_pods), enc.core_rev - 1)] = plant
+    t = explain_tables(enc)
+    assert not t["group_topo"].any() and not t["group_aff"].any(), (
+        "stale same-id cache entry served across a core_rev change"
+    )
+    # the fresh compute is now memoized under the TRUE key: warm hit
+    assert explain_tables(enc)["group_topo"] is t["group_topo"]
+
+    # 2. hand-built encs (core_rev < 0) never populate the memo
+    import dataclasses
+
+    n_before = len(_EXPLAIN_FLAGS_CACHE)
+    explain_tables(dataclasses.replace(enc, core_rev=-1))
+    assert len(_EXPLAIN_FLAGS_CACHE) == n_before
+
+    # 3. collect/re-allocate churn: alternate fleets with and without
+    #    spread so any id-recycled hit would flip the flags visibly
+    for i in range(6):
+        spread = bool(i % 2)
+        e = build(spread)
+        flags = explain_tables(e)
+        assert bool(flags["group_topo"].any()) == spread, (
+            f"iteration {i}: recycled-id cache hit served stale flags"
+        )
+        del e, flags
+        gc.collect()

@@ -91,6 +91,21 @@ TOLERANCES: Dict[str, float] = {
     "consolidation_savings_pct": 0.10,
     "convex_solve_ms": 0.35,
     "admm_iterations_to_converge": 0.25,
+    # sparse constraint engine (ISSUE 20): constrained-config medians are
+    # host-noisy like the other e2e p50s; the ratios vs the unconstrained
+    # base are what the acceptance targets (<= 2x / 1.7x) actually bound,
+    # so they get tighter slack. constraint_density is deterministic for a
+    # fixed fleet shape — any drift means the builder or encoder changed.
+    "constrained_solve_p50_ms_config3": 0.25,
+    "constrained_solve_p50_ms_config4": 0.25,
+    "constrained_vs_base_ratio_config3": 0.15,
+    "constrained_vs_base_ratio_config4": 0.15,
+    "constraint_density": 0.0,
+    # axis-eval compaction: higher-is-better (pinned below); the dense leg
+    # is memory-bound and runner-sensitive, tail-class slack
+    "sparse_speedup_x": 0.35,
+    # parity proof: 1 or the suite itself already failed — zero slack
+    "sharded_constrained_ok": 0.0,
 }
 
 HIGHER_BETTER_PAT = re.compile(
@@ -107,6 +122,13 @@ HIGHER_BETTER_KEYS = {
     # convex-vs-FFD consolidation win: bigger savings = better packing
     # ("savings" matches no direction pattern — pin it)
     "consolidation_savings_pct",
+    # sparse axis compaction (ISSUE 20): the name pattern already matches
+    # "speedup", but the acceptance gates on this key — pin it against a
+    # rename breaking the direction
+    "sparse_speedup_x",
+    # mesh-sharded constrained parity: 1 = served + bit-identical; a drop
+    # to 0 is a regression even though it's not a latency
+    "sharded_constrained_ok",
 }
 
 
